@@ -1,0 +1,42 @@
+"""spark_rapids_tpu — a TPU-native columnar data-processing framework.
+
+Brand-new implementation of the capability envelope of the reference
+``spark-rapids-jni`` (GPU columnar JNI library for Apache Spark; see SURVEY.md):
+device-resident columnar tables, byte-exact Spark fixed-width row ↔ columnar
+conversion, the cuDF-class op set (cast, sort, group-by, join, strings/regex,
+Parquet), and distributed shuffle — designed for TPU (JAX/XLA/Pallas, device
+meshes, XLA collectives) rather than translated from CUDA.
+
+Layer map (TPU counterpart of SURVEY.md §1):
+
+  host app (Spark executor / Python driver)
+    → :mod:`spark_rapids_tpu` Python API + native C ABI bridge (:mod:`.ffi`)
+      → eager ops layer (:mod:`.ops`) — jit-cached XLA programs per schema
+        → column/table model (:mod:`.column`, :mod:`.table`) — pytrees of
+          HBM-resident arrays
+          → XLA/Pallas kernels (:mod:`.rows.pallas_kernels`, op kernels)
+            → TPU (MXU/VPU/VMEM, ICI collectives via :mod:`.parallel`)
+"""
+
+import jax as _jax
+
+# 64-bit dtypes (Spark longs/doubles/decimal64) are part of the data model.
+# Must be set before any array is created.
+_jax.config.update("jax_enable_x64", True)
+
+from . import dtypes  # noqa: E402
+from .column import Column  # noqa: E402
+from .table import Table, assert_tables_equal  # noqa: E402
+from .dtypes import DType, TypeId  # noqa: E402
+
+__version__ = "26.02.0a0"
+
+__all__ = [
+    "Column",
+    "DType",
+    "Table",
+    "TypeId",
+    "assert_tables_equal",
+    "dtypes",
+    "__version__",
+]

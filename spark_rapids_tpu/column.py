@@ -1,0 +1,210 @@
+"""Device-resident column model.
+
+TPU-first redesign of the reference's columnar engine surface (the reference
+vendors cuDF for its column/table model; see SURVEY.md §2.3).  A
+:class:`Column` is a pytree of JAX arrays:
+
+  * ``data``     — the values buffer. Fixed-width: shape ``(n,)`` in the
+                   physical dtype. Strings: ``uint8`` char buffer (see
+                   :mod:`spark_rapids_tpu.ops.strings`).
+  * ``validity`` — ``None`` (all rows valid) or a ``bool_`` array of shape
+                   ``(n,)`` with ``True`` = valid.
+  * ``offsets``  — ``None`` for fixed-width; ``int32 (n+1,)`` for strings/lists.
+  * ``dtype``    — static :class:`~spark_rapids_tpu.dtypes.DType` metadata.
+
+Design note — validity as unpacked bools, not cudf's packed 32-bit words
+(reference row_conversion.cu:158-165 reconstructs packed words warp-cooperatively
+with ``__ballot_sync``): the VPU operates on ≥8-bit lanes and XLA fuses
+``where``-style masking into surrounding ops for free, so an unpacked mask is
+both faster and simpler on TPU.  Packed Arrow/cudf bitmasks exist only at the
+interop boundaries (:mod:`spark_rapids_tpu.io.arrow`,
+:mod:`spark_rapids_tpu.rows`), where they are (un)packed by vectorized
+shift/mask ops — the deterministic TPU replacement for the reference's
+``atomicOr_block`` fix-ups (row_conversion.cu:255-272).
+
+Columns are immutable; ops return new columns.  Because ``dtype`` and length
+live in the pytree's static structure, eager ops jit-cache per schema — the
+TPU analog of the reference's compile-once kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import BOOL8, DType, STRING, from_numpy_dtype
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class Column:
+    data: jax.Array
+    validity: Optional[jax.Array] = None   # bool_ (n,), True = valid
+    offsets: Optional[jax.Array] = None    # int32 (n+1,) for variable width
+    dtype: DType = None                    # static
+
+    # -- pytree protocol -----------------------------------------------------
+    def tree_flatten(self):
+        children = (self.data, self.validity, self.offsets)
+        return children, self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity, offsets = children
+        return cls(data=data, validity=validity, offsets=offsets, dtype=aux)
+
+    # -- basic properties ----------------------------------------------------
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def size(self) -> int:
+        if self.offsets is not None:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def nullable(self) -> bool:
+        return self.validity is not None
+
+    def null_count(self) -> int:
+        """Eager null count (device reduction, host sync)."""
+        if self.validity is None:
+            return 0
+        return int(jnp.sum(~self.validity))
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, validity: Optional[np.ndarray] = None,
+                   dtype: Optional[DType] = None) -> "Column":
+        """Build a fixed-width device column from host arrays.
+
+        ``validity`` is a boolean mask (True = valid) or None.  ``dtype``
+        overrides the inferred logical type (e.g. decimals, timestamps whose
+        physical type is plain int32/int64).
+        """
+        values = np.asarray(values)
+        if dtype is None:
+            dtype = from_numpy_dtype(values.dtype)
+        phys = dtype.np_dtype
+        if values.dtype == np.bool_ and dtype == BOOL8:
+            values = values.astype(np.uint8)
+        if values.dtype != phys:
+            raise ValueError(
+                f"physical dtype mismatch: values are {values.dtype}, {dtype!r} needs {phys}")
+        vmask = None
+        if validity is not None:
+            vmask = jnp.asarray(np.asarray(validity, dtype=np.bool_))
+        return Column(data=jnp.asarray(values), validity=vmask, dtype=dtype)
+
+    @staticmethod
+    def from_pylist(values: list, dtype: DType) -> "Column":
+        """Build from a Python list where ``None`` marks nulls.
+
+        Null slots get a deterministic zero payload (the engine never reads
+        payloads of null rows, but determinism keeps byte-oracle tests exact).
+        """
+        if dtype == STRING:
+            from .ops.strings import strings_from_pylist  # cycle-free: ops imports nothing back
+            return strings_from_pylist(values)
+        phys = dtype.np_dtype
+        n = len(values)
+        data = np.zeros(n, dtype=phys)
+        mask = np.ones(n, dtype=np.bool_)
+        for i, v in enumerate(values):
+            if v is None:
+                mask[i] = False
+            else:
+                data[i] = np.uint8(bool(v)) if dtype == BOOL8 else v
+        validity = None if mask.all() else mask
+        return Column.from_numpy(data, validity, dtype)
+
+    @staticmethod
+    def all_valid(data: jax.Array, dtype: DType) -> "Column":
+        return Column(data=data, dtype=dtype)
+
+    # -- host materialization ------------------------------------------------
+    def to_numpy(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        """Return host (values, validity-or-None)."""
+        vals = np.asarray(self.data)
+        mask = None if self.validity is None else np.asarray(self.validity)
+        return vals, mask
+
+    def to_pylist(self) -> list:
+        if self.dtype == STRING:
+            from .ops.strings import strings_to_pylist
+            return strings_to_pylist(self)
+        vals, mask = self.to_numpy()
+        if self.dtype == BOOL8:
+            out = [bool(v) for v in vals]
+        else:
+            out = [v.item() for v in vals]
+        if mask is not None:
+            out = [v if m else None for v, m in zip(out, mask)]
+        return out
+
+    # -- helpers -------------------------------------------------------------
+    def valid_mask(self) -> jax.Array:
+        """Validity as a materialized bool array (all-True when validity is None)."""
+        if self.validity is None:
+            return jnp.ones(self.size, dtype=jnp.bool_)
+        return self.validity
+
+    def with_validity(self, validity: Optional[jax.Array]) -> "Column":
+        return replace(self, validity=validity)
+
+    def gather(self, indices: jax.Array, fill_invalid: bool = False) -> "Column":
+        """Row gather.
+
+        ``fill_invalid=True`` turns out-of-range indices into null rows
+        (cudf ``out_of_bounds_policy::NULLIFY`` semantics); otherwise
+        out-of-range indices are clipped to the valid range.
+        """
+        indices = jnp.asarray(indices)
+        if fill_invalid:
+            in_range = (indices >= 0) & (indices < self.size)
+            base = self if self.offsets is None else None
+            if base is None:
+                from .ops.strings import strings_gather
+                out = strings_gather(self, jnp.clip(indices, 0, self.size - 1))
+            else:
+                out = self._fixed_gather(jnp.clip(indices, 0, self.size - 1))
+            return out.with_validity(out.valid_mask() & in_range)
+        if self.offsets is not None:
+            from .ops.strings import strings_gather
+            return strings_gather(self, indices)
+        return self._fixed_gather(indices)
+
+    def _fixed_gather(self, indices: jax.Array) -> "Column":
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        validity = None
+        if self.validity is not None:
+            validity = jnp.take(self.validity, indices, axis=0, mode="clip")
+        return Column(data=data, validity=validity, dtype=self.dtype)
+
+    def __repr__(self) -> str:
+        return (f"Column({self.dtype!r}, size={self.size}, "
+                f"nullable={self.nullable})")
+
+
+def column_from_any(values: Any, dtype: Optional[DType] = None) -> Column:
+    """Coerce lists / numpy arrays / Columns into a Column."""
+    if isinstance(values, Column):
+        return values
+    if isinstance(values, np.ndarray):
+        return Column.from_numpy(values, dtype=dtype)
+    if isinstance(values, (list, tuple)):
+        if dtype is None:
+            sample = next((v for v in values if v is not None), None)
+            if sample is None:
+                raise ValueError("cannot infer dtype from all-None list")
+            if isinstance(sample, str):
+                dtype = STRING
+            else:
+                dtype = from_numpy_dtype(np.asarray(sample).dtype)
+        return Column.from_pylist(list(values), dtype)
+    raise TypeError(f"cannot build a Column from {type(values)!r}")

@@ -1,0 +1,243 @@
+"""Logical dtype registry for the TPU columnar engine.
+
+The registry is wire-compatible with the reference's type-id/scale contract: the
+reference's JNI bridge reconstructs column types from parallel ``int`` arrays of
+cudf type-ids and decimal scales (reference: src/main/cpp/src/RowConversionJni.cpp:56-61),
+so external callers (e.g. a JVM host) describe schemas the same way here.
+
+Each logical :class:`DType` carries:
+  * ``type_id``  — the cudf-compatible integer id (``TypeId``),
+  * ``scale``    — decimal exponent (value = unscaled * 10**scale; cudf convention,
+                   normally <= 0), 0 for non-decimals,
+  * a *physical* JAX dtype used for the device representation.
+
+TPU notes: BOOL8 is stored as ``uint8`` (the row format and Arrow both treat it as
+one byte; TPU has no native bool lanes). Timestamps/durations are stored in their
+integer physical type. 64-bit types require ``jax_enable_x64`` (enabled in the
+package ``__init__``); on TPU hardware XLA emulates int64/float64 — ops modules
+prefer 32-bit compute paths where semantics allow.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeId(enum.IntEnum):
+    """cudf-compatible type ids (reference envelope: cudf 22.06 ``cudf::type_id``)."""
+
+    EMPTY = 0
+    INT8 = 1
+    INT16 = 2
+    INT32 = 3
+    INT64 = 4
+    UINT8 = 5
+    UINT16 = 6
+    UINT32 = 7
+    UINT64 = 8
+    FLOAT32 = 9
+    FLOAT64 = 10
+    BOOL8 = 11
+    TIMESTAMP_DAYS = 12
+    TIMESTAMP_SECONDS = 13
+    TIMESTAMP_MILLISECONDS = 14
+    TIMESTAMP_MICROSECONDS = 15
+    TIMESTAMP_NANOSECONDS = 16
+    DURATION_DAYS = 17
+    DURATION_SECONDS = 18
+    DURATION_MILLISECONDS = 19
+    DURATION_MICROSECONDS = 20
+    DURATION_NANOSECONDS = 21
+    DICTIONARY32 = 22
+    STRING = 23
+    LIST = 24
+    DECIMAL32 = 25
+    DECIMAL64 = 26
+    DECIMAL128 = 27
+    STRUCT = 28
+
+
+# type_id -> (physical numpy dtype, element size in bytes).  Fixed-width only;
+# variable-width/nested ids are absent (size is layout-defined, not scalar).
+_PHYSICAL: dict[TypeId, np.dtype] = {
+    TypeId.INT8: np.dtype(np.int8),
+    TypeId.INT16: np.dtype(np.int16),
+    TypeId.INT32: np.dtype(np.int32),
+    TypeId.INT64: np.dtype(np.int64),
+    TypeId.UINT8: np.dtype(np.uint8),
+    TypeId.UINT16: np.dtype(np.uint16),
+    TypeId.UINT32: np.dtype(np.uint32),
+    TypeId.UINT64: np.dtype(np.uint64),
+    TypeId.FLOAT32: np.dtype(np.float32),
+    TypeId.FLOAT64: np.dtype(np.float64),
+    TypeId.BOOL8: np.dtype(np.uint8),
+    TypeId.TIMESTAMP_DAYS: np.dtype(np.int32),
+    TypeId.TIMESTAMP_SECONDS: np.dtype(np.int64),
+    TypeId.TIMESTAMP_MILLISECONDS: np.dtype(np.int64),
+    TypeId.TIMESTAMP_MICROSECONDS: np.dtype(np.int64),
+    TypeId.TIMESTAMP_NANOSECONDS: np.dtype(np.int64),
+    TypeId.DURATION_DAYS: np.dtype(np.int32),
+    TypeId.DURATION_SECONDS: np.dtype(np.int64),
+    TypeId.DURATION_MILLISECONDS: np.dtype(np.int64),
+    TypeId.DURATION_MICROSECONDS: np.dtype(np.int64),
+    TypeId.DURATION_NANOSECONDS: np.dtype(np.int64),
+    TypeId.DECIMAL32: np.dtype(np.int32),
+    TypeId.DECIMAL64: np.dtype(np.int64),
+}
+
+_VARIABLE_WIDTH = frozenset({TypeId.STRING, TypeId.LIST, TypeId.STRUCT, TypeId.DICTIONARY32})
+
+
+@dataclass(frozen=True)
+class DType:
+    """A logical column type: cudf-compatible id plus decimal scale.
+
+    Hashable and comparable; used as static metadata in pytrees (so two tables
+    with the same schema share jit caches).
+    """
+
+    type_id: TypeId
+    scale: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "type_id", TypeId(self.type_id))
+        if self.scale != 0 and not self.is_decimal:
+            raise ValueError(f"scale is only valid for decimal types, got {self.type_id!r}")
+
+    # -- classification ------------------------------------------------------
+    @property
+    def is_decimal(self) -> bool:
+        return self.type_id in (TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128)
+
+    @property
+    def is_fixed_width(self) -> bool:
+        """Mirrors ``cudf::is_fixed_width`` for the ids we support on device."""
+        return self.type_id in _PHYSICAL
+
+    @property
+    def is_variable_width(self) -> bool:
+        return self.type_id in _VARIABLE_WIDTH
+
+    @property
+    def is_timestamp(self) -> bool:
+        return TypeId.TIMESTAMP_DAYS <= self.type_id <= TypeId.TIMESTAMP_NANOSECONDS
+
+    @property
+    def is_duration(self) -> bool:
+        return TypeId.DURATION_DAYS <= self.type_id <= TypeId.DURATION_NANOSECONDS
+
+    @property
+    def is_integer(self) -> bool:
+        return TypeId.INT8 <= self.type_id <= TypeId.UINT64
+
+    @property
+    def is_floating(self) -> bool:
+        return self.type_id in (TypeId.FLOAT32, TypeId.FLOAT64)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.type_id == TypeId.BOOL8
+
+    @property
+    def is_string(self) -> bool:
+        return self.type_id == TypeId.STRING
+
+    # -- physical layout -----------------------------------------------------
+    @property
+    def itemsize(self) -> int:
+        """Element size in bytes (``cudf::size_of``); errors for variable width."""
+        try:
+            return _PHYSICAL[self.type_id].itemsize
+        except KeyError:
+            raise ValueError(f"{self.type_id!r} has no fixed element size") from None
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        try:
+            return _PHYSICAL[self.type_id]
+        except KeyError:
+            raise ValueError(f"{self.type_id!r} has no fixed-width physical dtype") from None
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.np_dtype)
+
+    def __repr__(self) -> str:
+        if self.is_decimal:
+            return f"DType({self.type_id.name}, scale={self.scale})"
+        return f"DType({self.type_id.name})"
+
+
+# -- canonical singletons ----------------------------------------------------
+INT8 = DType(TypeId.INT8)
+INT16 = DType(TypeId.INT16)
+INT32 = DType(TypeId.INT32)
+INT64 = DType(TypeId.INT64)
+UINT8 = DType(TypeId.UINT8)
+UINT16 = DType(TypeId.UINT16)
+UINT32 = DType(TypeId.UINT32)
+UINT64 = DType(TypeId.UINT64)
+FLOAT32 = DType(TypeId.FLOAT32)
+FLOAT64 = DType(TypeId.FLOAT64)
+BOOL8 = DType(TypeId.BOOL8)
+TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
+TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
+TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)
+TIMESTAMP_MICROSECONDS = DType(TypeId.TIMESTAMP_MICROSECONDS)
+TIMESTAMP_NANOSECONDS = DType(TypeId.TIMESTAMP_NANOSECONDS)
+DURATION_DAYS = DType(TypeId.DURATION_DAYS)
+DURATION_SECONDS = DType(TypeId.DURATION_SECONDS)
+DURATION_MILLISECONDS = DType(TypeId.DURATION_MILLISECONDS)
+DURATION_MICROSECONDS = DType(TypeId.DURATION_MICROSECONDS)
+DURATION_NANOSECONDS = DType(TypeId.DURATION_NANOSECONDS)
+STRING = DType(TypeId.STRING)
+
+
+def decimal32(scale: int) -> DType:
+    return DType(TypeId.DECIMAL32, scale)
+
+
+def decimal64(scale: int) -> DType:
+    return DType(TypeId.DECIMAL64, scale)
+
+
+def from_type_ids(type_ids, scales=None) -> list[DType]:
+    """Build a schema from parallel type-id / scale arrays.
+
+    This is the external schema wire format (reference:
+    RowConversionJni.cpp:56-61 rebuilds ``cudf::data_type`` the same way).
+    """
+    if scales is None:
+        scales = [0] * len(type_ids)
+    if len(scales) != len(type_ids):
+        raise ValueError("type_ids and scales must be the same length")
+    decimal_ids = (TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128)
+    return [DType(TypeId(t), s if TypeId(t) in decimal_ids else 0)
+            for t, s in zip(type_ids, scales)]
+
+
+_NP_TO_DTYPE = {
+    np.dtype(np.int8): INT8,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.uint16): UINT16,
+    np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64,
+    np.dtype(np.float32): FLOAT32,
+    np.dtype(np.float64): FLOAT64,
+    np.dtype(np.bool_): BOOL8,
+}
+
+
+def from_numpy_dtype(dt) -> DType:
+    """Best-effort logical dtype for a numpy dtype (bool maps to BOOL8)."""
+    try:
+        return _NP_TO_DTYPE[np.dtype(dt)]
+    except KeyError:
+        raise ValueError(f"no logical DType for numpy dtype {dt!r}") from None

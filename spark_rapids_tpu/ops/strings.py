@@ -1,0 +1,89 @@
+"""String column support: Arrow-style offsets + UTF-8 char buffer.
+
+The reference punts on variable-width types (``CUDF_FAIL("Only fixed width
+types are currently supported")`` — row_conversion.cu:515) but its capability
+envelope includes cuDF's strings engine (SURVEY.md §2.3).  Representation:
+
+  * ``data``    — ``uint8`` char buffer of all strings concatenated,
+  * ``offsets`` — ``int32 (n+1,)``; string *i* is ``data[offsets[i]:offsets[i+1]]``,
+  * ``validity``— bool mask as for fixed-width columns (null strings have
+                  zero-length payloads).
+
+Design note: per-element byte work is hostile to the VPU's 32-bit lanes, so
+compute ops (contains/regex, in :func:`contains` and :mod:`regex`) operate on
+the flat char buffer with vectorized comparisons + segment logic rather than
+per-string loops.  Gather materializes the output size on host (eager op —
+the engine's host-driven model, see :mod:`spark_rapids_tpu.ops`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dtypes import STRING
+from ..column import Column
+
+
+def strings_from_pylist(values: list[Optional[str]]) -> Column:
+    """Build a STRING column from Python strings (``None`` = null)."""
+    n = len(values)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    mask = np.ones(n, dtype=np.bool_)
+    chunks: list[bytes] = []
+    pos = 0
+    for i, v in enumerate(values):
+        if v is None:
+            mask[i] = False
+        else:
+            b = v.encode("utf-8")
+            chunks.append(b)
+            pos += len(b)
+        offsets[i + 1] = pos
+    chars = np.frombuffer(b"".join(chunks), dtype=np.uint8).copy()
+    validity = None if mask.all() else jnp.asarray(mask)
+    return Column(data=jnp.asarray(chars), validity=validity,
+                  offsets=jnp.asarray(offsets), dtype=STRING)
+
+
+def strings_to_pylist(col: Column) -> list[Optional[str]]:
+    chars = np.asarray(col.data, dtype=np.uint8)
+    offsets = np.asarray(col.offsets)
+    mask = None if col.validity is None else np.asarray(col.validity)
+    out: list[Optional[str]] = []
+    for i in range(len(offsets) - 1):
+        if mask is not None and not mask[i]:
+            out.append(None)
+        else:
+            out.append(bytes(chars[offsets[i]:offsets[i + 1]]).decode("utf-8"))
+    return out
+
+
+def strings_gather(col: Column, indices) -> Column:
+    """Row gather for string columns.
+
+    Eager: the output char-buffer size is data dependent, so it is synced to
+    host once and the char copy runs as one vectorized device gather
+    (position->source map built from searchsorted over the new offsets).
+    """
+    indices = jnp.asarray(indices)
+    offsets = col.offsets
+    starts = jnp.take(offsets, indices)
+    lens = jnp.take(offsets, indices + 1) - starts
+    new_offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(lens, dtype=jnp.int32)])
+    total = int(new_offsets[-1])  # host sync: output size is data dependent
+    if total == 0:
+        chars = jnp.zeros(0, jnp.uint8)
+    else:
+        pos = jnp.arange(total, dtype=jnp.int32)
+        row = jnp.searchsorted(new_offsets, pos, side="right") - 1
+        src = jnp.take(starts, row) + (pos - jnp.take(new_offsets, row))
+        chars = jnp.take(col.data, src)
+    validity = None
+    if col.validity is not None:
+        validity = jnp.take(col.validity, indices)
+    return Column(data=chars, validity=validity, offsets=new_offsets, dtype=STRING)

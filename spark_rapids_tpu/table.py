@@ -1,0 +1,150 @@
+"""Table: an ordered collection of equal-length named columns.
+
+TPU-native replacement for the object model the reference inherits from cuDF
+(``ai.rapids.cudf.Table`` compiled into the reference jar, pom.xml:388-400).
+Tables are pytrees, so a whole table can flow through ``jax.jit`` /
+``shard_map`` as one argument, with names/dtypes as static structure.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from .column import Column, column_from_any
+from .dtypes import DType
+
+
+@jax.tree_util.register_pytree_node_class
+class Table:
+    """Immutable ordered mapping of column name -> Column."""
+
+    def __init__(self, columns: Union[Mapping[str, Column], Sequence[tuple[str, Column]]]):
+        if isinstance(columns, Mapping):
+            items = list(columns.items())
+        else:
+            items = list(columns)
+        if not items:
+            raise ValueError("Table needs at least one column")
+        self._names = tuple(name for name, _ in items)
+        if len(set(self._names)) != len(self._names):
+            raise ValueError(f"duplicate column names: {self._names}")
+        self._columns = tuple(column_from_any(col) for _, col in items)
+        sizes = {c.size for c in self._columns}
+        if len(sizes) != 1:
+            raise ValueError(f"columns have mismatched lengths: "
+                             f"{dict(zip(self._names, (c.size for c in self._columns)))}")
+
+    # -- pytree protocol -----------------------------------------------------
+    def tree_flatten(self):
+        return self._columns, self._names
+
+    @classmethod
+    def tree_unflatten(cls, names, columns):
+        obj = cls.__new__(cls)
+        obj._names = names
+        obj._columns = tuple(columns)
+        return obj
+
+    # -- structure -----------------------------------------------------------
+    @property
+    def names(self) -> tuple[str, ...]:
+        return self._names
+
+    @property
+    def columns(self) -> tuple[Column, ...]:
+        return self._columns
+
+    @property
+    def num_columns(self) -> int:
+        return len(self._columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self._columns[0].size
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def schema(self) -> list[DType]:
+        return [c.dtype for c in self._columns]
+
+    def __getitem__(self, name: str) -> Column:
+        try:
+            return self._columns[self._names.index(name)]
+        except ValueError:
+            raise KeyError(name) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def items(self) -> Iterable[tuple[str, Column]]:
+        return zip(self._names, self._columns)
+
+    # -- transforms ----------------------------------------------------------
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table([(n, self[n]) for n in names])
+
+    def drop(self, names: Sequence[str]) -> "Table":
+        dropped = set(names)
+        return Table([(n, c) for n, c in self.items() if n not in dropped])
+
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        return Table([(mapping.get(n, n), c) for n, c in self.items()])
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        """Replace ``name`` in place (schema order preserved), or append if new."""
+        col = column_from_any(col)
+        if name in self._names:
+            return Table([(n, col if n == name else c) for n, c in self.items()])
+        return Table(list(self.items()) + [(name, col)])
+
+    def gather(self, indices) -> "Table":
+        return Table([(n, c.gather(indices)) for n, c in self.items()])
+
+    # -- host materialization ------------------------------------------------
+    def to_pydict(self) -> dict[str, list]:
+        return {n: c.to_pylist() for n, c in self.items()}
+
+    @staticmethod
+    def from_pydict(data: Mapping[str, object],
+                    dtypes: Optional[Mapping[str, DType]] = None) -> "Table":
+        dtypes = dtypes or {}
+        return Table([(n, column_from_any(v, dtypes.get(n))) for n, v in data.items()])
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}: {c.dtype.type_id.name}" for n, c in self.items())
+        return f"Table[{self.num_rows} rows]({cols})"
+
+
+def assert_tables_equal(a: Table, b: Table, rtol: float = 0.0, atol: float = 0.0) -> None:
+    """Test oracle: full logical equality (names, dtypes, values, nulls).
+
+    TPU equivalent of the reference test's ``AssertUtils.assertTablesAreEqual``
+    (RowConversionTest.java:50-52).
+    """
+    assert a.names == b.names, f"names differ: {a.names} vs {b.names}"
+    assert a.schema() == b.schema(), f"schemas differ: {a.schema()} vs {b.schema()}"
+    assert a.num_rows == b.num_rows, f"row counts differ: {a.num_rows} vs {b.num_rows}"
+    for name in a.names:
+        ca, cb = a[name], b[name]
+        va, ma = ca.to_numpy() if ca.offsets is None else (None, None)
+        if ca.offsets is not None:
+            assert ca.to_pylist() == cb.to_pylist(), f"column {name!r} differs"
+            continue
+        vb, mb = cb.to_numpy()
+        ma = np.ones(ca.size, np.bool_) if ma is None else ma
+        mb = np.ones(cb.size, np.bool_) if mb is None else mb
+        assert (ma == mb).all(), f"column {name!r}: validity differs"
+        va_v, vb_v = va[ma], vb[mb]
+        if rtol or atol:
+            np.testing.assert_allclose(va_v, vb_v, rtol=rtol, atol=atol,
+                                       err_msg=f"column {name!r} values differ")
+        elif np.issubdtype(va_v.dtype, np.floating):
+            # Exact compare, but NaN == NaN (a NaN payload is a legal value).
+            assert np.array_equal(va_v, vb_v, equal_nan=True), \
+                f"column {name!r} values differ"
+        else:
+            assert (va_v == vb_v).all(), f"column {name!r} values differ"

@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual devices so the distributed layer (mesh
+sharding, all_to_all shuffle) is exercised without TPU hardware — the
+fake-backend capability the reference lacks (it gates tests on physical GPUs,
+SURVEY.md §4).  Real-TPU runs use the same tests via ci/premerge-build.sh.
+"""
+
+import os
+
+# Must happen before jax import.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = f"{_flags} --xla_force_host_platform_device_count=8".strip()
+# Force CPU for tests even when the session points at a TPU (JAX_PLATFORMS=axon):
+# the suite needs 8 virtual devices for mesh tests. Override with SRT_TEST_PLATFORM
+# to run the suite on real hardware (ci/premerge-build.sh does). The env var alone
+# is not enough — the TPU sitecustomize overrides jax.config directly, so we
+# override it back (config wins over env at backend init).
+_platform = os.environ.get("SRT_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)

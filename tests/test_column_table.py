@@ -1,0 +1,181 @@
+"""Columnar core tests: dtypes, Column, Table, pytree behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.table import Table, assert_tables_equal
+
+
+class TestDtypes:
+    def test_type_ids_match_cudf_numbering(self):
+        # Wire contract: ids must match cudf 22.06 (RowConversionJni.cpp:56-61).
+        assert dt.TypeId.INT8 == 1
+        assert dt.TypeId.INT64 == 4
+        assert dt.TypeId.FLOAT64 == 10
+        assert dt.TypeId.BOOL8 == 11
+        assert dt.TypeId.STRING == 23
+        assert dt.TypeId.DECIMAL32 == 25
+        assert dt.TypeId.DECIMAL64 == 26
+
+    def test_itemsizes(self):
+        assert dt.INT8.itemsize == 1
+        assert dt.INT16.itemsize == 2
+        assert dt.INT32.itemsize == 4
+        assert dt.INT64.itemsize == 8
+        assert dt.FLOAT32.itemsize == 4
+        assert dt.FLOAT64.itemsize == 8
+        assert dt.BOOL8.itemsize == 1
+        assert dt.decimal32(-2).itemsize == 4
+        assert dt.decimal64(-4).itemsize == 8
+        assert dt.TIMESTAMP_DAYS.itemsize == 4
+        assert dt.TIMESTAMP_MICROSECONDS.itemsize == 8
+
+    def test_decimal_scale_round_trips_through_wire_format(self):
+        schema = dt.from_type_ids([4, 25, 26], [0, -2, -5])
+        assert schema == [dt.INT64, dt.decimal32(-2), dt.decimal64(-5)]
+
+    def test_scale_rejected_for_non_decimal(self):
+        with pytest.raises(ValueError):
+            dt.DType(dt.TypeId.INT32, scale=-2)
+
+    def test_variable_width_has_no_itemsize(self):
+        with pytest.raises(ValueError):
+            dt.STRING.itemsize
+
+
+class TestColumn:
+    def test_from_pylist_with_nulls(self):
+        c = Column.from_pylist([1, None, 3], dt.INT32)
+        assert c.size == 3
+        assert c.null_count() == 1
+        assert c.to_pylist() == [1, None, 3]
+
+    def test_all_valid_has_no_mask(self):
+        c = Column.from_pylist([1, 2, 3], dt.INT64)
+        assert c.validity is None
+        assert c.null_count() == 0
+
+    def test_bool8_stored_as_bytes(self):
+        c = Column.from_pylist([True, None, False], dt.BOOL8)
+        assert c.data.dtype == jnp.uint8
+        assert c.to_pylist() == [True, None, False]
+
+    def test_int64_precision_preserved(self):
+        big = 2**62 + 12345
+        c = Column.from_pylist([big, -big], dt.INT64)
+        assert c.to_pylist() == [big, -big]
+
+    def test_gather(self):
+        c = Column.from_pylist([10, None, 30, 40], dt.INT32)
+        g = c.gather(jnp.array([3, 1, 0]))
+        assert g.to_pylist() == [40, None, 10]
+
+    def test_column_is_pytree(self):
+        c = Column.from_pylist([1.5, None, 2.5], dt.FLOAT64)
+        leaves, treedef = jax.tree_util.tree_flatten(c)
+        c2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert c2.dtype == dt.FLOAT64
+        assert c2.to_pylist() == c.to_pylist()
+
+    def test_jit_over_column(self):
+        c = Column.from_pylist([1, 2, None, 4], dt.INT32)
+
+        @jax.jit
+        def double(col: Column) -> Column:
+            return Column(data=col.data * 2, validity=col.validity, dtype=col.dtype)
+
+        assert double(c).to_pylist() == [2, 4, None, 8]
+
+
+class TestStrings:
+    def test_pylist_roundtrip_with_nulls(self):
+        c = Column.from_pylist(["hello", None, "", "wörld"], dt.STRING)
+        assert c.size == 4
+        assert c.to_pylist() == ["hello", None, "", "wörld"]
+
+    def test_inferred_from_pydict(self):
+        t = Table.from_pydict({"s": ["a", "bc", None]})
+        assert t.schema() == [dt.STRING]
+        assert t.to_pydict() == {"s": ["a", "bc", None]}
+
+    def test_gather(self):
+        c = Column.from_pylist(["aa", "b", None, "dddd"], dt.STRING)
+        g = c.gather(jnp.array([3, 0, 2]))
+        assert g.to_pylist() == ["dddd", "aa", None]
+
+
+class TestGatherBounds:
+    def test_fill_invalid_nullifies_out_of_range(self):
+        c = Column.from_pylist([10, 20], dt.INT32)
+        g = c.gather(jnp.array([0, 5, -1, 1]), fill_invalid=True)
+        assert g.to_pylist() == [10, None, None, 20]
+
+    def test_nan_survives_oracle(self):
+        t = Table.from_pydict({"x": [1.0, float("nan")]}, dtypes={"x": dt.FLOAT64})
+        assert_tables_equal(t, t)
+
+
+class TestTable:
+    def make(self):
+        return Table.from_pydict(
+            {"a": [1, None, 3], "b": [1.0, 2.0, None]},
+            dtypes={"a": dt.INT64, "b": dt.FLOAT64},
+        )
+
+    def test_basic_structure(self):
+        t = self.make()
+        assert t.num_rows == 3
+        assert t.num_columns == 2
+        assert t.names == ("a", "b")
+        assert t.schema() == [dt.INT64, dt.FLOAT64]
+
+    def test_mismatched_lengths_rejected(self):
+        with pytest.raises(ValueError):
+            Table.from_pydict({"a": [1, 2], "b": [1]})
+
+    def test_duplicate_names_rejected(self):
+        c = Column.from_pylist([1], dt.INT32)
+        with pytest.raises(ValueError):
+            Table([("x", c), ("x", c)])
+
+    def test_select_drop_rename_with_column(self):
+        t = self.make()
+        assert t.select(["b"]).names == ("b",)
+        assert t.drop(["a"]).names == ("b",)
+        assert t.rename({"a": "z"}).names == ("z", "b")
+        t2 = t.with_column("c", Column.from_pylist([7, 8, 9], dt.INT32))
+        assert t2.names == ("a", "b", "c")
+        # Replacing an existing column must keep schema order (positional
+        # type-id schemas depend on it).
+        t3 = t.with_column("a", Column.from_pylist([7, 8, 9], dt.INT32))
+        assert t3.names == ("a", "b")
+        assert t3.schema() == [dt.INT32, dt.FLOAT64]
+
+    def test_table_jit_roundtrip(self):
+        t = self.make()
+
+        @jax.jit
+        def ident(tbl: Table) -> Table:
+            return tbl
+
+        assert_tables_equal(ident(t), t)
+
+    def test_gather(self):
+        t = self.make()
+        g = t.gather(jnp.array([2, 0]))
+        assert g.to_pydict() == {"a": [3, 1], "b": [None, 1.0]}
+
+    def test_version(self):
+        assert srt.__version__
+
+
+class TestHarness:
+    def test_eight_virtual_devices(self):
+        if jax.default_backend() != "cpu":
+            pytest.skip("virtual device count only applies to the CPU harness")
+        assert len(jax.devices()) == 8
