@@ -167,12 +167,12 @@ class Column:
         indices = jnp.asarray(indices)
         if fill_invalid:
             in_range = (indices >= 0) & (indices < self.size)
-            base = self if self.offsets is None else None
-            if base is None:
+            clipped = jnp.clip(indices, 0, self.size - 1)
+            if self.offsets is not None:
                 from .ops.strings import strings_gather
-                out = strings_gather(self, jnp.clip(indices, 0, self.size - 1))
+                out = strings_gather(self, clipped)
             else:
-                out = self._fixed_gather(jnp.clip(indices, 0, self.size - 1))
+                out = self._fixed_gather(clipped)
             return out.with_validity(out.valid_mask() & in_range)
         if self.offsets is not None:
             from .ops.strings import strings_gather

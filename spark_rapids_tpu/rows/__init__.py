@@ -1,0 +1,16 @@
+"""Row-format subsystem: layout engine + columnar↔row conversion."""
+
+from .layout import (BATCH_ROW_MULTIPLE, MAX_BATCH_BYTES, MAX_ROW_WIDTH,
+                     RowLayout, compute_fixed_width_layout)
+from .convert import RowBlob, from_rows, to_rows
+
+__all__ = [
+    "BATCH_ROW_MULTIPLE",
+    "MAX_BATCH_BYTES",
+    "MAX_ROW_WIDTH",
+    "RowBlob",
+    "RowLayout",
+    "compute_fixed_width_layout",
+    "from_rows",
+    "to_rows",
+]

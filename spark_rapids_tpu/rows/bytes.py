@@ -1,0 +1,132 @@
+"""Device byte-manipulation primitives for the row format.
+
+The row blob is byte-addressed; TPU vector lanes are ≥8-bit but 64-bit types
+are emulated (x64 rewriting).  Empirically (probed on TPU v5e):
+
+  * ``bitcast_convert_type`` works for every width *except* float64 sources —
+    the x64 rewriter has no lowering for 64-bit float bitcasts
+    (``f64 -> u8``/``f64 -> i64`` fail to compile; ``u8 -> f64`` works).
+  * int64 shifts/masks and f64 arithmetic (frexp et al.) are emulated fine.
+
+So: ints/f32 use hardware bitcasts; f64 *packing* goes through an exact
+software bit-extraction (:func:`f64_to_bits`) on backends that need it.
+f64 *unpacking* uses the (working) u8→f64 bitcast everywhere.
+
+This module replaces the reference CUDA kernels' per-thread byte ``switch``
+(row_conversion.cu:128-156, :226-254) with whole-column vector ops, and its
+``__ballot_sync``/``atomicOr_block`` validity bit handling
+(row_conversion.cu:158-165, :255-272) with deterministic shift/mask packing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..dtypes import DType
+
+
+def backend_has_native_f64_bitcast() -> bool:
+    """True where f64→int bitcasts compile (CPU/GPU); False on TPU."""
+    return jax.default_backend() != "tpu"
+
+
+def f64_to_bits(x: jax.Array) -> jax.Array:
+    """IEEE-754 bit pattern of float64 values, with no 64-bit bitcast.
+
+    Used only where the native bitcast doesn't compile (TPU: the x64 rewriter
+    emulates f64 and has no lowering for f64 bitcasts — nor for frexp /
+    signbit / isnan, which lower through bitcasts).  Everything here is
+    comparison + power-of-two multiplication (exact per IEEE) + integer ops:
+    the exponent falls out of a branchless normalization of |x| into [1, 2)
+    using steps of 2**±64 — constants safely inside float32's exponent range,
+    because TPU "f64" is emulated with f32 pairs and larger constants degrade.
+
+    Exact for ±0, normals and ±inf (to the precision the backend's f64
+    arithmetic carries — full 52-bit on CPU, ~48-bit significands under TPU
+    f32-pair emulation, which already bounds what a TPU-resident f64 column
+    can hold).  Documented canonicalizations, both consistent with TPU
+    numerics: NaN -> quiet NaN 0x7ff8000000000000 (sign preserved), and
+    denormals -> ±0 (XLA flushes f64 denormals anyway).
+
+    Returns int64 bit patterns.
+    """
+    one = jnp.float64(1.0)
+    x = x.astype(jnp.float64)
+    # Comparison-based classification (signbit/isnan/isinf all need bitcasts).
+    sign = (x < 0) | ((x == 0) & (one / x < 0))          # catches -0.0
+    ax = jnp.abs(x)
+    is_nan = x != x
+    is_inf = (ax * 0.5 == ax) & (ax > 0)
+    # Branchless normalization of ax into [1, 2), tracking the exponent e so
+    # that value == ax * 2**e.  Scale-up covers denormals (17*64 >= 1088 >
+    # 1074); scale-down covers the top of the range (16*64 = 1024).
+    e = jnp.zeros(x.shape, jnp.int64)
+    up = jnp.float64(2.0**64)
+    down = jnp.float64(2.0**-64)
+    for _ in range(17):
+        small = (ax > 0) & (ax < one)
+        ax = jnp.where(small, ax * up, ax)
+        e = e - jnp.where(small, 64, 0)
+    for _ in range(16):
+        big = ax >= up
+        ax = jnp.where(big, ax * down, ax)
+        e = e + jnp.where(big, 64, 0)
+    for k in (32, 16, 8, 4, 2, 1):
+        big = ax >= jnp.float64(2.0**k)
+        ax = jnp.where(big, ax * jnp.float64(2.0**-k), ax)
+        e = e + jnp.where(big, k, 0)
+    # ax in [1, 2): mantissa = frac bits of ax * 2**52 (exactly an integer).
+    biased = e + 1023
+    mantissa = (ax * jnp.float64(2.0**52)).astype(jnp.int64) - (1 << 52)
+    bits = (biased << 52) | mantissa
+    bits = jnp.where((x == 0) | (biased <= 0), 0, bits)      # ±0 and denormals
+    bits = jnp.where(is_inf, jnp.int64(0x7FF) << 52, bits)
+    bits = jnp.where(is_nan, (jnp.int64(0x7FF) << 52) | (jnp.int64(1) << 51), bits)
+    return bits | jnp.where(sign & ~is_nan, jnp.int64(np.int64(-2**63)), jnp.int64(0))
+
+
+def to_bytes(data: jax.Array, dtype: DType) -> jax.Array:
+    """Column values → little-endian bytes, shape ``(n, dtype.itemsize)``."""
+    size = dtype.itemsize
+    np_dt = dtype.np_dtype
+    if size == 1:
+        return data.view(jnp.uint8).reshape(-1, 1) if data.dtype != jnp.uint8 \
+            else data.reshape(-1, 1)
+    if np_dt == np.float64 and not backend_has_native_f64_bitcast():
+        data = f64_to_bits(data)
+    return lax.bitcast_convert_type(data, jnp.uint8)
+
+
+def from_bytes(raw: jax.Array, dtype: DType) -> jax.Array:
+    """Little-endian bytes ``(n, dtype.itemsize)`` → column values ``(n,)``."""
+    target = dtype.jnp_dtype
+    if dtype.itemsize == 1:
+        return raw.reshape(-1).astype(target) if target != jnp.uint8 else raw.reshape(-1)
+    return lax.bitcast_convert_type(raw, target)
+
+
+def pack_validity_bytes(valid: jax.Array, num_bytes: int) -> jax.Array:
+    """Pack a bool matrix ``(n, num_fields)`` into row-format validity bytes.
+
+    Bit ``f % 8`` of byte ``f // 8`` is set iff field ``f`` is valid — the row
+    tail contract (row_conversion.cu:159-161 reads it back the same way).
+    Bits beyond ``num_fields`` are zero (deterministic, unlike the reference,
+    which leaves them as garbage shared-memory residue).
+    """
+    n, num_fields = valid.shape
+    padded = jnp.zeros((n, num_bytes * 8), dtype=jnp.uint8)
+    padded = padded.at[:, :num_fields].set(valid.astype(jnp.uint8))
+    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))
+    groups = padded.reshape(n, num_bytes, 8).astype(jnp.uint32)
+    return jnp.sum(groups * weights, axis=-1).astype(jnp.uint8)
+
+
+def unpack_validity_bytes(raw: jax.Array, num_fields: int) -> jax.Array:
+    """Inverse of :func:`pack_validity_bytes`; returns bool ``(n, num_fields)``."""
+    byte_idx = np.arange(num_fields) // 8
+    shifts = jnp.asarray(np.arange(num_fields) % 8, dtype=jnp.uint8)
+    per_field = raw[:, byte_idx]                  # (n, num_fields)
+    return ((per_field >> shifts) & 1).astype(jnp.bool_)

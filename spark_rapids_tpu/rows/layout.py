@@ -1,0 +1,96 @@
+"""Fixed-width row-format layout engine.
+
+Byte-identical implementation of the reference's row-format contract
+(reference: row_conversion.cu:425-456 ``compute_fixed_width_layout``; the
+format is documented at RowConversion.java:60-89):
+
+  * columns are laid out in schema order, each at its *natural alignment*
+    (alignment == element size for fixed-width types),
+  * after the last column's data comes the validity tail —
+    ``ceil(num_columns / 8)`` bytes, bit ``c % 8`` of byte ``c // 8`` set iff
+    column ``c`` is valid in that row (1 = valid),
+  * the row is padded to a multiple of 8 bytes (64-bit alignment).
+
+This layout is the host-interop contract (Spark ``UnsafeRow``-style fixed
+width rows); the bytes must match exactly, which the golden tests in
+tests/test_row_layout.py assert against an independent oracle.
+
+Pure host-side computation — no device code.  The native C++ bridge mirrors
+this function (native/src/row_layout.cpp) for non-Python hosts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from ..dtypes import DType
+
+#: Maximum bytes per row blob output column (reference: RowConversion.java:32-34,
+#: row_conversion.cu:384-386 — each batch must stay under 2**31 bytes).
+MAX_BATCH_BYTES = 2**31 - 1
+
+#: Batches are sized in multiples of 32 rows so packed validity words never
+#: split across batches (reference: row_conversion.cu:477-479).
+BATCH_ROW_MULTIPLE = 32
+
+#: Documented row-width limit of the reference API (RowConversion.java:98-99).
+#: The reference's real gate is shared-memory fit (row_conversion.cu:347); TPU
+#: has no such limit, so ours is a compatibility check that can be lifted via
+#: ``check_row_width=False``.
+MAX_ROW_WIDTH = 1024
+
+
+def align_offset(offset: int, alignment: int) -> int:
+    """Round ``offset`` up to ``alignment`` (power of two)."""
+    return (offset + alignment - 1) & ~(alignment - 1)
+
+
+@dataclass(frozen=True)
+class RowLayout:
+    """Resolved byte layout of one row for a fixed-width schema."""
+
+    schema: tuple[DType, ...]
+    column_starts: tuple[int, ...]   # byte offset of each column in the row
+    column_sizes: tuple[int, ...]    # element size of each column
+    validity_offset: int             # first byte of the validity tail
+    validity_bytes: int              # ceil(num_columns / 8)
+    row_size: int                    # padded total bytes per row
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.schema)
+
+    def max_rows_per_batch(self, max_batch_bytes: int = MAX_BATCH_BYTES) -> int:
+        """Largest 32-row-multiple batch that stays under the byte cap."""
+        return (max_batch_bytes // self.row_size) // BATCH_ROW_MULTIPLE * BATCH_ROW_MULTIPLE
+
+
+def compute_fixed_width_layout(schema: Sequence[DType]) -> RowLayout:
+    """Lay out a fixed-width schema; raises for variable-width columns."""
+    schema = tuple(schema)
+    if not schema:
+        raise ValueError("schema must have at least one column")
+    starts: list[int] = []
+    sizes: list[int] = []
+    at = 0
+    for dtype in schema:
+        if not dtype.is_fixed_width:
+            raise ValueError("Only fixed width types are currently supported")
+        size = dtype.itemsize
+        at = align_offset(at, size)   # natural alignment
+        starts.append(at)
+        sizes.append(size)
+        at += size
+    validity_offset = at              # validity tail is byte-aligned, no padding
+    validity_bytes = (len(schema) + 7) // 8
+    at += validity_bytes
+    row_size = align_offset(at, 8)    # 64-bit row alignment
+    return RowLayout(
+        schema=schema,
+        column_starts=tuple(starts),
+        column_sizes=tuple(sizes),
+        validity_offset=validity_offset,
+        validity_bytes=validity_bytes,
+        row_size=row_size,
+    )

@@ -1,0 +1,148 @@
+"""Row-format layout + byte-primitive tests.
+
+The layout golden values are computed by hand from the contract (reference:
+row_conversion.cu:425-456, RowConversion.java:60-89) — NOT by running this
+package's own code — so they are a true oracle.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.rows.layout import compute_fixed_width_layout, RowLayout
+
+
+class TestLayoutGolden:
+    def test_single_int64(self):
+        lay = compute_fixed_width_layout([dt.INT64])
+        assert lay.column_starts == (0,)
+        assert lay.column_sizes == (8,)
+        assert lay.validity_offset == 8
+        assert lay.validity_bytes == 1
+        assert lay.row_size == 16  # 8 data + 1 validity -> pad to 16
+
+    def test_single_int8(self):
+        lay = compute_fixed_width_layout([dt.INT8])
+        assert lay.row_size == 8   # 1 data + 1 validity = 2 -> pad to 8
+
+    def test_natural_alignment_gaps(self):
+        # int8 @0, int32 aligned to 4 -> @4, int16 @8, int64 aligned to 8 -> @16
+        lay = compute_fixed_width_layout([dt.INT8, dt.INT32, dt.INT16, dt.INT64])
+        assert lay.column_starts == (0, 4, 8, 16)
+        assert lay.validity_offset == 24
+        assert lay.validity_bytes == 1
+        assert lay.row_size == 32  # 24 + 1 = 25 -> pad to 32
+
+    def test_reference_test_schema(self):
+        # The 8-column schema of RowConversionTest.java:30-39:
+        # int64, float64, int32, bool8, float32, int8, decimal32, decimal64
+        schema = [dt.INT64, dt.FLOAT64, dt.INT32, dt.BOOL8, dt.FLOAT32,
+                  dt.INT8, dt.decimal32(-2), dt.decimal64(-5)]
+        lay = compute_fixed_width_layout(schema)
+        assert lay.column_starts == (0, 8, 16, 20, 24, 28, 32, 40)
+        assert lay.validity_offset == 48
+        assert lay.validity_bytes == 1
+        assert lay.row_size == 56  # 48 + 1 = 49 -> pad to 56
+
+    def test_nine_columns_two_validity_bytes(self):
+        lay = compute_fixed_width_layout([dt.INT8] * 9)
+        assert lay.validity_offset == 9
+        assert lay.validity_bytes == 2
+        assert lay.row_size == 16  # 9 + 2 = 11 -> pad to 16
+
+    def test_wide_to_narrow_ordering_halves_padding(self):
+        # The doc guidance (RowConversion.java:74-89): int64,int32,int16,int8
+        # packs tighter than int8,int16,int32,int64.
+        tight = compute_fixed_width_layout([dt.INT64, dt.INT32, dt.INT16, dt.INT8])
+        loose = compute_fixed_width_layout([dt.INT8, dt.INT16, dt.INT32, dt.INT64])
+        assert tight.row_size == 16   # 15 data+validity bytes -> 16
+        assert loose.row_size == 24   # alignment gaps inflate the row
+
+    def test_variable_width_rejected(self):
+        with pytest.raises(ValueError, match="Only fixed width"):
+            compute_fixed_width_layout([dt.INT32, dt.STRING])
+
+    def test_max_rows_per_batch_is_32_multiple(self):
+        lay = compute_fixed_width_layout([dt.INT64])
+        m = lay.max_rows_per_batch()
+        assert m % 32 == 0
+        assert m * lay.row_size < 2**31
+        assert (m + 32) * lay.row_size >= 2**31 - 32 * lay.row_size  # near-max
+
+
+class TestBytesPrimitives:
+    def test_to_bytes_little_endian(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import to_bytes
+        raw = np.asarray(to_bytes(jnp.array([0x0102030405060708], jnp.int64), dt.INT64))
+        assert raw.tolist() == [[8, 7, 6, 5, 4, 3, 2, 1]]
+
+    def test_roundtrip_all_dtypes(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import from_bytes, to_bytes
+        cases = [
+            (dt.INT8, np.array([-128, 127, 0], np.int8)),
+            (dt.INT16, np.array([-32768, 32767, 5], np.int16)),
+            (dt.INT32, np.array([-2**31, 2**31 - 1, 7], np.int32)),
+            (dt.INT64, np.array([-2**63, 2**63 - 1, 9], np.int64)),
+            (dt.UINT32, np.array([0, 2**32 - 1], np.uint32)),
+            (dt.FLOAT32, np.array([1.5, -0.0, np.inf], np.float32)),
+            (dt.FLOAT64, np.array([1.5, -0.0, np.inf, 5e-324], np.float64)),
+            (dt.BOOL8, np.array([0, 1], np.uint8)),
+        ]
+        for dtype, vals in cases:
+            raw = to_bytes(jnp.asarray(vals), dtype)
+            assert raw.shape == (len(vals), dtype.itemsize)
+            # bytes must equal numpy's little-endian layout
+            expect = vals.astype(vals.dtype.newbyteorder("<"), copy=False)
+            assert np.asarray(raw).tobytes() == expect.tobytes(), dtype
+            back = np.asarray(from_bytes(raw, dtype))
+            assert back.tobytes() == vals.tobytes(), dtype
+
+    def test_f64_software_bits_matches_hardware(self):
+        """The TPU f64 packing path must agree bit-for-bit with numpy."""
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import f64_to_bits
+        vals = np.array([
+            0.0, -0.0, 1.0, -1.0, 1.5, np.pi, 1e308, -1e308,
+            2.2250738585072014e-308,   # smallest normal
+            np.inf, -np.inf, 2.0**-1022, 1.7976931348623157e308,
+        ], dtype=np.float64)
+        got = np.asarray(f64_to_bits(jnp.asarray(vals)), np.int64)
+        expect = vals.view(np.int64)
+        assert got.tolist() == expect.tolist()
+
+    def test_f64_software_bits_denormals_flush_to_signed_zero(self):
+        # XLA FTZ makes denormals indistinguishable from 0 in-program; the
+        # soft path canonicalizes them to ±0 (documented deviation).
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import f64_to_bits
+        got = np.asarray(f64_to_bits(jnp.array([5e-324, -5e-324], jnp.float64)),
+                         np.uint64)
+        assert got[0] == 0
+        assert got[1] == 0x8000000000000000
+
+    def test_f64_software_bits_nan_canonical(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import f64_to_bits
+        got = np.asarray(f64_to_bits(jnp.array([np.nan], jnp.float64)), np.uint64)
+        assert got[0] == 0x7FF8000000000000
+
+    def test_f64_software_bits_random_sweep(self, rng):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import f64_to_bits
+        vals = rng.standard_normal(4096) * np.exp(rng.uniform(-300, 300, 4096))
+        vals = vals.astype(np.float64)
+        got = np.asarray(f64_to_bits(jnp.asarray(vals)), np.int64)
+        assert (got == vals.view(np.int64)).all()
+
+    def test_validity_pack_unpack(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.bytes import pack_validity_bytes, unpack_validity_bytes
+        valid = jnp.asarray(np.array([[1, 0, 1, 1, 0, 0, 0, 1, 1],
+                                      [0, 0, 0, 0, 0, 0, 0, 0, 0]], np.bool_))
+        packed = np.asarray(pack_validity_bytes(valid, 2))
+        # row 0: bits 0,2,3,7 of byte0 -> 0b10001101 = 0x8D; bit 8 -> byte1 = 1
+        assert packed.tolist() == [[0x8D, 0x01], [0x00, 0x00]]
+        back = np.asarray(unpack_validity_bytes(jnp.asarray(packed), 9))
+        assert (back == np.asarray(valid)).all()
