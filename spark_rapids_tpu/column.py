@@ -191,6 +191,16 @@ class Column:
                 f"nullable={self.nullable})")
 
 
+def all_null_column(dtype: DType, n: int) -> Column:
+    """A column of ``n`` null rows (zero payloads) of the given dtype."""
+    validity = jnp.zeros(n, jnp.bool_)
+    if dtype == STRING:
+        return Column(data=jnp.zeros(0, jnp.uint8), validity=validity,
+                      offsets=jnp.zeros(n + 1, jnp.int32), dtype=dtype)
+    return Column(data=jnp.zeros(n, dtype.jnp_dtype), validity=validity,
+                  dtype=dtype)
+
+
 def column_from_any(values: Any, dtype: Optional[DType] = None) -> Column:
     """Coerce lists / numpy arrays / Columns into a Column."""
     if isinstance(values, Column):

@@ -1,0 +1,33 @@
+"""Eager columnar ops layer (the cuDF capability-envelope equivalent).
+
+Each op executes immediately; pure compute runs as jit-cached XLA programs
+(see :mod:`.common` for the execution model).  TPU-first algorithm choices:
+sort-based groupby and join (no hash tables), lax.sort multi-key sorting,
+searchsorted merge probes, prefix-sum expansions.
+"""
+
+from . import reductions
+from .binary import binary_op, fill_null, if_else, is_null, is_valid, unary_op
+from .cast import cast
+from .filter import apply_boolean_mask, drop_nulls
+from .groupby import groupby, groupby_agg
+from .join import join
+from .sort import sort_by, sorted_order
+
+__all__ = [
+    "apply_boolean_mask",
+    "binary_op",
+    "cast",
+    "drop_nulls",
+    "fill_null",
+    "groupby",
+    "groupby_agg",
+    "if_else",
+    "is_null",
+    "is_valid",
+    "join",
+    "reductions",
+    "sort_by",
+    "sorted_order",
+    "unary_op",
+]

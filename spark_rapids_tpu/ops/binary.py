@@ -1,0 +1,170 @@
+"""Elementwise binary/unary operations with null propagation.
+
+cuDF binary-ops surface, null semantics: result is null where either input
+is null (and-masks compose for free in XLA — the mask ops fuse into the
+arithmetic).  Scalars broadcast.  Decimal add/sub require matching scales
+(callers rescale via :func:`..ops.cast.cast`); decimal mul adds scales.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..dtypes import BOOL8, DType, FLOAT64, TypeId
+
+Operand = Union[Column, int, float, bool]
+
+
+def _combine_validity(a: Column, b: Optional[Column]) -> Optional[jax.Array]:
+    masks = [c.validity for c in (a, b) if isinstance(c, Column) and c.validity is not None]
+    if not masks:
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out
+
+
+def _payload(x: Operand):
+    return x.data if isinstance(x, Column) else x
+
+
+def _check_decimal_operands(a: Column, b: Operand, op: str) -> None:
+    """Decimal ops are only defined decimal-to-decimal; add/sub/compare need
+    matching scales (cast first).  Anything else silently misinterprets the
+    unscaled payload, so reject it."""
+    a_dec = a.dtype.is_decimal
+    b_dec = isinstance(b, Column) and b.dtype.is_decimal
+    if not a_dec and not b_dec:
+        return
+    if not (a_dec and b_dec):
+        raise ValueError(
+            f"decimal {op}: both operands must be decimal columns "
+            f"(cast the other operand into a decimal first)")
+    if op == "mul" or op == "truediv":
+        return
+    if a.dtype.scale != b.dtype.scale:
+        raise ValueError(
+            f"decimal {op} requires matching scales "
+            f"({a.dtype.scale} vs {b.dtype.scale}): rescale via ops.cast")
+
+
+def _result_dtype(a: Column, b: Operand, op: str) -> DType:
+    if op in ("eq", "ne", "lt", "le", "gt", "ge", "and", "or"):
+        return BOOL8
+    if isinstance(b, Column):
+        if a.dtype.is_decimal and b.dtype.is_decimal:
+            if op in ("add", "sub"):
+                return a.dtype
+            if op == "mul":
+                return DType(a.dtype.type_id, a.dtype.scale + b.dtype.scale)
+            if op in ("div", "truediv"):
+                return FLOAT64
+        if a.dtype.itemsize >= b.dtype.itemsize:
+            return a.dtype if not b.dtype.is_floating or a.dtype.is_floating else b.dtype
+        return b.dtype if not a.dtype.is_floating or b.dtype.is_floating else a.dtype
+    return a.dtype
+
+
+_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "truediv": jnp.true_divide, "floordiv": jnp.floor_divide, "mod": jnp.mod,
+    "pow": jnp.power,
+    "eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less, "le": jnp.less_equal,
+    "gt": jnp.greater, "ge": jnp.greater_equal,
+    "and": jnp.logical_and, "or": jnp.logical_or,
+}
+
+
+def binary_op(a: Column, b: Operand, op: str) -> Column:
+    if op not in _OPS:
+        raise ValueError(f"unsupported binary op {op!r}")
+    _check_decimal_operands(a, b, op)
+    out_dtype = _result_dtype(a, b, op)
+    x, y = _payload(a), _payload(b)
+    if op in ("and", "or"):
+        x = x != 0
+        if isinstance(y, jax.Array):
+            y = y != 0
+    if op == "truediv":
+        if a.dtype.is_decimal:
+            # divide logical values: scale both payloads
+            x = x.astype(jnp.float64) * (10.0 ** a.dtype.scale)
+            y = y.astype(jnp.float64) * (10.0 ** b.dtype.scale)
+            out_dtype = FLOAT64
+        elif not a.dtype.is_floating:
+            x = x.astype(jnp.float64)
+            out_dtype = FLOAT64
+    res = _OPS[op](x, y)
+    if out_dtype == BOOL8:
+        res = res.astype(jnp.uint8)
+    else:
+        res = res.astype(out_dtype.jnp_dtype)
+    return Column(data=res,
+                  validity=_combine_validity(a, b if isinstance(b, Column) else None),
+                  dtype=out_dtype)
+
+
+# -- unary --------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "neg": jnp.negative, "not": lambda x: (x == 0),
+    "sqrt": jnp.sqrt, "floor": jnp.floor, "ceil": jnp.ceil,
+    "exp": jnp.exp, "log": jnp.log, "sin": jnp.sin, "cos": jnp.cos,
+    "rint": jnp.rint,
+}
+
+
+def unary_op(a: Column, op: str) -> Column:
+    if op not in _UNARY:
+        raise ValueError(f"unsupported unary op {op!r}")
+    res = _UNARY[op](a.data)
+    out_dtype = a.dtype
+    if op == "not":
+        res = res.astype(jnp.uint8)
+        out_dtype = BOOL8
+    else:
+        res = res.astype(a.dtype.jnp_dtype)
+    return Column(data=res, validity=a.validity, dtype=out_dtype)
+
+
+def is_null(a: Column) -> Column:
+    mask = (~a.valid_mask()).astype(jnp.uint8)
+    return Column(data=mask, dtype=BOOL8)
+
+
+def is_valid(a: Column) -> Column:
+    return Column(data=a.valid_mask().astype(jnp.uint8), dtype=BOOL8)
+
+
+def fill_null(a: Column, value) -> Column:
+    """Replace nulls with a scalar (cudf ``replace_nulls``)."""
+    if a.validity is None:
+        return a
+    if a.dtype.is_string:
+        from .strings import fill_null_strings
+        return fill_null_strings(a, value)
+    data = jnp.where(a.validity, a.data, a.data.dtype.type(value))
+    return Column(data=data, dtype=a.dtype)
+
+
+def if_else(cond: Column, a: Operand, b: Operand) -> Column:
+    """Row-wise select (cudf ``copy_if_else``): where cond true -> a else b."""
+    pred = cond.data != 0
+    if cond.validity is not None:
+        pred = pred & cond.validity
+    xa, xb = _payload(a), _payload(b)
+    dtype = a.dtype if isinstance(a, Column) else b.dtype
+    data = jnp.where(pred, xa, xb).astype(dtype.jnp_dtype)
+    validity = None
+    va = a.validity if isinstance(a, Column) else None
+    vb = b.validity if isinstance(b, Column) else None
+    if va is not None or vb is not None:
+        ma = va if va is not None else jnp.ones(cond.size, jnp.bool_)
+        mb = vb if vb is not None else jnp.ones(cond.size, jnp.bool_)
+        validity = jnp.where(pred, ma, mb)
+    return Column(data=data, validity=validity, dtype=dtype)

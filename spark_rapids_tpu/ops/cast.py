@@ -1,0 +1,67 @@
+"""Type casts, including decimal scale arithmetic.
+
+Covers the cast surface of the reference envelope (cuDF ``cast`` +
+the decimal semantics the JNI schema wire format carries — scale as a base-10
+exponent, value = unscaled * 10**scale; RowConversionJni.cpp:56-61).
+
+Numeric cast semantics follow cuDF: float -> int truncates toward zero;
+out-of-range is undefined behavior (we document XLA's saturation on TPU);
+bool casts map nonzero -> True.  Decimal rescaling multiplies/divides by
+powers of ten with truncation toward zero (cudf fixed_point::rescaled).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..column import Column
+from ..dtypes import BOOL8, DType, TypeId
+
+
+def cast(col: Column, to: DType) -> Column:
+    """Cast a fixed-width column to another fixed-width dtype."""
+    if col.dtype == to:
+        return col
+    if not col.dtype.is_fixed_width or not to.is_fixed_width:
+        raise ValueError(f"cast {col.dtype!r} -> {to!r}: both must be fixed width")
+
+    src, dst = col.dtype, to
+    data = col.data
+
+    if src.is_decimal and dst.is_decimal:
+        data = _rescale(data.astype(dst.jnp_dtype), src.scale, dst.scale)
+    elif src.is_decimal:
+        # decimal -> numeric: apply the scale
+        if dst.is_floating:
+            data = data.astype(jnp.float64) * (10.0 ** src.scale)
+            data = data.astype(dst.jnp_dtype)
+        else:
+            data = _rescale(data.astype(jnp.int64), src.scale, 0).astype(dst.jnp_dtype)
+    elif dst.is_decimal:
+        # numeric -> decimal: quantize into the target scale
+        if src.is_floating:
+            scaled = data.astype(jnp.float64) * (10.0 ** -dst.scale)
+            data = jnp.trunc(scaled).astype(dst.jnp_dtype)
+        else:
+            data = _rescale(data.astype(dst.jnp_dtype), 0, dst.scale)
+    elif dst == BOOL8:
+        data = (data != 0).astype(jnp.uint8)
+    elif src == BOOL8:
+        data = (data != 0).astype(dst.jnp_dtype)
+    else:
+        data = data.astype(dst.jnp_dtype)
+
+    return Column(data=data, validity=col.validity, dtype=to)
+
+
+def _rescale(unscaled, from_scale: int, to_scale: int):
+    """Move a base-10 fixed-point value between scales, truncating toward zero."""
+    diff = from_scale - to_scale
+    if diff == 0:
+        return unscaled
+    if diff > 0:
+        return unscaled * (10 ** diff)
+    factor = 10 ** (-diff)
+    # integer division truncating toward zero (jnp // floors)
+    q = jnp.abs(unscaled) // factor
+    return jnp.where(unscaled < 0, -q, q).astype(unscaled.dtype)

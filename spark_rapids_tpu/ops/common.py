@@ -1,0 +1,68 @@
+"""Shared machinery for the eager ops layer.
+
+The engine's execution model mirrors the reference system's (cuDF is an eager
+GPU library driven by the Spark plugin): each op executes immediately, with
+its pure compute expressed as jitted XLA programs cached per schema/shape.
+Ops whose *output size* is data dependent (filter, join, distinct groups)
+materialize one scalar count on host — the TPU analog of the reference's
+host-side batching decisions (row_conversion.cu:476-511) — then run a
+fixed-shape kernel.  XLA requires static shapes; recompilation is bounded by
+bucketing such sizes to powers of two where it matters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+
+
+def pow2_bucket(n: int) -> int:
+    """Round up to a power of two (minimum 1) to bound shape-recompiles."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def compact_indices(mask: jax.Array) -> jax.Array:
+    """Indices of True entries, in order — the dynamic-shape boundary.
+
+    One host sync for the count, then a stable argsort moves selected rows to
+    the front (False sorts after True is arranged via key inversion).  This is
+    the TPU replacement for stream-compaction scatters.
+    """
+    count = int(jnp.sum(mask))
+    order = jnp.argsort(~mask, stable=True)
+    return order[:count]
+
+
+def null_safe_equal_adjacent(col: Column) -> jax.Array:
+    """For a sorted column: mask[i] = row i differs from row i-1 (grouping
+    equality: null == null, NaN == NaN per Spark/cuDF). mask[0] is True."""
+    data = col.data
+    neq = data[1:] != data[:-1]
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        both_nan = (data[1:] != data[1:]) & (data[:-1] != data[:-1])
+        neq = neq & ~both_nan
+    if col.validity is not None:
+        v = col.validity
+        both_null = ~v[1:] & ~v[:-1]
+        null_differs = v[1:] != v[:-1]
+        neq = (neq & ~both_null) | null_differs
+    return jnp.concatenate([jnp.ones(1, jnp.bool_), neq])
+
+
+def grouping_columns(cols: list[Column]) -> list[Column]:
+    """Map key columns to group/compare-friendly forms: STRING columns become
+    lexicographically-ordered INT32 dictionary codes (validity preserved),
+    everything else passes through."""
+    out = []
+    for col in cols:
+        if col.offsets is not None:
+            from .strings import dictionary_encode
+            codes, _ = dictionary_encode(col)
+            out.append(codes)
+        else:
+            out.append(col)
+    return out

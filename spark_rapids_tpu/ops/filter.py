@@ -1,0 +1,35 @@
+"""Row filtering / stream compaction."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..table import Table
+from .common import compact_indices
+
+
+def apply_boolean_mask(table: Table, mask) -> Table:
+    """Keep rows where ``mask`` is True (null mask entries drop the row,
+    cudf ``apply_boolean_mask`` semantics)."""
+    if isinstance(mask, Column):
+        keep = mask.data.astype(jnp.bool_)
+        if mask.validity is not None:
+            keep = keep & mask.validity
+    else:
+        keep = jnp.asarray(mask).astype(jnp.bool_)
+    if keep.shape[0] != table.num_rows:
+        raise ValueError("mask length must equal table row count")
+    return table.gather(compact_indices(keep))
+
+
+def drop_nulls(table: Table, subset=None) -> Table:
+    """Drop rows with a null in any of ``subset`` (default: all columns)."""
+    names = list(table.names) if subset is None else list(subset)
+    keep = jnp.ones(table.num_rows, jnp.bool_)
+    for name in names:
+        col = table[name]
+        if col.validity is not None:
+            keep = keep & col.validity
+    return table.gather(compact_indices(keep))

@@ -1,0 +1,197 @@
+"""Group-by aggregation, sort-based.
+
+TPU-first redesign of the hash-groupby a GPU engine uses (cuDF's groupby is
+part of the reference's capability envelope; BASELINE.json names groupby
+throughput as a headline metric): hash tables need scatter-to-random-address,
+which the TPU memory system punishes, so groups are formed by the native
+multi-key sort (:mod:`.sort`), adjacent-difference boundaries, and
+segment reductions over sorted runs.
+
+One host sync materializes the group count; segment reductions run with the
+group count bucketed to a power of two so jit caches stay small.
+
+Null semantics follow cuDF/Spark: null keys form their own group (null ==
+null for grouping); null *values* are excluded from aggregations; an
+all-null group aggregates to null (except counts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..dtypes import (DType, FLOAT64, INT64, TypeId, UINT64)
+from ..table import Table
+from .common import (compact_indices, grouping_columns,
+                     null_safe_equal_adjacent, pow2_bucket)
+from .sort import sorted_order
+
+#: Aggregations supported (cuDF basic set).
+AGGS = ("count", "count_all", "sum", "min", "max", "mean", "first", "last",
+        "var", "std")
+
+
+def _sum_dtype(dtype: DType) -> DType:
+    """Accumulation/result type for sums (Spark semantics: widen)."""
+    if dtype.is_floating:
+        return FLOAT64
+    if dtype.type_id in (TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64):
+        return UINT64
+    if dtype.type_id == TypeId.DECIMAL32 or dtype.type_id == TypeId.DECIMAL64:
+        return DType(TypeId.DECIMAL64, dtype.scale)
+    return INT64
+
+
+def _minmax_identity(dtype: DType, for_min: bool):
+    np_dt = dtype.np_dtype
+    if dtype.is_floating:
+        return np_dt.type(np.inf if for_min else -np.inf)
+    info = np.iinfo(np_dt)
+    return np_dt.type(info.max if for_min else info.min)
+
+
+class GroupByResult:
+    """Carrier so ``groupby(t, keys).agg(...)`` reads naturally."""
+
+    def __init__(self, table: Table, keys: Sequence[str]):
+        self._table = table
+        self._keys = list(keys)
+
+    def agg(self, aggs: dict[str, Sequence[str] | str]) -> Table:
+        spec = []
+        for col, hows in aggs.items():
+            if isinstance(hows, str):
+                hows = [hows]
+            for how in hows:
+                out_name = col if len(hows) == 1 else f"{col}_{how}"
+                spec.append((col, how, out_name))
+        return groupby_agg(self._table, self._keys, spec)
+
+
+def groupby(table: Table, keys: Sequence[str] | str) -> GroupByResult:
+    if isinstance(keys, str):
+        keys = [keys]
+    return GroupByResult(table, keys)
+
+
+def groupby_agg(table: Table, keys: Sequence[str],
+                aggs: Sequence[tuple[str, str, str]]) -> Table:
+    """Aggregate ``aggs`` = [(value_col, how, out_name), ...] grouped by ``keys``.
+
+    Output: one row per group, key columns first (group order = sorted key
+    order), then aggregate columns.
+    """
+    for _, how, _ in aggs:
+        if how not in AGGS:
+            raise ValueError(f"unsupported aggregation {how!r} (have {AGGS})")
+
+    if table.num_rows == 0:
+        return _empty_result(table, keys, aggs)
+
+    # Encode keys once (strings -> dictionary codes), sort, find boundaries.
+    key_cols = grouping_columns([table[k] for k in keys])
+    perm = sorted_order(key_cols)
+    sorted_tbl = table.gather(perm)
+
+    # Group boundaries over the sorted keys (null == null, NaN == NaN).
+    boundary = jnp.zeros(table.num_rows, jnp.bool_)
+    for kc in key_cols:
+        boundary = boundary | null_safe_equal_adjacent(kc.gather(perm))
+    group_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    starts = compact_indices(boundary)          # host sync: group count
+    num_groups = int(starts.shape[0])
+    seg_count = pow2_bucket(num_groups)
+
+    out: list[tuple[str, Column]] = []
+    for k in keys:
+        out.append((k, sorted_tbl[k].gather(starts)))
+
+    ends = None
+    for value_name, how, out_name in aggs:
+        col = sorted_tbl[value_name]
+        if how in ("first", "last"):
+            if ends is None:
+                n = table.num_rows
+                ends = jnp.concatenate([starts[1:] - 1,
+                                        jnp.array([n - 1], starts.dtype)])
+            idx = starts if how == "first" else ends
+            out.append((out_name, col.gather(idx)))
+            continue
+        out.append((out_name, _segment_agg(col, group_id, seg_count,
+                                           num_groups, how)))
+    return Table(out)
+
+
+def _empty_result(table: Table, keys: Sequence[str],
+                  aggs: Sequence[tuple[str, str, str]]) -> Table:
+    out: list[tuple[str, Column]] = []
+    for k in keys:
+        out.append((k, table[k]))
+    for value_name, how, out_name in aggs:
+        src = table[value_name]
+        if how in ("count", "count_all"):
+            dtype = INT64
+        elif how == "sum":
+            dtype = _sum_dtype(src.dtype)
+        elif how in ("mean", "var", "std"):
+            dtype = FLOAT64
+        else:
+            dtype = src.dtype
+        out.append((out_name, Column(data=jnp.zeros(0, dtype.jnp_dtype),
+                                     dtype=dtype)))
+    return Table(out)
+
+
+def _segment_agg(col: Column, group_id: jax.Array, seg_count: int,
+                 num_groups: int, how: str) -> Column:
+    valid = col.valid_mask()
+    counts = jax.ops.segment_sum(valid.astype(jnp.int64), group_id,
+                                 num_segments=seg_count)[:num_groups]
+    if how == "count":
+        return Column(data=counts, dtype=INT64)
+    if how == "count_all":
+        ones = jnp.ones(col.size, jnp.int64)
+        all_counts = jax.ops.segment_sum(ones, group_id,
+                                         num_segments=seg_count)[:num_groups]
+        return Column(data=all_counts, dtype=INT64)
+
+    data = col.data
+    has_valid = counts > 0
+
+    if how in ("sum", "mean", "var", "std"):
+        acc_dtype = _sum_dtype(col.dtype)
+        vals = jnp.where(valid, data, data.dtype.type(0)).astype(acc_dtype.jnp_dtype)
+        sums = jax.ops.segment_sum(vals, group_id,
+                                   num_segments=seg_count)[:num_groups]
+        if how == "sum":
+            return Column(data=sums, validity=has_valid, dtype=acc_dtype)
+        # mean/var/std return logical FLOAT64 values: decimals apply 10**scale.
+        scale_factor = 10.0 ** col.dtype.scale if col.dtype.is_decimal else 1.0
+        fsums = sums.astype(jnp.float64) * scale_factor
+        fcounts = counts.astype(jnp.float64)
+        if how == "mean":
+            mean = fsums / jnp.maximum(fcounts, 1.0)
+            return Column(data=mean, validity=has_valid, dtype=FLOAT64)
+        # var/std (ddof=1, Spark sample variance)
+        sq = jnp.where(valid, data.astype(jnp.float64) * scale_factor, 0.0) ** 2
+        sumsq = jax.ops.segment_sum(sq, group_id,
+                                    num_segments=seg_count)[:num_groups]
+        denom = jnp.maximum(fcounts - 1.0, 1.0)
+        var = (sumsq - fsums * fsums / jnp.maximum(fcounts, 1.0)) / denom
+        var = jnp.maximum(var, 0.0)             # clamp fp round-off
+        ok = counts > 1
+        if how == "var":
+            return Column(data=var, validity=ok, dtype=FLOAT64)
+        return Column(data=jnp.sqrt(var), validity=ok, dtype=FLOAT64)
+
+    # min / max
+    for_min = how == "min"
+    ident = _minmax_identity(col.dtype, for_min)
+    vals = jnp.where(valid, data, ident)
+    seg = jax.ops.segment_min if for_min else jax.ops.segment_max
+    res = seg(vals, group_id, num_segments=seg_count)[:num_groups]
+    return Column(data=res, validity=has_valid, dtype=col.dtype)

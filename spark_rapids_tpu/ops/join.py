@@ -1,0 +1,152 @@
+"""Equi-joins, sort-based (the reference envelope's "hash join", re-architected).
+
+BASELINE.json names hash-join throughput as a headline metric, but hash
+probes scatter to random addresses — hostile to TPU memory.  Idiomatic
+replacement (SURVEY.md §7): factorize the join keys over the *union* of both
+sides with one multi-key sort (key equality becomes dense int32 group-id
+equality), then merge with vectorized ``searchsorted`` + prefix-sum
+expansion.  Every step is a sort, scan, gather, or segmented arithmetic —
+all TPU-native patterns.
+
+Null join keys never match (Spark/cuDF equi-join semantics): null-key rows
+get side-distinct sentinel group ids.
+
+Output-size materialization: one host sync for the total match count
+(inherent — the result shape is data dependent), then fixed-shape gathers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column, all_null_column
+from ..table import Table
+from .common import compact_indices, grouping_columns, null_safe_equal_adjacent
+from .sort import sorted_order
+
+
+def _factorize_union(left: Table, right: Table, left_on: Sequence[str],
+                     right_on: Sequence[str]) -> tuple[jax.Array, jax.Array]:
+    """Dense group ids for the key tuples of both sides, consistent across
+    sides; rows with any null key get a non-matching sentinel (-1 left,
+    -2 right)."""
+    n_left = left.num_rows
+    merged_cols = []
+    for lname, rname in zip(left_on, right_on):
+        lc, rc = left[lname], right[rname]
+        if lc.dtype != rc.dtype:
+            raise ValueError(
+                f"join key dtype mismatch: {lname}={lc.dtype!r} vs "
+                f"{rname}={rc.dtype!r} (cast first)")
+        if lc.offsets is not None:
+            from .strings import concat_columns
+            merged_cols.append(concat_columns([lc, rc]))
+            continue
+        data = jnp.concatenate([lc.data, rc.data])
+        validity = None
+        if lc.validity is not None or rc.validity is not None:
+            validity = jnp.concatenate([lc.valid_mask(), rc.valid_mask()])
+        merged_cols.append(Column(data=data, validity=validity, dtype=lc.dtype))
+    merged_cols = grouping_columns(merged_cols)   # strings -> dictionary codes
+
+    perm = sorted_order(merged_cols)
+    boundary = jnp.zeros(perm.shape[0], jnp.bool_)
+    for col in merged_cols:
+        boundary = boundary | null_safe_equal_adjacent(col.gather(perm))
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    gid = jnp.zeros(perm.shape[0], jnp.int32).at[perm].set(gid_sorted)
+
+    any_null = jnp.zeros(perm.shape[0], jnp.bool_)
+    for col in merged_cols:
+        if col.validity is not None:
+            any_null = any_null | ~col.validity
+    gid = jnp.where(any_null,
+                    jnp.where(jnp.arange(gid.shape[0]) < n_left, -1, -2),
+                    gid)
+    return gid[:n_left], gid[n_left:]
+
+
+def _suffix_overlaps(left: Table, right: Table, drop_right: set[str],
+                     suffixes: tuple[str, str]) -> tuple[Table, list[tuple[str, str]]]:
+    """Resolve output column names; returns (renamed left, right name pairs)."""
+    right_names = [(n, n) for n in right.names if n not in drop_right]
+    overlap = set(left.names) & {n for n, _ in right_names}
+    if overlap:
+        left = left.rename({n: n + suffixes[0] for n in overlap})
+        right_names = [(n, n + suffixes[1] if n in overlap else n)
+                       for n, _ in right_names]
+    return left, right_names
+
+
+def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
+         left_on: Optional[Sequence[str]] = None,
+         right_on: Optional[Sequence[str]] = None,
+         how: str = "inner", suffixes: tuple[str, str] = ("_x", "_y")) -> Table:
+    """Equi-join two tables.
+
+    ``how``: "inner", "left", "semi" (left rows with a match), or
+    "anti" (left rows without a match).
+    """
+    if how not in ("inner", "left", "semi", "anti"):
+        raise ValueError(f"unsupported join type {how!r}")
+    if on is not None:
+        if isinstance(on, str):
+            on = [on]
+        left_on = right_on = list(on)
+    if not left_on or not right_on or len(left_on) != len(right_on):
+        raise ValueError("join keys: pass `on=` or matching left_on/right_on")
+
+    lgid, rgid = _factorize_union(left, right, left_on, right_on)
+
+    # Sort the right side's group ids once; probe with searchsorted.
+    rorder = jnp.argsort(rgid, stable=True)
+    rgid_sorted = rgid[rorder]
+    lo = jnp.searchsorted(rgid_sorted, lgid, side="left")
+    hi = jnp.searchsorted(rgid_sorted, lgid, side="right")
+    counts = (hi - lo).astype(jnp.int64)
+
+    if how == "semi":
+        return left.gather(compact_indices(counts > 0))
+    if how == "anti":
+        return left.gather(compact_indices(counts == 0))
+
+    keep_right_gid_cols = set()
+    if on is not None:
+        keep_right_gid_cols = set(on)   # de-dup shared key columns
+    left_out, right_names = _suffix_overlaps(left, right, keep_right_gid_cols,
+                                             suffixes)
+
+    if how == "left":
+        out_counts = jnp.maximum(counts, 1)
+        if right.num_rows == 0:   # degenerate: all-null right side
+            cols = [(n, c) for n, c in left_out.items()]
+            for src_name, out_name in right_names:
+                cols.append((out_name,
+                             all_null_column(right[src_name].dtype, left.num_rows)))
+            return Table(cols)
+    else:
+        out_counts = counts
+    out_starts = jnp.cumsum(out_counts) - out_counts      # exclusive prefix sum
+    total = int(out_counts.sum())                         # host sync
+
+    pos = jnp.arange(total, dtype=jnp.int64)
+    # left row for each output position
+    bounds = out_starts + out_counts                      # == inclusive cumsum
+    lrow = jnp.searchsorted(bounds, pos, side="right").astype(jnp.int32)
+    k = pos - out_starts[lrow]
+    rpos = lo[lrow] + k
+    matched = counts[lrow] > 0
+    rrow = rorder[jnp.clip(rpos, 0, max(rgid_sorted.shape[0] - 1, 0))]
+
+    cols: list[tuple[str, Column]] = []
+    for name, col in left_out.items():
+        cols.append((name, col.gather(lrow)))
+    for src_name, out_name in right_names:
+        g = right[src_name].gather(rrow)
+        if how == "left":
+            g = g.with_validity(g.valid_mask() & matched)
+        cols.append((out_name, g))
+    return Table(cols)

@@ -1,0 +1,62 @@
+"""Whole-column reductions (cudf ``reduce`` surface)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+
+
+def _valid_data(col: Column, identity):
+    if col.validity is None:
+        return col.data, col.size
+    data = jnp.where(col.validity, col.data, col.data.dtype.type(identity))
+    return data, int(jnp.sum(col.validity))
+
+
+def sum(col: Column):  # noqa: A001 - cudf-style name
+    """Sum of valid values.  Returns the *logical* value: decimals apply
+    their 10**scale factor (as a float)."""
+    data, n = _valid_data(col, 0)
+    if n == 0:
+        return None
+    from .groupby import _sum_dtype
+    total = jnp.sum(data.astype(_sum_dtype(col.dtype).jnp_dtype)).item()
+    if col.dtype.is_decimal:
+        return total * (10.0 ** col.dtype.scale)
+    return total
+
+
+def count(col: Column) -> int:
+    return col.size - col.null_count()
+
+
+def minimum(col: Column):
+    if col.dtype.is_floating:
+        ident = np.inf
+    else:
+        ident = np.iinfo(col.dtype.np_dtype).max
+    data, n = _valid_data(col, ident)
+    if n == 0:
+        return None
+    return jnp.min(data).item()
+
+
+def maximum(col: Column):
+    if col.dtype.is_floating:
+        ident = -np.inf
+    else:
+        ident = np.iinfo(col.dtype.np_dtype).min
+    data, n = _valid_data(col, ident)
+    if n == 0:
+        return None
+    return jnp.max(data).item()
+
+
+def mean(col: Column):
+    data, n = _valid_data(col, 0)
+    if n == 0:
+        return None
+    scale = 10.0 ** col.dtype.scale if col.dtype.is_decimal else 1.0
+    return (jnp.sum(data.astype(jnp.float64)) * scale / n).item()

@@ -1,0 +1,84 @@
+"""Multi-key stable sort.
+
+TPU-first design: a single ``jax.lax.sort`` call with ``num_keys`` operands —
+XLA's native lexicographic multi-key sort, which lowers to the TPU's
+sort HLO — instead of the hash/radix machinery a GPU engine would use
+(sort is the workhorse here: groupby and join are built on it, because
+scatter-to-random-address hash tables are hostile to the TPU memory system;
+see SURVEY.md §7 "Hard parts").
+
+Null ordering is encoded as a leading rank key per sort key (0/1 before the
+value), so nulls group deterministically without sentinel values; descending
+order inverts integer keys bitwise (``~x``, total-order-preserving, no
+overflow) and negates floats after NaN canonicalization (XLA total order then
+places NaN consistently: ascending -> after +inf, Spark/cuDF semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..column import Column
+from ..table import Table
+
+
+def _canonicalize_nan(x: jax.Array) -> jax.Array:
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.where(x != x, jnp.array(jnp.nan, x.dtype), x)
+    return x
+
+
+def _descending_key(x: jax.Array) -> jax.Array:
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return -x            # after NaN canonicalization: -NaN sorts first
+    if x.dtype == jnp.bool_:
+        return ~x
+    return ~x                # bitwise complement: order-inverting for ints
+
+
+def sort_operands(columns: Sequence[Column], ascending: Sequence[bool],
+                  nulls_first: Sequence[bool]) -> list[jax.Array]:
+    """Build the lax.sort key operands (2 per column: null rank, value)."""
+    from .common import grouping_columns
+    ops: list[jax.Array] = []
+    for col, asc, nf in zip(grouping_columns(list(columns)), ascending, nulls_first):
+        valid = col.valid_mask()
+        # rank 0 sorts first. nulls_first -> nulls rank 0.
+        null_rank = jnp.where(valid, jnp.uint8(1 if nf else 0),
+                              jnp.uint8(0 if nf else 1))
+        val = _canonicalize_nan(col.data)
+        if not asc:
+            val = _descending_key(val)
+        ops.append(null_rank)
+        ops.append(val)
+    return ops
+
+
+def sorted_order(columns: Sequence[Column],
+                 ascending: Optional[Sequence[bool]] = None,
+                 nulls_first: Optional[Sequence[bool]] = None) -> jax.Array:
+    """Stable permutation that sorts by the given key columns."""
+    n = columns[0].size
+    if ascending is None:
+        ascending = [True] * len(columns)
+    if nulls_first is None:
+        # Spark default: nulls first when ascending, last when descending.
+        nulls_first = [a for a in ascending]
+    ops = sort_operands(columns, ascending, nulls_first)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    out = lax.sort(ops + [iota], dimension=0, is_stable=True, num_keys=len(ops))
+    return out[-1]
+
+
+def sort_by(table: Table, by: Union[str, Sequence[str]],
+            ascending: Optional[Sequence[bool]] = None,
+            nulls_first: Optional[Sequence[bool]] = None) -> Table:
+    """Sort a table by key columns (stable, multi-key, null-order aware)."""
+    if isinstance(by, str):
+        by = [by]
+    perm = sorted_order([table[name] for name in by], ascending, nulls_first)
+    return table.gather(perm)

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dtypes import STRING
+from ..dtypes import INT32, STRING
 from ..column import Column
 
 
@@ -60,6 +60,62 @@ def strings_to_pylist(col: Column) -> list[Optional[str]]:
         else:
             out.append(bytes(chars[offsets[i]:offsets[i + 1]]).decode("utf-8"))
     return out
+
+
+def concat_columns(cols: list[Column]) -> Column:
+    """Concatenate string columns row-wise (axis 0)."""
+    offsets_parts = [np.asarray(cols[0].offsets)]
+    base = int(offsets_parts[0][-1])
+    for c in cols[1:]:
+        off = np.asarray(c.offsets)
+        offsets_parts.append(off[1:] + base)
+        base += int(off[-1])
+    offsets = jnp.asarray(np.concatenate(offsets_parts))
+    chars = jnp.concatenate([c.data for c in cols])
+    validity = None
+    if any(c.validity is not None for c in cols):
+        validity = jnp.concatenate([c.valid_mask() for c in cols])
+    return Column(data=chars, validity=validity, offsets=offsets, dtype=STRING)
+
+
+def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
+    """Factorize strings to INT32 codes whose order matches lexicographic
+    (byte-wise) string order, plus the sorted unique values.
+
+    Host-assisted (np.unique over the materialized strings): an eager op in
+    the engine's host-driven model.  The codes column preserves validity, so
+    sort/groupby/join can operate on codes with unchanged null semantics.
+    Device-native string comparison is a planned Pallas optimization.
+    """
+    chars = np.asarray(col.data, dtype=np.uint8)
+    offsets = np.asarray(col.offsets)
+    mask = None if col.validity is None else np.asarray(col.validity)
+    values = []
+    for i in range(len(offsets) - 1):
+        if mask is not None and not mask[i]:
+            values.append(b"")          # placeholder; row is null
+        else:
+            values.append(chars[offsets[i]:offsets[i + 1]].tobytes())
+    uniq, codes = np.unique(np.array(values, dtype=object), return_inverse=True)
+    codes_col = Column(data=jnp.asarray(codes.astype(np.int32)),
+                       validity=col.validity, dtype=INT32)
+    return codes_col, [u.decode("utf-8") for u in uniq]
+
+
+def fill_null_strings(col: Column, value: str) -> Column:
+    """Replace null rows with ``value`` (cudf ``replace_nulls`` for strings).
+
+    Device formulation: append the replacement as one extra row, then gather
+    with indices redirected to it for null rows.
+    """
+    if col.validity is None:
+        return col
+    n = col.size
+    extra = strings_from_pylist([value])
+    widened = concat_columns([col.with_validity(None), extra])
+    indices = jnp.where(col.validity, jnp.arange(n, dtype=jnp.int32), n)
+    out = strings_gather(widened, indices)
+    return out.with_validity(None)
 
 
 def strings_gather(col: Column, indices) -> Column:

@@ -1,0 +1,418 @@
+"""Ops layer tests: cast, binary, filter, sort, groupby, join, reductions.
+
+Oracle strategy mirrors the reference's (round-trip/self-consistency plus
+known-answer tables); pandas is used as an independent oracle for the random
+sweeps.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu import ops
+from spark_rapids_tpu.ops import reductions
+
+
+class TestCast:
+    def test_int_widen_narrow(self):
+        c = Column.from_pylist([1, None, 300], dt.INT32)
+        assert ops.cast(c, dt.INT64).to_pylist() == [1, None, 300]
+        assert ops.cast(c, dt.INT16).to_pylist() == [1, None, 300]
+        assert ops.cast(c, dt.INT8).to_pylist() == [1, None, 300 - 256]
+
+    def test_float_to_int_truncates(self):
+        c = Column.from_pylist([1.9, -1.9, None], dt.FLOAT64)
+        assert ops.cast(c, dt.INT32).to_pylist() == [1, -1, None]
+
+    def test_bool_casts(self):
+        c = Column.from_pylist([0, 5, None], dt.INT32)
+        assert ops.cast(c, dt.BOOL8).to_pylist() == [False, True, None]
+        b = Column.from_pylist([True, False, None], dt.BOOL8)
+        assert ops.cast(b, dt.INT64).to_pylist() == [1, 0, None]
+
+    def test_decimal_rescale(self):
+        c = Column.from_pylist([12345, -678, None], dt.decimal64(-2))  # 123.45, -6.78
+        up = ops.cast(c, dt.decimal64(-4))
+        assert up.to_pylist() == [1234500, -67800, None]
+        down = ops.cast(c, dt.decimal64(-1))   # truncation toward zero
+        assert down.to_pylist() == [1234, -67, None]
+
+    def test_decimal_to_float_and_back(self):
+        c = Column.from_pylist([12345], dt.decimal32(-2))
+        f = ops.cast(c, dt.FLOAT64)
+        assert f.to_pylist() == [123.45]
+        back = ops.cast(f, dt.decimal64(-2))
+        assert back.to_pylist() == [12345]
+
+    def test_decimal_to_int_truncates(self):
+        c = Column.from_pylist([199, -199], dt.decimal32(-2))  # 1.99, -1.99
+        assert ops.cast(c, dt.INT32).to_pylist() == [1, -1]
+
+
+class TestBinary:
+    def test_null_propagation(self):
+        a = Column.from_pylist([1, None, 3], dt.INT64)
+        b = Column.from_pylist([10, 20, None], dt.INT64)
+        assert ops.binary_op(a, b, "add").to_pylist() == [11, None, None]
+
+    def test_scalar_broadcast(self):
+        a = Column.from_pylist([1, None, 3], dt.INT64)
+        assert ops.binary_op(a, 5, "mul").to_pylist() == [5, None, 15]
+
+    def test_comparisons_produce_bool8(self):
+        a = Column.from_pylist([1, 2, None], dt.INT32)
+        r = ops.binary_op(a, 2, "lt")
+        assert r.dtype == dt.BOOL8
+        assert r.to_pylist() == [True, False, None]
+
+    def test_int_division_promotes_to_float(self):
+        a = Column.from_pylist([7, 8], dt.INT32)
+        r = ops.binary_op(a, 2, "truediv")
+        assert r.dtype == dt.FLOAT64
+        assert r.to_pylist() == [3.5, 4.0]
+
+    def test_decimal_add_same_scale(self):
+        a = Column.from_pylist([100], dt.decimal64(-2))
+        b = Column.from_pylist([23], dt.decimal64(-2))
+        r = ops.binary_op(a, b, "add")
+        assert r.dtype == dt.decimal64(-2)
+        assert r.to_pylist() == [123]
+
+    def test_decimal_mul_adds_scales(self):
+        a = Column.from_pylist([150], dt.decimal64(-2))   # 1.50
+        b = Column.from_pylist([200], dt.decimal64(-2))   # 2.00
+        r = ops.binary_op(a, b, "mul")
+        assert r.dtype == dt.decimal64(-4)
+        assert r.to_pylist() == [30000]                   # 3.0000
+
+    def test_if_else_and_fill_null(self):
+        cond = Column.from_pylist([True, False, True], dt.BOOL8)
+        a = Column.from_pylist([1, 2, None], dt.INT64)
+        r = ops.if_else(cond, a, -1)
+        assert r.to_pylist()[:2] == [1, -1]
+        assert ops.fill_null(a, 0).to_pylist() == [1, 2, 0]
+
+    def test_is_null(self):
+        a = Column.from_pylist([1, None], dt.INT64)
+        assert ops.is_null(a).to_pylist() == [False, True]
+
+
+class TestFilter:
+    def test_mask_filter(self):
+        t = Table.from_pydict({"a": [1, 2, 3, 4], "s": ["w", "x", "y", "z"]})
+        out = ops.apply_boolean_mask(t, jnp.array([True, False, True, False]))
+        assert out.to_pydict() == {"a": [1, 3], "s": ["w", "y"]}
+
+    def test_null_mask_drops(self):
+        t = Table.from_pydict({"a": [1, 2, 3]})
+        mask = Column.from_pylist([True, None, True], dt.BOOL8)
+        assert ops.apply_boolean_mask(t, mask).to_pydict() == {"a": [1, 3]}
+
+    def test_drop_nulls(self):
+        t = Table.from_pydict({"a": [1, None, 3], "b": [None, 2.0, 3.0]})
+        assert ops.drop_nulls(t).to_pydict() == {"a": [3], "b": [3.0]}
+        assert ops.drop_nulls(t, ["a"]).to_pydict() == {"a": [1, 3], "b": [None, 3.0]}
+
+
+class TestSort:
+    def test_single_key_with_nulls(self):
+        t = Table.from_pydict({"k": [3, None, 1, 2]})
+        out = ops.sort_by(t, "k")
+        assert out.to_pydict() == {"k": [None, 1, 2, 3]}   # nulls first (asc)
+
+    def test_descending_nulls_last(self):
+        t = Table.from_pydict({"k": [3, None, 1, 2]})
+        out = ops.sort_by(t, "k", ascending=[False])
+        assert out.to_pydict() == {"k": [3, 2, 1, None]}
+
+    def test_multi_key_stable(self):
+        t = Table.from_pydict({"a": [1, 2, 1, 2, 1], "b": [9, 8, 7, 6, 5],
+                               "tag": [0, 1, 2, 3, 4]})
+        out = ops.sort_by(t, ["a", "b"])
+        assert out.to_pydict()["a"] == [1, 1, 1, 2, 2]
+        assert out.to_pydict()["b"] == [5, 7, 9, 6, 8]
+
+    def test_mixed_direction(self):
+        t = Table.from_pydict({"a": [1, 2, 1, 2], "b": [5, 6, 7, 8]})
+        out = ops.sort_by(t, ["a", "b"], ascending=[True, False])
+        assert out.to_pydict()["b"] == [7, 5, 8, 6]
+
+    def test_nan_sorts_last_ascending(self):
+        t = Table.from_pydict({"k": [float("nan"), 1.0, 2.0]},
+                              dtypes={"k": dt.FLOAT64})
+        got = ops.sort_by(t, "k").to_pydict()["k"]
+        assert got[:2] == [1.0, 2.0] and np.isnan(got[2])
+
+    def test_float_descending(self):
+        t = Table.from_pydict({"k": [1.5, -2.0, 0.5]}, dtypes={"k": dt.FLOAT64})
+        assert ops.sort_by(t, "k", ascending=[False]).to_pydict()["k"] == [1.5, 0.5, -2.0]
+
+    def test_random_sweep_vs_pandas(self, rng):
+        n = 1000
+        a = rng.integers(0, 50, n)
+        b = rng.standard_normal(n)
+        t = Table.from_pydict({"a": a.astype(np.int64).tolist(),
+                               "b": b.tolist()},
+                              dtypes={"a": dt.INT64, "b": dt.FLOAT64})
+        got = ops.sort_by(t, ["a", "b"]).to_pydict()
+        exp = pd.DataFrame({"a": a, "b": b}).sort_values(["a", "b"], kind="stable")
+        assert got["a"] == exp["a"].tolist()
+        assert got["b"] == exp["b"].tolist()
+
+
+class TestGroupBy:
+    def test_basic_aggs(self):
+        t = Table.from_pydict({"k": [1, 2, 1, 2, 1], "v": [10, 20, 30, None, 50]},
+                              dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": ["sum", "count", "min", "max", "mean"]})
+        assert out.to_pydict() == {
+            "k": [1, 2],
+            "v_sum": [90, 20],
+            "v_count": [3, 1],
+            "v_min": [10, 20],
+            "v_max": [50, 20],
+            "v_mean": [30.0, 20.0],
+        }
+
+    def test_null_key_is_a_group(self):
+        t = Table.from_pydict({"k": [1, None, 1, None], "v": [1, 2, 3, 4]},
+                              dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": "sum"})
+        assert out.to_pydict() == {"k": [None, 1], "v": [6, 4]}
+
+    def test_all_null_group_sum_is_null(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "v": [None, None, 5]},
+                              dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": ["sum", "count", "min"]})
+        assert out.to_pydict()["v_sum"] == [None, 5]
+        assert out.to_pydict()["v_count"] == [0, 1]
+        assert out.to_pydict()["v_min"] == [None, 5]
+
+    def test_first_last(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "v": [10, 20, 30]},
+                              dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": ["first", "last"]})
+        assert out.to_pydict()["v_first"] == [10, 30]
+        assert out.to_pydict()["v_last"] == [20, 30]
+
+    def test_multi_key(self):
+        t = Table.from_pydict({"a": [1, 1, 2, 2], "b": [1, 2, 1, 1],
+                               "v": [1.0, 2.0, 3.0, 4.0]},
+                              dtypes={"a": dt.INT32, "b": dt.INT32, "v": dt.FLOAT64})
+        out = ops.groupby(t, ["a", "b"]).agg({"v": "sum"})
+        assert out.to_pydict() == {"a": [1, 1, 2], "b": [1, 2, 1],
+                                   "v": [1.0, 2.0, 7.0]}
+
+    def test_var_std(self):
+        t = Table.from_pydict({"k": [1, 1, 1], "v": [1.0, 2.0, 3.0]},
+                              dtypes={"k": dt.INT32, "v": dt.FLOAT64})
+        out = ops.groupby(t, "k").agg({"v": ["var", "std"]})
+        assert out.to_pydict()["v_var"] == [1.0]
+        assert out.to_pydict()["v_std"] == [1.0]
+
+    def test_empty_table(self):
+        t = Table({"k": Column.from_numpy(np.zeros(0, np.int32)),
+                   "v": Column.from_numpy(np.zeros(0, np.int64))})
+        out = ops.groupby(t, "k").agg({"v": "sum"})
+        assert out.num_rows == 0
+
+    def test_random_sweep_vs_pandas(self, rng):
+        n = 2000
+        k = rng.integers(0, 37, n).astype(np.int64)
+        v = rng.standard_normal(n)
+        t = Table.from_pydict({"k": k.tolist(), "v": v.tolist()},
+                              dtypes={"k": dt.INT64, "v": dt.FLOAT64})
+        out = ops.groupby(t, "k").agg({"v": ["sum", "count", "min", "max"]})
+        exp = (pd.DataFrame({"k": k, "v": v}).groupby("k")["v"]
+               .agg(["sum", "count", "min", "max"]).reset_index())
+        got = out.to_pydict()
+        assert got["k"] == exp["k"].tolist()
+        np.testing.assert_allclose(got["v_sum"], exp["sum"].to_numpy(), rtol=1e-12)
+        assert got["v_count"] == exp["count"].tolist()
+        np.testing.assert_allclose(got["v_min"], exp["min"].to_numpy())
+        np.testing.assert_allclose(got["v_max"], exp["max"].to_numpy())
+
+
+class TestJoin:
+    def test_inner_basic(self):
+        left = Table.from_pydict({"k": [1, 2, 3], "l": [10, 20, 30]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [2, 3, 4], "r": [200, 300, 400]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k")
+        assert out.to_pydict() == {"k": [2, 3], "l": [20, 30], "r": [200, 300]}
+
+    def test_inner_one_to_many(self):
+        left = Table.from_pydict({"k": [1, 2], "l": [10, 20]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [2, 2, 2], "r": [1, 2, 3]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k")
+        assert out.to_pydict() == {"k": [2, 2, 2], "l": [20, 20, 20], "r": [1, 2, 3]}
+
+    def test_left_join_unmatched_null(self):
+        left = Table.from_pydict({"k": [1, 2], "l": [10, 20]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [2], "r": [200]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k", how="left")
+        assert out.to_pydict() == {"k": [1, 2], "l": [10, 20], "r": [None, 200]}
+
+    def test_null_keys_never_match(self):
+        left = Table.from_pydict({"k": [1, None], "l": [10, 20]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [None, 1], "r": [100, 200]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        inner = ops.join(left, right, on="k")
+        assert inner.to_pydict() == {"k": [1], "l": [10], "r": [200]}
+        leftj = ops.join(left, right, on="k", how="left")
+        assert leftj.to_pydict() == {"k": [1, None], "l": [10, 20], "r": [200, None]}
+
+    def test_semi_anti(self):
+        left = Table.from_pydict({"k": [1, 2, 3]}, dtypes={"k": dt.INT32})
+        right = Table.from_pydict({"k": [2, 2]}, dtypes={"k": dt.INT32})
+        assert ops.join(left, right, on="k", how="semi").to_pydict() == {"k": [2]}
+        assert ops.join(left, right, on="k", how="anti").to_pydict() == {"k": [1, 3]}
+
+    def test_multi_key_join(self):
+        left = Table.from_pydict({"a": [1, 1, 2], "b": [1, 2, 1], "l": [10, 20, 30]},
+                                 dtypes={"a": dt.INT32, "b": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"a": [1, 2], "b": [2, 1], "r": [100, 200]},
+                                  dtypes={"a": dt.INT32, "b": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on=["a", "b"])
+        assert out.to_pydict() == {"a": [1, 2], "b": [2, 1], "l": [20, 30],
+                                   "r": [100, 200]}
+
+    def test_name_collision_suffixes(self):
+        left = Table.from_pydict({"k": [1], "v": [10]},
+                                 dtypes={"k": dt.INT32, "v": dt.INT64})
+        right = Table.from_pydict({"k": [1], "v": [99]},
+                                  dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.join(left, right, on="k")
+        assert set(out.names) == {"k", "v_x", "v_y"}
+
+    def test_empty_right_left_join(self):
+        left = Table.from_pydict({"k": [1, 2], "l": [10, 20]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table({"k": Column.from_numpy(np.zeros(0, np.int32)),
+                       "r": Column.from_numpy(np.zeros(0, np.int64))})
+        out = ops.join(left, right, on="k", how="left")
+        assert out.to_pydict() == {"k": [1, 2], "l": [10, 20], "r": [None, None]}
+
+    def test_dtype_mismatch_rejected(self):
+        left = Table.from_pydict({"k": [1]}, dtypes={"k": dt.INT32})
+        right = Table.from_pydict({"k": [1]}, dtypes={"k": dt.INT64})
+        with pytest.raises(ValueError, match="dtype mismatch"):
+            ops.join(left, right, on="k")
+
+    def test_random_sweep_vs_pandas(self, rng):
+        n = 500
+        lk = rng.integers(0, 60, n).astype(np.int64)
+        rk = rng.integers(0, 60, n).astype(np.int64)
+        lv = np.arange(n, dtype=np.int64)
+        rv = np.arange(n, dtype=np.int64) * 10
+        left = Table.from_pydict({"k": lk.tolist(), "lv": lv.tolist()},
+                                 dtypes={"k": dt.INT64, "lv": dt.INT64})
+        right = Table.from_pydict({"k": rk.tolist(), "rv": rv.tolist()},
+                                  dtypes={"k": dt.INT64, "rv": dt.INT64})
+        got = ops.join(left, right, on="k").to_pydict()
+        exp = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                       pd.DataFrame({"k": rk, "rv": rv}), on="k", how="inner")
+        # compare as sorted multisets of rows
+        got_rows = sorted(zip(got["k"], got["lv"], got["rv"]))
+        exp_rows = sorted(zip(exp["k"], exp["lv"], exp["rv"]))
+        assert got_rows == exp_rows
+
+
+class TestNaNKeys:
+    def test_nan_groups_together(self):
+        t = Table.from_pydict({"k": [float("nan"), float("nan"), 1.0],
+                               "v": [1, 2, 3]},
+                              dtypes={"k": dt.FLOAT64, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": "sum"})
+        assert out.num_rows == 2
+        assert out.to_pydict()["v"] == [3, 3]   # 1.0 group, NaN group
+
+    def test_nan_keys_join(self):
+        left = Table.from_pydict({"k": [float("nan")], "l": [1]},
+                                 dtypes={"k": dt.FLOAT64, "l": dt.INT64})
+        right = Table.from_pydict({"k": [float("nan")], "r": [2]},
+                                  dtypes={"k": dt.FLOAT64, "r": dt.INT64})
+        out = ops.join(left, right, on="k")
+        assert out.num_rows == 1
+
+
+class TestStringKeys:
+    def test_sort_by_string(self):
+        t = Table.from_pydict({"s": ["pear", None, "apple", "fig"]})
+        assert ops.sort_by(t, "s").to_pydict()["s"] == [None, "apple", "fig", "pear"]
+
+    def test_groupby_string_key(self):
+        t = Table.from_pydict({"s": ["b", "a", "b", None], "v": [1, 2, 3, 4]},
+                              dtypes={"s": dt.STRING, "v": dt.INT64})
+        out = ops.groupby(t, "s").agg({"v": "sum"})
+        assert out.to_pydict() == {"s": [None, "a", "b"], "v": [4, 2, 4]}
+
+    def test_join_string_key(self):
+        left = Table.from_pydict({"s": ["x", "y"], "l": [1, 2]},
+                                 dtypes={"s": dt.STRING, "l": dt.INT64})
+        right = Table.from_pydict({"s": ["y", "z"], "r": [20, 30]},
+                                  dtypes={"s": dt.STRING, "r": dt.INT64})
+        out = ops.join(left, right, on="s")
+        assert out.to_pydict() == {"s": ["y"], "l": [2], "r": [20]}
+
+    def test_fill_null_strings(self):
+        c = Column.from_pylist(["a", None, "c"], dt.STRING)
+        assert ops.fill_null(c, "x").to_pylist() == ["a", "x", "c"]
+
+
+class TestDecimalSemantics:
+    def test_groupby_mean_applies_scale(self):
+        t = Table.from_pydict({"k": [1, 1], "v": [100, 200]},
+                              dtypes={"k": dt.INT32, "v": dt.decimal64(-2)})
+        out = ops.groupby(t, "k").agg({"v": "mean"})
+        assert out.to_pydict()["v"] == [1.5]
+
+    def test_reduction_sum_mean_apply_scale(self):
+        c = Column.from_pylist([100, 200], dt.decimal64(-2))
+        assert reductions.sum(c) == 3.0
+        assert reductions.mean(c) == 1.5
+
+    def test_decimal_scalar_rejected(self):
+        a = Column.from_pylist([123], dt.decimal64(-2))
+        with pytest.raises(ValueError, match="decimal"):
+            ops.binary_op(a, 1, "add")
+
+    def test_decimal_mixed_scale_compare_rejected(self):
+        a = Column.from_pylist([123], dt.decimal64(-2))
+        b = Column.from_pylist([123], dt.decimal64(-1))
+        with pytest.raises(ValueError, match="matching scales"):
+            ops.binary_op(a, b, "eq")
+
+    def test_decimal_division_applies_scales(self):
+        a = Column.from_pylist([100], dt.decimal64(-2))   # 1.00
+        b = Column.from_pylist([2], dt.decimal64(0))      # 2
+        assert ops.binary_op(a, b, "truediv").to_pylist() == [0.5]
+
+    def test_uint64_sum_no_wrap(self):
+        c = Column.from_pylist([2**63, 2**63 - 1], dt.UINT64)
+        assert reductions.sum(c) == 2**64 - 1
+
+
+class TestReductions:
+    def test_basic(self):
+        c = Column.from_pylist([1, None, 3], dt.INT64)
+        assert reductions.sum(c) == 4
+        assert reductions.count(c) == 2
+        assert reductions.minimum(c) == 1
+        assert reductions.maximum(c) == 3
+        assert reductions.mean(c) == 2.0
+
+    def test_all_null_returns_none(self):
+        c = Column.from_pylist([None, None], dt.INT64)
+        assert reductions.sum(c) is None
+        assert reductions.minimum(c) is None
+        assert reductions.mean(c) is None
