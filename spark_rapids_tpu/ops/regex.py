@@ -1,0 +1,453 @@
+"""Regex engine: host-compiled DFA, device-vectorized execution.
+
+The reference envelope includes cuDF's strings/regex engine (BASELINE.json
+names the TPC-DS q28/q88 string/regex suite).  A GPU engine walks an NFA per
+thread with data-dependent branching — the exact shape TPU hates.  TPU-first
+redesign:
+
+  * the pattern is compiled **on host** (parse → Thompson NFA → subset-
+    construction DFA over *symbol equivalence classes*, so the transition
+    table is (num_states, num_classes) int32, typically tiny),
+  * execution is one ``lax.scan`` over character positions of the padded
+    (rows, max_len) byte matrix: every row's DFA state advances in lockstep
+    via a vectorized table gather.  No per-row branching; the table lives in
+    VMEM.
+
+Anchors are first-class: the symbol alphabet is 258 wide — 256 bytes plus
+virtual BOS/EOS markers processed before/after the byte stream.  ``^``/``$``
+compile to classes over {BOS}/{EOS}; every DFA state implicitly *retains*
+itself across BOS/EOS (assertion, not consumption), so anchors work anywhere
+in the pattern, including per-alternation-branch (``^q|z$``).
+
+Supported syntax: literals, ``.``, ``[...]`` classes (ranges, negation),
+escapes ``\\d \\D \\w \\W \\s \\S \\n \\t \\r`` and escaped metachars,
+``* + ? {m} {m,} {m,n}``, alternation ``|``, groups ``(...)`` (non-capturing
+semantics), anchors ``^``/``$``.  UTF-8 operates at the byte level
+(multi-byte literals match as byte sequences).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_BYTES = 256
+BOS = 256          # virtual begin-of-string symbol
+EOS = 257          # virtual end-of-string symbol
+NUM_SYMBOLS = 258
+
+
+def _byte_bits() -> np.ndarray:
+    return np.zeros(NUM_SYMBOLS, np.bool_)
+
+
+def _invert_bytes(bits: np.ndarray) -> np.ndarray:
+    """Negate a class over the byte range only (anchors never match classes)."""
+    out = bits.copy()
+    out[:NUM_BYTES] = ~bits[:NUM_BYTES]
+    out[NUM_BYTES:] = False
+    return out
+
+
+# -- parsing into an AST ------------------------------------------------------
+
+class _Parser:
+    """Recursive-descent parser for the supported regex subset."""
+
+    def __init__(self, pattern: str):
+        self.src = pattern
+        self.pos = 0
+
+    def error(self, msg: str):
+        raise ValueError(f"regex parse error at {self.pos} in {self.src!r}: {msg}")
+
+    def peek(self):
+        return self.src[self.pos] if self.pos < len(self.src) else None
+
+    def take(self):
+        ch = self.peek()
+        self.pos += 1
+        return ch
+
+    def parse(self):
+        node = self.alt()
+        if self.pos != len(self.src):
+            self.error(f"unexpected {self.peek()!r}")
+        return node
+
+    def alt(self):
+        branches = [self.concat()]
+        while self.peek() == "|":
+            self.take()
+            branches.append(self.concat())
+        return ("alt", branches) if len(branches) > 1 else branches[0]
+
+    def concat(self):
+        parts = []
+        while self.peek() not in (None, "|", ")"):
+            parts.append(self.repeat())
+        if not parts:
+            return ("empty",)
+        return ("cat", parts) if len(parts) > 1 else parts[0]
+
+    def repeat(self):
+        node = self.atom()
+        while True:
+            ch = self.peek()
+            if ch == "*":
+                self.take()
+                node = ("star", node)
+            elif ch == "+":
+                self.take()
+                node = ("cat", [node, ("star", node)])
+            elif ch == "?":
+                self.take()
+                node = ("alt", [node, ("empty",)])
+            elif ch == "{":
+                node = self.bounded(node)
+            else:
+                return node
+
+    def bounded(self, node):
+        self.take()  # '{'
+        digits = ""
+        while self.peek() and self.peek().isdigit():
+            digits += self.take()
+        if not digits:
+            self.error("expected digit in {m,n}")
+        lo = int(digits)
+        hi = lo
+        if self.peek() == ",":
+            self.take()
+            digits = ""
+            while self.peek() and self.peek().isdigit():
+                digits += self.take()
+            hi = int(digits) if digits else None
+        if self.take() != "}":
+            self.error("expected }")
+        parts = [node] * lo
+        if hi is None:
+            parts.append(("star", node))
+        else:
+            if hi < lo:
+                self.error("{m,n} with n < m")
+            for _ in range(hi - lo):
+                parts.append(("alt", [node, ("empty",)]))
+        if not parts:
+            return ("empty",)
+        return ("cat", parts) if len(parts) > 1 else parts[0]
+
+    def atom(self):
+        ch = self.take()
+        if ch == "(":
+            node = self.alt()
+            if self.take() != ")":
+                self.error("expected )")
+            return node
+        if ch == "[":
+            return ("class", self.char_class())
+        if ch == ".":
+            bits = _byte_bits()
+            bits[:NUM_BYTES] = True
+            bits[ord("\n")] = False
+            return ("class", bits)
+        if ch == "^":
+            bits = _byte_bits()
+            bits[BOS] = True
+            return ("class", bits)
+        if ch == "$":
+            bits = _byte_bits()
+            bits[EOS] = True
+            return ("class", bits)
+        if ch == "\\":
+            return ("class", self.escape(self.take()))
+        if ch in "*+?{":
+            self.error(f"dangling quantifier {ch!r}")
+        encoded = ch.encode("utf-8")
+        if len(encoded) > 1:
+            # multi-byte literal: a byte *sequence*, not a class
+            parts = []
+            for b in encoded:
+                one = _byte_bits()
+                one[b] = True
+                parts.append(("class", one))
+            return ("cat", parts)
+        bits = _byte_bits()
+        bits[encoded[0]] = True
+        return ("class", bits)
+
+    def escape(self, ch):
+        if ch is None:
+            self.error("dangling backslash")
+        bits = _byte_bits()
+        if ch in ("d", "D"):
+            bits[ord("0"):ord("9") + 1] = True
+            return _invert_bytes(bits) if ch == "D" else bits
+        if ch in ("w", "W"):
+            bits[ord("a"):ord("z") + 1] = True
+            bits[ord("A"):ord("Z") + 1] = True
+            bits[ord("0"):ord("9") + 1] = True
+            bits[ord("_")] = True
+            return _invert_bytes(bits) if ch == "W" else bits
+        if ch in ("s", "S"):
+            for c in " \t\n\r\f\v":
+                bits[ord(c)] = True
+            return _invert_bytes(bits) if ch == "S" else bits
+        if ch == "x":
+            hexits = (self.take() or "") + (self.take() or "")
+            try:
+                bits[int(hexits, 16)] = True
+            except ValueError:
+                self.error(f"bad \\x escape {hexits!r}")
+            return bits
+        if ch in {"n": 1, "t": 1, "r": 1, "f": 1, "v": 1, "0": 1}:
+            mapped = {"n": "\n", "t": "\t", "r": "\r", "f": "\f",
+                      "v": "\v", "0": "\0"}[ch]
+            bits[ord(mapped)] = True
+            return bits
+        if ch.isalnum():
+            # \b, \B, \A, \Z, backreferences, ... : unsupported — raising is
+            # better than silently matching the literal letter.
+            self.error(f"unsupported escape \\{ch}")
+        for b in ch.encode("utf-8"):   # escaped metachar / punctuation
+            bits[b] = True
+        return bits
+
+    def _class_atom(self):
+        """One class element: an int byte value (usable as a range bound) or
+        a bitset (multi-byte literal or \\d-style escape)."""
+        ch = self.take()
+        if ch == "\\":
+            nxt = self.take()
+            if nxt == "x":
+                hexits = (self.take() or "") + (self.take() or "")
+                try:
+                    return int(hexits, 16)
+                except ValueError:
+                    self.error(f"bad \\x escape {hexits!r}")
+            single = {"n": "\n", "t": "\t", "r": "\r", "f": "\f",
+                      "v": "\v", "0": "\0"}.get(nxt)
+            if single is not None:
+                return ord(single)
+            self.pos -= 1            # rewind so escape() re-reads nxt
+            return self.escape(self.take())
+        encoded = ch.encode("utf-8")
+        if len(encoded) > 1:
+            bits = _byte_bits()
+            for b in encoded:
+                bits[b] = True
+            return bits
+        return encoded[0]
+
+    def char_class(self):
+        bits = _byte_bits()
+        negate = False
+        if self.peek() == "^":
+            self.take()
+            negate = True
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                self.error("unterminated [")
+            if ch == "]" and not first:
+                self.take()
+                break
+            first = False
+            atom = self._class_atom()
+            if isinstance(atom, np.ndarray):
+                bits |= atom
+                continue
+            lo = atom
+            if self.peek() == "-" and self.pos + 1 < len(self.src) \
+                    and self.src[self.pos + 1] != "]":
+                self.take()  # '-'
+                hi = self._class_atom()
+                if isinstance(hi, np.ndarray):
+                    self.error("bad range bound")
+                if hi < lo:
+                    self.error("bad range")
+                bits[lo:hi + 1] = True
+            else:
+                bits[lo] = True
+        return _invert_bytes(bits) if negate else bits
+
+
+# -- Thompson NFA -------------------------------------------------------------
+
+class _NFA:
+    def __init__(self):
+        self.eps: list[list[int]] = []        # epsilon edges per state
+        self.trans: list[list[tuple[np.ndarray, int]]] = []  # (symbolset, target)
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+    def build(self, node) -> tuple[int, int]:
+        """Returns (start, accept) fragment for an AST node."""
+        kind = node[0]
+        if kind == "empty":
+            s = self.new_state()
+            return s, s
+        if kind == "class":
+            s, a = self.new_state(), self.new_state()
+            self.trans[s].append((node[1], a))
+            return s, a
+        if kind == "cat":
+            start, acc = self.build(node[1][0])
+            for part in node[1][1:]:
+                s2, a2 = self.build(part)
+                self.eps[acc].append(s2)
+                acc = a2
+            return start, acc
+        if kind == "alt":
+            s, a = self.new_state(), self.new_state()
+            for branch in node[1]:
+                bs, ba = self.build(branch)
+                self.eps[s].append(bs)
+                self.eps[ba].append(a)
+            return s, a
+        if kind == "star":
+            s, a = self.new_state(), self.new_state()
+            bs, ba = self.build(node[1])
+            self.eps[s] += [bs, a]
+            self.eps[ba] += [bs, a]
+            return s, a
+        raise AssertionError(f"unknown AST node {kind}")
+
+    def closure(self, states: frozenset[int]) -> frozenset[int]:
+        seen = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for t in self.eps[s]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+
+# -- compiled DFA -------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CompiledRegex:
+    """Host-compiled DFA, ready for device execution."""
+
+    pattern: str
+    table: np.ndarray          # (num_states, num_classes) int32
+    symbol_class: np.ndarray   # (258,) int32 — byte/BOS/EOS -> class
+    accept: np.ndarray         # (num_states,) bool
+    start_state: int
+
+
+@functools.lru_cache(maxsize=256)
+def compile(pattern: str, full_match: bool = False) -> CompiledRegex:  # noqa: A001
+    """Compile a pattern for device execution.
+
+    ``full_match=False``: cuDF ``contains_re`` / ``re.search`` semantics
+    (unanchored unless the pattern uses ^/$).  ``full_match=True``:
+    ``re.fullmatch`` semantics (both ends anchored).
+    """
+    ast = _Parser(pattern).parse()
+    nfa = _NFA()
+    start, accept = nfa.build(ast)
+    if not full_match:
+        # implicit byte-skipping prefix: restart anywhere in the string
+        pre = nfa.new_state()
+        anybyte = _byte_bits()
+        anybyte[:NUM_BYTES] = True
+        nfa.trans[pre].append((anybyte, pre))
+        nfa.eps[pre].append(start)
+        start = pre
+
+    # Symbol equivalence classes over all NFA edges.  BOS/EOS are forced
+    # into their own classes (they get assertion semantics below).
+    edge_sets = [bits for state_edges in nfa.trans for bits, _ in state_edges]
+    sig_matrix = (np.stack(edge_sets) if edge_sets
+                  else np.zeros((1, NUM_SYMBOLS), np.bool_))
+    anchor_rows = np.zeros((2, NUM_SYMBOLS), np.bool_)
+    anchor_rows[0, BOS] = True
+    anchor_rows[1, EOS] = True
+    sig_matrix = np.concatenate([sig_matrix, anchor_rows])
+    sigs: dict[bytes, int] = {}
+    symbol_class = np.zeros(NUM_SYMBOLS, np.int32)
+    for sym in range(NUM_SYMBOLS):
+        key = sig_matrix[:, sym].tobytes()
+        symbol_class[sym] = sigs.setdefault(key, len(sigs))
+    num_classes = len(sigs)
+    class_rep = np.zeros(num_classes, np.int32)
+    for sym in range(NUM_SYMBOLS - 1, -1, -1):
+        class_rep[symbol_class[sym]] = sym
+
+    # Subset construction.  BOS/EOS steps *retain* the current state set
+    # (zero-width assertion) in addition to explicit anchor edges.
+    start_set = nfa.closure(frozenset([start]))
+    dfa_ids: dict[frozenset[int], int] = {start_set: 0}
+    order: list[frozenset[int]] = [start_set]
+    rows: list[np.ndarray] = []
+    accepts: list[bool] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = np.zeros(num_classes, np.int32)
+        for cls in range(num_classes):
+            sym = class_rep[cls]
+            moved = set()
+            for s in cur:
+                for bits, t in nfa.trans[s]:
+                    if bits[sym]:
+                        moved.add(t)
+            if sym >= NUM_BYTES:
+                moved |= set(cur)           # assertion: survive the marker
+            nxt = nfa.closure(frozenset(moved)) if moved else frozenset()
+            if nxt not in dfa_ids:
+                dfa_ids[nxt] = len(order)
+                order.append(nxt)
+            row[cls] = dfa_ids[nxt]
+        rows.append(row)
+        accepts.append(accept in cur)
+
+    table = np.stack(rows).astype(np.int32)
+    acc = np.array(accepts, np.bool_)
+    if not full_match:
+        # Sticky accept: once matched, stay matched (search semantics).
+        for s in range(len(table)):
+            if acc[s]:
+                table[s, :] = s
+    return CompiledRegex(pattern=pattern, table=table,
+                         symbol_class=symbol_class, accept=acc, start_state=0)
+
+
+def run_dfa(rx: CompiledRegex, padded: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Run the DFA over a padded (rows, max_len) uint8 matrix.
+
+    Returns a bool (rows,) match mask.  BOS is processed before the byte
+    scan, EOS after; each step is a vectorized gather from the transition
+    table.
+    """
+    num_classes = rx.table.shape[1]
+    flat_table = jnp.asarray(rx.table.reshape(-1))
+    symbol_class = jnp.asarray(rx.symbol_class)
+    accept = jnp.asarray(rx.accept)
+    n, max_len = padded.shape
+
+    state = jnp.full((n,), rx.start_state, jnp.int32)
+    state = flat_table[state * num_classes + symbol_class[BOS]]
+
+    def step(state, j):
+        cls = symbol_class[padded[:, j].astype(jnp.int32)]
+        nxt = flat_table[state * num_classes + cls]
+        state = jnp.where(j < lengths, nxt, state)
+        return state, None
+
+    if max_len > 0:
+        state, _ = jax.lax.scan(step, state, jnp.arange(max_len))
+    state = flat_table[state * num_classes + symbol_class[EOS]]
+    return accept[state]

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dtypes import INT32, STRING
+from ..dtypes import BOOL8, INT32, STRING
 from ..column import Column
 
 
@@ -60,6 +60,291 @@ def strings_to_pylist(col: Column) -> list[Optional[str]]:
         else:
             out.append(bytes(chars[offsets[i]:offsets[i + 1]]).decode("utf-8"))
     return out
+
+
+def padded_chars(col: Column) -> tuple[jax.Array, jax.Array]:
+    """Materialize a (rows, max_len) uint8 matrix + (rows,) int32 lengths.
+
+    The workhorse layout for vectorized string compute: fixed-shape, so every
+    string op becomes lockstep VPU work over rows (the TPU replacement for
+    the per-thread byte loops a GPU strings engine uses).  Pad bytes are 0
+    and masked by ``lengths``.  One host sync for max_len.
+    """
+    offsets = col.offsets
+    starts = offsets[:-1]
+    lengths = (offsets[1:] - starts).astype(jnp.int32)
+    n = lengths.shape[0]
+    max_len = int(jnp.max(lengths)) if n else 0   # host sync
+    if max_len == 0:
+        return jnp.zeros((n, 0), jnp.uint8), lengths
+    pos = jnp.arange(max_len, dtype=jnp.int32)
+    idx = starts[:, None] + pos[None, :]
+    flat = jnp.take(col.data, jnp.clip(idx, 0, max(col.data.shape[0] - 1, 0)))
+    return jnp.where(pos[None, :] < lengths[:, None], flat, jnp.uint8(0)), lengths
+
+
+def _bool_col(mask: jax.Array, validity) -> Column:
+    return Column(data=mask.astype(jnp.uint8), validity=validity, dtype=BOOL8)
+
+
+def length_bytes(col: Column) -> Column:
+    """Byte length per string (cudf ``count_bytes``)."""
+    lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+    return Column(data=lens, validity=col.validity, dtype=INT32)
+
+
+def length_chars(col: Column) -> Column:
+    """Character (code point) count per string (cudf ``len``): counts UTF-8
+    lead bytes — vectorized, no per-row loop."""
+    is_lead = ((col.data & 0xC0) != 0x80).astype(jnp.int32)
+    csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(is_lead, dtype=jnp.int32)])
+    counts = jnp.take(csum, col.offsets[1:]) - jnp.take(csum, col.offsets[:-1])
+    return Column(data=counts, validity=col.validity, dtype=INT32)
+
+
+def upper(col: Column) -> Column:
+    """ASCII uppercase (multi-byte code points pass through unchanged)."""
+    b = col.data
+    is_lower = (b >= ord("a")) & (b <= ord("z"))
+    return Column(data=jnp.where(is_lower, b - 32, b), validity=col.validity,
+                  offsets=col.offsets, dtype=STRING)
+
+
+def lower(col: Column) -> Column:
+    """ASCII lowercase."""
+    b = col.data
+    is_upper = (b >= ord("A")) & (b <= ord("Z"))
+    return Column(data=jnp.where(is_upper, b + 32, b), validity=col.validity,
+                  offsets=col.offsets, dtype=STRING)
+
+
+def _match_windows(col: Column, needle: str):
+    """(hit, n) where hit is the (rows, max_len) bool matrix of literal match
+    start positions, or (None, n) for the trivial empty-needle case."""
+    pat = np.frombuffer(needle.encode("utf-8"), np.uint8)
+    m = len(pat)
+    padded, lengths = padded_chars(col)
+    n, max_len = padded.shape
+    if m == 0 or m > max_len:
+        return (None if m == 0 else jnp.zeros((n, max(max_len, 1)), jnp.bool_)), n
+    ext = jnp.pad(padded, ((0, 0), (0, m)))
+    acc = jnp.ones((n, max_len), jnp.bool_)
+    for k in range(m):
+        acc = acc & (ext[:, k:k + max_len] == pat[k])
+    pos = jnp.arange(max_len, dtype=jnp.int32)
+    return acc & (pos[None, :] <= (lengths[:, None] - m)), n
+
+
+def contains(col: Column, needle: str) -> Column:
+    """Literal substring containment (cudf ``contains``)."""
+    hit, n = _match_windows(col, needle)
+    if hit is None:
+        return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
+    return _bool_col(jnp.any(hit, axis=1), col.validity)
+
+
+def find(col: Column, needle: str) -> Column:
+    """Byte position of the first occurrence, -1 if absent (cudf ``find``)."""
+    hit, n = _match_windows(col, needle)
+    if hit is None:
+        return Column(data=jnp.zeros(n, jnp.int32), validity=col.validity,
+                      dtype=INT32)
+    first = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    return Column(data=jnp.where(jnp.any(hit, axis=1), first, -1),
+                  validity=col.validity, dtype=INT32)
+
+
+def starts_with(col: Column, prefix: str) -> Column:
+    pat = np.frombuffer(prefix.encode("utf-8"), np.uint8)
+    m = len(pat)
+    padded, lengths = padded_chars(col)
+    n, max_len = padded.shape
+    if m == 0:
+        return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
+    if m > max_len:
+        return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
+    ok = jnp.all(padded[:, :m] == pat, axis=1) & (lengths >= m)
+    return _bool_col(ok, col.validity)
+
+
+def ends_with(col: Column, suffix: str) -> Column:
+    pat = np.frombuffer(suffix.encode("utf-8"), np.uint8)
+    m = len(pat)
+    padded, lengths = padded_chars(col)
+    n, max_len = padded.shape
+    if m == 0:
+        return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
+    if m > max_len:
+        return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
+    idx = jnp.clip(lengths[:, None] - m + jnp.arange(m, dtype=jnp.int32)[None, :],
+                   0, max_len - 1)
+    tail = jnp.take_along_axis(padded, idx, axis=1)       # one (n, m) gather
+    ok = jnp.all(tail == jnp.asarray(pat), axis=1)
+    return _bool_col(ok & (lengths >= m), col.validity)
+
+
+def _segment_gather(data: jax.Array, src_starts: jax.Array,
+                    new_offsets: jax.Array) -> jax.Array:
+    """Copy per-row byte segments into a packed buffer.
+
+    ``src_starts[i]`` is the source byte offset of row *i*'s segment;
+    ``new_offsets`` delimits the destination.  The per-output-byte source is
+    found with one searchsorted over the destination offsets — the shared
+    core of every variable-width rebuild (gather, slice, concat).
+    One host sync for the total size.
+    """
+    total = int(new_offsets[-1])
+    if total == 0:
+        return jnp.zeros(0, jnp.uint8)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets, pos, side="right") - 1
+    src = jnp.take(src_starts, row) + (pos - jnp.take(new_offsets, row))
+    return jnp.take(data, src)
+
+
+def _offsets_from_lens(lens: jax.Array) -> jax.Array:
+    return jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(lens, dtype=jnp.int32)])
+
+
+def slice_strings(col: Column, start: int, length: Optional[int] = None) -> Column:
+    """Byte-position substring (negative ``start`` counts from the end).
+
+    NOTE: positions are *bytes*; for ASCII data this equals cudf's
+    character-based ``slice_strings``.  Char-position slicing for multi-byte
+    UTF-8 is tracked as a follow-up (needs a lead-byte prefix-sum remap).
+    """
+    offsets = col.offsets
+    starts0 = offsets[:-1]
+    lens = (offsets[1:] - starts0).astype(jnp.int32)
+    if start >= 0:
+        begin = jnp.minimum(start, lens)
+    else:
+        begin = jnp.maximum(lens + start, 0)
+    avail = lens - begin
+    take = avail if length is None else jnp.clip(length, 0, None)
+    new_offsets = _offsets_from_lens(jnp.minimum(avail, take).astype(jnp.int32))
+    chars = _segment_gather(col.data, starts0 + begin, new_offsets)
+    return Column(data=chars, validity=col.validity, offsets=new_offsets,
+                  dtype=STRING)
+
+
+def concatenate(cols: list[Column], sep: str = "") -> Column:
+    """Row-wise concatenation (cudf ``concatenate`` null semantics: a null in
+    any input nulls the row)."""
+    out = _concat_rows(cols, sep, skip_nulls=False)
+    validity = None
+    if any(c.validity is not None for c in cols):
+        validity = cols[0].valid_mask()
+        for c in cols[1:]:
+            validity = validity & c.valid_mask()
+    return out.with_validity(validity)
+
+
+def concat_ws(cols: list[Column], sep: str = "") -> Column:
+    """Row-wise concatenation, Spark ``concat_ws`` null semantics: null
+    inputs are skipped (and contribute no separator); the result is never
+    null."""
+    return _concat_rows(cols, sep, skip_nulls=True)
+
+
+def _concat_rows(cols: list[Column], sep: str, skip_nulls: bool) -> Column:
+    if not cols:
+        raise ValueError("need at least one column")
+    sep_bytes = jnp.asarray(np.frombuffer(sep.encode("utf-8"), np.uint8))
+    sep_len = sep_bytes.shape[0]
+    n = cols[0].size
+
+    raw_lens = [(c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32) for c in cols]
+    if skip_nulls:
+        part_lens = [jnp.where(c.valid_mask(), l, 0)
+                     for c, l in zip(cols, raw_lens)]
+        emit = [c.valid_mask() for c in cols]
+    else:
+        part_lens = raw_lens
+        emit = [jnp.ones(n, jnp.bool_) for _ in cols]
+
+    # Separator before part i iff part i is emitted and some earlier part was.
+    any_prev = jnp.zeros(n, jnp.bool_)
+    sep_lens: list[jax.Array] = []
+    for e in emit:
+        sep_lens.append(jnp.where(e & any_prev, sep_len, 0).astype(jnp.int32))
+        any_prev = any_prev | e
+
+    total_lens = sum(part_lens[1:], part_lens[0])
+    for sl in sep_lens:
+        total_lens = total_lens + sl
+    new_offsets = _offsets_from_lens(total_lens)
+
+    total = int(new_offsets[-1])
+    out = jnp.zeros(total, jnp.uint8)
+    if total:
+        cursor = new_offsets[:-1]
+        for i, c in enumerate(cols):
+            if sep_len:
+                sl = sep_lens[i]
+                sep_off = _offsets_from_lens(sl)
+                m = int(sep_off[-1])
+                if m:
+                    pos = jnp.arange(m, dtype=jnp.int32)
+                    row = jnp.searchsorted(sep_off, pos, side="right") - 1
+                    k = pos - jnp.take(sep_off, row)
+                    out = out.at[jnp.take(cursor, row) + k].set(sep_bytes[k])
+                cursor = cursor + sl
+            pl = part_lens[i]
+            part_off = _offsets_from_lens(pl)
+            if int(part_off[-1]):
+                rel = _segment_gather(c.data, c.offsets[:-1], part_off)
+                pos = jnp.arange(rel.shape[0], dtype=jnp.int32)
+                row = jnp.searchsorted(part_off, pos, side="right") - 1
+                k = pos - jnp.take(part_off, row)
+                out = out.at[jnp.take(cursor, row) + k].set(rel)
+            cursor = cursor + pl
+    return Column(data=out, offsets=new_offsets, dtype=STRING)
+
+
+def contains_re(col: Column, pattern: str) -> Column:
+    """Regex containment (cudf ``contains_re``): unanchored search unless the
+    pattern carries ^/$ anchors."""
+    from . import regex
+    rx = regex.compile(pattern)
+    padded, lengths = padded_chars(col)
+    return _bool_col(regex.run_dfa(rx, padded, lengths), col.validity)
+
+
+def matches_re(col: Column, pattern: str) -> Column:
+    """Full-string regex match (anchored both ends)."""
+    from . import regex
+    rx = regex.compile(pattern, full_match=True)
+    padded, lengths = padded_chars(col)
+    return _bool_col(regex.run_dfa(rx, padded, lengths), col.validity)
+
+
+def like(col: Column, pattern: str, escape: str = "\\") -> Column:
+    """SQL LIKE (Spark semantics): ``%`` any run, ``_`` any char; full match."""
+    out = []
+    i = 0
+    specials = ".^$*+?{}[]|()\\"
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            out.append("\\" + nxt if nxt in specials else nxt)
+            i += 2
+            continue
+        if ch == "%":
+            out.append("[\\s\\S]*")              # any run of bytes
+        elif ch == "_":
+            # exactly one UTF-8 code point: a non-continuation byte followed
+            # by its continuation bytes
+            out.append("[^\\x80-\\xbf][\\x80-\\xbf]*")
+        elif ch in specials:
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+        i += 1
+    return matches_re(col, "".join(out))
 
 
 def concat_columns(cols: list[Column]) -> Column:
@@ -131,14 +416,7 @@ def strings_gather(col: Column, indices) -> Column:
     lens = jnp.take(offsets, indices + 1) - starts
     new_offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                    jnp.cumsum(lens, dtype=jnp.int32)])
-    total = int(new_offsets[-1])  # host sync: output size is data dependent
-    if total == 0:
-        chars = jnp.zeros(0, jnp.uint8)
-    else:
-        pos = jnp.arange(total, dtype=jnp.int32)
-        row = jnp.searchsorted(new_offsets, pos, side="right") - 1
-        src = jnp.take(starts, row) + (pos - jnp.take(new_offsets, row))
-        chars = jnp.take(col.data, src)
+    chars = _segment_gather(col.data, starts, new_offsets)
     validity = None
     if col.validity is not None:
         validity = jnp.take(col.validity, indices)

@@ -1,0 +1,164 @@
+"""String ops + regex engine tests.
+
+Regex oracle: Python's ``re`` module over the same inputs.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.ops import strings as st
+from spark_rapids_tpu.ops import regex as rx
+
+
+def scol(vals):
+    return Column.from_pylist(vals, dt.STRING)
+
+
+class TestBasicOps:
+    def test_lengths(self):
+        c = scol(["abc", "", None, "héllo"])
+        assert st.length_bytes(c).to_pylist() == [3, 0, None, 6]
+        assert st.length_chars(c).to_pylist() == [3, 0, None, 5]
+
+    def test_upper_lower(self):
+        c = scol(["aBc", None, "Z9é"])
+        assert st.upper(c).to_pylist() == ["ABC", None, "Z9é"]
+        assert st.lower(c).to_pylist() == ["abc", None, "z9é"]
+
+    def test_contains_find(self):
+        c = scol(["hello world", "world", "hell", None, ""])
+        assert st.contains(c, "world").to_pylist() == [True, True, False, None, False]
+        assert st.find(c, "world").to_pylist() == [6, 0, -1, None, -1]
+        assert st.contains(c, "").to_pylist() == [True, True, True, None, True]
+
+    def test_starts_ends(self):
+        c = scol(["spark", "sparrow", "park", None])
+        assert st.starts_with(c, "spar").to_pylist() == [True, True, False, None]
+        assert st.ends_with(c, "ark").to_pylist() == [True, False, True, None]
+        assert st.ends_with(c, "k").to_pylist() == [True, False, True, None]
+
+    def test_slice(self):
+        c = scol(["hello", "hi", None, ""])
+        assert st.slice_strings(c, 1, 3).to_pylist() == ["ell", "i", None, ""]
+        assert st.slice_strings(c, -2).to_pylist() == ["lo", "hi", None, ""]
+        assert st.slice_strings(c, 0, 0).to_pylist() == ["", "", None, ""]
+
+    def test_concatenate_cudf_null_semantics(self):
+        a = scol(["x", "y", None])
+        b = scol(["1", "2", "3"])
+        assert st.concatenate([a, b], "-").to_pylist() == ["x-1", "y-2", None]
+        assert st.concatenate([a, b]).to_pylist() == ["x1", "y2", None]
+
+    def test_concat_ws_spark_skips_nulls(self):
+        a = scol(["x", None, None])
+        b = scol(["1", "2", None])
+        assert st.concat_ws([a, b], "-").to_pylist() == ["x-1", "2", ""]
+        assert st.concat_ws([a, b]).to_pylist() == ["x1", "2", ""]
+
+    def test_dictionary_encode_orders_lexicographically(self):
+        c = scol(["pear", "apple", "pear", None, "fig"])
+        codes, uniq = st.dictionary_encode(c)
+        # null placeholder is b"" -> code 0; real values sorted after
+        assert uniq == ["", "apple", "fig", "pear"]
+        assert codes.to_pylist() == [3, 1, 3, None, 2]
+
+
+class TestRegexEngine:
+    CASES = [
+        ("abc", ["abc", "xabcx", "ab", "", "ABC"]),
+        ("a.c", ["abc", "axc", "ac", "a\nc"]),
+        ("a*b", ["b", "ab", "aaab", "ba", "ca"]),
+        ("a+b", ["b", "ab", "aaab", "c"]),
+        ("colou?r", ["color", "colour", "colouur"]),
+        ("[0-9]+", ["abc123", "no digits", "42"]),
+        ("[^0-9]+", ["123", "a1", "abc"]),
+        ("\\d{2,4}", ["1", "12", "1234", "12345", "a99b"]),
+        ("foo|bar", ["foo", "bar", "baz", "xfoox"]),
+        ("(ab)+c", ["abc", "ababc", "ac", "abab"]),
+        ("\\w+@\\w+", ["user@host", "nope", "@", "a@b"]),
+        ("\\s", ["no-space", "has space", "\ttab"]),
+    ]
+
+    @pytest.mark.parametrize("pattern,inputs", CASES)
+    def test_contains_matches_python_re(self, pattern, inputs):
+        c = scol(inputs)
+        got = st.contains_re(c, pattern).to_pylist()
+        exp = [re.search(pattern, s) is not None for s in inputs]
+        assert got == exp, f"pattern={pattern!r}"
+
+    @pytest.mark.parametrize("pattern,inputs", CASES)
+    def test_fullmatch_matches_python_re(self, pattern, inputs):
+        c = scol(inputs)
+        got = st.matches_re(c, pattern).to_pylist()
+        exp = [re.fullmatch(pattern, s) is not None for s in inputs]
+        assert got == exp, f"pattern={pattern!r}"
+
+    def test_anchors(self):
+        c = scol(["hello world", "world hello", "hello"])
+        assert st.contains_re(c, "^hello").to_pylist() == [True, False, True]
+        assert st.contains_re(c, "world$").to_pylist() == [True, False, False]
+        assert st.contains_re(c, "^hello$").to_pylist() == [False, False, True]
+
+    def test_null_propagation(self):
+        c = scol(["abc", None])
+        assert st.contains_re(c, "b").to_pylist() == [True, None]
+
+    def test_empty_pattern_matches_all(self):
+        c = scol(["", "x"])
+        assert st.contains_re(c, "").to_pylist() == [True, True]
+
+    def test_invalid_pattern_raises(self):
+        with pytest.raises(ValueError):
+            rx.compile("a(b")
+        with pytest.raises(ValueError):
+            rx.compile("*a")
+        with pytest.raises(ValueError):
+            rx.compile("a{3,1}")
+
+    def test_unsupported_escape_raises_not_silently_matches(self):
+        with pytest.raises(ValueError, match="unsupported escape"):
+            rx.compile("\\bword")
+        with pytest.raises(ValueError, match="unsupported escape"):
+            rx.compile("a\\1")
+
+    def test_hex_escape_and_ranges(self):
+        c = scol(["\x7f", "é", "a"])
+        assert st.contains_re(c, "[\\x7f]").to_pylist() == [True, False, False]
+        assert st.contains_re(c, "[\\x80-\\xbf]").to_pylist() == [False, True, False]
+
+    def test_random_fuzz_vs_python_re(self, rng):
+        patterns = ["[a-c]+d", "x\\d*y", "(ab|cd)+", "a.{1,3}z", "^q|z$"]
+        alphabet = "abcdxyz019 q"
+        inputs = ["".join(rng.choice(list(alphabet), size=rng.integers(0, 12)))
+                  for _ in range(200)]
+        c = scol(inputs)
+        for pattern in patterns:
+            got = st.contains_re(c, pattern).to_pylist()
+            exp = [re.search(pattern, s) is not None for s in inputs]
+            assert got == exp, f"pattern={pattern!r}"
+
+
+class TestLike:
+    def test_like_basics(self):
+        c = scol(["apple pie", "apple", "pie", None])
+        assert st.like(c, "apple%").to_pylist() == [True, True, False, None]
+        assert st.like(c, "%pie").to_pylist() == [True, False, True, None]
+        assert st.like(c, "a___e").to_pylist() == [False, True, False, None]
+        assert st.like(c, "%p%e%").to_pylist() == [True, True, True, None]
+
+    def test_like_escapes_regex_metachars(self):
+        c = scol(["a.b", "axb"])
+        assert st.like(c, "a.b").to_pylist() == [True, False]
+
+    def test_like_escape_char(self):
+        c = scol(["100%", "100x"])
+        assert st.like(c, "100\\%").to_pylist() == [True, False]
+
+    def test_like_underscore_is_one_utf8_char(self):
+        c = scol(["é", "ab", "a"])
+        assert st.like(c, "_").to_pylist() == [True, False, True]
+        assert st.like(c, "__").to_pylist() == [False, True, False]
