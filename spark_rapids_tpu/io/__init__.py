@@ -1,0 +1,13 @@
+"""IO layer: Arrow interop and Parquet scan/write."""
+
+from .arrow import from_arrow, from_arrow_array, to_arrow, to_arrow_array
+from .parquet import read_parquet, write_parquet
+
+__all__ = [
+    "from_arrow",
+    "from_arrow_array",
+    "read_parquet",
+    "to_arrow",
+    "to_arrow_array",
+    "write_parquet",
+]

@@ -1,0 +1,183 @@
+"""Arrow interop: device Table ↔ pyarrow.
+
+The reference system's host-interop object model is Arrow-shaped (cuDF
+columns are Arrow-layout device buffers; the Java layer moves Arrow data
+across the JNI boundary).  Here the boundary is host Arrow <-> HBM jax
+arrays: fixed-width values move as numpy buffers (zero-copy on host),
+validity converts between Arrow's packed LSB bitmaps and our unpacked bool
+masks, strings move as offsets+chars buffer pairs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+import jax.numpy as jnp
+
+from ..column import Column
+from ..dtypes import DType, TypeId
+from ..table import Table
+
+_PA_TO_TYPEID = {
+    pa.int8(): TypeId.INT8,
+    pa.int16(): TypeId.INT16,
+    pa.int32(): TypeId.INT32,
+    pa.int64(): TypeId.INT64,
+    pa.uint8(): TypeId.UINT8,
+    pa.uint16(): TypeId.UINT16,
+    pa.uint32(): TypeId.UINT32,
+    pa.uint64(): TypeId.UINT64,
+    pa.float32(): TypeId.FLOAT32,
+    pa.float64(): TypeId.FLOAT64,
+    pa.bool_(): TypeId.BOOL8,
+    pa.date32(): TypeId.TIMESTAMP_DAYS,
+    pa.timestamp("s"): TypeId.TIMESTAMP_SECONDS,
+    pa.timestamp("ms"): TypeId.TIMESTAMP_MILLISECONDS,
+    pa.timestamp("us"): TypeId.TIMESTAMP_MICROSECONDS,
+    pa.timestamp("ns"): TypeId.TIMESTAMP_NANOSECONDS,
+    pa.duration("s"): TypeId.DURATION_SECONDS,
+    pa.duration("ms"): TypeId.DURATION_MILLISECONDS,
+    pa.duration("us"): TypeId.DURATION_MICROSECONDS,
+    pa.duration("ns"): TypeId.DURATION_NANOSECONDS,
+    pa.string(): TypeId.STRING,
+    pa.large_string(): TypeId.STRING,
+}
+
+
+def _pa_type_to_dtype(t: pa.DataType) -> DType:
+    if pa.types.is_decimal(t):
+        # Arrow scale is digits right of the point; cudf scale is the base-10
+        # exponent (negated).  precision <= 9 -> decimal32, <= 18 -> decimal64.
+        if t.precision > 18:
+            raise ValueError(
+                f"decimal precision {t.precision} > 18 needs decimal128, "
+                f"which has no device representation yet")
+        type_id = TypeId.DECIMAL32 if t.precision <= 9 else TypeId.DECIMAL64
+        return DType(type_id, -t.scale)
+    try:
+        return DType(_PA_TO_TYPEID[t])
+    except KeyError:
+        raise ValueError(f"unsupported arrow type {t}") from None
+
+
+def _dtype_to_pa_type(dtype: DType) -> pa.DataType:
+    if dtype.is_decimal:
+        precision = 9 if dtype.type_id == TypeId.DECIMAL32 else 18
+        return pa.decimal128(precision, -dtype.scale)
+    for pa_t, tid in _PA_TO_TYPEID.items():
+        if tid == dtype.type_id and pa_t != pa.large_string():
+            return pa_t
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def _unpack_bitmap(buf, offset: int, length: int) -> np.ndarray | None:
+    if buf is None:
+        return None
+    raw = np.frombuffer(buf, np.uint8)
+    bits = np.unpackbits(raw, bitorder="little")[offset:offset + length]
+    return bits.astype(np.bool_)
+
+
+def from_arrow_array(arr: pa.Array | pa.ChunkedArray) -> Column:
+    """Build a device Column from a pyarrow array."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = _pa_type_to_dtype(arr.type)
+    n = len(arr)
+
+    if dtype.type_id == TypeId.STRING:
+        if pa.types.is_large_string(arr.type):
+            arr = arr.cast(pa.string())
+        bufs = arr.buffers()            # [validity, offsets(int32), data]
+        validity = _unpack_bitmap(bufs[0], arr.offset, n)
+        offsets = np.frombuffer(bufs[1], np.int32,
+                                count=n + 1 + arr.offset)[arr.offset:]
+        chars = (np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None
+                 else np.zeros(0, np.uint8))
+        base = offsets[0]
+        return Column(data=jnp.asarray(chars[base:offsets[-1]].copy()),
+                      validity=None if validity is None or validity.all()
+                      else jnp.asarray(validity),
+                      offsets=jnp.asarray((offsets - base).copy()), dtype=dtype)
+
+    if pa.types.is_decimal(arr.type):
+        # decimal128 payloads -> unscaled int32/int64 (host loop; decimals
+        # are schema-rare enough that this stays off the hot path)
+        np_dt = dtype.np_dtype
+        unscaled = []
+        mask = np.ones(n, np.bool_)
+        for i, v in enumerate(arr):
+            pyv = v.as_py()
+            if pyv is None:
+                mask[i] = False
+                unscaled.append(0)
+            else:
+                unscaled.append(int(pyv.scaleb(arr.type.scale)))
+        data = np.asarray(unscaled, dtype=np_dt)
+        return Column(data=jnp.asarray(data),
+                      validity=None if mask.all() else jnp.asarray(mask),
+                      dtype=dtype)
+
+    if pa.types.is_boolean(arr.type):
+        bufs = arr.buffers()
+        validity = _unpack_bitmap(bufs[0], arr.offset, n)
+        values = _unpack_bitmap(bufs[1], arr.offset, n)
+        data = values.astype(np.uint8)
+    else:
+        bufs = arr.buffers()
+        validity = _unpack_bitmap(bufs[0], arr.offset, n)
+        np_dt = dtype.np_dtype
+        data = np.frombuffer(bufs[1], np_dt,
+                             count=n + arr.offset)[arr.offset:].copy()
+    return Column(data=jnp.asarray(data),
+                  validity=None if validity is None or validity.all()
+                  else jnp.asarray(validity),
+                  dtype=dtype)
+
+
+def to_arrow_array(col: Column) -> pa.Array:
+    """Materialize a device Column as a pyarrow array."""
+    dtype = col.dtype
+    mask = None
+    if col.validity is not None:
+        mask = ~np.asarray(col.validity)
+
+    if dtype.type_id == TypeId.STRING:
+        # zero-copy from the Arrow-layout offsets+chars the column already holds
+        offsets = np.asarray(col.offsets, np.int32)
+        chars = np.asarray(col.data, np.uint8)
+        n = len(offsets) - 1
+        validity_buf = None
+        null_count = 0
+        if mask is not None:
+            null_count = int(mask.sum())
+            validity_buf = pa.py_buffer(
+                np.packbits(~mask, bitorder="little").tobytes())
+        return pa.StringArray.from_buffers(
+            n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(chars.tobytes()),
+            validity_buf, null_count)
+
+    values = np.asarray(col.data)
+    if dtype.is_decimal:
+        pa_t = _dtype_to_pa_type(dtype)
+        import decimal
+        pyvals = []
+        for i, v in enumerate(values):
+            if mask is not None and mask[i]:
+                pyvals.append(None)
+            else:
+                pyvals.append(decimal.Decimal(int(v)).scaleb(dtype.scale))
+        return pa.array(pyvals, type=pa_t)
+    if dtype.type_id == TypeId.BOOL8:
+        values = values.astype(np.bool_)
+    return pa.array(values, type=_dtype_to_pa_type(dtype), mask=mask)
+
+
+def from_arrow(table: pa.Table) -> Table:
+    return Table([(name, from_arrow_array(table.column(name)))
+                  for name in table.column_names])
+
+
+def to_arrow(table: Table) -> pa.Table:
+    return pa.table({name: to_arrow_array(col) for name, col in table.items()})

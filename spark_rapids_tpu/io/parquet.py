@@ -1,0 +1,37 @@
+"""Parquet scan/write.
+
+The reference envelope's Parquet decode lives in cuDF's GPU decoder
+(BASELINE.json: "Parquet decode" is on the op list).  Current TPU design:
+host-side decode via Arrow (pyarrow's vectorized C++ reader) feeding
+device-resident columns — the decode itself is IO/CPU-bound and overlaps
+with device compute in a pipeline; predicate/column pushdown happens in the
+reader.  A device-side decoder for PLAIN/RLE/dictionary pages (decompressed
+bytes shipped to HBM, unpacked with the same word-image machinery as
+:mod:`..rows`) is the planned next step for scan-bound queries.
+
+Row-group filtering: ``filters`` accepts pyarrow dataset filter expressions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow.parquet as pq
+
+from ..table import Table
+from .arrow import from_arrow, to_arrow
+
+
+def read_parquet(path, columns: Optional[Sequence[str]] = None,
+                 filters=None) -> Table:
+    """Read a Parquet file into a device Table (column pruning + row-group
+    predicate pushdown via the Arrow reader)."""
+    tbl = pq.read_table(path,
+                        columns=list(columns) if columns is not None else None,
+                        filters=filters)
+    return from_arrow(tbl)
+
+
+def write_parquet(table: Table, path, compression: str = "snappy") -> None:
+    """Write a device Table to Parquet."""
+    pq.write_table(to_arrow(table), path, compression=compression)

@@ -1,0 +1,112 @@
+"""Arrow interop + Parquet round-trip tests (pyarrow as the oracle)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.io import (from_arrow, read_parquet, to_arrow,
+                                 write_parquet)
+
+
+def full_table() -> Table:
+    return Table.from_pydict(
+        {
+            "i64": [5, None, 3],
+            "i32": [1, 2, None],
+            "i8": [None, -8, 8],
+            "u32": [1, None, 2**32 - 1],
+            "f64": [1.5, None, -2.5],
+            "f32": [0.5, 1.5, None],
+            "b": [True, None, False],
+            "s": ["hello", None, "wörld"],
+            "dec": [12345, None, -678],
+        },
+        dtypes={"i64": dt.INT64, "i32": dt.INT32, "i8": dt.INT8,
+                "u32": dt.UINT32, "f64": dt.FLOAT64, "f32": dt.FLOAT32,
+                "b": dt.BOOL8, "s": dt.STRING, "dec": dt.decimal64(-2)},
+    )
+
+
+class TestArrowRoundTrip:
+    def test_full_roundtrip(self):
+        t = full_table()
+        at = to_arrow(t)
+        back = from_arrow(at)
+        assert_tables_equal(back, t)
+
+    def test_arrow_values_match(self):
+        t = full_table()
+        at = to_arrow(t)
+        assert at.column("i64").to_pylist() == [5, None, 3]
+        assert at.column("s").to_pylist() == ["hello", None, "wörld"]
+        import decimal
+        assert at.column("dec").to_pylist() == \
+            [decimal.Decimal("123.45"), None, decimal.Decimal("-6.78")]
+
+    def test_from_arrow_made_by_pyarrow(self):
+        at = pa.table({
+            "x": pa.array([1, 2, None], pa.int64()),
+            "s": pa.array(["a", None, "ccc"]),
+            "ts": pa.array([1000, None, 3000], pa.timestamp("us")),
+        })
+        t = from_arrow(at)
+        assert t["x"].to_pylist() == [1, 2, None]
+        assert t["s"].to_pylist() == ["a", None, "ccc"]
+        assert t["ts"].dtype == dt.TIMESTAMP_MICROSECONDS
+        assert t["ts"].to_pylist() == [1000, None, 3000]
+
+    def test_sliced_arrow_array_offsets(self):
+        arr = pa.array([1, None, 3, 4, 5], pa.int32()).slice(1, 3)
+        t = from_arrow(pa.table({"x": arr}))
+        assert t["x"].to_pylist() == [None, 3, 4]
+
+    def test_chunked_array_combines(self):
+        ch = pa.chunked_array([pa.array([1, 2], pa.int64()),
+                               pa.array([3], pa.int64())])
+        t = from_arrow(pa.table({"x": ch}))
+        assert t["x"].to_pylist() == [1, 2, 3]
+
+    def test_large_string_cast(self):
+        at = pa.table({"s": pa.array(["aa", "b"], pa.large_string())})
+        assert from_arrow(at)["s"].to_pylist() == ["aa", "b"]
+
+    def test_decimal128_wide_precision_rejected(self):
+        at = pa.table({"d": pa.array([None], pa.decimal128(38, 2))})
+        with pytest.raises(ValueError, match="decimal128"):
+            from_arrow(at)
+
+
+class TestParquet:
+    def test_roundtrip(self, tmp_path):
+        t = full_table()
+        p = tmp_path / "t.parquet"
+        write_parquet(t, p)
+        back = read_parquet(p)
+        assert_tables_equal(back, t)
+
+    def test_column_pruning(self, tmp_path):
+        t = full_table()
+        p = tmp_path / "t.parquet"
+        write_parquet(t, p)
+        back = read_parquet(p, columns=["i64", "s"])
+        assert back.names == ("i64", "s")
+
+    def test_filters_pushdown(self, tmp_path):
+        t = Table.from_pydict({"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]},
+                              dtypes={"k": dt.INT64, "v": dt.INT64})
+        p = tmp_path / "t.parquet"
+        write_parquet(t, p)
+        back = read_parquet(p, filters=[("k", ">", 2)])
+        assert back.to_pydict() == {"k": [3, 4], "v": [30, 40]}
+
+    def test_pandas_written_file(self, tmp_path):
+        import pandas as pd
+        df = pd.DataFrame({"a": [1.5, np.nan, 3.0], "s": ["x", "y", None]})
+        p = tmp_path / "pd.parquet"
+        df.to_parquet(p)
+        back = read_parquet(p)
+        assert back["s"].to_pylist() == ["x", "y", None]
+        # pandas stores NaN as parquet null
+        assert back["a"].to_pylist() == [1.5, None, 3.0]
