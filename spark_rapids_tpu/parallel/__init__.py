@@ -1,0 +1,25 @@
+"""Distributed layer: mesh sharding, ICI/DCN shuffle, distributed ops.
+
+The engine's scale-out model (SURVEY.md §2.4): tables shard row-wise over a
+jax.sharding.Mesh; repartitioning is one lax.all_to_all under shard_map
+(ICI within a slice, DCN across); groupby/join are shuffle + static-shape
+local kernels with zero host syncs inside the compiled program.
+"""
+
+from .dist_ops import dist_groupby, dist_join
+from .hashing import hash_columns, partition_ids
+from .mesh import AXIS, DistTable, collect, make_mesh, shard_table
+from .shuffle import shuffle
+
+__all__ = [
+    "AXIS",
+    "DistTable",
+    "collect",
+    "dist_groupby",
+    "dist_join",
+    "hash_columns",
+    "make_mesh",
+    "partition_ids",
+    "shard_table",
+    "shuffle",
+]

@@ -1,0 +1,323 @@
+"""Distributed groupby and join: shuffle + static-shape local kernels.
+
+Both ops follow the same TPU-native recipe (SURVEY.md §7): hash-shuffle rows
+by key so equal keys colocate, then run a *fixed-shape* local kernel per
+shard under ``shard_map`` — sorted segments for groupby, searchsorted merge
+for join — producing padded outputs with row masks.  Zero host syncs inside
+the compiled program; the only dynamic decisions (shuffle overflow, join
+output capacity) surface as flags the caller reacts to.
+
+This is the engine's answer to the reference system's executor-side
+hash aggregation / shuffled hash join over UCX (spark-rapids plugin world):
+same query semantics, but every step is a sort/scan/gather XLA already knows
+how to tile onto the TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+from ..column import Column
+from ..dtypes import FLOAT64, INT64
+from ..table import Table
+from .mesh import DistTable
+from .shuffle import shuffle
+
+_DIST_AGGS = ("sum", "count", "min", "max", "mean")
+
+
+def dist_groupby(dist: DistTable, mesh: Mesh, keys: Sequence[str],
+                 aggs: Sequence[tuple[str, str, str]],
+                 bucket_size: Optional[int] = None) -> DistTable:
+    """Distributed group-by: one shuffle, then per-shard sorted segments.
+
+    ``aggs`` = [(value_col, how, out_name)] with how in {sum, count, min,
+    max, mean}.  Output: a DistTable of group rows (padded; ``row_mask``
+    marks real groups).
+    """
+    for _, how, _ in aggs:
+        if how not in _DIST_AGGS:
+            raise ValueError(f"unsupported distributed agg {how!r}")
+    shuffled = shuffle(dist, mesh, keys, bucket_size=bucket_size)
+    return _local_groupby(shuffled, mesh, list(keys), list(aggs))
+
+
+def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
+                   aggs: list[tuple[str, str, str]]) -> DistTable:
+    axis = mesh.axis_names[0]
+    table = dist.table
+    key_cols = [table[k] for k in keys]
+    val_cols = [table[v] for v, _, _ in aggs]
+
+    n_in = 1 + 2 * len(key_cols) + 2 * len(val_cols)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(PartitionSpec(axis),) * n_in,
+             out_specs=(PartitionSpec(axis),) * (1 + 2 * len(key_cols)
+                                                 + 2 * len(aggs)))
+    def body(mask, *flat):
+        nk, nv = len(key_cols), len(val_cols)
+        kdatas = flat[:nk]
+        kvalids = flat[nk:2 * nk]
+        vdatas = flat[2 * nk:2 * nk + nv]
+        vvalids = flat[2 * nk + nv:]
+        C = mask.shape[0]
+
+        # Sort local rows by (dead-last, keys...) — dead slots group at the end.
+        operands = [(~mask).astype(jnp.uint8)]
+        for kd, kv in zip(kdatas, kvalids):
+            operands.append(jnp.where(kv, jnp.uint8(1), jnp.uint8(0)))
+            val = kd
+            if jnp.issubdtype(val.dtype, jnp.floating):
+                val = jnp.where(val != val, jnp.array(jnp.nan, val.dtype), val)
+            operands.append(val)
+        iota = jnp.arange(C, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(operands + [iota], dimension=0,
+                                  is_stable=True, num_keys=len(operands))
+        perm = sorted_ops[-1]
+        smask = jnp.take(mask, perm)
+        skd = [jnp.take(kd, perm) for kd in kdatas]
+        skv = [jnp.take(kv, perm) for kv in kvalids]
+
+        # Boundaries (first row of each group); dead rows are never starts.
+        boundary = jnp.zeros(C, jnp.bool_)
+        for kd, kv in zip(skd, skv):
+            neq = kd[1:] != kd[:-1]
+            if jnp.issubdtype(kd.dtype, jnp.floating):
+                neq = neq & ~((kd[1:] != kd[1:]) & (kd[:-1] != kd[:-1]))
+            both_null = ~kv[1:] & ~kv[:-1]
+            neq = (neq & ~both_null) | (kv[1:] != kv[:-1])
+            boundary = boundary | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), neq])
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), smask[1:] != smask[:-1]])
+        boundary = boundary & smask
+        gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        gid = jnp.where(smask, gid, C - 1)     # dead rows -> scratch segment
+
+        outs = [boundary]                       # new row mask = group starts
+        for kd, kv in zip(skd, skv):
+            outs.append(kd)                     # group key at start position
+            outs.append(kv)
+
+        for (vname, how, _), vd, vv in zip(aggs, vdatas, vvalids):
+            svd = jnp.take(vd, perm)
+            svv = jnp.take(vv, perm) & smask
+            counts = jax.ops.segment_sum(svv.astype(jnp.int64), gid,
+                                         num_segments=C)
+            counts_at = jnp.take(counts, gid)
+            if how == "count":
+                outs.append(counts_at)
+                outs.append(jnp.ones(C, jnp.bool_))
+                continue
+            if how in ("sum", "mean"):
+                acc_dt = jnp.float64 if how == "mean" or \
+                    jnp.issubdtype(svd.dtype, jnp.floating) else jnp.int64
+                vals = jnp.where(svv, svd, svd.dtype.type(0)).astype(acc_dt)
+                sums = jax.ops.segment_sum(vals, gid, num_segments=C)
+                if how == "mean":
+                    res = jnp.take(sums, gid) / jnp.maximum(
+                        counts_at.astype(jnp.float64), 1.0)
+                else:
+                    res = jnp.take(sums, gid)
+                outs.append(res)
+                outs.append(counts_at > 0)
+                continue
+            # min / max
+            if jnp.issubdtype(svd.dtype, jnp.floating):
+                ident = jnp.array(np.inf if how == "min" else -np.inf, svd.dtype)
+            else:
+                info = np.iinfo(np.dtype(svd.dtype))
+                ident = jnp.array(info.max if how == "min" else info.min,
+                                  svd.dtype)
+            vals = jnp.where(svv, svd, ident)
+            seg = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+            res = jnp.take(seg(vals, gid, num_segments=C), gid)
+            outs.append(res)
+            outs.append(counts_at > 0)
+        return tuple(outs)
+
+    flat_in = [dist.row_mask]
+    for kc in key_cols:
+        flat_in += [kc.data]
+    for kc in key_cols:
+        flat_in += [kc.valid_mask()]
+    for vc in val_cols:
+        flat_in += [vc.data]
+    for vc in val_cols:
+        flat_in += [vc.valid_mask()]
+
+    results = jax.jit(body)(*flat_in)
+    new_mask = results[0]
+    pos = 1
+    cols = []
+    for k, kc in zip(keys, key_cols):
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        validity = None if kc.validity is None else valid
+        cols.append((k, Column(data=data, validity=validity, dtype=kc.dtype)))
+    for (vname, how, out_name), vc in zip(aggs, val_cols):
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        if how == "count":
+            dtype = INT64
+        elif how == "mean":
+            dtype = FLOAT64
+        elif how == "sum":
+            from ..ops.groupby import _sum_dtype
+            dtype = _sum_dtype(vc.dtype)
+        else:
+            dtype = vc.dtype
+        cols.append((out_name, Column(data=data.astype(dtype.jnp_dtype),
+                                      validity=valid, dtype=dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask)
+
+
+def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
+              on: Sequence[str], how: str = "inner",
+              out_capacity_per_shard: Optional[int] = None,
+              bucket_size: Optional[int] = None) -> DistTable:
+    """Distributed equi-join: co-shuffle both sides, merge-join per shard.
+
+    Join keys must share names (``on``).  Output is padded to
+    ``out_capacity_per_shard`` rows per shard (default: left shard capacity
+    x2); overflow raises with the required capacity so callers can retry.
+    """
+    if how not in ("inner", "left"):
+        raise ValueError(f"unsupported distributed join type {how!r}")
+    lsh = shuffle(left, mesh, on, bucket_size=bucket_size)
+    rsh = shuffle(right, mesh, on, bucket_size=bucket_size)
+    P = mesh.devices.size
+    Cl = lsh.capacity_total // P
+    if out_capacity_per_shard is None:
+        out_capacity_per_shard = 2 * Cl
+
+    out, needed = _local_join(lsh, rsh, mesh, list(on), how,
+                              out_capacity_per_shard)
+    max_needed = int(needed)
+    if max_needed > out_capacity_per_shard:
+        out, _ = _local_join(lsh, rsh, mesh, list(on), how, max_needed)
+    return out
+
+
+def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
+                how: str, Cout: int):
+    axis = mesh.axis_names[0]
+    lkeys = [lsh.table[k] for k in on]
+    rkeys = [rsh.table[k] for k in on]
+    for lk, rk in zip(lkeys, rkeys):
+        if lk.dtype != rk.dtype:
+            raise ValueError("join key dtype mismatch (cast first)")
+    # Output naming mirrors ops.join: shared key columns come from the left
+    # side, overlapping non-key names get ('_x', '_y') suffixes.
+    lothers = []
+    overlap = (set(lsh.table.names) & set(rsh.table.names)) - set(on)
+    for n, c in lsh.table.items():
+        lothers.append((n + "_x" if n in overlap else n, c))
+    rothers = [(n + "_y" if n in overlap else n, c)
+               for n, c in rsh.table.items() if n not in on]
+
+    def flatten_side(cols):
+        flat = []
+        for c in cols:
+            flat += [c.data, c.valid_mask()]
+        return flat
+
+    l_flat = flatten_side([c for _, c in lothers])
+    r_flat = flatten_side([c for _, c in rothers])
+    lk_flat = flatten_side(lkeys)
+    rk_flat = flatten_side(rkeys)
+
+    n_in = 2 + len(lk_flat) + len(rk_flat) + len(l_flat) + len(r_flat)
+    n_out = 1 + len(l_flat) + len(r_flat) + 1
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(PartitionSpec(axis),) * n_in,
+             out_specs=((PartitionSpec(axis),) * (n_out - 1)
+                        + (PartitionSpec(),)))
+    def body(lmask, rmask, *flat):
+        i = 0
+        def take_pairs(count):
+            nonlocal i
+            out = [(flat[i + 2 * j], flat[i + 2 * j + 1]) for j in range(count)]
+            i += 2 * count
+            return out
+        lk = take_pairs(len(lkeys))
+        rk = take_pairs(len(rkeys))
+        lo_cols = take_pairs(len(lothers))
+        ro_cols = take_pairs(len(rothers))
+        Cl = lmask.shape[0]
+        Cr = rmask.shape[0]
+
+        # Surrogate single key: hash of key tuple (the SAME hash_arrays that
+        # routed the shuffle, so colocation and matching stay equality-
+        # compatible by construction). Equal tuples share a hash; collisions
+        # across distinct tuples are ~2^-64 per pair — the correctness budget
+        # GPU hash joins run on. Null keys never match.
+        def key_hash(pairs):
+            from .hashing import hash_arrays
+            h = hash_arrays([(kd, kv) for kd, kv in pairs], seed=17)
+            any_null = jnp.zeros(h.shape[0], jnp.bool_)
+            for _, kv in pairs:
+                any_null = any_null | ~kv
+            return h, any_null
+
+        lh, lnull = key_hash(lk)
+        rh, rnull = key_hash(rk)
+        # Dead/null-key rows get side-distinct sentinels that never match.
+        lh = jnp.where(lmask & ~lnull, lh, jnp.uint64(0xDEAD00000000DEAD))
+        rh = jnp.where(rmask & ~rnull, rh, jnp.uint64(0xBEEF00000000BEEF))
+
+        rorder = jnp.argsort(rh, stable=True)
+        rh_sorted = jnp.take(rh, rorder)
+        lo = jnp.searchsorted(rh_sorted, lh, side="left")
+        hi = jnp.searchsorted(rh_sorted, lh, side="right")
+        counts = jnp.where(lmask & ~lnull, hi - lo, 0).astype(jnp.int32)
+        if how == "left":
+            counts_out = jnp.where(lmask, jnp.maximum(counts, 1), 0)
+        else:
+            counts_out = counts
+        bounds = jnp.cumsum(counts_out)
+        starts = bounds - counts_out
+        total = bounds[-1] if Cl else jnp.int32(0)
+
+        pos = jnp.arange(Cout, dtype=jnp.int32)
+        lrow = jnp.searchsorted(bounds, pos, side="right").astype(jnp.int32)
+        lrow_c = jnp.clip(lrow, 0, Cl - 1)
+        k = pos - jnp.take(starts, lrow_c)
+        matched = jnp.take(counts, lrow_c) > 0
+        rpos = jnp.take(lo, lrow_c) + k
+        rrow = jnp.take(rorder, jnp.clip(rpos, 0, Cr - 1))
+        out_mask = pos < total
+
+        outs = [out_mask]
+        for ld, lv in lo_cols:
+            outs.append(jnp.take(ld, lrow_c, axis=0))
+            outs.append(jnp.take(lv, lrow_c) & out_mask)
+        for rd, rv in ro_cols:
+            outs.append(jnp.take(rd, rrow, axis=0))
+            outs.append(jnp.take(rv, rrow) & matched & out_mask)
+        needed = jax.lax.pmax(total, axis)
+        return tuple(outs) + (needed,)
+
+    flat_in = [lsh.row_mask, rsh.row_mask] + lk_flat + rk_flat + l_flat + r_flat
+    results = jax.jit(body)(*flat_in)
+    new_mask = results[0]
+    needed = results[-1]
+    pos = 1
+    cols = []
+    for (name, c) in lothers:
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
+    for (name, c) in rothers:
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask), needed

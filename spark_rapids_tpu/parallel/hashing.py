@@ -1,0 +1,67 @@
+"""Deterministic 64-bit column hashing for partitioning.
+
+The shuffle contract needs a device-computable, deterministic hash of the
+key tuple (the role Murmur3 plays in Spark's HashPartitioner).  We use
+splitmix64 finalization — multiply/xor/shift only, all of which the TPU x64
+emulation supports.  Float keys hash their canonical bit patterns (NaN
+canonicalized, -0.0 == 0.0) via the same TPU-safe bit extraction the row
+format uses, so hash-equality matches group-equality exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..rows.bytes import backend_has_native_f64_bitcast, f64_to_bits
+
+
+def _splitmix64(x: jax.Array) -> jax.Array:
+    x = x.astype(jnp.uint64)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def _key_bits(data: jax.Array) -> jax.Array:
+    """Canonical int64 bit payload for hashing (group-equality safe)."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = data.astype(jnp.float64)
+        data = jnp.where(data != data, jnp.float64(jnp.nan), data)   # NaN canon
+        data = jnp.where(data == 0, jnp.float64(0.0), data)          # -0.0 canon
+        if backend_has_native_f64_bitcast():
+            return jax.lax.bitcast_convert_type(data, jnp.int64)
+        return f64_to_bits(data)
+    return data.astype(jnp.int64)
+
+
+def hash_arrays(pairs: list[tuple[jax.Array, Optional[jax.Array]]],
+                seed: int = 42) -> jax.Array:
+    """Combined uint64 hash of a key tuple given raw (data, validity-or-None)
+    pairs.  Jit-safe (used inside shard_map kernels as well as eagerly); null
+    contributes a distinct sentinel mix so (null,) != (0,)."""
+    n = pairs[0][0].shape[0]
+    h = jnp.full(n, np.uint64(seed), jnp.uint64)
+    for data, validity in pairs:
+        bits = _key_bits(data).astype(jnp.uint64)
+        if validity is not None:
+            bits = jnp.where(validity, bits, jnp.uint64(0x6E756C6C_6E756C6C))
+            h = h ^ jnp.where(validity, jnp.uint64(0), jnp.uint64(1))
+        h = _splitmix64(h ^ _splitmix64(bits))
+    return h
+
+
+def hash_columns(cols: list[Column], seed: int = 42) -> jax.Array:
+    """Combined uint64 hash of a key tuple of Columns."""
+    return hash_arrays([(c.data, c.validity) for c in cols], seed)
+
+
+def partition_ids(cols: list[Column], num_partitions: int,
+                  seed: int = 42) -> jax.Array:
+    """Target partition per row: hash(keys) mod P, int32."""
+    return (hash_columns(cols, seed) % jnp.uint64(num_partitions)).astype(jnp.int32)

@@ -1,0 +1,129 @@
+"""Device mesh + sharded (distributed) tables.
+
+TPU-native replacement for the reference system's distribution model (one
+Spark executor per GPU, UCX/NCCL shuffle in the spark-rapids plugin —
+SURVEY.md §2.4): a 1-D ``jax.sharding.Mesh`` whose axis is the partition
+dimension, tables sharded row-wise across it, and XLA collectives over
+ICI/DCN for data movement.
+
+**Static-shape representation.** Distributed ops run under ``shard_map``
+inside ``jit``, where output shapes must be static, but real partition sizes
+are data dependent.  Resolution: every shard holds a fixed ``capacity`` of
+row slots plus a ``row_mask`` marking live rows.  All distributed ops
+(shuffle/groupby/join) consume and produce this padded form with zero host
+round-trips; compaction happens only at :func:`collect` (host materialize).
+This replaces the reference world's dynamic buffers + executor-side resizing
+with the compile-once discipline TPU wants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..column import Column
+from ..table import Table
+
+AXIS = "x"    #: the partition axis name used throughout the engine
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis_name: str = AXIS) -> Mesh:
+    """A 1-D mesh over all (or the given) devices.
+
+    On a pod slice this is the ICI ring; across slices JAX orders DCN
+    transparently (multi-host: pass ``jax.devices()`` spanning hosts).
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def row_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class DistTable:
+    """A row-sharded table with padded shards.
+
+    ``table`` columns have global length ``P * capacity`` (``P`` mesh
+    devices), sharded on the row axis; ``row_mask`` marks live rows.
+    Fixed-width columns only (strings must be dictionary-encoded before
+    distribution — device-side global dictionaries are a follow-up).
+    """
+
+    table: Table
+    row_mask: jax.Array     # bool (P * capacity,)
+
+    def tree_flatten(self):
+        return (self.table, self.row_mask), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        table, row_mask = children
+        return cls(table=table, row_mask=row_mask)
+
+    @property
+    def capacity_total(self) -> int:
+        return int(self.row_mask.shape[0])
+
+    def num_rows(self) -> int:
+        """Live row count (host sync)."""
+        return int(jnp.sum(self.row_mask))
+
+
+def shard_table(table: Table, mesh: Mesh,
+                capacity: Optional[int] = None) -> DistTable:
+    """Distribute a host/device table row-wise over the mesh.
+
+    Rows are dealt out contiguously; each shard is padded to ``capacity``
+    slots (default: even split, rounded up).
+    """
+    P = mesh.devices.size
+    n = table.num_rows
+    if capacity is None:
+        capacity = max(1, -(-n // P))
+    if n > P * capacity:
+        raise ValueError(f"{n} rows exceed mesh capacity {P}x{capacity}")
+    total = P * capacity
+
+    cols = []
+    for name, col in table.items():
+        if col.offsets is not None:
+            raise ValueError(
+                f"column {name!r} is variable-width: dictionary-encode string "
+                f"columns before distributing (ops.strings.dictionary_encode)")
+        data = jnp.zeros(total, col.data.dtype).at[:n].set(col.data)
+        validity = None
+        if col.validity is not None:
+            validity = jnp.zeros(total, jnp.bool_).at[:n].set(col.validity)
+        cols.append((name, Column(data=data, validity=validity, dtype=col.dtype)))
+    row_mask = jnp.zeros(total, jnp.bool_).at[:n].set(True)
+
+    spec = row_spec(mesh)
+    sharded_cols = [(name, Column(data=jax.device_put(c.data, spec),
+                                  validity=None if c.validity is None
+                                  else jax.device_put(c.validity, spec),
+                                  dtype=c.dtype))
+                    for name, c in cols]
+    return DistTable(table=Table(sharded_cols),
+                     row_mask=jax.device_put(row_mask, spec))
+
+
+def collect(dist: DistTable) -> Table:
+    """Materialize a DistTable on host, dropping padding slots."""
+    mask = np.asarray(dist.row_mask)
+    cols = []
+    for name, col in dist.table.items():
+        data = np.asarray(col.data)[mask]
+        validity = None
+        if col.validity is not None:
+            v = np.asarray(col.validity)[mask]
+            validity = None if v.all() else v
+        cols.append((name, Column.from_numpy(data, validity, dtype=col.dtype)))
+    return Table(cols)

@@ -1,0 +1,107 @@
+"""Hash shuffle over the mesh: the engine's repartition primitive.
+
+TPU-native equivalent of the RAPIDS Shuffle Manager's UCX/NCCL transport
+(SURVEY.md §2.4): rows move between shards with one ``lax.all_to_all`` over
+the mesh axis — ICI bandwidth within a slice, DCN across slices — inside a
+single jitted ``shard_map``.  No host round-trips, no dynamic shapes:
+
+  1. per shard, order local rows by target partition (one small sort),
+  2. slice the ordered rows into P fixed-capacity buckets (padding marked
+     in the bucket mask; per-target overflow detected, not silently dropped),
+  3. ``all_to_all`` the bucket slabs (the only cross-chip step),
+  4. the received P slabs *are* the new shard: capacity P * bucket_size,
+     live rows marked in the new row mask.
+
+Overflow handling is cooperative: the op returns an overflow flag (psum of
+per-target overruns); callers re-run with a larger ``bucket_size``.  The
+default slack (2x even split) absorbs typical hash skew.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from ..column import Column
+from ..table import Table
+from .hashing import partition_ids
+from .mesh import AXIS, DistTable
+
+
+def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
+            bucket_size: Optional[int] = None, seed: int = 42) -> DistTable:
+    """Redistribute rows so equal key tuples land on the same shard."""
+    P = mesh.devices.size
+    capacity = dist.capacity_total // P
+    if bucket_size is None:
+        bucket_size = max(1, 2 * (-(-capacity // P)))   # 2x even-split slack
+
+    pids = partition_ids([dist.table[k] for k in keys], P, seed)
+
+    out, overflow = _shuffle_arrays(dist, mesh, pids, P, capacity, bucket_size)
+    if bool(overflow):   # host sync; rerun with more slack
+        return shuffle(dist, mesh, keys, bucket_size=bucket_size * 2, seed=seed)
+    return out
+
+
+def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
+                    capacity: int, bucket_size: int):
+    axis = mesh.axis_names[0]
+    names = dist.table.names
+    datas = tuple(c.data for c in dist.table.columns)
+    valids = tuple(c.valid_mask() for c in dist.table.columns)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(PartitionSpec(axis),) * (2 + len(datas) + len(valids)),
+             out_specs=((PartitionSpec(axis),) * (1 + len(datas) + len(valids))
+                        + (PartitionSpec(),)))
+    def body(pids_l, mask_l, *cols_l):
+        datas_l = cols_l[:len(datas)]
+        valids_l = cols_l[len(datas):]
+        # Dead slots route to a virtual partition P (sorts last, never sent).
+        eff_pid = jnp.where(mask_l, pids_l, P)
+        order = jnp.argsort(eff_pid, stable=True)
+        sorted_pid = eff_pid[order]
+        # Bucket boundaries within the sorted local rows.
+        starts = jnp.searchsorted(sorted_pid, jnp.arange(P, dtype=jnp.int32))
+        ends = jnp.searchsorted(sorted_pid, jnp.arange(P, dtype=jnp.int32),
+                                side="right")
+        counts = ends - starts                          # (P,)
+        overflow = jnp.any(counts > bucket_size)
+        # Gather rows into (P * bucket_size,) bucket-major layout.
+        slot = jnp.arange(P * bucket_size, dtype=jnp.int32)
+        b_target = slot // bucket_size
+        b_idx = slot % bucket_size
+        src_pos = jnp.take(starts, b_target) + b_idx
+        live = b_idx < jnp.take(counts, b_target)
+        src = jnp.take(order, jnp.clip(src_pos, 0, capacity - 1))
+
+        def exchange(x, mask_with_live=False):
+            bucketed = jnp.take(x, src, axis=0)
+            if mask_with_live:
+                bucketed = bucketed & live
+            return jax.lax.all_to_all(bucketed, axis, split_axis=0,
+                                      concat_axis=0, tiled=True)
+
+        new_mask = exchange(mask_l, mask_with_live=True)
+        new_datas = tuple(exchange(d) for d in datas_l)
+        new_valids = tuple(exchange(v, mask_with_live=True) for v in valids_l)
+        overflow_any = jax.lax.psum(overflow.astype(jnp.int32), axis) > 0
+        return (new_mask,) + new_datas + new_valids + (overflow_any,)
+
+    results = jax.jit(body)(pids, dist.row_mask, *datas, *valids)
+    new_mask = results[0]
+    new_datas = results[1:1 + len(datas)]
+    new_valids = results[1 + len(datas):-1]
+    overflow = results[-1]
+
+    cols = []
+    for name, old, data, valid in zip(names, dist.table.columns, new_datas,
+                                      new_valids):
+        validity = None if old.validity is None else valid
+        cols.append((name, Column(data=data, validity=validity, dtype=old.dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask), overflow

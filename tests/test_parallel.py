@@ -1,0 +1,221 @@
+"""Distributed layer tests on the 8-virtual-device CPU mesh.
+
+The oracle is always the single-device eager engine (or pandas): distributed
+results, collected and sorted, must equal local results.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu import ops
+from spark_rapids_tpu.parallel import (DistTable, collect, dist_groupby,
+                                       dist_join, hash_columns, make_mesh,
+                                       partition_ids, shard_table, shuffle)
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def make_table(rng, n, with_nulls=True):
+    k = rng.integers(0, 23, n).astype(np.int64)
+    v = rng.standard_normal(n)
+    mask = rng.random(n) > 0.1 if with_nulls else None
+    return Table({
+        "k": Column.from_numpy(k),
+        "v": Column.from_numpy(v, mask),
+    })
+
+
+class TestHashing:
+    def test_deterministic_and_spread(self):
+        c = Column.from_pylist(list(range(1000)), dt.INT64)
+        h1 = hash_columns([c])
+        h2 = hash_columns([c])
+        assert (np.asarray(h1) == np.asarray(h2)).all()
+        pids = np.asarray(partition_ids([c], 8))
+        counts = np.bincount(pids, minlength=8)
+        assert (counts > 60).all()          # roughly uniform
+
+    def test_null_differs_from_zero(self):
+        a = Column.from_pylist([0], dt.INT64)
+        b = Column.from_pylist([None], dt.INT64)
+        assert np.asarray(hash_columns([a]))[0] != np.asarray(hash_columns([b]))[0]
+
+    def test_float_canonicalization(self):
+        a = Column.from_numpy(np.array([0.0, np.nan]))
+        b = Column.from_numpy(np.array([-0.0, np.nan]))
+        assert (np.asarray(hash_columns([a])) == np.asarray(hash_columns([b]))).all()
+
+
+@needs_8
+class TestShardCollect:
+    def test_roundtrip(self, mesh, rng):
+        t = make_table(rng, 1000)
+        dist = shard_table(t, mesh)
+        assert dist.num_rows() == 1000
+        back = collect(dist)
+        assert_tables_equal(back, t)
+
+    def test_string_column_rejected(self, mesh):
+        t = Table.from_pydict({"s": ["a", "b"]})
+        with pytest.raises(ValueError, match="dictionary-encode"):
+            shard_table(t, mesh)
+
+
+@needs_8
+class TestShuffle:
+    def test_preserves_rows_and_colocates_keys(self, mesh, rng):
+        t = make_table(rng, 2000)
+        dist = shard_table(t, mesh)
+        sh = shuffle(dist, mesh, ["k"])
+        assert sh.num_rows() == 2000
+        back = collect(sh)
+        # multiset of rows preserved
+        got = sorted(zip(back.to_pydict()["k"],
+                         [x if x is None else round(x, 9)
+                          for x in back.to_pydict()["v"]]),
+                     key=lambda p: (p[0], p[1] is None, p[1] or 0))
+        exp = sorted(zip(t.to_pydict()["k"],
+                         [x if x is None else round(x, 9)
+                          for x in t.to_pydict()["v"]]),
+                     key=lambda p: (p[0], p[1] is None, p[1] or 0))
+        assert got == exp
+        # colocation: every key lives on exactly one shard
+        P = mesh.devices.size
+        cap = sh.capacity_total // P
+        mask = np.asarray(sh.row_mask).reshape(P, cap)
+        keys = np.asarray(sh.table["k"].data).reshape(P, cap)
+        owners = {}
+        for p in range(P):
+            for key in np.unique(keys[p][mask[p]]):
+                assert owners.setdefault(int(key), p) == p
+
+    def test_overflow_retry_with_skew(self, mesh, rng):
+        # all rows share one key -> every row must land on one shard
+        t = Table({"k": Column.from_numpy(np.zeros(800, np.int64)),
+                   "v": Column.from_numpy(np.arange(800).astype(np.int64))})
+        dist = shard_table(t, mesh)
+        sh = shuffle(dist, mesh, ["k"])
+        assert sh.num_rows() == 800
+        back = collect(sh)
+        assert sorted(back.to_pydict()["v"]) == list(range(800))
+
+
+@needs_8
+class TestDistGroupBy:
+    def test_matches_local_engine(self, mesh, rng):
+        t = make_table(rng, 3000)
+        dist = shard_table(t, mesh)
+        g = dist_groupby(dist, mesh, ["k"],
+                         [("v", "sum", "v_sum"), ("v", "count", "v_count"),
+                          ("v", "min", "v_min"), ("v", "max", "v_max"),
+                          ("v", "mean", "v_mean")])
+        got = ops.sort_by(collect(g), "k")
+        exp = ops.sort_by(
+            ops.groupby(t, "k").agg({"v": ["sum", "count", "min", "max", "mean"]}),
+            "k")
+        assert got.to_pydict()["k"] == exp.to_pydict()["k"]
+        np.testing.assert_allclose(got.to_pydict()["v_sum"],
+                                   exp.to_pydict()["v_sum"], rtol=1e-9)
+        assert got.to_pydict()["v_count"] == exp.to_pydict()["v_count"]
+        np.testing.assert_allclose(got.to_pydict()["v_min"],
+                                   exp.to_pydict()["v_min"])
+        np.testing.assert_allclose(got.to_pydict()["v_max"],
+                                   exp.to_pydict()["v_max"])
+        np.testing.assert_allclose(got.to_pydict()["v_mean"],
+                                   exp.to_pydict()["v_mean"], rtol=1e-9)
+
+    def test_null_keys_form_group(self, mesh):
+        t = Table.from_pydict({"k": [1, None, 1, None], "v": [1, 2, 3, 4]},
+                              dtypes={"k": dt.INT64, "v": dt.INT64})
+        dist = shard_table(t, mesh)
+        g = dist_groupby(dist, mesh, ["k"], [("v", "sum", "v")])
+        got = ops.sort_by(collect(g), "k")
+        assert got.to_pydict() == {"k": [None, 1], "v": [6, 4]}
+
+    def test_multi_key(self, mesh, rng):
+        n = 1000
+        a = rng.integers(0, 5, n).astype(np.int64)
+        b = rng.integers(0, 7, n).astype(np.int64)
+        v = rng.integers(0, 100, n).astype(np.int64)
+        t = Table({"a": Column.from_numpy(a), "b": Column.from_numpy(b),
+                   "v": Column.from_numpy(v)})
+        dist = shard_table(t, mesh)
+        g = dist_groupby(dist, mesh, ["a", "b"], [("v", "sum", "v")])
+        got = ops.sort_by(collect(g), ["a", "b"]).to_pydict()
+        exp = (pd.DataFrame({"a": a, "b": b, "v": v})
+               .groupby(["a", "b"])["v"].sum().reset_index())
+        assert got["a"] == exp["a"].tolist()
+        assert got["b"] == exp["b"].tolist()
+        assert got["v"] == exp["v"].tolist()
+
+
+@needs_8
+class TestDistJoin:
+    def test_inner_matches_local(self, mesh, rng):
+        nl, nr = 1500, 1200
+        lk = rng.integers(0, 40, nl).astype(np.int64)
+        rk = rng.integers(0, 40, nr).astype(np.int64)
+        left = Table({"k": Column.from_numpy(lk),
+                      "lv": Column.from_numpy(np.arange(nl, dtype=np.int64))})
+        right = Table({"k": Column.from_numpy(rk),
+                       "rv": Column.from_numpy(np.arange(nr, dtype=np.int64) * 7)})
+        dl = shard_table(left, mesh)
+        dr = shard_table(right, mesh)
+        j = dist_join(dl, dr, mesh, ["k"])
+        got = collect(j).to_pydict()
+        exp = ops.join(left, right, on="k").to_pydict()
+        assert sorted(zip(got["k"], got["lv"], got["rv"])) == \
+            sorted(zip(exp["k"], exp["lv"], exp["rv"]))
+
+    def test_left_join(self, mesh):
+        left = Table.from_pydict({"k": [1, 2, 3], "lv": [10, 20, 30]},
+                                 dtypes={"k": dt.INT64, "lv": dt.INT64})
+        right = Table.from_pydict({"k": [2], "rv": [200]},
+                                  dtypes={"k": dt.INT64, "rv": dt.INT64})
+        j = dist_join(shard_table(left, mesh), shard_table(right, mesh),
+                      mesh, ["k"], how="left")
+        got = ops.sort_by(collect(j), "k").to_pydict()
+        assert got == {"k": [1, 2, 3], "lv": [10, 20, 30],
+                       "rv": [None, 200, None]}
+
+    def test_null_keys_never_match(self, mesh):
+        left = Table.from_pydict({"k": [1, None], "lv": [10, 20]},
+                                 dtypes={"k": dt.INT64, "lv": dt.INT64})
+        right = Table.from_pydict({"k": [None, 1], "rv": [100, 200]},
+                                  dtypes={"k": dt.INT64, "rv": dt.INT64})
+        j = dist_join(shard_table(left, mesh), shard_table(right, mesh),
+                      mesh, ["k"])
+        got = collect(j).to_pydict()
+        assert got == {"k": [1], "lv": [10], "rv": [200]}
+
+    def test_overlapping_non_key_names_suffixed(self, mesh):
+        left = Table.from_pydict({"k": [1], "v": [10]},
+                                 dtypes={"k": dt.INT64, "v": dt.INT64})
+        right = Table.from_pydict({"k": [1], "v": [99]},
+                                  dtypes={"k": dt.INT64, "v": dt.INT64})
+        j = dist_join(shard_table(left, mesh), shard_table(right, mesh),
+                      mesh, ["k"])
+        got = collect(j)
+        assert set(got.names) == {"k", "v_x", "v_y"}
+        assert got.to_pydict() == {"k": [1], "v_x": [10], "v_y": [99]}
+
+    def test_one_to_many_expansion(self, mesh):
+        left = Table.from_pydict({"k": [7], "lv": [1]},
+                                 dtypes={"k": dt.INT64, "lv": dt.INT64})
+        right = Table.from_pydict({"k": [7] * 50, "rv": list(range(50))},
+                                  dtypes={"k": dt.INT64, "rv": dt.INT64})
+        j = dist_join(shard_table(left, mesh), shard_table(right, mesh),
+                      mesh, ["k"])
+        got = collect(j).to_pydict()
+        assert sorted(got["rv"]) == list(range(50))
