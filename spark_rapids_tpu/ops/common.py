@@ -37,20 +37,40 @@ def compact_indices(mask: jax.Array) -> jax.Array:
     return order[:count]
 
 
-def null_safe_equal_adjacent(col: Column) -> jax.Array:
-    """For a sorted column: mask[i] = row i differs from row i-1 (grouping
-    equality: null == null, NaN == NaN per Spark/cuDF). mask[0] is True."""
-    data = col.data
+def adjacent_differs(data: jax.Array, validity=None) -> jax.Array:
+    """For sorted raw arrays: mask[i] = row i differs from row i-1 (grouping
+    equality: null == null, NaN == NaN per Spark/cuDF). mask[0] is True.
+
+    Array-level form shared by the local engine and the distributed
+    shard_map kernels (parallel.dist_ops) so grouping-equality semantics
+    have exactly one definition."""
     neq = data[1:] != data[:-1]
     if jnp.issubdtype(data.dtype, jnp.floating):
         both_nan = (data[1:] != data[1:]) & (data[:-1] != data[:-1])
         neq = neq & ~both_nan
-    if col.validity is not None:
-        v = col.validity
-        both_null = ~v[1:] & ~v[:-1]
-        null_differs = v[1:] != v[:-1]
+    if validity is not None:
+        both_null = ~validity[1:] & ~validity[:-1]
+        null_differs = validity[1:] != validity[:-1]
         neq = (neq & ~both_null) | null_differs
     return jnp.concatenate([jnp.ones(1, jnp.bool_), neq])
+
+
+def null_safe_equal_adjacent(col: Column) -> jax.Array:
+    """Column wrapper over :func:`adjacent_differs`."""
+    return adjacent_differs(col.data, col.validity)
+
+
+def null_safe_equal_at(ldata: jax.Array, lvalid, rdata: jax.Array, rvalid) -> jax.Array:
+    """Elementwise grouping equality between two gathered key arrays
+    (null == null, NaN == NaN — same semantics as :func:`adjacent_differs`)."""
+    eq = ldata == rdata
+    if jnp.issubdtype(ldata.dtype, jnp.floating):
+        eq = eq | ((ldata != ldata) & (rdata != rdata))
+    if lvalid is None and rvalid is None:
+        return eq
+    lv = jnp.ones(ldata.shape[0], jnp.bool_) if lvalid is None else lvalid
+    rv = jnp.ones(rdata.shape[0], jnp.bool_) if rvalid is None else rvalid
+    return jnp.where(lv & rv, eq, ~lv & ~rv)
 
 
 def grouping_columns(cols: list[Column]) -> list[Column]:

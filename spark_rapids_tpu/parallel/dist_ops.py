@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec
 
 from ..column import Column
 from ..dtypes import FLOAT64, INT64
+from ..ops.common import adjacent_differs, null_safe_equal_at
 from ..table import Table
 from .mesh import DistTable
 from .shuffle import shuffle
@@ -86,15 +87,12 @@ def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
         skv = [jnp.take(kv, perm) for kv in kvalids]
 
         # Boundaries (first row of each group); dead rows are never starts.
+        # Grouping equality is defined once, in ops.common.adjacent_differs
+        # (null == null, NaN == NaN) — shared with the local engine so
+        # distributed results can never drift from the local oracle.
         boundary = jnp.zeros(C, jnp.bool_)
         for kd, kv in zip(skd, skv):
-            neq = kd[1:] != kd[:-1]
-            if jnp.issubdtype(kd.dtype, jnp.floating):
-                neq = neq & ~((kd[1:] != kd[1:]) & (kd[:-1] != kd[:-1]))
-            both_null = ~kv[1:] & ~kv[:-1]
-            neq = (neq & ~both_null) | (kv[1:] != kv[:-1])
-            boundary = boundary | jnp.concatenate(
-                [jnp.ones(1, jnp.bool_), neq])
+            boundary = boundary | adjacent_differs(kd, kv)
         boundary = boundary | jnp.concatenate(
             [jnp.ones(1, jnp.bool_), smask[1:] != smask[:-1]])
         boundary = boundary & smask
@@ -187,7 +185,11 @@ def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
 
     Join keys must share names (``on``).  Output is padded to
     ``out_capacity_per_shard`` rows per shard (default: left shard capacity
-    x2); overflow raises with the required capacity so callers can retry.
+    x2).  If any shard's join expansion exceeds that capacity, the op
+    detects it (one host-synced scalar) and automatically re-runs the local
+    kernel with the required capacity — callers never see an overflow, but
+    a badly under-sized ``out_capacity_per_shard`` costs a second jitted
+    pass.
     """
     if how not in ("inner", "left"):
         raise ValueError(f"unsupported distributed join type {how!r}")
@@ -257,9 +259,10 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
 
         # Surrogate single key: hash of key tuple (the SAME hash_arrays that
         # routed the shuffle, so colocation and matching stay equality-
-        # compatible by construction). Equal tuples share a hash; collisions
-        # across distinct tuples are ~2^-64 per pair — the correctness budget
-        # GPU hash joins run on. Null keys never match.
+        # compatible by construction).  The hash probe is a candidate filter
+        # only: every emitted pair is re-verified against the real key
+        # columns below (null_safe_equal_at), as cuDF/spark-rapids hash
+        # joins verify equality after the probe.  Null keys never match.
         def key_hash(pairs):
             from .hashing import hash_arrays
             h = hash_arrays([(kd, kv) for kd, kv in pairs], seed=17)
@@ -283,18 +286,46 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
             counts_out = jnp.where(lmask, jnp.maximum(counts, 1), 0)
         else:
             counts_out = counts
-        bounds = jnp.cumsum(counts_out)
-        starts = bounds - counts_out
-        total = bounds[-1] if Cl else jnp.int32(0)
+        # Expansion bookkeeping in int64: per-shard output positions can
+        # exceed 2**31 under heavy key skew; int32 cumsum would wrap and
+        # silently truncate the join instead of triggering the capacity
+        # retry.  The per-slot index math, though, runs at int32 whenever
+        # the output fits (every realistic shard) — TPU emulates int64, so
+        # the hot gather-index path shouldn't pay x64 cost just for
+        # overflow detection.
+        bounds64 = jnp.cumsum(counts_out.astype(jnp.int64))
+        total = bounds64[-1] if Cl else jnp.int64(0)
+        idx_dt = jnp.int32 if Cout < 2**31 else jnp.int64
+        bounds = jnp.clip(bounds64, 0, 2**31 - 1).astype(idx_dt) \
+            if idx_dt == jnp.int32 else bounds64
+        starts = bounds - counts_out.astype(idx_dt)
 
-        pos = jnp.arange(Cout, dtype=jnp.int32)
+        pos = jnp.arange(Cout, dtype=idx_dt)
         lrow = jnp.searchsorted(bounds, pos, side="right").astype(jnp.int32)
         lrow_c = jnp.clip(lrow, 0, Cl - 1)
-        k = pos - jnp.take(starts, lrow_c)
+        k = (pos - jnp.take(starts, lrow_c)).astype(jnp.int32)
         matched = jnp.take(counts, lrow_c) > 0
-        rpos = jnp.take(lo, lrow_c) + k
+        rpos = jnp.take(lo, lrow_c).astype(jnp.int32) + k
         rrow = jnp.take(rorder, jnp.clip(rpos, 0, Cr - 1))
-        out_mask = pos < total
+        out_mask = pos.astype(jnp.int64) < total
+
+        # Post-probe verification: the probe matched on the 64-bit hash; a
+        # collision between distinct key tuples (or a left hash landing on
+        # the dead-right sentinel) must not emit a bogus pair.  Verify the
+        # real key columns and that the right row is live with a non-null
+        # key.  A collided pair becomes a dead output slot (for "left", the
+        # affected left row is dropped rather than null-padded — the
+        # ~2^-64-probability residual of the hash probe).
+        verified = jnp.take(rmask & ~rnull, rrow)
+        for (ld, lv), (rd, rv) in zip(lk, rk):
+            verified = verified & null_safe_equal_at(
+                jnp.take(ld, lrow_c, axis=0), jnp.take(lv, lrow_c),
+                jnp.take(rd, rrow, axis=0), jnp.take(rv, rrow))
+        right_live = matched & verified
+        if how == "left":
+            out_mask = out_mask & (verified | ~matched)
+        else:
+            out_mask = out_mask & verified
 
         outs = [out_mask]
         for ld, lv in lo_cols:
@@ -302,7 +333,7 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
             outs.append(jnp.take(lv, lrow_c) & out_mask)
         for rd, rv in ro_cols:
             outs.append(jnp.take(rd, rrow, axis=0))
-            outs.append(jnp.take(rv, rrow) & matched & out_mask)
+            outs.append(jnp.take(rv, rrow) & right_live & out_mask)
         needed = jax.lax.pmax(total, axis)
         return tuple(outs) + (needed,)
 

@@ -14,7 +14,11 @@ single jitted ``shard_map``.  No host round-trips, no dynamic shapes:
 
 Overflow handling is cooperative: the op returns an overflow flag (psum of
 per-target overruns); callers re-run with a larger ``bucket_size``.  The
-default slack (2x even split) absorbs typical hash skew.
+default ``bucket_size`` is derived from the *live*-row distribution (the
+busiest sender's rows spread over P buckets, 2x slack for hash skew) — not
+from the input's padded capacity — so chained distributed ops keep output
+capacity proportional to real rows; hot-key skew is absorbed by the
+overflow retry doubling instead of by permanent padding.
 """
 
 from __future__ import annotations
@@ -34,11 +38,22 @@ from .mesh import AXIS, DistTable
 
 def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
             bucket_size: Optional[int] = None, seed: int = 42) -> DistTable:
-    """Redistribute rows so equal key tuples land on the same shard."""
+    """Redistribute rows so equal key tuples land on the same shard.
+
+    Output capacity is ``P * bucket_size`` slots per shard.  The default
+    ``bucket_size`` is sized from the *live* row distribution (one
+    host-synced P-element reduction), not from the input's padded capacity —
+    chained distributed ops (join -> groupby) therefore keep capacity
+    proportional to real rows instead of doubling it at every stage.
+    """
     P = mesh.devices.size
     capacity = dist.capacity_total // P
     if bucket_size is None:
-        bucket_size = max(1, 2 * (-(-capacity // P)))   # 2x even-split slack
+        # Worst sender must fit its rows in P buckets; 2x slack for hash
+        # skew, floor of 8 so tiny shards don't thrash the overflow retry.
+        per_shard_live = jnp.sum(dist.row_mask.reshape(P, capacity), axis=1)
+        max_live = int(jnp.max(per_shard_live))   # host sync (P scalars)
+        bucket_size = max(8, 2 * (-(-max_live // P)))
 
     pids = partition_ids([dist.table[k] for k in keys], P, seed)
 

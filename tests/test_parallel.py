@@ -219,3 +219,46 @@ class TestDistJoin:
                       mesh, ["k"])
         got = collect(j).to_pydict()
         assert sorted(got["rv"]) == list(range(50))
+
+
+class TestCapacityDiscipline:
+    """Chained distributed ops must keep padded capacity proportional to
+    live rows, not double it per stage (shuffle sizes buckets from the live
+    row distribution)."""
+
+    def test_repeated_shuffle_capacity_bounded(self, mesh):
+        n = 256
+        t = Table.from_pydict({
+            "k": np.arange(n, dtype=np.int64) % 13,
+            "v": np.arange(n, dtype=np.int64),
+        })
+        d = shard_table(t, mesh)
+        for i in range(6):
+            d = shuffle(d, mesh, ["k"], seed=i)
+            assert d.num_rows() == n
+            # Capacity stays bounded by the live-row distribution (worst
+            # case ~P x live when skew routes a whole shard to one target),
+            # NOT compounding 2x per stage: a capacity-derived default
+            # would exceed 64x by iteration 6.
+            assert d.capacity_total <= 16 * n + 8 * 64
+        got = collect(d)
+        assert sorted(got["v"].to_pylist()) == list(range(n))
+
+    def test_join_then_groupby_capacity_bounded(self, mesh):
+        n = 128
+        facts = Table.from_pydict({
+            "k": np.arange(n, dtype=np.int64) % 8,
+            "v": np.ones(n, dtype=np.int64),
+        })
+        dims = Table.from_pydict({
+            "k": np.arange(8, dtype=np.int64),
+            "w": np.arange(8, dtype=np.int64),
+        })
+        j = dist_join(shard_table(facts, mesh), shard_table(dims, mesh),
+                      mesh, ["k"])
+        g = dist_groupby(j, mesh, ["k"], [("w", "sum", "w_sum")])
+        assert g.capacity_total <= 16 * n + 8 * 64
+        got = collect(g)
+        expect = {k: k * (n // 8) for k in range(8)}
+        assert dict(zip(got["k"].to_pylist(),
+                        got["w_sum"].to_pylist())) == expect
