@@ -1,0 +1,235 @@
+/* C ABI host bridge (the JNI-bridge counterpart).
+ *
+ * Plays the role of the reference's RowConversionJni.cpp for non-JVM hosts:
+ *   - dtypes cross the boundary as parallel int32 arrays of type-id and
+ *     decimal scale (RowConversionJni.cpp:56-61),
+ *   - library-allocated results are returned as opaque int64 handles whose
+ *     lifetime the caller owns and must explicitly free
+ *     (RowConversionJni.cpp:33-38 released-pointer contract),
+ *   - C++ exceptions are mapped to status codes + a thread-local message
+ *     retrievable via srt_last_error() (the CATCH_STD analog,
+ *     RowConversionJni.cpp:40),
+ *   - build provenance is stamped into the binary (build/build-info analog).
+ *
+ * Loaded from Python via ctypes (spark_rapids_tpu/ffi/) and linkable from any
+ * C-compatible host (a JVM shim would be a thin JNI wrapper over this ABI).
+ */
+#include <cstdint>
+#include <cstring>
+#include <exception>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "row_conversion.hpp"
+#include "row_layout.hpp"
+
+#ifndef SRT_VERSION
+#define SRT_VERSION "0.0.0-dev"
+#endif
+#ifndef SRT_GIT_REV
+#define SRT_GIT_REV "unknown"
+#endif
+#ifndef SRT_BUILD_DATE
+#define SRT_BUILD_DATE "unknown"
+#endif
+
+namespace {
+
+using namespace spark_rapids_tpu;
+
+thread_local std::string g_last_error;
+
+constexpr int32_t SRT_OK = 0;
+constexpr int32_t SRT_ERR_INVALID = 1;  // std::invalid_argument (CUDF_EXPECTS analog)
+constexpr int32_t SRT_ERR_INTERNAL = 2; // anything else
+
+template <typename Fn>
+int32_t guarded(Fn&& fn) noexcept {
+  try {
+    fn();
+    return SRT_OK;
+  } catch (const std::invalid_argument& e) {
+    g_last_error = e.what();
+    return SRT_ERR_INVALID;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return SRT_ERR_INTERNAL;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return SRT_ERR_INTERNAL;
+  }
+}
+
+std::vector<DType> make_schema(int32_t ncols, const int32_t* type_ids,
+                               const int32_t* scales) {
+  if (ncols <= 0) throw std::invalid_argument("schema must have at least one column");
+  if (type_ids == nullptr) throw std::invalid_argument("type_ids is null");
+  std::vector<DType> schema;
+  schema.reserve(static_cast<size_t>(ncols));
+  for (int32_t i = 0; i < ncols; ++i)
+    schema.push_back(DType{static_cast<TypeId>(type_ids[i]),
+                           scales != nullptr ? scales[i] : 0});
+  return schema;
+}
+
+/* A batch of rows in the fixed-width format: the native analog of one
+ * LIST<INT8> output column (row_conversion.cu:405-406). */
+struct Blob {
+  std::vector<uint8_t> data;
+  int64_t num_rows = 0;
+  int32_t row_size = 0;
+};
+
+struct BlobSet {
+  std::vector<Blob> blobs;
+};
+
+BlobSet* as_blobset(int64_t handle) {
+  if (handle == 0) throw std::invalid_argument("null blob handle");
+  return reinterpret_cast<BlobSet*>(handle);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* srt_last_error() { return g_last_error.c_str(); }
+const char* srt_version() { return SRT_VERSION; }
+const char* srt_build_info() {
+  static const std::string info = std::string("version=") + SRT_VERSION +
+                                  ";revision=" + SRT_GIT_REV +
+                                  ";date=" + SRT_BUILD_DATE;
+  return info.c_str();
+}
+
+int32_t srt_compute_fixed_width_layout(int32_t ncols, const int32_t* type_ids,
+                                       const int32_t* scales, int32_t* col_starts,
+                                       int32_t* col_sizes, int32_t* validity_offset,
+                                       int32_t* validity_bytes, int32_t* row_size) {
+  return guarded([&] {
+    RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));
+    for (int32_t i = 0; i < ncols; ++i) {
+      if (col_starts) col_starts[i] = layout.column_starts[static_cast<size_t>(i)];
+      if (col_sizes) col_sizes[i] = layout.column_sizes[static_cast<size_t>(i)];
+    }
+    if (validity_offset) *validity_offset = layout.validity_offset;
+    if (validity_bytes) *validity_bytes = layout.validity_bytes;
+    if (row_size) *row_size = layout.row_size;
+  });
+}
+
+/* Direct caller-buffer pack: out_rows must hold num_rows * row_size bytes. */
+int32_t srt_pack_rows(int32_t ncols, const int32_t* type_ids, const int32_t* scales,
+                      int64_t num_rows, const void* const* col_data,
+                      const uint8_t* const* col_valid, uint8_t* out_rows) {
+  return guarded([&] {
+    if (num_rows < 0) throw std::invalid_argument("negative row count");
+    if (col_data == nullptr || out_rows == nullptr)
+      throw std::invalid_argument("null buffer");
+    RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));
+    pack_rows(layout, num_rows, col_data, col_valid, out_rows);
+  });
+}
+
+/* Direct caller-buffer unpack; validates the blob size against the schema
+ * layout like the reference (row_conversion.cu:541). */
+int32_t srt_unpack_rows(int32_t ncols, const int32_t* type_ids, const int32_t* scales,
+                        int64_t num_rows, const uint8_t* rows, int64_t rows_bytes,
+                        void* const* col_data, uint8_t* const* col_valid) {
+  return guarded([&] {
+    if (num_rows < 0) throw std::invalid_argument("negative row count");
+    if (rows == nullptr) throw std::invalid_argument("null buffer");
+    RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));
+    if (rows_bytes != num_rows * static_cast<int64_t>(layout.row_size))
+      throw std::invalid_argument("The layout of the data appears to be off");
+    unpack_rows(layout, num_rows, rows, col_data, col_valid);
+  });
+}
+
+/* Batched conversion with the reference's output contract: splits into blobs
+ * so none exceeds max_batch_bytes (<= 2^31-1), batch row counts in multiples
+ * of 32 (row_conversion.cu:476-479, :505-511); enforces the 1 KB row-width
+ * limit unless check_row_width is 0 (RowConversion.java:98-99).  Returns a
+ * blob-set handle the caller must free with srt_blobs_free; 0 on error with
+ * the error class written to out_status (if non-null) and the message
+ * available via srt_last_error. */
+int64_t srt_convert_to_rows(int32_t ncols, const int32_t* type_ids,
+                            const int32_t* scales, int64_t num_rows,
+                            const void* const* col_data,
+                            const uint8_t* const* col_valid,
+                            int64_t max_batch_bytes, int32_t check_row_width,
+                            int32_t* out_num_blobs, int32_t* out_status) {
+  BlobSet* result = nullptr;
+  int32_t status = guarded([&] {
+    if (num_rows < 0) throw std::invalid_argument("negative row count");
+    if (col_data == nullptr) throw std::invalid_argument("null buffer");
+    if (max_batch_bytes <= 0 || max_batch_bytes > kMaxBatchBytes)
+      max_batch_bytes = kMaxBatchBytes;
+    RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));
+    if (check_row_width != 0 && layout.row_size > kMaxRowWidth)
+      throw std::invalid_argument("row size exceeds the 1 KB row format limit");
+    int64_t max_rows = (max_batch_bytes / layout.row_size) / kBatchRowMultiple *
+                       kBatchRowMultiple;
+    if (max_rows <= 0) throw std::invalid_argument("row size too large for batch limit");
+
+    auto set = std::make_unique<BlobSet>();
+    std::vector<const uint8_t*> data_at(static_cast<size_t>(ncols));
+    std::vector<const uint8_t*> valid_at(static_cast<size_t>(ncols));
+    int64_t start = 0;
+    do {  // one empty blob for num_rows == 0 so the round trip stays total
+      int64_t count = std::min(max_rows, num_rows - start);
+      Blob blob;
+      blob.num_rows = count;
+      blob.row_size = layout.row_size;
+      blob.data.resize(static_cast<size_t>(count * layout.row_size));
+      for (int32_t c = 0; c < ncols; ++c) {
+        size_t ci = static_cast<size_t>(c);
+        data_at[ci] = static_cast<const uint8_t*>(col_data[c]) +
+                      start * layout.column_sizes[ci];
+        valid_at[ci] = (col_valid != nullptr && col_valid[c] != nullptr)
+                           ? col_valid[c] + start
+                           : nullptr;
+      }
+      pack_rows(layout, count,
+                reinterpret_cast<const void* const*>(data_at.data()),
+                valid_at.data(), blob.data.data());
+      set->blobs.push_back(std::move(blob));
+      start += count;
+    } while (start < num_rows);
+    if (out_num_blobs) *out_num_blobs = static_cast<int32_t>(set->blobs.size());
+    result = set.release();
+  });
+  if (out_status) *out_status = status;
+  return status == SRT_OK ? reinterpret_cast<int64_t>(result) : 0;
+}
+
+int32_t srt_blobs_count(int64_t handle) {
+  int32_t n = -1;
+  guarded([&] { n = static_cast<int32_t>(as_blobset(handle)->blobs.size()); });
+  return n;
+}
+
+int64_t srt_blob_num_rows(int64_t handle, int32_t i) {
+  int64_t n = -1;
+  guarded([&] { n = as_blobset(handle)->blobs.at(static_cast<size_t>(i)).num_rows; });
+  return n;
+}
+
+int32_t srt_blob_row_size(int64_t handle, int32_t i) {
+  int32_t n = -1;
+  guarded([&] { n = as_blobset(handle)->blobs.at(static_cast<size_t>(i)).row_size; });
+  return n;
+}
+
+const uint8_t* srt_blob_data(int64_t handle, int32_t i) {
+  const uint8_t* p = nullptr;
+  guarded([&] { p = as_blobset(handle)->blobs.at(static_cast<size_t>(i)).data.data(); });
+  return p;
+}
+
+void srt_blobs_free(int64_t handle) {
+  if (handle != 0) delete reinterpret_cast<BlobSet*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+#include "row_conversion.hpp"
+
+#include <algorithm>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace spark_rapids_tpu {
+namespace {
+
+/* Row-range parallel-for.  The reference sizes CUDA grids to saturate device
+ * memory bandwidth (row_conversion.cu:349-359); the host analog is one thread
+ * per core over contiguous row ranges, each range a multiple of 8 rows so a
+ * validity byte's rows never split across threads (they don't anyway — the
+ * tail is per-row — but keeping ranges cache-line-friendly is free). */
+template <typename Fn>
+void parallel_rows(int64_t num_rows, Fn&& fn) {
+  const int64_t kGrain = 16384;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t max_threads = std::max<int64_t>(1, hw);
+  int64_t n_threads = std::min(max_threads, (num_rows + kGrain - 1) / kGrain);
+  if (n_threads <= 1) {
+    fn(0, num_rows);
+    return;
+  }
+  int64_t chunk = (num_rows + n_threads - 1) / n_threads;
+  chunk = (chunk + 7) & ~int64_t{7};
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads));
+  for (int64_t start = 0; start < num_rows; start += chunk) {
+    int64_t end = std::min(start + chunk, num_rows);
+    threads.emplace_back([&fn, start, end] { fn(start, end); });
+  }
+  for (auto& t : threads) t.join();
+}
+
+/* Fixed-size strided copy: column buffer <-> row images.  The switch on
+ * element size mirrors the reference kernels' gather/scatter switch
+ * (row_conversion.cu:128-156, :226-254) and lets the compiler emit direct
+ * loads/stores instead of memcpy calls. */
+template <typename T>
+void copy_col_to_rows(const uint8_t* src, uint8_t* dst, int64_t n, int64_t row_size) {
+  for (int64_t r = 0; r < n; ++r) {
+    T v;
+    std::memcpy(&v, src + r * sizeof(T), sizeof(T));
+    std::memcpy(dst + r * row_size, &v, sizeof(T));
+  }
+}
+
+template <typename T>
+void copy_rows_to_col(const uint8_t* src, uint8_t* dst, int64_t n, int64_t row_size) {
+  for (int64_t r = 0; r < n; ++r) {
+    T v;
+    std::memcpy(&v, src + r * row_size, sizeof(T));
+    std::memcpy(dst + r * sizeof(T), &v, sizeof(T));
+  }
+}
+
+void strided_copy(const uint8_t* src, int64_t src_stride, uint8_t* dst,
+                  int64_t dst_stride, int64_t n, int32_t size) {
+  // Exactly one of the strides equals `size` (the column side is contiguous).
+  bool to_rows = src_stride == size;
+  const uint8_t* s = src;
+  uint8_t* d = dst;
+  int64_t row_stride = to_rows ? dst_stride : src_stride;
+  switch (size) {
+    case 1:
+      to_rows ? copy_col_to_rows<uint8_t>(s, d, n, row_stride)
+              : copy_rows_to_col<uint8_t>(s, d, n, row_stride);
+      break;
+    case 2:
+      to_rows ? copy_col_to_rows<uint16_t>(s, d, n, row_stride)
+              : copy_rows_to_col<uint16_t>(s, d, n, row_stride);
+      break;
+    case 4:
+      to_rows ? copy_col_to_rows<uint32_t>(s, d, n, row_stride)
+              : copy_rows_to_col<uint32_t>(s, d, n, row_stride);
+      break;
+    case 8:
+      to_rows ? copy_col_to_rows<uint64_t>(s, d, n, row_stride)
+              : copy_rows_to_col<uint64_t>(s, d, n, row_stride);
+      break;
+    default:
+      for (int64_t r = 0; r < n; ++r)
+        std::memcpy(d + r * dst_stride, s + r * src_stride, static_cast<size_t>(size));
+  }
+}
+
+}  // namespace
+
+void pack_rows(const RowLayout& layout, int64_t num_rows,
+               const void* const* col_data, const uint8_t* const* col_valid,
+               uint8_t* out) {
+  const int64_t row_size = layout.row_size;
+  const size_t ncols = layout.column_starts.size();
+  parallel_rows(num_rows, [&](int64_t lo, int64_t hi) {
+    const int64_t n = hi - lo;
+    uint8_t* base = out + lo * row_size;
+    // Deterministic zeros everywhere first (gaps, padding, unused validity
+    // bits) — the framework's contract tightens the reference, which leaves
+    // pad bytes as garbage (convert.py module doc).
+    std::memset(base, 0, static_cast<size_t>(n * row_size));
+    // Column at a time: contiguous source reads, strided row stores.
+    for (size_t c = 0; c < ncols; ++c) {
+      const int32_t size = layout.column_sizes[c];
+      const uint8_t* src = static_cast<const uint8_t*>(col_data[c]) + lo * size;
+      strided_copy(src, size, base + layout.column_starts[c], row_size, n, size);
+    }
+    // Validity tail: bit c%8 of byte c/8 (row_conversion.cu:158-165 word
+    // semantics, expressed per byte — no atomics needed on the host side).
+    // col_valid may itself be null: every column all-valid.
+    for (size_t c = 0; c < ncols; ++c) {
+      const uint8_t* valid = col_valid != nullptr ? col_valid[c] : nullptr;
+      uint8_t* vbase = base + layout.validity_offset + (c >> 3);
+      const uint8_t bit = static_cast<uint8_t>(1u << (c & 7));
+      if (valid == nullptr) {
+        for (int64_t r = 0; r < n; ++r) vbase[r * row_size] |= bit;
+      } else {
+        const uint8_t* v = valid + lo;
+        for (int64_t r = 0; r < n; ++r)
+          vbase[r * row_size] |= static_cast<uint8_t>((v[r] != 0) ? bit : 0);
+      }
+    }
+  });
+}
+
+void unpack_rows(const RowLayout& layout, int64_t num_rows, const uint8_t* rows,
+                 void* const* col_data, uint8_t* const* col_valid) {
+  const int64_t row_size = layout.row_size;
+  const size_t ncols = layout.column_starts.size();
+  parallel_rows(num_rows, [&](int64_t lo, int64_t hi) {
+    const int64_t n = hi - lo;
+    const uint8_t* base = rows + lo * row_size;
+    for (size_t c = 0; c < ncols; ++c) {
+      const int32_t size = layout.column_sizes[c];
+      if (col_data != nullptr && col_data[c] != nullptr) {
+        uint8_t* dst = static_cast<uint8_t*>(col_data[c]) + lo * size;
+        strided_copy(base + layout.column_starts[c], row_size, dst, size, n, size);
+      }
+      if (col_valid != nullptr && col_valid[c] != nullptr) {
+        uint8_t* vdst = col_valid[c] + lo;
+        const uint8_t* vsrc = base + layout.validity_offset + (c >> 3);
+        const uint8_t bit = static_cast<uint8_t>(1u << (c & 7));
+        for (int64_t r = 0; r < n; ++r)
+          vdst[r] = static_cast<uint8_t>((vsrc[r * row_size] & bit) ? 1 : 0);
+      }
+    }
+  });
+}
+
+}  // namespace spark_rapids_tpu

@@ -1,0 +1,40 @@
+/* Host-side columnar <-> row-major conversion (native half).
+ *
+ * The C++ counterpart of spark_rapids_tpu/rows/convert.py for non-Python /
+ * non-device hosts (Spark executors handing UnsafeRow-style buffers across
+ * the FFI boundary).  Functional equivalent of the reference's
+ * `spark_rapids_jni::convert_to_rows` / `convert_from_rows`
+ * (row_conversion.cu:458-517, :519-575) with the device kernels replaced by
+ * cache-blocked multi-threaded host loops; the TPU device path is the
+ * JAX/Pallas implementation, this path is the byte-exact host interop /
+ * fallback.
+ *
+ * Byte contract (shared with the JAX path; asserted by tests/test_ffi.py):
+ * alignment gaps, row padding, and unused validity bits are deterministic
+ * zeros; null entries' payload bytes are copied verbatim from the column
+ * buffer (the engine never invents values).
+ */
+#pragma once
+
+#include <cstdint>
+
+#include "row_layout.hpp"
+
+namespace spark_rapids_tpu {
+
+/* Columnar -> rows.  col_data[i] points to num_rows * column_sizes[i] bytes of
+ * contiguous column data; col_valid[i] is num_rows bytes of 0/1 validity, or
+ * nullptr meaning all-valid (col_valid itself may be nullptr: every column
+ * all-valid).  out must hold num_rows * layout.row_size bytes. */
+void pack_rows(const RowLayout& layout, int64_t num_rows,
+               const void* const* col_data, const uint8_t* const* col_valid,
+               uint8_t* out);
+
+/* Rows -> columnar.  rows holds num_rows * layout.row_size bytes; writes each
+ * column's data into col_data[i] (num_rows * column_sizes[i] bytes) and its
+ * validity into col_valid[i] (num_rows bytes of 0/1), skipping nullptr
+ * destinations (either outer array may also be nullptr entirely). */
+void unpack_rows(const RowLayout& layout, int64_t num_rows, const uint8_t* rows,
+                 void* const* col_data, uint8_t* const* col_valid);
+
+}  // namespace spark_rapids_tpu

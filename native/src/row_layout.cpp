@@ -1,0 +1,82 @@
+#include "row_layout.hpp"
+
+namespace spark_rapids_tpu {
+
+int32_t itemsize(TypeId id) {
+  switch (id) {
+    case TypeId::INT8:
+    case TypeId::UINT8:
+    case TypeId::BOOL8:
+      return 1;
+    case TypeId::INT16:
+    case TypeId::UINT16:
+      return 2;
+    case TypeId::INT32:
+    case TypeId::UINT32:
+    case TypeId::FLOAT32:
+    case TypeId::TIMESTAMP_DAYS:
+    case TypeId::DURATION_DAYS:
+    case TypeId::DECIMAL32:
+      return 4;
+    case TypeId::INT64:
+    case TypeId::UINT64:
+    case TypeId::FLOAT64:
+    case TypeId::TIMESTAMP_SECONDS:
+    case TypeId::TIMESTAMP_MILLISECONDS:
+    case TypeId::TIMESTAMP_MICROSECONDS:
+    case TypeId::TIMESTAMP_NANOSECONDS:
+    case TypeId::DURATION_SECONDS:
+    case TypeId::DURATION_MILLISECONDS:
+    case TypeId::DURATION_MICROSECONDS:
+    case TypeId::DURATION_NANOSECONDS:
+    case TypeId::DECIMAL64:
+      return 8;
+    default:
+      // DECIMAL128 included: the Python/JAX side has no 16-byte physical
+      // dtype (dtypes.py _PHYSICAL), and the cross-host byte contract must
+      // not let one side pack what the other cannot unpack.
+      throw std::invalid_argument("Only fixed width types are currently supported");
+  }
+}
+
+bool is_fixed_width(TypeId id) {
+  switch (id) {
+    case TypeId::EMPTY:
+    case TypeId::DICTIONARY32:
+    case TypeId::STRING:
+    case TypeId::LIST:
+    case TypeId::STRUCT:
+    case TypeId::DECIMAL128:  // no physical dtype on the Python/JAX side
+      return false;
+    default:
+      return true;
+  }
+}
+
+static int32_t align_offset(int32_t offset, int32_t alignment) {
+  return (offset + alignment - 1) & ~(alignment - 1);
+}
+
+RowLayout compute_fixed_width_layout(const std::vector<DType>& schema) {
+  if (schema.empty()) throw std::invalid_argument("schema must have at least one column");
+  RowLayout layout;
+  layout.column_starts.reserve(schema.size());
+  layout.column_sizes.reserve(schema.size());
+  int32_t at = 0;
+  for (const DType& dt : schema) {
+    if (!is_fixed_width(dt.type_id))
+      throw std::invalid_argument("Only fixed width types are currently supported");
+    int32_t size = itemsize(dt.type_id);
+    at = align_offset(at, size);  // natural alignment
+    layout.column_starts.push_back(at);
+    layout.column_sizes.push_back(size);
+    at += size;
+  }
+  layout.validity_offset = at;  // validity tail is byte-aligned, no padding
+  layout.validity_bytes = (static_cast<int32_t>(schema.size()) + 7) / 8;
+  at += layout.validity_bytes;
+  layout.row_size = align_offset(at, 8);  // 64-bit row alignment
+  return layout;
+}
+
+}  // namespace spark_rapids_tpu

@@ -1,0 +1,82 @@
+/* Fixed-width row-format layout engine (native half).
+ *
+ * Byte-identical C++ mirror of spark_rapids_tpu/rows/layout.py, itself the
+ * TPU-native re-implementation of the reference's layout contract
+ * (reference: src/main/cpp/src/row_conversion.cu:425-456
+ * `compute_fixed_width_layout`; format documented at RowConversion.java:60-89):
+ * columns at natural alignment in schema order, ceil(ncols/8) validity tail
+ * bytes (bit c%8 of byte c/8 set iff column c valid), row padded to 8 bytes.
+ *
+ * This is the host-interop contract: Python (JAX) and non-Python hosts must
+ * produce the same bytes.  tests/test_ffi.py asserts C++/Python parity.
+ */
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <vector>
+
+namespace spark_rapids_tpu {
+
+/* cudf-compatible type ids — must match spark_rapids_tpu/dtypes.py TypeId
+ * (which follows the id mapping the reference reconstructs at
+ * RowConversionJni.cpp:56-61 via cudf::jni::make_data_type). */
+enum class TypeId : int32_t {
+  EMPTY = 0,
+  INT8 = 1,
+  INT16 = 2,
+  INT32 = 3,
+  INT64 = 4,
+  UINT8 = 5,
+  UINT16 = 6,
+  UINT32 = 7,
+  UINT64 = 8,
+  FLOAT32 = 9,
+  FLOAT64 = 10,
+  BOOL8 = 11,
+  TIMESTAMP_DAYS = 12,
+  TIMESTAMP_SECONDS = 13,
+  TIMESTAMP_MILLISECONDS = 14,
+  TIMESTAMP_MICROSECONDS = 15,
+  TIMESTAMP_NANOSECONDS = 16,
+  DURATION_DAYS = 17,
+  DURATION_SECONDS = 18,
+  DURATION_MILLISECONDS = 19,
+  DURATION_MICROSECONDS = 20,
+  DURATION_NANOSECONDS = 21,
+  DICTIONARY32 = 22,
+  STRING = 23,
+  LIST = 24,
+  DECIMAL32 = 25,
+  DECIMAL64 = 26,
+  DECIMAL128 = 27,
+  STRUCT = 28,
+};
+
+struct DType {
+  TypeId type_id;
+  int32_t scale;  // decimal scale; 0 for non-decimals
+};
+
+/* Element byte width of a fixed-width type; throws for variable-width types
+ * (same gate as the reference: row_conversion.cu:514-516 "Only fixed width
+ * types are currently supported"). */
+int32_t itemsize(TypeId id);
+
+bool is_fixed_width(TypeId id);
+
+struct RowLayout {
+  std::vector<int32_t> column_starts;
+  std::vector<int32_t> column_sizes;
+  int32_t validity_offset = 0;
+  int32_t validity_bytes = 0;
+  int32_t row_size = 0;
+};
+
+constexpr int64_t kMaxBatchBytes = (int64_t{1} << 31) - 1;  // RowConversion.java:32-34
+constexpr int32_t kBatchRowMultiple = 32;                   // row_conversion.cu:477-479
+constexpr int32_t kMaxRowWidth = 1024;                      // RowConversion.java:98-99
+
+RowLayout compute_fixed_width_layout(const std::vector<DType>& schema);
+
+}  // namespace spark_rapids_tpu

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
 from ..column import Column
+from ..ops.common import pow2_bucket
 from ..table import Table
 from .hashing import partition_ids
 from .mesh import AXIS, DistTable
@@ -53,7 +54,10 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         # skew, floor of 8 so tiny shards don't thrash the overflow retry.
         per_shard_live = jnp.sum(dist.row_mask.reshape(P, capacity), axis=1)
         max_live = int(jnp.max(per_shard_live))   # host sync (P scalars)
-        bucket_size = max(8, 2 * (-(-max_live // P)))
+        # Power-of-two bucketing keeps the shard_map's static shapes (and the
+        # downstream kernels keyed off capacity_total) from recompiling on
+        # every slightly-different live-row count (ops/common.py contract).
+        bucket_size = max(8, pow2_bucket(2 * (-(-max_live // P))))
 
     pids = partition_ids([dist.table[k] for k in keys], P, seed)
 

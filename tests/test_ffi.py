@@ -1,0 +1,165 @@
+"""Native C++ bridge parity tests.
+
+The native host library (native/src/) must agree byte-for-byte with the
+JAX/device path: same layout (rows/layout.py), same pack bytes, same
+round-trip semantics, same error behavior (the JNI contract of the
+reference's RowConversionJni.cpp re-expressed over a C ABI).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table, ffi
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.rows import to_rows
+from spark_rapids_tpu.rows.layout import compute_fixed_width_layout
+
+from test_row_conversion import reference_test_table
+
+SCHEMAS = [
+    (dt.INT8,),
+    (dt.INT64, dt.INT8, dt.INT16, dt.INT32),
+    (dt.BOOL8, dt.FLOAT64, dt.UINT16),
+    (dt.INT64, dt.FLOAT64, dt.INT32, dt.BOOL8, dt.FLOAT32, dt.INT8,
+     dt.decimal32(-3), dt.decimal64(-8)),
+    tuple([dt.INT8] * 9),                      # >8 cols -> 2 validity bytes
+    (dt.TIMESTAMP_MICROSECONDS, dt.DURATION_DAYS, dt.UINT64),
+    tuple([dt.FLOAT32] * 17),                  # 3 validity bytes
+]
+
+
+def table_buffers(table):
+    schema = tuple(table.schema())
+    datas, valids = [], []
+    for _name, col in table.items():
+        vals, mask = col.to_numpy()
+        datas.append(np.ascontiguousarray(vals))
+        valids.append(None if mask is None else np.ascontiguousarray(mask))
+    return schema, datas, valids
+
+
+@pytest.mark.parametrize("schema", SCHEMAS)
+def test_layout_parity(schema):
+    py = compute_fixed_width_layout(schema)
+    nat = ffi.compute_fixed_width_layout(schema)
+    assert nat["column_starts"] == py.column_starts
+    assert nat["column_sizes"] == py.column_sizes
+    assert nat["validity_offset"] == py.validity_offset
+    assert nat["validity_bytes"] == py.validity_bytes
+    assert nat["row_size"] == py.row_size
+
+
+def test_pack_bytes_match_device_path():
+    table = reference_test_table()
+    schema, datas, valids = table_buffers(table)
+    native = ffi.pack_rows(schema, datas, valids)
+    [blob] = to_rows(table)
+    device = np.asarray(blob.data)
+    assert native.tobytes() == device.tobytes()
+
+
+def test_pack_unpack_round_trip():
+    table = reference_test_table()
+    schema, datas, valids = table_buffers(table)
+    rows = ffi.pack_rows(schema, datas, valids)
+    out_datas, out_valids = ffi.unpack_rows(schema, rows, table.num_rows)
+    for dtp, src, valid, out, out_valid in zip(schema, datas, valids,
+                                               out_datas, out_valids):
+        np.testing.assert_array_equal(np.asarray(src).view(out.dtype), out)
+        expect = np.ones(table.num_rows, bool) if valid is None else valid
+        np.testing.assert_array_equal(expect.astype(bool), out_valid)
+
+
+def test_pack_parity_random_wide(rng):
+    n = 1000
+    schema = (dt.INT64, dt.INT16, dt.FLOAT32, dt.UINT8, dt.FLOAT64, dt.BOOL8,
+              dt.INT32, dt.UINT32, dt.INT8, dt.UINT64, dt.decimal64(2))
+    datas = [
+        rng.integers(-1 << 40, 1 << 40, n).astype(np.int64),
+        rng.integers(-1 << 10, 1 << 10, n).astype(np.int16),
+        rng.normal(size=n).astype(np.float32),
+        rng.integers(0, 256, n).astype(np.uint8),
+        rng.normal(size=n),
+        rng.integers(0, 2, n).astype(np.bool_),
+        rng.integers(-1 << 20, 1 << 20, n).astype(np.int32),
+        rng.integers(0, 1 << 20, n).astype(np.uint32),
+        rng.integers(-128, 128, n).astype(np.int8),
+        rng.integers(0, 1 << 40, n).astype(np.uint64),
+        rng.integers(-1 << 40, 1 << 40, n).astype(np.int64),
+    ]
+    valids = [rng.integers(0, 4, n) > 0 for _ in schema]
+    valids[3] = None  # one all-valid column exercises the nullptr mask path
+
+    native = ffi.pack_rows(schema, datas, valids)
+
+    cols = {}
+    for i, (dtp, data, valid) in enumerate(zip(schema, datas, valids)):
+        from spark_rapids_tpu import Column
+        import jax.numpy as jnp
+        cols[f"c{i}"] = Column(
+            data=jnp.asarray(data), dtype=dtp,
+            validity=None if valid is None else jnp.asarray(valid))
+    [blob] = to_rows(Table(list(cols.items())))
+    assert native.tobytes() == np.asarray(blob.data).tobytes()
+
+
+def test_convert_to_rows_batching():
+    n = 257
+    schema = (dt.INT64, dt.INT32)
+    rng = np.random.default_rng(3)
+    datas = [rng.integers(0, 1 << 30, n).astype(np.int64),
+             rng.integers(0, 1 << 20, n).astype(np.int32)]
+    valids = [rng.integers(0, 2, n).astype(np.bool_), None]
+    layout = compute_fixed_width_layout(schema)
+
+    # Cap small enough to force splitting: 64 rows per blob (multiple of 32).
+    cap = layout.row_size * 70
+    blobs = ffi.convert_to_rows(schema, datas, valids, max_batch_bytes=cap)
+    rows_per_blob = [b.size // layout.row_size for b in blobs]
+    assert sum(rows_per_blob) == n
+    assert all(r % 32 == 0 for r in rows_per_blob[:-1])
+    assert all(r * layout.row_size <= cap for r in rows_per_blob)
+
+    whole = ffi.pack_rows(schema, datas, valids)
+    assert b"".join(b.tobytes() for b in blobs) == whole.tobytes()
+
+
+def test_convert_to_rows_empty():
+    schema = (dt.INT64,)
+    blobs = ffi.convert_to_rows(schema, [np.zeros(0, np.int64)], [None])
+    assert len(blobs) == 1 and blobs[0].size == 0
+
+
+def test_errors():
+    with pytest.raises(ValueError, match="fixed width"):
+        ffi.compute_fixed_width_layout((dt.STRING,))
+    schema = (dt.INT64,)
+    with pytest.raises(ValueError, match="layout of the data"):
+        ffi.unpack_rows(schema, np.zeros(7, np.uint8), 1)
+    wide = tuple([dt.FLOAT64] * 200)  # row_size > 1 KB
+    datas = [np.zeros(4) for _ in wide]
+    with pytest.raises(ValueError, match="1 KB"):
+        ffi.convert_to_rows(wide, datas, [None] * len(wide))
+    # liftable, as in the device path
+    blobs = ffi.convert_to_rows(wide, datas, [None] * len(wide),
+                                check_row_width=False)
+    assert len(blobs) == 1
+
+
+def test_buffer_validation():
+    schema = (dt.INT64, dt.INT64)
+    a = np.zeros(8, np.int64)
+    with pytest.raises(ValueError, match="expected shape"):
+        ffi.pack_rows(schema, [a, np.zeros(5, np.int64)], [None, None])
+    with pytest.raises(ValueError, match="does not match"):
+        ffi.pack_rows(schema, [a, np.zeros(8, np.int32)], [None, None])
+    with pytest.raises(ValueError, match="validity shape"):
+        ffi.pack_rows(schema, [a, a], [None, np.zeros(3, np.uint8)])
+    with pytest.raises(ValueError, match="buffers for"):
+        ffi.convert_to_rows(schema, [a], [None])
+
+
+def test_build_info():
+    info = ffi.build_info()
+    assert "version" in info and "revision" in info
+    assert ffi.load().srt_version().decode() == info["version"]
