@@ -127,6 +127,9 @@ int32_t srt_pack_rows(int32_t ncols, const int32_t* type_ids, const int32_t* sca
     if (num_rows < 0) throw std::invalid_argument("negative row count");
     if (col_data == nullptr || out_rows == nullptr)
       throw std::invalid_argument("null buffer");
+    for (int32_t c = 0; c < ncols; ++c)
+      if (col_data[c] == nullptr)
+        throw std::invalid_argument("null column data pointer");
     RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));
     pack_rows(layout, num_rows, col_data, col_valid, out_rows);
   });
@@ -164,6 +167,9 @@ int64_t srt_convert_to_rows(int32_t ncols, const int32_t* type_ids,
   int32_t status = guarded([&] {
     if (num_rows < 0) throw std::invalid_argument("negative row count");
     if (col_data == nullptr) throw std::invalid_argument("null buffer");
+    for (int32_t c = 0; c < ncols; ++c)
+      if (col_data[c] == nullptr)
+        throw std::invalid_argument("null column data pointer");
     if (max_batch_bytes <= 0 || max_batch_bytes > kMaxBatchBytes)
       max_batch_bytes = kMaxBatchBytes;
     RowLayout layout = compute_fixed_width_layout(make_schema(ncols, type_ids, scales));

@@ -2,12 +2,11 @@
 
 TPU-native equivalent of ``spark_rapids_jni::convert_to_rows`` /
 ``convert_from_rows`` (reference: row_conversion.cu:458-517, :519-575 and the
-Java API RowConversion.java:101-121).  Where the reference stages row images
-through CUDA shared memory with warp-cooperative validity ballots, this
-implementation expresses the transpose as whole-batch vector ops — bitcasts,
-concatenation along the byte axis, shift/mask validity packing — and lets XLA
-tile it through VMEM.  One jitted XLA program per (schema, batch-shape),
-cached, mirroring the reference's compile-once kernels.
+Java API RowConversion.java:101-121).  The device payload is the word-major
+uint32 row image of :mod:`.image` (see its module doc for why a device-side
+flat byte blob is wrong on TPU); the exact Spark-row **bytes** — the interop
+contract — are materialized at the host boundary via :meth:`RowBlob.data` /
+:meth:`RowBlob.from_host_bytes`.
 
 Semantics preserved from the reference:
 
@@ -26,15 +25,17 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..column import Column
-from ..dtypes import DType, TypeId
+from ..dtypes import DType
 from ..table import Table
-from .bytes import from_bytes, pack_validity_bytes, to_bytes, unpack_validity_bytes
+from .image import (host_bytes_to_words, pack_image, unpack_image,
+                    words_to_host_bytes)
 from .layout import (BATCH_ROW_MULTIPLE, MAX_BATCH_BYTES, MAX_ROW_WIDTH,
                      RowLayout, compute_fixed_width_layout)
 
@@ -45,28 +46,49 @@ class RowBlob:
     """A batch of rows serialized to the fixed-width row format.
 
     Equivalent of the reference's ``LIST<INT8>`` output column
-    (row_conversion.cu:405-406): ``data`` is the flat byte buffer, ``offsets``
-    the int32 ``(n+1,)`` row offsets (a sequence with stride ``row_size``).
+    (row_conversion.cu:405-406), held device-side as the word-major
+    ``(row_size/4, num_rows)`` uint32 image.  ``data`` materializes the
+    byte-exact host blob; ``offsets`` is the int32 ``(n+1,)`` row-offset
+    sequence of the reference contract.
     """
 
-    data: jax.Array        # uint8 (num_rows * row_size,)
-    offsets: jax.Array     # int32 (num_rows + 1,)
+    words: jax.Array       # uint32 (row_size // 4, num_rows)
     row_size: int          # static
 
     def tree_flatten(self):
-        return (self.data, self.offsets), self.row_size
+        return (self.words,), self.row_size
 
     @classmethod
     def tree_unflatten(cls, row_size, children):
-        data, offsets = children
-        return cls(data=data, offsets=offsets, row_size=row_size)
+        (words,) = children
+        return cls(words=words, row_size=row_size)
 
     @property
     def num_rows(self) -> int:
-        return int(self.offsets.shape[0]) - 1
+        return int(self.words.shape[1])
 
-    def rows_2d(self) -> jax.Array:
-        return self.data.reshape(-1, self.row_size)
+    @property
+    def nbytes(self) -> int:
+        return self.num_rows * self.row_size
+
+    @property
+    def data(self) -> np.ndarray:
+        """Byte-exact host row blob (the Spark ``UnsafeRow`` interop bytes)."""
+        return words_to_host_bytes(self.words, self.row_size)
+
+    @property
+    def offsets(self) -> jax.Array:
+        return jnp.arange(self.num_rows + 1, dtype=jnp.int32) * self.row_size
+
+    @classmethod
+    def from_host_bytes(cls, data: np.ndarray, row_size: int) -> "RowBlob":
+        """Build a device blob from exact host row bytes (the inverse interop
+        direction: Spark rows arriving over the wire)."""
+        arr = np.asarray(data)
+        if arr.dtype not in (np.uint8, np.int8):
+            raise ValueError("Only a list of bytes is supported as input")
+        words = host_bytes_to_words(arr.view(np.uint8), row_size)
+        return cls(words=jnp.asarray(words), row_size=row_size)
 
 
 # -- jitted kernels, cached per schema ---------------------------------------
@@ -77,21 +99,7 @@ def _packer(schema: tuple[DType, ...]):
 
     @jax.jit
     def pack(datas: tuple[jax.Array, ...], masks: tuple[jax.Array, ...]) -> jax.Array:
-        n = datas[0].shape[0]
-        pieces = []
-        cursor = 0
-        for dtype, start, size, data in zip(schema, layout.column_starts,
-                                            layout.column_sizes, datas):
-            if start > cursor:   # alignment gap -> deterministic zero padding
-                pieces.append(jnp.zeros((n, start - cursor), jnp.uint8))
-            pieces.append(to_bytes(data, dtype))
-            cursor = start + size
-        valid = jnp.stack(masks, axis=1)           # (n, num_columns) bool
-        pieces.append(pack_validity_bytes(valid, layout.validity_bytes))
-        cursor += layout.validity_bytes
-        if layout.row_size > cursor:
-            pieces.append(jnp.zeros((n, layout.row_size - cursor), jnp.uint8))
-        return jnp.concatenate(pieces, axis=1).reshape(-1)
+        return pack_image(layout, datas, masks)
 
     return layout, pack
 
@@ -101,15 +109,8 @@ def _unpacker(schema: tuple[DType, ...]):
     layout = compute_fixed_width_layout(schema)
 
     @jax.jit
-    def unpack(flat: jax.Array):
-        image = flat.reshape(-1, layout.row_size)
-        datas = []
-        for dtype, start, size in zip(schema, layout.column_starts, layout.column_sizes):
-            datas.append(from_bytes(image[:, start:start + size], dtype))
-        raw_validity = image[:, layout.validity_offset:
-                             layout.validity_offset + layout.validity_bytes]
-        valid = unpack_validity_bytes(raw_validity, layout.num_columns)
-        return tuple(datas), valid
+    def unpack(words: jax.Array):
+        return unpack_image(layout, words)
 
     return layout, unpack
 
@@ -142,9 +143,11 @@ def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
             jnp.ones(count, jnp.bool_) if c.validity is None
             else c.validity[start:start + count]
             for c in table.columns)
-        flat = pack(datas, masks)
-        offsets = jnp.arange(count + 1, dtype=jnp.int32) * layout.row_size
-        return RowBlob(data=flat, offsets=offsets, row_size=layout.row_size)
+        if count == 0:
+            words = jnp.zeros((layout.row_size // 4, 0), jnp.uint32)
+        else:
+            words = pack(datas, masks)
+        return RowBlob(words=words, row_size=layout.row_size)
 
     if num_rows == 0:   # one empty blob so the round trip stays total
         return [batch_blob(0, 0)]
@@ -152,7 +155,7 @@ def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
             for start in range(0, num_rows, max_rows)]
 
 
-def from_rows(blobs: Sequence[RowBlob] | RowBlob, schema: Sequence[DType],
+def from_rows(blobs: Union[Sequence[RowBlob], RowBlob], schema: Sequence[DType],
               names: Optional[Sequence[str]] = None) -> Table:
     """Convert row blobs back to a columnar table.
 
@@ -168,31 +171,35 @@ def from_rows(blobs: Sequence[RowBlob] | RowBlob, schema: Sequence[DType],
     elif len(names) != len(schema):
         raise ValueError(f"{len(names)} names for {len(schema)} schema columns")
     layout, unpack = _unpacker(schema)
+    W = layout.row_size // 4
     if not blobs:
-        blobs = [RowBlob(data=jnp.zeros(0, jnp.uint8),
-                         offsets=jnp.zeros(1, jnp.int32),
+        blobs = [RowBlob(words=jnp.zeros((W, 0), jnp.uint32),
                          row_size=layout.row_size)]
 
     all_datas: list[tuple] = []
-    all_valid: list[jax.Array] = []
+    all_valid: list[tuple] = []
     for blob in blobs:
-        if blob.data.dtype not in (jnp.uint8, jnp.int8):
-            raise ValueError("Only a list of bytes is supported as input")
-        num_rows = blob.num_rows
-        if layout.row_size * num_rows != blob.data.size:
+        if blob.words.dtype != jnp.uint32:
+            raise ValueError("Only a word image of bytes is supported as input")
+        if blob.row_size != layout.row_size or blob.words.shape[0] != W:
             raise ValueError("The layout of the data appears to be off")
-        datas, valid = unpack(blob.data)
+        if blob.num_rows == 0:
+            all_datas.append(tuple(jnp.zeros(0, dt.jnp_dtype) for dt in schema))
+            all_valid.append(tuple(jnp.zeros(0, jnp.bool_) for _ in schema))
+            continue
+        datas, valid = unpack(blob.words)
         all_datas.append(datas)
         all_valid.append(valid)
 
     if len(all_datas) > 1:
         datas = tuple(jnp.concatenate([d[i] for d in all_datas])
                       for i in range(len(schema)))
-        valid = jnp.concatenate(all_valid, axis=0)
+        valid = tuple(jnp.concatenate([v[i] for v in all_valid])
+                      for i in range(len(schema)))
     else:
         datas, valid = all_datas[0], all_valid[0]
 
     columns = []
     for i, (name, dtype) in enumerate(zip(names, schema)):
-        columns.append((name, Column(data=datas[i], validity=valid[:, i], dtype=dtype)))
+        columns.append((name, Column(data=datas[i], validity=valid[i], dtype=dtype)))
     return Table(columns)

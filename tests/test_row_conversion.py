@@ -185,9 +185,21 @@ class TestFromRowsValidation:
         with pytest.raises(ValueError, match="layout of the data appears to be off"):
             from_rows(blob, [dt.INT32])   # wrong schema -> wrong row size
 
-    def test_non_byte_blob_rejected(self):
+    def test_non_word_blob_rejected(self):
         from spark_rapids_tpu.rows import RowBlob
-        bad = RowBlob(data=jnp.zeros(16, jnp.int32),
-                      offsets=jnp.array([0, 16], jnp.int32), row_size=16)
-        with pytest.raises(ValueError, match="list of bytes"):
+        bad = RowBlob(words=jnp.zeros((4, 1), jnp.int32), row_size=16)
+        with pytest.raises(ValueError, match="word image"):
             from_rows(bad, [dt.INT64])
+
+    def test_host_bytes_round_trip(self):
+        """The interop direction: exact bytes out, exact bytes back in."""
+        from spark_rapids_tpu.rows import RowBlob
+        t = reference_test_table()
+        blob = to_rows(t)[0]
+        host = blob.data                       # np.uint8, byte-exact
+        back = RowBlob.from_host_bytes(host, blob.row_size)
+        assert_tables_equal(from_rows(back, t.schema(), names=t.names), t)
+        with pytest.raises(ValueError, match="list of bytes"):
+            RowBlob.from_host_bytes(np.zeros(4, np.int32), 16)
+        with pytest.raises(ValueError, match="layout of the data"):
+            RowBlob.from_host_bytes(np.zeros(7, np.uint8), 16)
