@@ -1,0 +1,106 @@
+"""Query-shaped benchmarks (BASELINE.json configs #2/#3 scaffolding).
+
+Supplementary to the driver-run bench.py (which stays single-metric):
+measures TPC-H q1 (filter -> projected arithmetic -> groupby -> sort) and a
+fact-dim inner join + agg at 4M fact rows on the current default device,
+with the tunnel-safe protocol from BASELINE.md (chained data dependencies,
+host-read fencing, exact-composition warmup).
+
+Run: python benchmarks/bench_queries.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N = 4_000_000
+N_DIM = 10_000
+REPS = 5
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import dtypes as dt
+    from spark_rapids_tpu import ops
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.ops.binary import binary_op
+
+    rng = np.random.default_rng(7)
+    lineitem = srt.Table([
+        ("flag", Column.from_numpy(rng.integers(0, 3, N).astype(np.int8))),
+        ("status", Column.from_numpy(rng.integers(0, 2, N).astype(np.int8))),
+        ("qty", Column.from_numpy(rng.integers(1, 51, N).astype(np.int64))),
+        ("price", Column.from_numpy(rng.uniform(900, 105000, N))),
+        ("disc", Column.from_numpy(np.round(rng.uniform(0, 0.1, N), 2))),
+        ("tax", Column.from_numpy(np.round(rng.uniform(0, 0.08, N), 2))),
+        ("shipdate", Column.from_numpy(rng.integers(8000, 11000, N).astype(np.int32))),
+    ])
+
+    def q1(table, bump):
+        t = srt.Table(list(table.items())).with_column(
+            "qty", binary_op(table["qty"], bump, "add"))
+        pred = binary_op(t["shipdate"], 10_500, "le")
+        t = ops.apply_boolean_mask(t, pred)
+        disc_price = binary_op(t["price"], binary_op(1.0, t["disc"], "sub"), "mul")
+        charge = binary_op(disc_price, binary_op(1.0, t["tax"], "add"), "mul")
+        t = t.with_column("disc_price", disc_price).with_column("charge", charge)
+        agg = ops.groupby_agg(t, ["flag", "status"],
+                              [("qty", "sum", "sum_qty"),
+                               ("price", "sum", "sum_price"),
+                               ("disc_price", "sum", "sum_disc_price"),
+                               ("charge", "sum", "sum_charge"),
+                               ("qty", "mean", "avg_qty"),
+                               ("disc", "mean", "avg_disc"),
+                               ("qty", "count", "n")])
+        return ops.sort_by(agg, ["flag", "status"])
+
+    # warm exact composition, then chained reps
+    out = q1(lineitem, 0)
+    bump = int(np.asarray(out["n"].data)[0]) & 1
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = q1(lineitem, bump)
+        bump = int(np.asarray(out["n"].data)[0]) & 1
+    dt_q1 = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": "tpch_q1_4M", "value": round(N / dt_q1, 1),
+                      "unit": "rows/sec"}))
+
+    fact_key = rng.integers(0, N_DIM, N).astype(np.int64)
+    fact = srt.Table([
+        ("k", Column.from_numpy(fact_key)),
+        ("rev", Column.from_numpy(rng.uniform(1, 1000, N))),
+    ])
+    dim = srt.Table([
+        ("k", Column.from_numpy(np.arange(N_DIM, dtype=np.int64))),
+        ("cat", Column.from_numpy(rng.integers(0, 100, N_DIM).astype(np.int32))),
+    ])
+
+    def join_agg(f, bump):
+        f2 = srt.Table(list(f.items())).with_column(
+            "rev", binary_op(f["rev"], float(bump), "add"))
+        j = ops.join(f2, dim, on=["k"], how="inner")
+        return ops.groupby_agg(j, ["cat"], [("rev", "sum", "rev_sum"),
+                                            ("rev", "count", "n")])
+    out = join_agg(fact, 0)
+    bump = int(np.asarray(out["n"].data)[0]) & 1
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = join_agg(fact, bump)
+        bump = int(np.asarray(out["n"].data)[0]) & 1
+    dt_j = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": "fact_dim_join_agg_4M",
+                      "value": round(N / dt_j, 1), "unit": "rows/sec"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
-from ..dtypes import BOOL8, DType, FLOAT64, TypeId
+from ..dtypes import BOOL8, DType, FLOAT64, INT64, TypeId
 
 Operand = Union[Column, int, float, bool]
 
@@ -80,7 +80,30 @@ _OPS = {
 }
 
 
-def binary_op(a: Column, b: Operand, op: str) -> Column:
+#: scalar-op-column forms: how to express `scalar OP col` as `col OP' ...`
+_REFLECT = {"add": "add", "mul": "mul", "and": "and", "or": "or",
+            "eq": "eq", "ne": "ne",
+            "lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def binary_op(a: Operand, b: Operand, op: str) -> Column:
+    if not isinstance(a, Column):
+        # Literal-first expressions (Spark plans emit them, e.g. `1 - disc`).
+        if not isinstance(b, Column):
+            raise TypeError("binary_op needs at least one Column operand")
+        if op in _REFLECT:
+            return binary_op(b, a, _REFLECT[op])
+        if op == "sub":                  # s - x  ==  (-x) + s
+            return binary_op(unary_op(b, "neg"), a, "add")
+        if op in ("truediv", "floordiv", "mod", "pow"):
+            # Materialize the literal as a column; the normal path handles
+            # promotion and null propagation.
+            lit = Column.all_valid(
+                jnp.full(b.data.shape, a,
+                         jnp.float64 if isinstance(a, float) else jnp.int64),
+                FLOAT64 if isinstance(a, float) else INT64)
+            return binary_op(lit, b, op)
+        raise ValueError(f"unsupported binary op {op!r} with scalar left operand")
     if op not in _OPS:
         raise ValueError(f"unsupported binary op {op!r}")
     _check_decimal_operands(a, b, op)

@@ -1,0 +1,190 @@
+"""End-to-end query-shaped integration tests (BASELINE.json configs #2/#3).
+
+TPC-H q1 (scan -> filter -> projected arithmetic -> group-by agg -> sort)
+and a TPC-DS-style fact-dimension join + aggregation, run through the real
+framework pipeline — Parquet scan included — and verified against an
+independent numpy oracle.  The distributed variants run the same queries
+over the 8-virtual-device mesh (dist shuffle + groupby/join).
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu import ops
+from spark_rapids_tpu.io.parquet import read_parquet, write_parquet
+from spark_rapids_tpu.ops.binary import binary_op
+
+
+N = 20_000
+CUTOFF_DAYS = 10_500     # the l_shipdate <= date '1998-09-02' analog
+
+
+def make_lineitem(rng, n=N):
+    """A lineitem-shaped table: flag/status codes, qty, price, disc, tax."""
+    return {
+        "l_returnflag": rng.integers(0, 3, n).astype(np.int8),    # A/N/R codes
+        "l_linestatus": rng.integers(0, 2, n).astype(np.int8),    # F/O codes
+        "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(900, 105000, n), 2),
+        "l_discount": np.round(rng.uniform(0.0, 0.1, n), 2),
+        "l_tax": np.round(rng.uniform(0.0, 0.08, n), 2),
+        "l_shipdate": rng.integers(8000, 11000, n).astype(np.int32),  # days
+    }
+
+
+def q1_oracle(cols):
+    """Independent numpy implementation of the q1 aggregation."""
+    sel = cols["l_shipdate"] <= CUTOFF_DAYS
+    flag = cols["l_returnflag"][sel]
+    status = cols["l_linestatus"][sel]
+    qty = cols["l_quantity"][sel].astype(np.float64)
+    price = cols["l_extendedprice"][sel]
+    disc = cols["l_discount"][sel]
+    tax = cols["l_tax"][sel]
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+    out = {}
+    for f in np.unique(flag):
+        for s in np.unique(status[flag == f]):
+            g = (flag == f) & (status == s)
+            out[(int(f), int(s))] = dict(
+                sum_qty=qty[g].sum(), sum_base_price=price[g].sum(),
+                sum_disc_price=disc_price[g].sum(), sum_charge=charge[g].sum(),
+                avg_qty=qty[g].mean(), avg_price=price[g].mean(),
+                avg_disc=disc[g].mean(), count_order=int(g.sum()))
+    return out
+
+
+def run_q1(table):
+    """TPC-H q1 through the framework ops (what the Spark plan would emit)."""
+    pred = binary_op(table["l_shipdate"], CUTOFF_DAYS, "le")
+    t = ops.apply_boolean_mask(table, pred)
+    one_minus_disc = binary_op(1.0, t["l_discount"], "sub")
+    disc_price = binary_op(t["l_extendedprice"], one_minus_disc, "mul")
+    charge = binary_op(disc_price, binary_op(1.0, t["l_tax"], "add"), "mul")
+    t = t.with_column("disc_price", disc_price).with_column("charge", charge)
+    agg = ops.groupby_agg(
+        t, ["l_returnflag", "l_linestatus"],
+        [("l_quantity", "sum", "sum_qty"),
+         ("l_extendedprice", "sum", "sum_base_price"),
+         ("disc_price", "sum", "sum_disc_price"),
+         ("charge", "sum", "sum_charge"),
+         ("l_quantity", "mean", "avg_qty"),
+         ("l_extendedprice", "mean", "avg_price"),
+         ("l_discount", "mean", "avg_disc"),
+         ("l_quantity", "count", "count_order")])
+    return ops.sort_by(agg, ["l_returnflag", "l_linestatus"])
+
+
+def assert_q1_matches(result, oracle):
+    got = result.to_pydict()
+    keys = list(zip(got["l_returnflag"], got["l_linestatus"]))
+    assert keys == sorted(oracle)                  # sorted group order
+    for i, k in enumerate(keys):
+        exp = oracle[k]
+        assert got["count_order"][i] == exp["count_order"]
+        for field in ("sum_qty", "sum_base_price", "sum_disc_price",
+                      "sum_charge", "avg_qty", "avg_price", "avg_disc"):
+            np.testing.assert_allclose(got[field][i], exp[field], rtol=1e-9)
+
+
+def test_tpch_q1_via_parquet(tmp_path, rng):
+    cols = make_lineitem(rng)
+    table = srt.Table.from_pydict({k: v.tolist() for k, v in cols.items()},
+                                  dtypes={
+        "l_returnflag": dt.INT8, "l_linestatus": dt.INT8,
+        "l_quantity": dt.INT64, "l_extendedprice": dt.FLOAT64,
+        "l_discount": dt.FLOAT64, "l_tax": dt.FLOAT64,
+        "l_shipdate": dt.TIMESTAMP_DAYS})
+    path = tmp_path / "lineitem.parquet"
+    write_parquet(table, path)
+    scanned = read_parquet(path)                   # full pipeline incl. scan
+    assert_q1_matches(run_q1(scanned), q1_oracle(cols))
+
+
+def test_tpch_q1_column_pruning(tmp_path, rng):
+    cols = make_lineitem(rng, 2000)
+    table = srt.Table.from_pydict({k: v.tolist() for k, v in cols.items()},
+                                  dtypes={
+        "l_returnflag": dt.INT8, "l_linestatus": dt.INT8,
+        "l_quantity": dt.INT64, "l_extendedprice": dt.FLOAT64,
+        "l_discount": dt.FLOAT64, "l_tax": dt.FLOAT64,
+        "l_shipdate": dt.TIMESTAMP_DAYS})
+    path = tmp_path / "lineitem.parquet"
+    write_parquet(table, path)
+    pruned = read_parquet(path, columns=["l_returnflag", "l_quantity"])
+    assert list(pruned.names) == ["l_returnflag", "l_quantity"]
+    assert pruned.num_rows == 2000
+
+
+def test_fact_dim_join_agg(rng):
+    """TPC-DS-style: fact join dim on key, then grouped revenue by category."""
+    n, n_dim = 30_000, 500
+    fact_key = rng.integers(0, n_dim, n).astype(np.int64)
+    revenue = np.round(rng.uniform(1, 1000, n), 2)
+    category = rng.integers(0, 8, n_dim).astype(np.int32)
+
+    fact = srt.Table.from_pydict(
+        {"item_key": fact_key.tolist(), "revenue": revenue.tolist()},
+        dtypes={"item_key": dt.INT64, "revenue": dt.FLOAT64})
+    dim = srt.Table.from_pydict(
+        {"item_key": list(range(n_dim)), "category": category.tolist()},
+        dtypes={"item_key": dt.INT64, "category": dt.INT32})
+
+    joined = ops.join(fact, dim, on=["item_key"], how="inner")
+    agg = ops.groupby_agg(joined, ["category"],
+                          [("revenue", "sum", "revenue_sum"),
+                           ("revenue", "count", "n")])
+    result = ops.sort_by(agg, ["category"]).to_pydict()
+
+    expect = {}
+    for c in range(8):
+        sel = category[fact_key] == c
+        expect[c] = (revenue[sel].sum(), int(sel.sum()))
+    assert result["category"] == [c for c in sorted(expect) if expect[c][1]]
+    for i, c in enumerate(result["category"]):
+        np.testing.assert_allclose(result["revenue_sum"][i], expect[c][0],
+                                   rtol=1e-9)
+        assert result["n"][i] == expect[c][1]
+
+
+@pytest.mark.parametrize("n_devices", [8])
+def test_tpch_q1_distributed(n_devices, rng):
+    """The q1 aggregation over the mesh: shuffle + distributed groupby."""
+    import jax
+
+    from spark_rapids_tpu.parallel import (collect, dist_groupby, make_mesh,
+                                           shard_table)
+
+    cols = make_lineitem(rng, 4096)
+    sel = cols["l_shipdate"] <= CUTOFF_DAYS
+    filtered = {k: v[sel] for k, v in cols.items()}
+    oracle = q1_oracle(cols)
+
+    mesh = make_mesh(jax.devices()[:n_devices])
+    one_minus_disc = 1.0 - filtered["l_discount"]
+    disc_price = filtered["l_extendedprice"] * one_minus_disc
+    table = srt.Table.from_pydict({
+        "flag": filtered["l_returnflag"].tolist(),
+        "status": filtered["l_linestatus"].tolist(),
+        "qty": filtered["l_quantity"].tolist(),
+        "price": filtered["l_extendedprice"].tolist(),
+        "disc_price": disc_price.tolist(),
+    }, dtypes={"flag": dt.INT8, "status": dt.INT8, "qty": dt.INT64,
+               "price": dt.FLOAT64, "disc_price": dt.FLOAT64})
+    dtab = shard_table(table, mesh)
+    out = dist_groupby(dtab, mesh, ["flag", "status"],
+                       [("qty", "sum", "sum_qty"),
+                        ("price", "sum", "sum_base_price"),
+                        ("disc_price", "sum", "sum_disc_price"),
+                        ("qty", "count", "count_order")])
+    got = ops.sort_by(collect(out), ["flag", "status"]).to_pydict()
+    keys = list(zip(got["flag"], got["status"]))
+    assert keys == sorted(oracle)
+    for i, k in enumerate(keys):
+        np.testing.assert_allclose(got["sum_qty"][i], oracle[k]["sum_qty"])
+        np.testing.assert_allclose(got["sum_disc_price"][i],
+                                   oracle[k]["sum_disc_price"], rtol=1e-9)
+        assert got["count_order"][i] == oracle[k]["count_order"]
