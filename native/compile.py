@@ -1,0 +1,63 @@
+"""Single source of truth for compiling the native host library with g++.
+
+CMake (native/CMakeLists.txt) is the official build for packagers; this
+module is the direct-g++ path shared by the wheel build (setup.py) and the
+ffi loader's dev-tree bootstrap, so flags/sources/provenance definitions can
+never diverge between the two.  Deliberately importable standalone (no
+package-relative imports, no jax) because setup.py must run before the
+package's dependencies are importable.
+
+Publishes atomically (compile to a process-unique temp path, then
+``os.replace``): a concurrent process may dlopen the library mid-rebuild and
+must never see a partially written ELF.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+SOURCES = ("row_layout.cpp", "row_conversion.cpp", "bridge.cpp")
+
+
+def command(src_dir: Path, out_path: Path, version: str, rev: str,
+            cxx: Optional[str] = None) -> List[str]:
+    """The full compile command (mirrors native/CMakeLists.txt flags)."""
+    return [
+        cxx or os.environ.get("CXX", "g++"),
+        "-std=c++17", "-O3", "-fPIC", "-shared",
+        "-Wall", "-Wextra", "-Werror",
+        f'-DSRT_VERSION="{version}"', f'-DSRT_GIT_REV="{rev}"',
+        *(str(src_dir / s) for s in SOURCES),
+        "-pthread", "-o", str(out_path),
+    ]
+
+
+def git_rev(repo_dir: Path) -> str:
+    try:
+        return subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo_dir,
+                              capture_output=True, text=True, check=False
+                              ).stdout.strip() or "unknown"
+    except OSError:
+        return "unknown"
+
+
+def build(src_dir: Path, out_path: Path, version: str,
+          rev: Optional[str] = None) -> Path:
+    """Compile and atomically publish the shared library at ``out_path``."""
+    src_dir, out_path = Path(src_dir), Path(out_path)
+    if rev is None:
+        rev = git_rev(src_dir.parent)
+    tmp = out_path.with_name(f".{out_path.name}.{os.getpid()}.tmp")
+    cmd = command(src_dir, tmp, version, rev)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except OSError as e:
+        raise RuntimeError(f"native build failed: cannot run {cmd[0]}: {e}") from e
+    if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    os.replace(tmp, out_path)
+    return out_path

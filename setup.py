@@ -1,0 +1,85 @@
+"""Wheel build orchestrator — the reference's `mvn package` counterpart.
+
+The reference's Maven build (pom.xml) sequences: native build (CMake) →
+build-info stamping → copying native libs + properties into the artifact →
+packaging one jar.  This setup.py does the same for a wheel:
+
+  1. compile ``native/src`` into ``libspark_rapids_tpu_host.so`` with
+     provenance compile definitions (native/CMakeLists.txt is the official
+     project; the in-process g++ path below is the self-contained fallback,
+     mirroring the ffi loader's dev-tree bootstrap),
+  2. run ``buildtools/build-info`` and stamp the result as
+     ``spark_rapids_tpu/spark-rapids-tpu-version-info.properties``
+     (pom.xml:273-298 analog),
+  3. package both inside the wheel (pom.xml:324-352 analog — the reference
+     places native libs at ``${os.arch}/${os.name}/`` in the jar; a wheel is
+     already platform-tagged, so the library lives at a fixed package path).
+
+Config knobs honored (CONTRIBUTING.md "Build Properties"):
+  SRT_CPP_PARALLEL_LEVEL — reserved for multi-TU native builds
+  SRT_SKIP_NATIVE=1      — build a pure-Python wheel (ffi builds on demand)
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import Command, setup
+from setuptools.command.build_py import build_py as _build_py
+
+ROOT = Path(__file__).resolve().parent
+
+
+def _version() -> str:
+    for line in (ROOT / "spark_rapids_tpu" / "__init__.py").read_text().splitlines():
+        if line.startswith("__version__"):
+            return line.split('"')[1]
+    raise RuntimeError("__version__ not found")
+
+
+class build_native(Command):
+    """Compile the native host library into the package tree."""
+
+    description = "build libspark_rapids_tpu_host.so from native/src"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        if os.environ.get("SRT_SKIP_NATIVE") == "1":
+            return
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "srt_native_compile", ROOT / "native" / "compile.py")
+        compiler = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(compiler)
+        out = ROOT / "spark_rapids_tpu" / "ffi" / "libspark_rapids_tpu_host.so"
+        print(f"building native library -> {out}", file=sys.stderr)
+        compiler.build(ROOT / "native" / "src", out, _version(),
+                       rev=compiler.git_rev(ROOT))
+
+
+class build_py(_build_py):
+    """build_py that first builds the native lib and stamps provenance."""
+
+    def run(self):
+        self.run_command("build_native")
+        props = subprocess.run(
+            ["bash", str(ROOT / "buildtools" / "build-info"), _version(),
+             str(ROOT)],
+            capture_output=True, text=True, check=True).stdout
+        stamp = ROOT / "spark_rapids_tpu" / "spark-rapids-tpu-version-info.properties"
+        stamp.write_text(props)
+        try:
+            super().run()
+        finally:
+            # The stamp is a build artifact, not a source file.
+            stamp.unlink(missing_ok=True)
+
+
+setup(cmdclass={"build_native": build_native, "build_py": build_py})

@@ -1,0 +1,92 @@
+"""Build-provenance access (the reference's build-info properties, at runtime).
+
+The reference stamps ``*-version-info.properties`` files (version, user,
+revision, branch, date, url — build/build-info:27-43) into the jar
+(pom.xml:273-298) so any artifact can answer "what exactly am I running?".
+The wheel analog: ``setup.py`` runs ``buildtools/build-info`` and packages the
+result as ``spark-rapids-tpu-version-info.properties`` next to this module;
+:func:`properties` reads it, falling back to live ``git`` queries in a dev
+tree so the answer is always available.
+
+:func:`native_build_info` reports the provenance compiled into the native
+host library (native/CMakeLists.txt stamps ``SRT_VERSION``/``SRT_GIT_REV``/
+``SRT_BUILD_DATE`` as compile definitions) — the two can legitimately differ
+when a stale native build is loaded, and comparing them is the supported way
+to detect that.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Dict
+
+PROPERTIES_FILE = "spark-rapids-tpu-version-info.properties"
+
+_PKG_DIR = Path(__file__).resolve().parent
+
+
+def _git(args, cwd) -> str:
+    try:
+        out = subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                             text=True, check=False).stdout.strip()
+        return out or "unknown"
+    except OSError:
+        return "unknown"
+
+
+def _live_properties() -> Dict[str, str]:
+    """Dev-tree fallback: compute the same fields buildtools/build-info emits."""
+    import getpass
+
+    from . import __version__
+
+    cwd = _PKG_DIR.parent
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        user = "unknown"
+    return {
+        "version": __version__,
+        "user": user,
+        "revision": _git(["rev-parse", "HEAD"], cwd),
+        "branch": _git(["rev-parse", "--abbrev-ref", "HEAD"], cwd),
+        "date": datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "url": _git(["config", "--get", "remote.origin.url"], cwd),
+    }
+
+
+def properties() -> Dict[str, str]:
+    """Provenance of the installed Python package.
+
+    Packaged wheel: parsed from the stamped properties resource.  Source
+    checkout: computed live (marked ``"source": "git"`` so callers can tell).
+    """
+    path = _PKG_DIR / PROPERTIES_FILE
+    if path.is_file():
+        props: Dict[str, str] = {}
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if line and not line.startswith("#") and "=" in line:
+                k, v = line.split("=", 1)
+                props[k] = v
+        props["source"] = "wheel"
+        return props
+    props = _live_properties()
+    props["source"] = "git"
+    return props
+
+
+def native_build_info() -> Dict[str, str]:
+    """Provenance stamped into the loaded native host library."""
+    from . import ffi
+    return ffi.build_info()
+
+
+def banner() -> str:
+    """One-line human-readable provenance summary."""
+    p = properties()
+    return (f"spark-rapids-tpu {p['version']} "
+            f"(rev {p['revision'][:12]}, branch {p['branch']}, "
+            f"built {p['date']} by {p['user']}, from {p['source']})")

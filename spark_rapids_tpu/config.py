@@ -1,0 +1,92 @@
+"""Runtime configuration surface — the reference's `-D` build-property system.
+
+The reference exposes every knob as a Maven ``-D`` property flowing through
+Ant into CMake cache variables and compile definitions (pom.xml:76-103,
+documented as a table in CONTRIBUTING.md "Build Properties").  The TPU
+framework's single config surface is **environment variables with typed
+accessors**, read lazily so tests can monkeypatch them; the authoritative
+knob table lives in CONTRIBUTING.md ("Configuration knobs") the same way.
+
+Knobs (all optional):
+
+  ``SRT_ROWS_IMPL``            ``xla`` (default) | ``pallas`` — row-image
+                               kernel implementation (rows/image.py).
+  ``SPARK_RAPIDS_TPU_NATIVE_LIB``  absolute path override for the native host
+                               library (ffi loader), like ``-Dcudf.path``.
+  ``SRT_TEST_PLATFORM``        jax platform for the test suite (conftest).
+  ``SRT_TRACE``                ``1`` enables named profiler scopes
+                               (utils/tracing.py) — the NVTX-ranges toggle
+                               ``-Dai.rapids.cudf.nvtx.enabled`` analog.
+  ``SRT_LEAK_DEBUG``           ``1`` records creation stacks for native blob
+                               handles and reports leaks at exit — the
+                               ``-Dai.rapids.refcount.debug`` analog.
+  ``SRT_LOG_LEVEL``            python logging level name for the framework
+                               logger (``RMM_LOGGING_LEVEL`` analog).
+  ``SRT_SKIP_NATIVE``          ``1`` skips the native build in setup.py
+                               (``-Dsubmodule.check.skip``-style escape).
+  ``SRT_CPP_PARALLEL_LEVEL``   native build parallelism (``CPP_PARALLEL_LEVEL``).
+
+Accessors return live values (no import-time caching) because the reference's
+properties are per-invocation too.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_TRUTHY = frozenset({"1", "true", "yes", "on"})
+
+
+def _flag(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in _TRUTHY
+
+
+def rows_impl() -> str:
+    """Row-image kernel implementation: ``xla`` (default) or ``pallas``."""
+    val = os.environ.get("SRT_ROWS_IMPL", "xla")
+    if val not in ("xla", "pallas"):
+        raise ValueError(f"SRT_ROWS_IMPL must be 'xla' or 'pallas', got {val!r}")
+    return val
+
+
+def native_lib_override() -> str | None:
+    """Explicit native-library path, or None for the packaged/dev build."""
+    return os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB") or None
+
+
+def trace_enabled() -> bool:
+    """Named profiler scopes on/off (NVTX-toggle analog)."""
+    return _flag("SRT_TRACE")
+
+
+def leak_debug_enabled() -> bool:
+    """Native-handle leak tracking on/off (refcount.debug analog)."""
+    return _flag("SRT_LEAK_DEBUG")
+
+
+def log_level() -> int:
+    """Framework logger level (RMM_LOGGING_LEVEL analog), default WARNING."""
+    name = os.environ.get("SRT_LOG_LEVEL", "WARNING").upper()
+    level = logging.getLevelName(name)
+    if not isinstance(level, int):
+        raise ValueError(f"SRT_LOG_LEVEL: unknown level {name!r}")
+    return level
+
+
+def get_logger(name: str = "spark_rapids_tpu") -> logging.Logger:
+    """The framework logger, honoring ``SRT_LOG_LEVEL``."""
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level())
+    return logger
+
+
+def knob_table() -> dict[str, str]:
+    """Current values of every knob (for diagnostics / bug reports)."""
+    names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
+             "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_LEAK_DEBUG",
+             "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL")
+    return {n: os.environ.get(n, "<default>") for n in names}

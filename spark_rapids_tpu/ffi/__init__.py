@@ -14,6 +14,7 @@ CATCH_STD reverse mapping).
 
 from __future__ import annotations
 
+import atexit
 import ctypes
 import os
 import subprocess
@@ -35,45 +36,32 @@ class NativeError(RuntimeError):
     """A C++-side failure, message propagated via srt_last_error()."""
 
 
-def _build_from_source() -> Path:
-    """Dev-tree fallback: compile the native library in one g++ invocation.
+def _compile_module():
+    """Load native/compile.py (the shared g++ build logic) by path."""
+    import importlib.util
+    path = _REPO_NATIVE / "compile.py"
+    spec = importlib.util.spec_from_file_location("srt_native_compile", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
-    CMake (native/CMakeLists.txt) is the official build; this keeps a source
-    checkout self-bootstrapping, stamping the same provenance definitions.
+
+def _build_from_source() -> Path:
+    """Dev-tree fallback: compile the native library via native/compile.py.
+
+    CMake (native/CMakeLists.txt) is the official build for packagers; the
+    shared g++ path keeps a source checkout self-bootstrapping with the same
+    flags and provenance definitions as the wheel build (setup.py).
     """
     src = _REPO_NATIVE / "src"
     if not src.is_dir():
         raise NativeError(
             f"{_LIB_NAME} not found in {_PKG_DIR} and no source tree at {src}")
-    out = _PKG_DIR / _LIB_NAME
-    try:
-        rev = subprocess.run(["git", "rev-parse", "HEAD"], cwd=_REPO_NATIVE,
-                             capture_output=True, text=True, check=False
-                             ).stdout.strip() or "unknown"
-    except OSError:
-        rev = "unknown"
     from .. import __version__
-    # Link to a process-unique temp path, then atomically publish: concurrent
-    # first loads (e.g. pytest -n auto on a fresh checkout) must never dlopen
-    # a partially-written ELF.
-    tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
-    cmd = [
-        "g++", "-std=c++17", "-O3", "-fPIC", "-shared",
-        "-Wall", "-Wextra", "-Werror",
-        f'-DSRT_VERSION="{__version__}"',
-        f'-DSRT_GIT_REV="{rev}"',
-        str(src / "row_layout.cpp"), str(src / "row_conversion.cpp"),
-        str(src / "bridge.cpp"), "-pthread", "-o", str(tmp),
-    ]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-    except OSError as e:
-        raise NativeError(f"native build failed: cannot run g++: {e}") from e
-    if proc.returncode != 0:
-        tmp.unlink(missing_ok=True)
-        raise NativeError(f"native build failed:\n{proc.stderr}")
-    os.replace(tmp, out)
-    return out
+        return _compile_module().build(src, _PKG_DIR / _LIB_NAME, __version__)
+    except RuntimeError as e:
+        raise NativeError(str(e)) from e
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -131,7 +119,8 @@ def load() -> ctypes.CDLL:
     global _lib
     with _lock:
         if _lib is None:
-            env = os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB")
+            from ..config import native_lib_override
+            env = native_lib_override()
             if env:
                 path = Path(env)
             else:
@@ -264,16 +253,110 @@ def unpack_rows(schema, rows: np.ndarray, num_rows: int):
     return datas, [v.astype(np.bool_) for v in valids]
 
 
-def convert_to_rows(schema, datas: Sequence[np.ndarray],
-                    valids: Sequence[Optional[np.ndarray]],
-                    max_batch_bytes: int = 0,
-                    check_row_width: bool = True) -> list[np.ndarray]:
-    """Batched conversion through the handle-based ABI.
+class RowBlobs:
+    """Caller-owned native blob set — the reference's handle contract.
+
+    The reference returns *released* native column pointers across the JNI
+    boundary and the Java caller owns closing them (RowConversionJni.cpp:33-38,
+    RowConversionTest.java:53-57), with opt-in leak diagnostics under
+    ``-Dai.rapids.refcount.debug``.  This class is that contract for Python:
+    it wraps the ``srt_convert_to_rows`` handle, exposes zero-copy views into
+    native memory, must be :meth:`close`\\ d (or used as a context manager),
+    and — when ``SRT_LEAK_DEBUG=1`` — records its creation stack and reports
+    any still-open handle at interpreter exit.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, handle: int, count: int):
+        self._lib = lib
+        self._handle = handle
+        self._count = count
+        self._creation_stack: Optional[str] = None
+        from ..config import leak_debug_enabled
+        if leak_debug_enabled():
+            import traceback
+            self._creation_stack = "".join(traceback.format_stack(limit=16))
+            _live_blobs[id(self)] = self
+
+    @property
+    def closed(self) -> bool:
+        return self._handle == 0
+
+    def _require_open(self) -> int:
+        if self._handle == 0:
+            raise NativeError("RowBlobs used after close()")
+        return self._handle
+
+    def __len__(self) -> int:
+        return self._count
+
+    def num_rows(self, i: int) -> int:
+        return int(self._lib.srt_blob_num_rows(self._require_open(), i))
+
+    def row_size(self, i: int) -> int:
+        return int(self._lib.srt_blob_row_size(self._require_open(), i))
+
+    def data(self, i: int) -> np.ndarray:
+        """Zero-copy uint8 view into the native blob (valid until close)."""
+        handle = self._require_open()
+        nbytes = self.num_rows(i) * self.row_size(i)
+        addr = self._lib.srt_blob_data(handle, i)
+        if nbytes == 0 or addr is None:
+            return np.zeros(0, np.uint8)
+        buf = (ctypes.c_uint8 * nbytes).from_address(addr)
+        return np.frombuffer(buf, np.uint8)
+
+    def to_arrays(self) -> list[np.ndarray]:
+        """Python-owned copies of every blob."""
+        return [self.data(i).copy() for i in range(self._count)]
+
+    def close(self) -> None:
+        if self._handle != 0:
+            self._lib.srt_blobs_free(self._handle)
+            self._handle = 0
+            _live_blobs.pop(id(self), None)
+
+    def __enter__(self) -> "RowBlobs":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        # Deliberately NOT freeing here: the contract is caller-owns-close,
+        # and silently freeing on GC would mask lifetime bugs the leak
+        # debugger exists to catch.  Native memory is reclaimed at process
+        # exit by the OS; the leak report names the allocation site.
+        pass
+
+
+# Live handle registry for SRT_LEAK_DEBUG (populated by RowBlobs.__init__).
+_live_blobs: dict = {}
+
+
+def _report_leaks() -> None:  # pragma: no cover - exercised via subprocess test
+    if not _live_blobs:
+        return
+    import sys
+    print(f"[spark_rapids_tpu] LEAK: {len(_live_blobs)} RowBlobs handle(s) "
+          "never closed:", file=sys.stderr)
+    for blobs in _live_blobs.values():
+        stack = blobs._creation_stack or "<creation stack not recorded>"
+        print(f"  - {len(blobs)} blob(s), created at:\n{stack}",
+              file=sys.stderr)
+
+
+atexit.register(_report_leaks)
+
+
+def convert_to_rows_handle(schema, datas: Sequence[np.ndarray],
+                           valids: Sequence[Optional[np.ndarray]],
+                           max_batch_bytes: int = 0,
+                           check_row_width: bool = True) -> RowBlobs:
+    """Batched conversion returning a caller-owned :class:`RowBlobs` handle.
 
     Applies the reference's output contract (blobs capped at 2 GB, batch row
-    counts in 32-row multiples, optional 1 KB row-width gate); returns one
-    byte array per blob (copies owned by Python; the native blob set is freed
-    before returning, exercising the caller-owns-handle lifetime contract).
+    counts in 32-row multiples, optional 1 KB row-width gate —
+    row_conversion.cu:458-517).
     """
     lib = load()
     ncols, ids_p, scales_p, *_keep = _schema_arrays(schema)
@@ -286,27 +369,26 @@ def convert_to_rows(schema, datas: Sequence[np.ndarray],
         ctypes.byref(nblobs), ctypes.byref(status))
     if handle == 0:
         _check(lib, status.value or 2)
-    try:
-        out = []
-        for i in range(nblobs.value):
-            nbytes = (int(lib.srt_blob_num_rows(handle, i)) *
-                      int(lib.srt_blob_row_size(handle, i)))
-            addr = lib.srt_blob_data(handle, i)
-            if nbytes == 0 or addr is None:
-                out.append(np.zeros(0, np.uint8))
-                continue
-            buf = (ctypes.c_uint8 * nbytes).from_address(addr)
-            out.append(np.frombuffer(buf, np.uint8).copy())
-        return out
-    finally:
-        lib.srt_blobs_free(handle)
+    return RowBlobs(lib, handle, nblobs.value)
+
+
+def convert_to_rows(schema, datas: Sequence[np.ndarray],
+                    valids: Sequence[Optional[np.ndarray]],
+                    max_batch_bytes: int = 0,
+                    check_row_width: bool = True) -> list[np.ndarray]:
+    """Copying convenience over :func:`convert_to_rows_handle`."""
+    with convert_to_rows_handle(schema, datas, valids, max_batch_bytes,
+                                check_row_width) as blobs:
+        return blobs.to_arrays()
 
 
 __all__ = [
     "NativeError",
+    "RowBlobs",
     "build_info",
     "compute_fixed_width_layout",
     "convert_to_rows",
+    "convert_to_rows_handle",
     "load",
     "pack_rows",
     "unpack_rows",

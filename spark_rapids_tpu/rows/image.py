@@ -359,9 +359,8 @@ def use_pallas() -> bool:
     starting point for future Mosaic work.  Set SRT_ROWS_IMPL=pallas to
     select them.
     """
-    import os
-    return os.environ.get("SRT_ROWS_IMPL", "xla") == "pallas" \
-        and jax.default_backend() == "tpu"
+    from ..config import rows_impl
+    return rows_impl() == "pallas" and jax.default_backend() == "tpu"
 
 
 def pack_image(layout: RowLayout, datas, masks) -> jax.Array:

@@ -1,0 +1,62 @@
+"""Named profiler scopes — the NVTX-ranges analog.
+
+The reference's tracing story is NVTX ranges in the cudf Java layer behind
+``-Dai.rapids.cudf.nvtx.enabled`` (pom.xml:84, :366-369) plus ``-lineinfo``
+device compiles for profiler introspection (ConfigureCUDA.cmake:33-37).  The
+TPU equivalents are ``jax.profiler`` trace annotations (visible in
+TensorBoard/XPlane captures and Perfetto) and jitted-function naming.
+
+Everything here is a no-op unless ``SRT_TRACE=1`` (config.trace_enabled), so
+instrumented code pays nothing in production — the same opt-in contract as
+the NVTX toggle.
+
+Usage::
+
+    with trace("convert_to_rows"):
+        ...
+    @traced
+    def shuffle(...): ...
+
+``start_server(port)`` re-exports the on-demand profiler server so a running
+job can be attached to (the TPU replacement for attaching nsys to a live
+process).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Iterator, TypeVar
+
+from ..config import trace_enabled
+
+_F = TypeVar("_F", bound=Callable)
+
+
+@contextlib.contextmanager
+def trace(name: str) -> Iterator[None]:
+    """Named scope visible in jax profiler captures (NVTX push/pop analog)."""
+    if not trace_enabled():
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def traced(fn: _F) -> _F:
+    """Decorator form of :func:`trace`, scope named after the function."""
+    name = f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__qualname__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with trace(name):
+            return fn(*args, **kwargs)
+
+    return wrapper  # type: ignore[return-value]
+
+
+def start_server(port: int = 9012):
+    """Start the on-demand jax profiler server (attach via TensorBoard)."""
+    import jax.profiler
+    return jax.profiler.start_server(port)

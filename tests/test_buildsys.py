@@ -1,0 +1,186 @@
+"""Tests for the build/packaging/config/aux-subsystem layer.
+
+Covers the analogs of the reference's build-info stamping (buildtools/build-info, the reference's build/build-info),
+`-D` property surface (pom.xml:76-103), NVTX toggle, and the
+refcount-leak-debug contract (`-Dai.rapids.refcount.debug`)."""
+
+import logging
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+class TestBuildInfoScript:
+    def test_emits_all_fields(self):
+        out = subprocess.run(
+            ["bash", str(ROOT / "buildtools" / "build-info"), "1.2.3", str(ROOT)],
+            capture_output=True, text=True, check=True).stdout
+        props = dict(line.split("=", 1) for line in out.strip().splitlines())
+        assert props["version"] == "1.2.3"
+        for key in ("user", "revision", "branch", "date", "url"):
+            assert key in props
+        # revision is the live git HEAD of this repo
+        head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=ROOT,
+                              capture_output=True, text=True).stdout.strip()
+        assert props["revision"] == head
+
+    def test_requires_version_arg(self):
+        proc = subprocess.run(["bash", str(ROOT / "buildtools" / "build-info")],
+                              capture_output=True, text=True)
+        assert proc.returncode != 0
+
+
+class TestBuildInfoModule:
+    def test_properties_dev_tree(self):
+        from spark_rapids_tpu import __version__, build_info
+        props = build_info.properties()
+        assert props["version"] == __version__
+        assert props["source"] in ("git", "wheel")
+        assert len(props["revision"]) in (7, 40) or props["revision"] == "unknown"
+
+    def test_properties_wheel_stamp(self, tmp_path, monkeypatch):
+        from spark_rapids_tpu import build_info
+        stamp = tmp_path / build_info.PROPERTIES_FILE
+        stamp.write_text("version=9.9.9\nrevision=deadbeef\nbranch=rel\n"
+                         "user=ci\ndate=2026-01-01T00:00:00Z\nurl=none\n")
+        monkeypatch.setattr(build_info, "_PKG_DIR", tmp_path)
+        props = build_info.properties()
+        assert props == {"version": "9.9.9", "revision": "deadbeef",
+                         "branch": "rel", "user": "ci",
+                         "date": "2026-01-01T00:00:00Z", "url": "none",
+                         "source": "wheel"}
+
+    def test_banner(self):
+        from spark_rapids_tpu import build_info
+        b = build_info.banner()
+        assert "spark-rapids-tpu" in b and "rev" in b
+
+    def test_native_matches_python_version(self):
+        from spark_rapids_tpu import __version__, build_info
+        info = build_info.native_build_info()
+        assert info["version"] == __version__
+
+
+class TestConfig:
+    def test_rows_impl_default_and_override(self, monkeypatch):
+        from spark_rapids_tpu import config
+        monkeypatch.delenv("SRT_ROWS_IMPL", raising=False)
+        assert config.rows_impl() == "xla"
+        monkeypatch.setenv("SRT_ROWS_IMPL", "pallas")
+        assert config.rows_impl() == "pallas"
+        monkeypatch.setenv("SRT_ROWS_IMPL", "cuda")
+        with pytest.raises(ValueError):
+            config.rows_impl()
+
+    def test_flags_parse_truthy(self, monkeypatch):
+        from spark_rapids_tpu import config
+        for raw, want in (("1", True), ("true", True), ("ON", True),
+                          ("0", False), ("no", False), ("", False)):
+            monkeypatch.setenv("SRT_TRACE", raw)
+            assert config.trace_enabled() is want
+        monkeypatch.delenv("SRT_TRACE")
+        assert config.trace_enabled() is False
+
+    def test_log_level(self, monkeypatch):
+        from spark_rapids_tpu import config
+        monkeypatch.delenv("SRT_LOG_LEVEL", raising=False)
+        assert config.log_level() == logging.WARNING
+        monkeypatch.setenv("SRT_LOG_LEVEL", "debug")
+        assert config.log_level() == logging.DEBUG
+        monkeypatch.setenv("SRT_LOG_LEVEL", "nope")
+        with pytest.raises(ValueError):
+            config.log_level()
+
+    def test_knob_table_lists_every_knob(self):
+        from spark_rapids_tpu import config
+        table = config.knob_table()
+        assert "SRT_ROWS_IMPL" in table and "SRT_LEAK_DEBUG" in table
+
+
+class TestTracing:
+    def test_noop_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("SRT_TRACE", raising=False)
+        from spark_rapids_tpu.utils.tracing import trace, traced
+        with trace("scope"):
+            x = 1
+
+        @traced
+        def f(a):
+            return a + 1
+
+        assert f(x) == 2
+
+    def test_annotates_when_enabled(self, monkeypatch):
+        monkeypatch.setenv("SRT_TRACE", "1")
+        from spark_rapids_tpu.utils.tracing import trace
+
+        # TraceAnnotation works outside an active capture; just verify the
+        # scope body executes under the annotation without error.
+        with trace("srt-test-scope"):
+            assert True
+
+
+class TestRowBlobsHandle:
+    SCHEMA = None
+
+    def _convert(self):
+        from spark_rapids_tpu import ffi
+        from spark_rapids_tpu.dtypes import INT32, INT64
+        schema = (INT64, INT32)
+        datas = [np.arange(100, dtype=np.int64),
+                 np.arange(100, dtype=np.int32)]
+        valids = [np.ones(100, np.uint8), None]
+        return ffi.convert_to_rows_handle(schema, datas, valids)
+
+    def test_context_manager_lifecycle(self):
+        with self._convert() as blobs:
+            assert len(blobs) == 1
+            assert blobs.num_rows(0) == 100
+            assert blobs.row_size(0) == 16
+            view = blobs.data(0)
+            assert view.nbytes == 1600
+        assert blobs.closed
+
+    def test_use_after_close_raises(self):
+        from spark_rapids_tpu.ffi import NativeError
+        blobs = self._convert()
+        blobs.close()
+        blobs.close()  # idempotent
+        with pytest.raises(NativeError):
+            blobs.data(0)
+
+    def test_leak_report_at_exit(self):
+        """SRT_LEAK_DEBUG=1 must report unclosed handles on interpreter exit
+        with the creation stack (the refcount.debug contract)."""
+        code = (
+            "import numpy as np\n"
+            "from spark_rapids_tpu import ffi\n"
+            "from spark_rapids_tpu.dtypes import INT64\n"
+            "b = ffi.convert_to_rows_handle((INT64,), [np.arange(4, dtype=np.int64)], [None])\n"
+            "print('blobs:', len(b))\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=ROOT, env={"PATH": "/usr/bin:/bin", "SRT_LEAK_DEBUG": "1",
+                           "JAX_PLATFORMS": "cpu", "HOME": "/root"})
+        assert proc.returncode == 0, proc.stderr
+        assert "LEAK" in proc.stderr
+        assert "convert_to_rows_handle" in proc.stderr
+
+    def test_no_leak_report_when_closed(self):
+        code = (
+            "import numpy as np\n"
+            "from spark_rapids_tpu import ffi\n"
+            "from spark_rapids_tpu.dtypes import INT64\n"
+            "with ffi.convert_to_rows_handle((INT64,), [np.arange(4, dtype=np.int64)], [None]) as b:\n"
+            "    pass\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=ROOT, env={"PATH": "/usr/bin:/bin", "SRT_LEAK_DEBUG": "1",
+                           "JAX_PLATFORMS": "cpu", "HOME": "/root"})
+        assert proc.returncode == 0, proc.stderr
+        assert "LEAK" not in proc.stderr
