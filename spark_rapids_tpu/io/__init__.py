@@ -1,12 +1,14 @@
-"""IO layer: Arrow interop and Parquet scan/write."""
+"""IO layer: Arrow interop, Parquet scan/write, native page decoder."""
 
 from .arrow import from_arrow, from_arrow_array, to_arrow, to_arrow_array
 from .parquet import read_parquet, write_parquet
+from .parquet_native import read_parquet_native
 
 __all__ = [
     "from_arrow",
     "from_arrow_array",
     "read_parquet",
+    "read_parquet_native",
     "to_arrow",
     "to_arrow_array",
     "write_parquet",

@@ -23,9 +23,28 @@ from .arrow import from_arrow, to_arrow
 
 
 def read_parquet(path, columns: Optional[Sequence[str]] = None,
-                 filters=None) -> Table:
-    """Read a Parquet file into a device Table (column pruning + row-group
-    predicate pushdown via the Arrow reader)."""
+                 filters=None, engine: str = "auto") -> Table:
+    """Read a Parquet file into a device Table.
+
+    ``engine="native"`` decodes pages with the device-side decoder
+    (:mod:`.parquet_native`: RLE/bit-packed expansion, dictionary gather,
+    boolean unpack and null scatter all run as jitted XLA on device);
+    ``engine="arrow"`` uses pyarrow's host reader; ``engine="auto"``
+    (default) picks native when the file is inside its envelope (flat
+    schema, no filters) and falls back to Arrow otherwise.
+    """
+    if engine not in ("auto", "native", "arrow"):
+        raise ValueError(f"engine must be auto|native|arrow, got {engine!r}")
+    if engine == "native" and filters is not None:
+        raise ValueError("engine='native' does not support filters; "
+                         "use engine='auto' or 'arrow'")
+    if engine != "arrow" and filters is None:
+        from .parquet_native import read_parquet_native
+        try:
+            return read_parquet_native(path, columns)
+        except NotImplementedError:
+            if engine == "native":
+                raise
     tbl = pq.read_table(path,
                         columns=list(columns) if columns is not None else None,
                         filters=filters)

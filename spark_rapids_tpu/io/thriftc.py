@@ -1,0 +1,128 @@
+"""Minimal Thrift compact-protocol reader for Parquet metadata.
+
+Parquet file metadata and page headers are Thrift "compact protocol"
+structs.  The reference gets this for free from the vendored cuDF Parquet
+reader (SURVEY.md §2.3: "Parquet decode" is on the capability envelope);
+here the metadata walk is a small pure-Python host component — metadata is
+KB-scale, the heavy value decode happens on device
+(:mod:`spark_rapids_tpu.io.parquet_native`).
+
+Only what Parquet needs is implemented: varint/zigzag ints, binary, bool,
+double, list, struct (recursively parsed into ``{field_id: value}`` dicts).
+Map/set never occur in parquet.thrift's metadata path and raise.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+from typing import Any, Dict, Tuple
+
+# Compact-protocol wire types.
+_STOP = 0
+_BOOL_TRUE = 1
+_BOOL_FALSE = 2
+_BYTE = 3
+_I16 = 4
+_I32 = 5
+_I64 = 6
+_DOUBLE = 7
+_BINARY = 8
+_LIST = 9
+_SET = 10
+_MAP = 11
+_STRUCT = 12
+
+
+class ThriftReader:
+    """Cursor over a bytes-like object holding compact-protocol data."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    # -- primitives ----------------------------------------------------------
+    def read_varint(self) -> int:
+        result = 0
+        shift = 0
+        buf, pos = self.buf, self.pos
+        while True:
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        self.pos = pos
+        return result
+
+    def read_zigzag(self) -> int:
+        n = self.read_varint()
+        return (n >> 1) ^ -(n & 1)
+
+    def read_binary(self) -> bytes:
+        n = self.read_varint()
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def read_double(self) -> float:
+        (v,) = _struct.unpack_from("<d", self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    # -- containers ----------------------------------------------------------
+    def read_value(self, wire_type: int) -> Any:
+        if wire_type in (_BOOL_TRUE, _BOOL_FALSE):
+            return wire_type == _BOOL_TRUE
+        if wire_type == _BYTE:
+            # i8 is one raw (signed) byte, not a zigzag varint.
+            b = self.buf[self.pos]
+            self.pos += 1
+            return b - 256 if b >= 128 else b
+        if wire_type in (_I16, _I32, _I64):
+            return self.read_zigzag()
+        if wire_type == _DOUBLE:
+            return self.read_double()
+        if wire_type == _BINARY:
+            return self.read_binary()
+        if wire_type == _LIST:
+            return self.read_list()
+        if wire_type == _STRUCT:
+            return self.read_struct()
+        raise ValueError(f"unsupported thrift compact wire type {wire_type}")
+
+    def read_list(self) -> list:
+        header = self.buf[self.pos]
+        self.pos += 1
+        size = header >> 4
+        elem_type = header & 0x0F
+        if size == 15:
+            size = self.read_varint()
+        return [self.read_value(elem_type) for _ in range(size)]
+
+    def read_struct(self) -> Dict[int, Any]:
+        """Parse a struct into ``{field_id: value}`` (bools inline)."""
+        out: Dict[int, Any] = {}
+        last_id = 0
+        while True:
+            header = self.buf[self.pos]
+            self.pos += 1
+            if header == _STOP:
+                return out
+            delta = header >> 4
+            wire_type = header & 0x0F
+            if delta:
+                field_id = last_id + delta
+            else:
+                field_id = self.read_zigzag()
+            last_id = field_id
+            out[field_id] = self.read_value(wire_type)
+
+
+def parse_struct(buf: bytes, pos: int = 0) -> Tuple[Dict[int, Any], int]:
+    """Parse one struct starting at ``pos``; returns (fields, end_pos)."""
+    r = ThriftReader(buf, pos)
+    fields = r.read_struct()
+    return fields, r.pos

@@ -73,6 +73,27 @@ def null_safe_equal_at(ldata: jax.Array, lvalid, rdata: jax.Array, rvalid) -> ja
     return jnp.where(lv & rv, eq, ~lv & ~rv)
 
 
+def concat_columns(pieces: list[Column]) -> Column:
+    """Concatenate columns of one dtype (cudf ``concatenate`` equivalent).
+
+    Validity materializes to an explicit mask if any piece is nullable;
+    string pieces concatenate char buffers and rebase offsets.
+    """
+    if not pieces:
+        raise ValueError("concat_columns needs at least one column")
+    dtype = pieces[0].dtype
+    if any(p.dtype != dtype for p in pieces[1:]):
+        raise TypeError(f"dtype mismatch: {[p.dtype for p in pieces]}")
+    if pieces[0].offsets is not None:
+        from .strings import concat_columns as strings_concat
+        return strings_concat(pieces)
+    validity = None
+    if any(p.validity is not None for p in pieces):
+        validity = jnp.concatenate([p.valid_mask() for p in pieces])
+    data = jnp.concatenate([p.data for p in pieces])
+    return Column(data=data, validity=validity, dtype=dtype)
+
+
 def grouping_columns(cols: list[Column]) -> list[Column]:
     """Map key columns to group/compare-friendly forms: STRING columns become
     lexicographically-ordered INT32 dictionary codes (validity preserved),

@@ -1,0 +1,265 @@
+"""Native Parquet page decoder tests (pyarrow as writer and oracle).
+
+Mirrors the reference's oracle strategy (SURVEY.md §4: round-trip equality
+against a known-good implementation) for the decode direction: files written
+by pyarrow across the encoding/codec/page-version matrix must decode to
+tables equal to what the Arrow reader produces.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import assert_tables_equal
+from spark_rapids_tpu.io import from_arrow, read_parquet, read_parquet_native
+from spark_rapids_tpu.io.parquet_native import decode_rle_bp, parse_rle_runs
+
+
+def _mixed_arrow_table(n=1000, seed=3, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    def maybe_null(arr):
+        if not with_nulls:
+            return arr
+        mask = rng.random(n) < 0.25
+        return pa.array(arr, mask=mask)
+    cols = {
+        "i32": maybe_null(rng.integers(-1 << 20, 1 << 20, n).astype(np.int32)),
+        "i64": maybe_null(rng.integers(-1 << 40, 1 << 40, n).astype(np.int64)),
+        "f32": maybe_null(rng.normal(size=n).astype(np.float32)),
+        "f64": maybe_null(rng.normal(size=n)),
+        "b": maybe_null(rng.integers(0, 2, n).astype(np.bool_)),
+        "u32": maybe_null(rng.integers(0, 1 << 31, n).astype(np.uint32)),
+        "s": pa.array(
+            [None if with_nulls and rng.random() < 0.2
+             else f"row-{rng.integers(0, 50)}" for _ in range(n)],
+            pa.string()),
+    }
+    return pa.table(cols)
+
+
+def _check_file(tmp_path, at, **write_kwargs):
+    path = tmp_path / "t.parquet"
+    pq.write_table(at, path, **write_kwargs)
+    got = read_parquet_native(path)
+    want = from_arrow(pq.read_table(path))
+    assert_tables_equal(got, want)
+    return got
+
+
+class TestDecodeMatrix:
+    @pytest.mark.parametrize("compression", [None, "snappy", "zstd", "gzip"])
+    def test_codecs(self, tmp_path, compression):
+        _check_file(tmp_path, _mixed_arrow_table(),
+                    compression=compression)
+
+    @pytest.mark.parametrize("version", ["1.0", "2.0"])
+    def test_data_page_versions(self, tmp_path, version):
+        _check_file(tmp_path, _mixed_arrow_table(),
+                    data_page_version=version)
+
+    @pytest.mark.parametrize("use_dictionary", [True, False])
+    def test_dictionary_toggle(self, tmp_path, use_dictionary):
+        _check_file(tmp_path, _mixed_arrow_table(),
+                    use_dictionary=use_dictionary)
+
+    def test_no_nulls(self, tmp_path):
+        _check_file(tmp_path, _mixed_arrow_table(with_nulls=False))
+
+    def test_multiple_row_groups_and_pages(self, tmp_path):
+        _check_file(tmp_path, _mixed_arrow_table(n=5000),
+                    row_group_size=700, data_page_size=1024)
+
+    def test_plain_fallback_after_dict_overflow(self, tmp_path):
+        # A tiny dictionary page limit forces pyarrow to fall back to PLAIN
+        # data pages mid-chunk: both encodings must coexist in one chunk.
+        rng = np.random.default_rng(0)
+        at = pa.table({"s": pa.array([f"unique-string-{i}-{rng.integers(1<<30)}"
+                                      for i in range(2000)])})
+        _check_file(tmp_path, at, dictionary_pagesize_limit=1024,
+                    data_page_size=2048)
+
+    def test_decimal_and_date(self, tmp_path):
+        import datetime
+        import decimal as pydec
+        at = pa.table({
+            "d32": pa.array([pydec.Decimal("1.23"), None,
+                             pydec.Decimal("-99.01")],
+                            pa.decimal128(7, 2)),
+            "d64": pa.array([pydec.Decimal("123456.789"), None,
+                             pydec.Decimal("-1.001")],
+                            pa.decimal128(15, 3)),
+            "day": pa.array([datetime.date(2026, 7, 30), None,
+                             datetime.date(1969, 12, 31)]),
+        })
+        _check_file(tmp_path, at)
+
+    def test_timestamps(self, tmp_path):
+        at = pa.table({
+            "ts_us": pa.array([1_700_000_000_000_000, None, 12345],
+                              pa.timestamp("us")),
+            "ts_ms": pa.array([1_700_000_000_000, None, -5],
+                              pa.timestamp("ms")),
+        })
+        _check_file(tmp_path, at)
+
+    def test_column_pruning(self, tmp_path):
+        at = _mixed_arrow_table()
+        path = tmp_path / "t.parquet"
+        pq.write_table(at, path)
+        got = read_parquet_native(path, columns=["i64", "s"])
+        assert list(got.names) == ["i64", "s"]
+        want = from_arrow(pq.read_table(path, columns=["i64", "s"]))
+        assert_tables_equal(got, want)
+
+    def test_missing_column_raises(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(_mixed_arrow_table(n=10), path)
+        with pytest.raises(KeyError):
+            read_parquet_native(path, columns=["nope"])
+
+    def test_empty_file(self, tmp_path):
+        at = pa.table({"a": pa.array([], pa.int64()),
+                       "s": pa.array([], pa.string())})
+        got = _check_file(tmp_path, at)
+        assert got.num_rows == 0
+
+    def test_incompressible_page_roundtrips(self, tmp_path):
+        # Page whose compressed size ~= uncompressed size must still be
+        # decompressed (no size-equality shortcut).
+        rng = np.random.default_rng(11)
+        at = pa.table({"x": rng.integers(-1 << 60, 1 << 60, 500)})
+        _check_file(tmp_path, at, compression="snappy",
+                    use_dictionary=False)
+
+    def test_native_rejects_filters(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(_mixed_arrow_table(n=10), path)
+        with pytest.raises(ValueError):
+            read_parquet(path, engine="native", filters=[("i32", ">", 0)])
+
+    def test_all_null_column(self, tmp_path):
+        at = pa.table({"x": pa.array([None, None, None], pa.int64())})
+        _check_file(tmp_path, at)
+
+    def test_all_null_string_column(self, tmp_path):
+        at = pa.table({"s": pa.array([None, None, None], pa.string())})
+        got = _check_file(tmp_path, at)
+        assert got["s"].to_pylist() == [None, None, None]
+
+    def test_tz_aware_timestamp_rejected(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(pa.table({"ts": pa.array([1, 2],
+                                                pa.timestamp("us", tz="UTC"))}),
+                       path)
+        with pytest.raises(NotImplementedError):
+            read_parquet_native(path)
+
+    def test_empty_strings_and_unicode(self, tmp_path):
+        at = pa.table({"s": pa.array(["", "wörld", None, "", "日本語", "x"])})
+        _check_file(tmp_path, at)
+
+
+class TestEngineDispatch:
+    def test_auto_uses_native_result(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(_mixed_arrow_table(n=100), path)
+        assert_tables_equal(read_parquet(path, engine="auto"),
+                            read_parquet(path, engine="arrow"))
+
+    def test_native_rejects_nested(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(pa.table({"l": pa.array([[1, 2], [3]])}), path)
+        with pytest.raises(NotImplementedError):
+            read_parquet(path, engine="native")
+
+    def test_auto_falls_back_on_delta_encoding(self, tmp_path):
+        path = tmp_path / "t.parquet"
+        pq.write_table(pa.table({"x": pa.array(range(100), pa.int64())}),
+                       path, use_dictionary=False, version="2.6",
+                       column_encoding={"x": "DELTA_BINARY_PACKED"})
+        with pytest.raises(NotImplementedError):
+            read_parquet(path, engine="native")
+        t = read_parquet(path, engine="auto")        # silent Arrow fallback
+        assert t["x"].to_pylist() == list(range(100))
+
+    def test_bad_engine(self, tmp_path):
+        with pytest.raises(ValueError):
+            read_parquet(tmp_path / "x.parquet", engine="gpu")
+
+
+class TestRleKernel:
+    """Direct unit tests of the RLE/bit-packed hybrid decoder against a
+    pure-python encoder (the format spec, independently re-implemented)."""
+
+    @staticmethod
+    def _encode(values, bit_width, runs):
+        """Encode ``values`` as the given (kind, count) run plan."""
+        out = bytearray()
+        pos = 0
+        def varint(v):
+            while True:
+                b = v & 0x7F
+                v >>= 7
+                out.append(b | (0x80 if v else 0))
+                if not v:
+                    break
+        for kind, count in runs:
+            if kind == "rle":
+                varint(count << 1)
+                out.extend(int(values[pos]).to_bytes((bit_width + 7) // 8,
+                                                     "little"))
+                pos += count
+            else:
+                assert count % 8 == 0
+                varint(((count // 8) << 1) | 1)
+                acc = 0
+                nbits = 0
+                for v in values[pos:pos + count]:
+                    acc |= int(v) << nbits
+                    nbits += bit_width
+                    while nbits >= 8:
+                        out.append(acc & 0xFF)
+                        acc >>= 8
+                        nbits -= 8
+                if nbits:
+                    out.append(acc & 0xFF)
+                pos += count
+        assert pos == len(values)
+        return bytes(out)
+
+    @pytest.mark.parametrize("bit_width", [1, 2, 3, 5, 7, 8, 12, 17, 20])
+    def test_mixed_runs(self, bit_width):
+        rng = np.random.default_rng(bit_width)
+        hi = (1 << bit_width) - 1
+        plan = [("rle", 7), ("bp", 16), ("rle", 300), ("bp", 64), ("rle", 1)]
+        n = sum(c for _, c in plan)
+        values = np.zeros(n, np.int64)
+        pos = 0
+        for kind, count in plan:
+            if kind == "rle":
+                values[pos:pos + count] = rng.integers(0, hi + 1)
+            else:
+                values[pos:pos + count] = rng.integers(0, hi + 1, count)
+            pos += count
+        buf = self._encode(values, bit_width, plan)
+        got = np.asarray(decode_rle_bp(buf, bit_width, n))
+        np.testing.assert_array_equal(got, values)
+
+    def test_bit_packed_tail_overrun(self):
+        # Bit-packed runs cover multiples of 8; the decoder must clamp to
+        # the requested count.
+        values = np.arange(8) % 4
+        buf = self._encode(values, 2, [("bp", 8)])
+        got = np.asarray(decode_rle_bp(buf, 2, 5))
+        np.testing.assert_array_equal(got, values[:5])
+
+    def test_exhausted_stream_raises(self):
+        values = np.ones(4, np.int64)
+        buf = self._encode(values, 1, [("rle", 4)])
+        with pytest.raises(ValueError):
+            parse_rle_runs(buf, 1, 100)
+
+    def test_width_zero(self):
+        got = np.asarray(decode_rle_bp(b"", 0, 17))
+        np.testing.assert_array_equal(got, np.zeros(17, np.int32))
