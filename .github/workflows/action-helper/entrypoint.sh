@@ -1,0 +1,12 @@
+#!/bin/bash
+# Dispatch to the requested operator; extra args are word-split on purpose.
+set -e
+OPERATOR="$1"
+shift || true
+case "$OPERATOR" in
+    deps-sync|auto-merge|cleanup-bot-branch)
+        exec python "/opt/action-helper/$OPERATOR" $* ;;
+    *)
+        echo "unknown operator: $OPERATOR" >&2
+        exit 2 ;;
+esac

@@ -1,0 +1,131 @@
+"""Minimal GitHub REST helper for the repo-automation bots.
+
+Reference analog: .github/workflows/action-helper/python/utils.py (a
+requests-based PullRequest class used by the auto-merge / submodule-sync /
+cleanup bots).  This one is stdlib-only (urllib) so the container action
+needs no third-party installs, and the decision logic is factored into
+pure functions (`pick_existing_pr`, `should_auto_merge`, `strtobool`) so the
+test suite can exercise bot behavior offline (tests/test_automation.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+API_ROOT = os.environ.get("GITHUB_API_URL", "https://api.github.com")
+
+
+def strtobool(val: str) -> bool:
+    """Parse truthy/falsy strings ("true"/"false" from workflow inputs)."""
+    v = str(val).strip().lower()
+    if v in ("y", "yes", "t", "true", "on", "1"):
+        return True
+    if v in ("n", "no", "f", "false", "off", "0"):
+        return False
+    raise ValueError(f"invalid truth value {val!r}")
+
+
+class EnvDefault(argparse.Action):
+    """argparse action that defaults to an environment variable."""
+
+    def __init__(self, env, required=True, default=None, **kwargs):
+        if default is None and env in os.environ:
+            default = os.environ[env]
+        if default is not None:
+            required = False
+        super().__init__(default=default, required=required, **kwargs)
+        self.env = env
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        setattr(namespace, self.dest, values)
+
+
+def pick_existing_pr(prs: List[Dict[str, Any]], head_ref: str,
+                     base_ref: str) -> Optional[Dict[str, Any]]:
+    """Choose the open PR matching head/base, if any (pure function)."""
+    for pr in prs:
+        if (pr.get("head", {}).get("ref") == head_ref
+                and pr.get("base", {}).get("ref") == base_ref
+                and pr.get("state") == "open"):
+            return pr
+    return None
+
+
+def should_auto_merge(passed: bool, local_sha: str, remote_sha: str) -> bool:
+    """Merge only when tests passed AND the pushed head still matches what
+    was tested (reference submodule-sync gate: python/submodule-sync:72-78)."""
+    return bool(passed) and bool(local_sha) and local_sha == remote_sha
+
+
+class Repo:
+    """Thin authenticated client bound to one repository."""
+
+    def __init__(self, repo: str, token: str):
+        self.repo = repo
+        self.token = token
+
+    # -- transport -----------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> Any:
+        url = f"{API_ROOT}/repos/{self.repo}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method, headers={
+            "Authorization": f"Bearer {self.token}",
+            "Accept": "application/vnd.github+json",
+            "Content-Type": "application/json",
+        })
+        try:
+            with urllib.request.urlopen(req) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise RuntimeError(
+                f"{method} {path} -> HTTP {e.code}: {detail}") from None
+        return json.loads(payload) if payload else None
+
+    # -- pull requests -------------------------------------------------------
+    def open_prs(self, head_ref: Optional[str] = None) -> List[Dict[str, Any]]:
+        path = "/pulls?state=open&per_page=100"
+        prs = self._request("GET", path) or []
+        if head_ref:
+            prs = [p for p in prs if p["head"]["ref"] == head_ref]
+        return prs
+
+    def create_pr(self, title: str, head: str, base: str,
+                  body: str = "") -> Dict[str, Any]:
+        return self._request("POST", "/pulls", {
+            "title": title, "head": head, "base": base, "body": body,
+            "maintainer_can_modify": True})
+
+    def ensure_pr(self, title: str, head: str, base: str,
+                  body: str = "") -> Dict[str, Any]:
+        existing = pick_existing_pr(self.open_prs(), head, base)
+        return existing if existing else self.create_pr(title, head, base, body)
+
+    def comment(self, number: int, text: str) -> None:
+        self._request("POST", f"/issues/{number}/comments", {"body": text})
+
+    def merge_pr(self, number: int, method: str = "squash") -> bool:
+        try:
+            out = self._request("PUT", f"/pulls/{number}/merge",
+                                {"merge_method": method})
+            return bool(out and out.get("merged"))
+        except RuntimeError as e:
+            print(f"merge failed: {e}")
+            return False
+
+    def head_sha(self, branch: str) -> str:
+        out = self._request("GET", f"/git/ref/heads/{branch}")
+        return out["object"]["sha"]
+
+    def delete_branch(self, branch: str) -> None:
+        self._request("DELETE", f"/git/refs/heads/{branch}")
+
+    def branches(self, prefix: str = "") -> List[str]:
+        out = self._request("GET", "/branches?per_page=100") or []
+        return [b["name"] for b in out if b["name"].startswith(prefix)]

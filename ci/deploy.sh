@@ -1,0 +1,46 @@
+#!/bin/bash
+# Publish built artifacts to a package index.
+#
+# Reference analog: ci/deploy.sh:45-76 — publishes the jar plus per-CUDA
+# classifier jars to a Maven repo, optionally GPG-signed, with server creds
+# injected from the environment.  The wheel world equivalent: build
+# sdist+wheel, optionally detach-sign them, upload with twine to
+# $DEPLOY_REPO_URL using env credentials.  Nothing is read from disk config
+# so CI secrets stay in the environment (reference ci/settings.xml pattern).
+#
+# Env:
+#   DEPLOY_REPO_URL      index URL (required; e.g. an internal pypi)
+#   DEPLOY_USER/DEPLOY_TOKEN  credentials (required)
+#   SIGN_FILE=1          GPG-sign artifacts (GPG_PASSPHRASE if needed)
+#   SKIP_BUILD=1         upload existing dist/ artifacts as-is
+set -e
+
+cd "$(dirname "$0")/.."
+
+: "${DEPLOY_REPO_URL:?DEPLOY_REPO_URL must be set}"
+: "${DEPLOY_USER:?DEPLOY_USER must be set}"
+: "${DEPLOY_TOKEN:?DEPLOY_TOKEN must be set}"
+
+if [[ "${SKIP_BUILD:-0}" != "1" ]]; then
+    rm -rf dist/
+    python -m pip wheel --no-deps --no-build-isolation -w dist/ .
+    python setup.py sdist --dist-dir dist/ >/dev/null 2>&1 || \
+        python -m build --sdist --outdir dist/ 2>/dev/null || \
+        echo "deploy: sdist skipped (no sdist backend available)"
+fi
+
+ARTIFACTS=(dist/*.whl)
+[[ -e dist/*.tar.gz ]] && ARTIFACTS+=(dist/*.tar.gz)
+
+if [[ "${SIGN_FILE:-0}" == "1" ]]; then
+    for f in "${ARTIFACTS[@]}"; do
+        gpg --batch --yes ${GPG_PASSPHRASE:+--passphrase "$GPG_PASSPHRASE" --pinentry-mode loopback} \
+            --armor --detach-sign "$f"
+    done
+fi
+
+TWINE_USERNAME="$DEPLOY_USER" TWINE_PASSWORD="$DEPLOY_TOKEN" \
+python -m twine upload --repository-url "$DEPLOY_REPO_URL" "${ARTIFACTS[@]}" \
+    $(for f in "${ARTIFACTS[@]}"; do [[ -f "$f.asc" ]] && echo "$f.asc"; done)
+
+echo "deploy: uploaded ${#ARTIFACTS[@]} artifact(s) to $DEPLOY_REPO_URL"

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Nightly dependency-bump bot (shell half).
+#
+# The reference's submodule-sync bot bumps the vendored cudf pointer to
+# remote HEAD, exits if unchanged, commits with signoff, runs the full GPU
+# test suite, pushes a bot branch and hands off to the Python half which
+# opens/updates a PR and squash-auto-merges only on green
+# (reference: ci/submodule-sync.sh:41-100).  Here the "submodule" is the
+# pinned JAX/XLA dependency surface: the runner environment is expected to
+# have the candidate (latest) versions installed; this job re-pins to them,
+# tests, and hands off.
+#
+# Env:  REF (target branch, default main), GITHUB_TOKEN, GITHUB_REPOSITORY.
+set -ex
+
+cd "$(dirname "$0")/.."
+REF="${REF:-main}"
+BOT_BRANCH="bot-deps-sync-${REF}"
+
+git fetch origin "$REF"
+git checkout -B "$BOT_BRANCH" "origin/$REF"
+
+# Re-pin to the environment's installed versions; exit quietly if current.
+python buildtools/pins-check --write
+if git diff --quiet -- buildtools/pins.toml; then
+    echo "deps-sync: pins already current; nothing to do"
+    exit 0
+fi
+
+SUMMARY=$(git diff --unified=0 -- buildtools/pins.toml | grep '^[+-][a-z]' || true)
+git add buildtools/pins.toml
+git commit -s -m "Update dependency pins" -m "$SUMMARY"
+
+# Full premerge suite against the new versions decides mergeability.
+passed=true
+./ci/premerge-build.sh || passed=false
+
+git push -f origin "$BOT_BRANCH"
+python .github/workflows/action-helper/python/deps-sync \
+    --head "$BOT_BRANCH" --base "$REF" --passed "$passed" \
+    --summary "$SUMMARY"

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Nightly CI: clean build + full suite + benchmark sweep.
+#
+# Reference analog: ci/nightly-build.sh:24-28 (clean GPU `mvn package`).
+# The nightly additionally records benchmark JSON lines (bench.py is the
+# driver-facing single-metric bench; benchmarks/ holds the query-shaped
+# suite) into $BENCH_OUT for trend tracking.
+set -ex
+
+cd "$(dirname "$0")/.."
+
+rm -rf dist/ build/
+./ci/premerge-build.sh
+
+BENCH_OUT="${BENCH_OUT:-dist/bench-nightly.jsonl}"
+mkdir -p "$(dirname "$BENCH_OUT")"
+# Benchmarks want the real device; skip gracefully on CPU-only runners.
+if python -c 'import jax; assert jax.default_backend() != "cpu"' 2>/dev/null; then
+    python bench.py | tee -a "$BENCH_OUT"
+    python benchmarks/bench_queries.py | tee -a "$BENCH_OUT"
+else
+    echo "nightly: no accelerator on this runner; benchmarks skipped"
+fi

@@ -131,15 +131,17 @@ def _logical_dtype(phys: int, elem: Dict[int, Any], name: str) -> DType:
     if converted == _CT_DECIMAL or _LT_DECIMAL in logical:
         scale = elem.get(7)
         if scale is None:
-            scale = logical[_LT_DECIMAL].get(1, 0)
+            scale = logical.get(_LT_DECIMAL, {}).get(1, 0)
         precision = elem.get(8)
         if precision is None:
-            precision = logical.get(_LT_DECIMAL, {}).get(2, 18)
-        if phys == T_INT32:
-            return decimal32(-scale)
-        if phys == T_INT64:
-            return decimal64(-scale)
-        if phys == T_FIXED_LEN_BYTE_ARRAY and precision <= 18:
+            precision = logical.get(_LT_DECIMAL, {}).get(
+                2, 9 if phys == T_INT32 else 18)
+        if phys in (T_INT32, T_INT64, T_FIXED_LEN_BYTE_ARRAY) \
+                and precision <= 18:
+            # Width follows PRECISION, not the physical lanes (the spec
+            # allows storing a narrow decimal in wider lanes) — this is the
+            # Arrow engine's mapping (io/arrow.py: precision<=9 → DECIMAL32),
+            # kept identical so both engines agree on schemas.
             return decimal32(-scale) if precision <= 9 else decimal64(-scale)
         raise NotImplementedError(
             f"column {name!r}: DECIMAL physical type {phys} at precision "
@@ -183,15 +185,13 @@ def _logical_dtype(phys: int, elem: Dict[int, Any], name: str) -> DType:
         "(INT96/FIXED_LEN_BYTE_ARRAY need the Arrow reader)")
 
 
-def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]],
-                                 bytes]:
+def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]]]:
     """Parse footer metadata: per-leaf columns and per-row-group chunks.
 
-    The footer is read (and the schema/encoding envelope validated) via
-    tail seeks *before* the data bytes are touched, so out-of-envelope files
-    cost only the footer read.  On success the whole file is then read into
-    memory once — Spark-scale scans feed whole row groups anyway, and the
-    byte blob is what the page walk and decompressors slice from.
+    Only the footer is read (via tail seeks), and the schema/encoding
+    envelope is validated here — so out-of-envelope files cost one footer
+    read and no data IO.  Data bytes are fetched later as per-chunk range
+    reads (:func:`read_parquet_native`), so column pruning prunes IO too.
     """
     with open(path, "rb") as f:
         f.seek(0, 2)
@@ -233,7 +233,13 @@ def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]],
     for rg in fmeta.get(4, []):
         chunks = []
         for cc, col in zip(rg[1], columns):
-            md = cc[3]
+            md = cc.get(3)
+            if md is None:
+                # meta_data is optional in parquet.thrift: absent for
+                # column-encrypted or external-file chunks.
+                raise NotImplementedError(
+                    f"column {col.name!r}: chunk without inline metadata "
+                    "(encrypted/external chunks need the Arrow reader)")
             codec_id = md[4]
             if codec_id not in _CODEC_NAMES:
                 raise NotImplementedError(f"codec id {codec_id}")
@@ -253,13 +259,7 @@ def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]],
                 num_values=md[5], start_offset=start,
                 total_compressed=md[7]))
         row_groups.append(chunks)
-
-    # Envelope validated — now (and only now) pull the data bytes.
-    with open(path, "rb") as f:
-        blob = f.read()
-    if blob[:4] != MAGIC:
-        raise ValueError(f"{path}: not a Parquet file")
-    return columns, row_groups, blob
+    return columns, row_groups
 
 
 def _decompress(codec: Optional[str], data: bytes, out_size: int) -> bytes:
@@ -614,7 +614,7 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
     passes with no device involvement.
     """
     info = chunk.column
-    pos = chunk.start_offset
+    pos = 0                     # blob is the chunk's own byte range
     remaining = chunk.num_values
     dictionary: Optional[_Dict] = None
     pages: List[_PageSlice] = []
@@ -787,6 +787,12 @@ def _decode_chunk(blob: bytes, chunk: ChunkInfo) -> Column:
 
     if not info.optional:
         return dense_col
+    if sum(p.n_defined for p in pages) == total_rows:
+        # No nulls anywhere in the chunk — known host-side from the page
+        # walk, so the def-level expansion and null scatter are skipped
+        # entirely (and the column carries validity=None, matching the
+        # Arrow reader, with no device sync needed downstream).
+        return dense_col
     valid = _chunk_validity(pages, total_rows)
 
     if dense_col.offsets is not None:
@@ -824,21 +830,27 @@ def _concat_columns(pieces: Sequence[Column]) -> Column:
 def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
     """Read a Parquet file via the native page decoder into a device Table.
 
-    Column pruning happens before any page IO touches the pruned chunks.
-    Raises ``NotImplementedError`` for shapes outside the supported envelope
-    (nested schemas, INT96, DELTA encodings) — callers fall back to the
-    Arrow-backed :func:`spark_rapids_tpu.io.parquet.read_parquet`.
+    Column pruning prunes IO: only the selected chunks' byte ranges are
+    read from the file.  Raises ``NotImplementedError`` for shapes outside
+    the supported envelope (nested schemas, INT96, DELTA encodings) —
+    callers fall back to the Arrow-backed
+    :func:`spark_rapids_tpu.io.parquet.read_parquet`.
     """
-    cols, row_groups, blob = read_metadata(path)
+    cols, row_groups = read_metadata(path)
     want = list(columns) if columns is not None else [c.name for c in cols]
     missing = set(want) - {c.name for c in cols}
     if missing:
         raise KeyError(f"columns not in file: {sorted(missing)}")
     per_name: Dict[str, List[Column]] = {name: [] for name in want}
-    for rg in row_groups:
-        for chunk in rg:
-            if chunk.column.name in per_name:
-                per_name[chunk.column.name].append(_decode_chunk(blob, chunk))
+    with open(path, "rb") as f:
+        for rg in row_groups:
+            for chunk in rg:
+                if chunk.column.name not in per_name:
+                    continue
+                f.seek(chunk.start_offset)
+                chunk_bytes = f.read(chunk.total_compressed)
+                per_name[chunk.column.name].append(
+                    _decode_chunk(chunk_bytes, chunk))
     dtypes_by_name = {c.name: c.dtype for c in cols}
     out = []
     for name in want:
@@ -849,7 +861,5 @@ def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
             col = pieces[0]
         else:
             col = _concat_columns(pieces)
-        if col.validity is not None and bool(jnp.all(col.validity)):
-            col = col.with_validity(None)   # match the Arrow reader's shape
         out.append((name, col))
     return Table(out)

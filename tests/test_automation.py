@@ -1,0 +1,137 @@
+"""Offline tests for the repo-automation layer (bots, pins guard, DCO gate).
+
+The reference tests none of its automation; here the decision logic is
+factored into pure functions precisely so it can be covered without a
+network or a GitHub token (SURVEY.md §2.2 components: submodule guard,
+submodule-sync/auto-merge/cleanup bots, signoff check).
+"""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load(path: Path, name: str):
+    spec = importlib.util.spec_from_loader(
+        name, importlib.machinery.SourceFileLoader(name, str(path)))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+pins_check = _load(REPO / "buildtools" / "pins-check", "pins_check")
+ghapi = _load(REPO / ".github/workflows/action-helper/python/ghapi.py",
+              "ghapi")
+signoff = _load(REPO / ".github/workflows/signoff-check/signoff-check",
+                "signoff_check")
+
+
+class TestPinsCheck:
+    def test_current_environment_is_pinned(self):
+        # The committed pins must match the CI environment (this IS the
+        # guard the reference wires into every build).
+        rc = subprocess.run(
+            [sys.executable, str(REPO / "buildtools" / "pins-check")],
+            capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stdout + rc.stderr
+
+    def test_classify_exact(self):
+        assert pins_check.classify_drift("1.2.3", "1.2.3", "exact") == "ok"
+        assert pins_check.classify_drift("1.2.3", "1.2.4", "exact") == "fail"
+        assert pins_check.classify_drift("1.2.3", None, "exact") == "fail"
+
+    def test_classify_minor(self):
+        assert pins_check.classify_drift("1.2.3", "1.2.9", "minor") == "warn"
+        assert pins_check.classify_drift("1.2.3", "1.3.0", "minor") == "fail"
+
+    def test_drift_detected_and_write_fixes(self, tmp_path):
+        pins = tmp_path / "pins.toml"
+        pins.write_text('[pins]\nnumpy = "0.0.1"\n\n'
+                        '[policy]\nmode = "exact"\n')
+        rows = pins_check.check(*pins_check.load_pins(pins))
+        assert rows[0][3] == "fail"
+        assert pins_check.write_pins(pins) is True
+        rows = pins_check.check(*pins_check.load_pins(pins))
+        assert rows[0][3] == "ok"
+        assert pins_check.write_pins(pins) is False    # idempotent
+
+    def test_skip_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("SRT_PINS_CHECK_SKIP", "1")
+        assert pins_check.main(["--pins", str(tmp_path / "nope.toml")]) == 0
+
+    def test_unreadable_pins(self, tmp_path):
+        assert pins_check.main(["--pins", str(tmp_path / "nope.toml")]) == 2
+
+
+class TestGhApiLogic:
+    def test_strtobool(self):
+        assert ghapi.strtobool("True") and ghapi.strtobool("1")
+        assert not ghapi.strtobool("off")
+        with pytest.raises(ValueError):
+            ghapi.strtobool("maybe")
+
+    def test_pick_existing_pr(self):
+        prs = [
+            {"head": {"ref": "bot-x"}, "base": {"ref": "main"},
+             "state": "open", "number": 1},
+            {"head": {"ref": "bot-y"}, "base": {"ref": "main"},
+             "state": "open", "number": 2},
+        ]
+        assert ghapi.pick_existing_pr(prs, "bot-y", "main")["number"] == 2
+        assert ghapi.pick_existing_pr(prs, "bot-z", "main") is None
+        assert ghapi.pick_existing_pr(prs, "bot-x", "branch-26.10") is None
+
+    def test_should_auto_merge_gate(self):
+        # Merge only on green AND sha-consistency (tested == pushed).
+        assert ghapi.should_auto_merge(True, "abc", "abc")
+        assert not ghapi.should_auto_merge(False, "abc", "abc")
+        assert not ghapi.should_auto_merge(True, "abc", "def")
+        assert not ghapi.should_auto_merge(True, "", "")
+
+
+class TestCleanupBot:
+    def test_stale_branch_selection(self):
+        cleanup = _load(
+            REPO / ".github/workflows/action-helper/python/cleanup-bot-branch",
+            "cleanup_bot")
+        out = cleanup.stale_branches(
+            ["bot-deps-sync-main", "bot-auto-merge-x", "bot-live"],
+            open_head_refs={"bot-live"})
+        assert out == ["bot-deps-sync-main", "bot-auto-merge-x"]
+
+
+class TestSignoffCheck:
+    def test_signed(self):
+        msgs = ["Fix thing\n\nSigned-off-by: Dev One <dev@example.com>"]
+        assert signoff.unsigned_commits(msgs) == []
+
+    def test_unsigned_and_malformed(self):
+        msgs = [
+            "no signoff at all",
+            "Signed-off-by: missing email",
+            "ok\nSigned-off-by: Dev <d@e.io>",
+            None,
+        ]
+        assert signoff.unsigned_commits(msgs) == [0, 1, 3]
+
+
+class TestCiScripts:
+    def test_shell_syntax(self):
+        for script in list((REPO / "ci").glob("*.sh")) + [
+                REPO / "buildtools" / "build-in-docker",
+                REPO / ".github/workflows/action-helper/entrypoint.sh"]:
+            rc = subprocess.run(["bash", "-n", str(script)],
+                                capture_output=True, text=True)
+            assert rc.returncode == 0, f"{script}: {rc.stderr}"
+
+    def test_workflow_yaml_parses(self):
+        yaml = pytest.importorskip("yaml")
+        for wf in (REPO / ".github/workflows").glob("*.yml"):
+            data = yaml.safe_load(wf.read_text())
+            assert "jobs" in data, wf

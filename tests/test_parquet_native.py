@@ -94,6 +94,21 @@ class TestDecodeMatrix:
         })
         _check_file(tmp_path, at)
 
+    def test_decimal_stored_as_integer(self, tmp_path):
+        # Spec allows narrow decimals in INT32/INT64 physical lanes; the
+        # dtype must follow precision (arrow-engine mapping), not the lanes.
+        import decimal as pydec
+        at = pa.table({
+            "d32": pa.array([pydec.Decimal("1.23"), None],
+                            pa.decimal128(7, 2)),
+            "d64": pa.array([pydec.Decimal("1.001"), None],
+                            pa.decimal128(15, 3)),
+        })
+        try:
+            _check_file(tmp_path, at, store_decimal_as_integer=True)
+        except TypeError:
+            pytest.skip("pyarrow without store_decimal_as_integer")
+
     def test_timestamps(self, tmp_path):
         at = pa.table({
             "ts_us": pa.array([1_700_000_000_000_000, None, 12345],
