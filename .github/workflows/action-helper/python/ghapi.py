@@ -88,10 +88,23 @@ class Repo:
                 f"{method} {path} -> HTTP {e.code}: {detail}") from None
         return json.loads(payload) if payload else None
 
+    def _paginate(self, path: str) -> List[Dict[str, Any]]:
+        """Fetch every page (GitHub clamps per_page at 100); stops on the
+        first short page."""
+        sep = "&" if "?" in path else "?"
+        out: List[Dict[str, Any]] = []
+        page = 1
+        while True:
+            batch = self._request(
+                "GET", f"{path}{sep}per_page=100&page={page}") or []
+            out.extend(batch)
+            if len(batch) < 100:
+                return out
+            page += 1
+
     # -- pull requests -------------------------------------------------------
     def open_prs(self, head_ref: Optional[str] = None) -> List[Dict[str, Any]]:
-        path = "/pulls?state=open&per_page=100"
-        prs = self._request("GET", path) or []
+        prs = self._paginate("/pulls?state=open")
         if head_ref:
             prs = [p for p in prs if p["head"]["ref"] == head_ref]
         return prs
@@ -127,5 +140,5 @@ class Repo:
         self._request("DELETE", f"/git/refs/heads/{branch}")
 
     def branches(self, prefix: str = "") -> List[str]:
-        out = self._request("GET", "/branches?per_page=100") or []
+        out = self._paginate("/branches")
         return [b["name"] for b in out if b["name"].startswith(prefix)]

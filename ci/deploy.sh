@@ -30,7 +30,9 @@ if [[ "${SKIP_BUILD:-0}" != "1" ]]; then
 fi
 
 ARTIFACTS=(dist/*.whl)
-[[ -e dist/*.tar.gz ]] && ARTIFACTS+=(dist/*.tar.gz)
+if compgen -G "dist/*.tar.gz" >/dev/null; then
+    ARTIFACTS+=(dist/*.tar.gz)
+fi
 
 if [[ "${SIGN_FILE:-0}" == "1" ]]; then
     for f in "${ARTIFACTS[@]}"; do

@@ -1,14 +1,17 @@
 """IO layer: Arrow interop, Parquet scan/write, native page decoder."""
 
 from .arrow import from_arrow, from_arrow_array, to_arrow, to_arrow_array
+from .feed import prefetch, scan_parquet
 from .parquet import read_parquet, write_parquet
 from .parquet_native import read_parquet_native
 
 __all__ = [
     "from_arrow",
     "from_arrow_array",
+    "prefetch",
     "read_parquet",
     "read_parquet_native",
+    "scan_parquet",
     "to_arrow",
     "to_arrow_array",
     "write_parquet",

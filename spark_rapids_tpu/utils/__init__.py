@@ -1,0 +1,16 @@
+"""Utilities: tracing/profiling scopes and device-memory management."""
+
+from .memory import (MemoryScope, device_memory_stats, donating_jit, free,
+                     no_implicit_transfers)
+from .tracing import start_server, trace, traced
+
+__all__ = [
+    "MemoryScope",
+    "device_memory_stats",
+    "donating_jit",
+    "free",
+    "no_implicit_transfers",
+    "start_server",
+    "trace",
+    "traced",
+]

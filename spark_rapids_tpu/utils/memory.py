@@ -1,0 +1,135 @@
+"""Device-memory management surface — the RMM analog.
+
+The reference threads an explicit ``rmm::cuda_stream_view`` and
+``rmm::mr::device_memory_resource*`` through every native API
+(reference: src/main/cpp/src/row_conversion.hpp:27-36) and exposes RMM's
+log level as a first-class build knob (pom.xml:81, CMakeLists.txt:56-64).
+On TPU the allocator is XLA/PJRT: there is no user-pluggable memory
+resource, so the idiomatic equivalents are
+
+  * **donation** — the buffer-reuse contract.  Where RMM lets a kernel
+    allocate from a pool and steal its input's storage, XLA reuses an
+    input buffer for the output iff the argument is *donated* to ``jit``.
+    :func:`donating_jit` is the framework-blessed spelling.
+  * **accounting** — :func:`device_memory_stats` (PJRT allocator counters)
+    and :class:`MemoryScope`, which brackets a region and reports the HBM
+    delta and peak, the analog of RMM's logging_resource_adaptor.
+  * **explicit free** — :func:`free` deletes device buffers immediately
+    instead of waiting for GC, the analog of RMM's eager deallocation
+    (Python GC latency is the TPU equivalent of the reference's
+    caller-owns-close discipline, RowConversionTest.java:53-57).
+  * **host-sync hygiene** — :func:`no_implicit_transfers`, a context that
+    makes accidental device→host syncs raise (jax transfer guard), since
+    unintended syncs are the TPU profile's equivalent of unintended
+    pageable-memory copies.
+
+Everything degrades gracefully on backends whose PJRT client reports no
+memory stats (CPU): stats return empty dicts and scopes report zeros.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+def device_memory_stats(device: Optional[Any] = None) -> Dict[str, int]:
+    """Allocator counters for one device (``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit``, ...), or ``{}`` where the backend reports none."""
+    dev = device if device is not None else jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def donating_jit(fn: Callable = None, /, *, donate_argnums=(), **jit_kwargs):
+    """``jax.jit`` with donated inputs — the buffer-reuse (RMM-pool) analog.
+
+    Donated arguments' HBM is handed to XLA for reuse by the outputs; the
+    caller must not touch them afterwards (same contract as the reference's
+    released native handles, RowConversionJni.cpp:33-38).  Usable as a
+    decorator or called directly.
+    """
+    if fn is None:
+        return lambda f: donating_jit(f, donate_argnums=donate_argnums,
+                                      **jit_kwargs)
+    return jax.jit(fn, donate_argnums=donate_argnums, **jit_kwargs)
+
+
+def free(*arrays) -> None:
+    """Eagerly release device buffers (no-op for deleted/committed views).
+
+    The GC frees buffers eventually; ``free`` is for the reference's
+    explicit-close discipline where a pipeline stage must return HBM before
+    the next stage allocates.
+    """
+    for arr in arrays:
+        try:
+            arr.delete()
+        except Exception:
+            pass        # already deleted, or a tracer/npy value
+
+
+@dataclass
+class MemoryReport:
+    """HBM accounting for a :class:`MemoryScope` region (bytes)."""
+    begin_in_use: int = 0
+    end_in_use: int = 0
+    peak_in_use: int = 0
+
+    @property
+    def delta(self) -> int:
+        return self.end_in_use - self.begin_in_use
+
+    @property
+    def peak_delta(self) -> int:
+        return self.peak_in_use - self.begin_in_use
+
+
+class MemoryScope:
+    """Context manager reporting the device-memory delta/peak of a region.
+
+    The logging_resource_adaptor analog: wrap a pipeline stage, read
+    ``scope.report`` after.  Peak is derived from the PJRT allocator's
+    ``peak_bytes_in_use`` counter; on backends without stats the report is
+    all zeros (still safe to use unconditionally).
+    """
+
+    def __init__(self, device: Optional[Any] = None, label: str = ""):
+        self.device = device if device is not None else jax.devices()[0]
+        self.label = label
+        self.report = MemoryReport()
+
+    def __enter__(self) -> "MemoryScope":
+        stats = device_memory_stats(self.device)
+        self.report.begin_in_use = stats.get("bytes_in_use", 0)
+        self._begin_peak = stats.get("peak_bytes_in_use", 0)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        stats = device_memory_stats(self.device)
+        self.report.end_in_use = stats.get("bytes_in_use", 0)
+        end_peak = stats.get("peak_bytes_in_use", 0)
+        # peak_bytes_in_use is a LIFETIME high-water mark: it only tells us
+        # the in-scope peak when the scope pushed it past the pre-scope
+        # value.  Otherwise report the best available lower bound (the
+        # larger of begin/end in-use) rather than a stale earlier peak.
+        if end_peak > self._begin_peak:
+            self.report.peak_in_use = end_peak
+        else:
+            self.report.peak_in_use = max(self.report.begin_in_use,
+                                          self.report.end_in_use)
+        return None
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Raise on implicit device↔host transfers inside the region.
+
+    Catches the silent ``np.asarray(device_array)`` syncs that serialize
+    TPU pipelines — explicit ``jax.device_get``/``device_put`` still work.
+    """
+    with jax.transfer_guard("disallow"):
+        yield
