@@ -1,0 +1,72 @@
+"""Parquet scan benchmark: native device decoder vs Arrow host reader.
+
+Measures end-to-end file→device-Table throughput for both engines on the
+same file (4M-row mixed fixed-width + dictionary-string schema, snappy).
+IO noise is minimized by tmpfs-or-page-cache residency (the file is read
+multiple times; first pass primes the cache).  The native path's win
+condition is the decode itself: RLE/dictionary expansion and null scatter
+on device instead of pyarrow's host threads.
+
+Run: python benchmarks/bench_parquet.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N = 4_000_000
+REPS = 3
+
+
+def main():
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io import read_parquet
+
+    rng = np.random.default_rng(17)
+    vocab = np.asarray([f"cat-{i:03d}" for i in range(200)])
+    at = pa.table({
+        "i64": pa.array(rng.integers(-1 << 40, 1 << 40, N),
+                        mask=rng.random(N) < 0.1),
+        "f64": rng.normal(size=N),
+        "i32": rng.integers(-1 << 20, 1 << 20, N).astype(np.int32),
+        "s": pa.array(vocab[rng.integers(0, len(vocab), N)]),
+    })
+
+    with tempfile.TemporaryDirectory() as d:
+        # One distinct file per rep: identical repeated device inputs can be
+        # served from a repeated-computation cache through the TPU tunnel
+        # (BASELINE.md measurement rule #2), so every read must differ.
+        paths = []
+        for r in range(REPS):
+            p = Path(d) / f"bench-{r}.parquet"
+            at2 = at.set_column(1, "f64", pa.array(
+                np.asarray(at["f64"]) + float(r)))
+            pq.write_table(at2, p, compression="snappy",
+                           row_group_size=1 << 20)
+            paths.append(p)
+
+        for engine in ("native", "arrow"):
+            t = read_parquet(paths[-1], engine=engine)  # warm: cache + jit
+            _ = np.asarray(t["i64"].data[-1:])
+            t0 = time.perf_counter()
+            for p in paths:
+                t = read_parquet(p, engine=engine)
+            _ = np.asarray(t["i64"].data[-1:])          # fence
+            dt = (time.perf_counter() - t0) / REPS
+            print(json.dumps({"metric": f"parquet_scan_{engine}_4M",
+                              "value": round(N / dt, 1),
+                              "unit": "rows/sec"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Distributed shuffle benchmark (BASELINE.json config #5 scaffolding).
+
+Measures hash-partitioned ``all_to_all`` shuffle throughput plus the
+shuffle-backed distributed group-by over a device mesh.  On a multi-chip
+TPU slice the collective rides ICI; on a single-host dev box the same code
+runs on the 8-device virtual CPU mesh (set SRT_BENCH_PLATFORM=cpu, the
+default when only one real device exists) — numbers there are *shape*
+validation, not bandwidth: the real sweep belongs on a pod slice.
+
+Run: python benchmarks/bench_shuffle.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ROWS_PER_DEV = 1_000_000
+REPS = 5
+
+
+def _setup_platform():
+    import jax
+    want = os.environ.get("SRT_BENCH_PLATFORM")
+    if want is None and len(jax.devices()) < 2:
+        # A 1-device mesh can't exercise all_to_all; fall back to the
+        # virtual CPU mesh (must be configured before the backend spins up,
+        # hence the re-exec).
+        if "--reexec" not in sys.argv:
+            env = dict(os.environ,
+                       XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                                  " --xla_force_host_platform_device_count=8"),
+                       JAX_PLATFORMS="cpu", SRT_BENCH_PLATFORM="cpu")
+            os.execvpe(sys.executable,
+                       [sys.executable, __file__, "--reexec"], env)
+    if want:
+        jax.config.update("jax_platforms", want)
+    return jax
+
+
+def main():
+    jax = _setup_platform()
+    import jax.numpy as jnp
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.parallel.dist_ops import dist_groupby
+    from spark_rapids_tpu.parallel.mesh import make_mesh, shard_table
+    from spark_rapids_tpu.parallel.shuffle import shuffle
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = make_mesh(devices)
+    n = ROWS_PER_DEV * n_dev
+    rng = np.random.default_rng(3)
+
+    table = srt.Table([
+        ("key", Column.from_numpy(rng.integers(0, 1 << 20, n).astype(np.int64))),
+        ("val", Column.from_numpy(rng.integers(0, 1000, n).astype(np.int64))),
+    ])
+    dist = shard_table(table, mesh)
+
+    # Warm + chain through a data-dependent bump on the keys.
+    out = shuffle(dist, mesh, ["key"])
+    bump = int(np.asarray(out.table["key"].data).ravel()[0]) & 1
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        shifted = shard_table(srt.Table([
+            ("key", Column(data=table["key"].data + bump,
+                           dtype=table["key"].dtype)),
+            ("val", table["val"])]), mesh)
+        out = shuffle(shifted, mesh, ["key"])
+        bump = int(np.asarray(out.table["key"].data).ravel()[0]) & 1
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": f"shuffle_all_to_all_{n_dev}dev",
+                      "value": round(n / dt, 1), "unit": "rows/sec",
+                      "devices": n_dev}))
+
+    # Distributed group-by (shuffle + per-shard sorted-segment reduce).
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        g = dist_groupby(dist, mesh, ["key"], [("val", "sum", "s"),
+                                               ("val", "count", "c")])
+        bump = int(np.asarray(g.table["c"].data).ravel()[0]) & 1
+        dist = shard_table(srt.Table([
+            ("key", Column(data=table["key"].data + bump,
+                           dtype=table["key"].dtype)),
+            ("val", table["val"])]), mesh)
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": f"dist_groupby_{n_dev}dev",
+                      "value": round(n / dt, 1), "unit": "rows/sec",
+                      "devices": n_dev}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""String/regex + decimal-cast kernel benchmark (BASELINE.json config #4).
+
+TPC-DS q28/q88 shape: predicate-heavy scans where the per-row work is
+string matching (LIKE / regex) and decimal arithmetic over a wide fact
+table.  Measures each kernel family standalone plus the fused
+filter→cast→aggregate pipeline, with the tunnel-safe protocol from
+BASELINE.md (chained data dependencies, host-read fence, exact-composition
+warmup).
+
+Run: python benchmarks/bench_strings.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N = 2_000_000
+REPS = 5
+
+
+def _bench(label, fn, state0, n=N, reps=REPS):
+    """Chained-reps timing: fn(state) -> (result_col, next_state)."""
+    out, state = fn(state0)                  # warm the exact composition
+    out, state = fn(state)
+    _ = np.asarray(out.data[-1:])            # fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out, state = fn(state)
+    _ = np.asarray(out.data[-1:])            # fence
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({"metric": label, "value": round(n / dt, 1),
+                      "unit": "rows/sec"}))
+    return out
+
+
+def main():
+    import jax.numpy as jnp
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import dtypes as dt
+    from spark_rapids_tpu import ops
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.ops import strings
+    from spark_rapids_tpu.ops.binary import binary_op
+
+    rng = np.random.default_rng(13)
+
+    # Dictionary-shaped string column (realistic: bounded distinct values).
+    vocab = [f"item-{i:04d}-{'promo' if i % 7 == 0 else 'base'}"
+             for i in range(500)]
+    codes = rng.integers(0, len(vocab), N)
+    names = strings.strings_from_pylist([vocab[c] for c in codes])
+
+    unscaled = rng.integers(-10**7, 10**7, N)
+    price = Column.from_numpy(unscaled.astype(np.int64)).data
+    price_col = Column(data=price, dtype=dt.decimal64(-2))
+
+    # -- LIKE scan (q88-style predicate) -------------------------------------
+    def like_scan(state):
+        # Shift the char domain by a data-dependent bump so runs chain.
+        col = Column(data=names.data + state, offsets=names.offsets,
+                     validity=names.validity, dtype=names.dtype)
+        m = strings.like(col, "%promo%")
+        nxt = (m.data[-1]).astype(jnp.uint8)
+        return m, nxt
+
+    _bench("strings_like_2M", like_scan, jnp.uint8(0))
+
+    # -- regex scan (q28-style) ----------------------------------------------
+    def regex_scan(state):
+        col = Column(data=names.data + state, offsets=names.offsets,
+                     validity=names.validity, dtype=names.dtype)
+        m = strings.contains_re(col, "item-0*[1-3][0-9]-(promo|base)")
+        nxt = (m.data[-1]).astype(jnp.uint8)
+        return m, nxt
+
+    _bench("strings_regex_2M", regex_scan, jnp.uint8(0))
+
+    # -- decimal cast + rescale ----------------------------------------------
+    def cast_chain(state):
+        col = Column(data=price_col.data + state, dtype=dt.decimal64(-2))
+        wide = ops.cast(col, dt.decimal64(-4))       # rescale x100
+        back = ops.cast(wide, dt.FLOAT64)
+        nxt = (back.data[-1] > 0).astype(price_col.data.dtype)
+        return back, nxt
+
+    _bench("decimal_cast_2M", cast_chain, np.int64(0))
+
+    # -- fused pipeline: LIKE filter -> decimal cast -> grouped sum ----------
+    group = Column.from_numpy(rng.integers(0, 64, N).astype(np.int32))
+    table = srt.Table([("name", names), ("price", price_col), ("g", group)])
+
+    def q28ish(state):
+        t = srt.Table(list(table.items())).with_column(
+            "price", Column(data=table["price"].data + state,
+                            dtype=dt.decimal64(-2)))
+        pred = strings.like(t["name"], "%promo%")
+        t = ops.apply_boolean_mask(t, pred)
+        t = t.with_column("pricef", ops.cast(t["price"], dt.FLOAT64))
+        agg = ops.groupby_agg(t, ["g"], [("pricef", "sum", "rev"),
+                                         ("pricef", "count", "n")])
+        nxt = (agg["n"].data[0] & 1).astype(np.int64)
+        return agg["rev"], nxt
+
+    _bench("q28_like_cast_groupby_2M", q28ish, np.int64(0))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,8 @@ import subprocess
 from pathlib import Path
 from typing import List, Optional
 
-SOURCES = ("row_layout.cpp", "row_conversion.cpp", "bridge.cpp")
+SOURCES = ("row_layout.cpp", "row_conversion.cpp", "rle_decode.cpp",
+           "bridge.cpp")
 
 
 def command(src_dir: Path, out_path: Path, version: str, rev: str,

@@ -21,6 +21,7 @@
 #include <string>
 #include <vector>
 
+#include "error.hpp"
 #include "row_conversion.hpp"
 #include "row_layout.hpp"
 
@@ -38,28 +39,8 @@ namespace {
 
 using namespace spark_rapids_tpu;
 
-thread_local std::string g_last_error;
-
-constexpr int32_t SRT_OK = 0;
-constexpr int32_t SRT_ERR_INVALID = 1;  // std::invalid_argument (CUDF_EXPECTS analog)
-constexpr int32_t SRT_ERR_INTERNAL = 2; // anything else
-
-template <typename Fn>
-int32_t guarded(Fn&& fn) noexcept {
-  try {
-    fn();
-    return SRT_OK;
-  } catch (const std::invalid_argument& e) {
-    g_last_error = e.what();
-    return SRT_ERR_INVALID;
-  } catch (const std::exception& e) {
-    g_last_error = e.what();
-    return SRT_ERR_INTERNAL;
-  } catch (...) {
-    g_last_error = "unknown native error";
-    return SRT_ERR_INTERNAL;
-  }
-}
+using spark_rapids_tpu::g_last_error;
+using spark_rapids_tpu::guarded;
 
 std::vector<DType> make_schema(int32_t ncols, const int32_t* type_ids,
                                const int32_t* scales) {

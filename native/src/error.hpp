@@ -1,0 +1,39 @@
+/* Shared error-propagation machinery for the C ABI surface.
+ *
+ * The reference maps C++ exceptions to Java exceptions at the JNI boundary
+ * with CATCH_STD (reference: src/main/cpp/src/RowConversionJni.cpp:40,65);
+ * this is the C-ABI counterpart: exceptions become status codes plus a
+ * thread-local message retrievable via srt_last_error() (bridge.cpp).
+ */
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace spark_rapids_tpu {
+
+inline thread_local std::string g_last_error;
+
+constexpr int32_t SRT_OK = 0;
+constexpr int32_t SRT_ERR_INVALID = 1;   // std::invalid_argument (CUDF_EXPECTS analog)
+constexpr int32_t SRT_ERR_INTERNAL = 2;  // anything else
+
+template <typename Fn>
+int32_t guarded(Fn&& fn) noexcept {
+  try {
+    fn();
+    return SRT_OK;
+  } catch (const std::invalid_argument& e) {
+    g_last_error = e.what();
+    return SRT_ERR_INVALID;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return SRT_ERR_INTERNAL;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return SRT_ERR_INTERNAL;
+  }
+}
+
+}  // namespace spark_rapids_tpu

@@ -95,6 +95,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srt_blob_data.argtypes = [i64, i32]
     lib.srt_blobs_free.restype = None
     lib.srt_blobs_free.argtypes = [i64]
+    u8p = p(ctypes.c_uint8)
+    lib.srt_rle_count_runs.restype = i32
+    lib.srt_rle_count_runs.argtypes = [u8p, i64, i32, i64, p(i64)]
+    lib.srt_rle_parse_runs.restype = i32
+    lib.srt_rle_parse_runs.argtypes = [
+        u8p, i64, i32, i64, i64, p(i32), p(i64), p(i32), p(i64), u8p,
+        p(i64), p(i64)]
     return lib
 
 
@@ -382,6 +389,50 @@ def convert_to_rows(schema, datas: Sequence[np.ndarray],
         return blobs.to_arrays()
 
 
+def parse_rle_runs(buf: bytes, bit_width: int, num_values: int):
+    """Native single-pass RLE/bit-packed run parse (+ width-1 popcount).
+
+    Returns ``(runs, ones)`` where ``runs`` has the same keys as the Python
+    reference parser (``spark_rapids_tpu.io.parquet_native.parse_rle_runs``)
+    and ``ones`` is the count of 1-values for width-1 streams (``None``
+    otherwise).  Raises ``ValueError`` on truncated/exhausted streams.
+    """
+    lib = load()
+    i64 = ctypes.c_int64
+    n = len(buf)
+    # Zero-copy view: `view` must stay referenced across both native calls.
+    view = np.frombuffer(buf, np.uint8) if n else None
+    cbuf = ctypes.cast(view.ctypes.data,
+                       ctypes.POINTER(ctypes.c_uint8)) if n else None
+    n_runs = i64(0)
+    _check(lib, lib.srt_rle_count_runs(cbuf, n, bit_width, num_values,
+                                       ctypes.byref(n_runs)))
+    r = n_runs.value
+    out_start = np.empty(r, np.int32)
+    count = np.empty(r, np.int64)
+    rle_value = np.empty(r, np.int32)
+    bp_bit_base = np.empty(r, np.int64)
+    is_rle = np.empty(r, np.uint8)
+    ones = i64(0)
+    as_p = ctypes.cast
+    _check(lib, lib.srt_rle_parse_runs(
+        cbuf, n, bit_width, num_values, r,
+        as_p(out_start.ctypes.data, ctypes.POINTER(ctypes.c_int32)),
+        as_p(count.ctypes.data, ctypes.POINTER(ctypes.c_int64)),
+        as_p(rle_value.ctypes.data, ctypes.POINTER(ctypes.c_int32)),
+        as_p(bp_bit_base.ctypes.data, ctypes.POINTER(ctypes.c_int64)),
+        as_p(is_rle.ctypes.data, ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(n_runs), ctypes.byref(ones)))
+    runs = {
+        "out_start": out_start,
+        "count": count,
+        "rle_value": rle_value,
+        "bp_bit_base": bp_bit_base,
+        "is_rle": is_rle.astype(np.bool_),
+    }
+    return runs, (ones.value if bit_width == 1 else None)
+
+
 __all__ = [
     "NativeError",
     "RowBlobs",
@@ -391,5 +442,6 @@ __all__ = [
     "convert_to_rows_handle",
     "load",
     "pack_rows",
+    "parse_rle_runs",
     "unpack_rows",
 ]

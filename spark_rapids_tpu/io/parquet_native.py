@@ -337,6 +337,36 @@ def parse_rle_runs(buf: bytes, bit_width: int,
     }
 
 
+_native_parse = None
+_native_checked = False
+
+
+def _parse_runs_and_ones(buf: bytes, bit_width: int, num_values: int
+                         ) -> Tuple[Dict[str, np.ndarray], Optional[int]]:
+    """Run-table parse + width-1 popcount, native C++ when available.
+
+    Null-dense definition-level streams carry ~100k runs per chunk; the
+    single-pass C++ walk (native/src/rle_decode.cpp) is ~100x the Python
+    loop there.  Falls back to the pure-Python parser (kept as the
+    behavioral reference; tests assert parity) when the host library is
+    unavailable.
+    """
+    global _native_parse, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from .. import ffi
+            ffi.load()
+            _native_parse = ffi.parse_rle_runs
+        except Exception:
+            _native_parse = None
+    if _native_parse is not None:
+        return _native_parse(buf, bit_width, num_values)
+    runs = parse_rle_runs(buf, bit_width, num_values)
+    ones = count_rle_ones(buf, runs, num_values) if bit_width == 1 else None
+    return runs, ones
+
+
 def count_rle_ones(buf: bytes, runs: Dict[str, np.ndarray],
                    num_values: int) -> int:
     """Host popcount of a width-1 RLE/bit-packed stream (definition levels).
@@ -386,7 +416,7 @@ class RunMerger:
         ``out_base``; returns the parsed (un-rebased) run table.  Pass
         ``runs`` when the stream was already parsed (avoids a re-walk)."""
         if runs is None:
-            runs = parse_rle_runs(buf, bit_width, num_values)
+            runs, _ = _parse_runs_and_ones(buf, bit_width, num_values)
         self._tables.append({
             "out_start": runs["out_start"] + np.int32(out_base),
             "rle_value": runs["rle_value"],
@@ -419,6 +449,12 @@ class RunMerger:
         rle_value = np.concatenate([t["rle_value"] for t in self._tables])
         bp_bit_base = np.concatenate([t["bp_bit_base"] for t in self._tables])
         is_rle = np.concatenate([t["is_rle"] for t in self._tables])
+        # Bit indices fit int32 whenever the merged stream is < 256 MB (the
+        # practical case: level/index streams are a fraction of a <=2 GB
+        # chunk) — int64 index math would run in emulated x64 on TPU.
+        # Worst-case index: a run base plus (pow2-padded) run-local offset.
+        if self._bit_base + 2 * num_values * max(bit_width, 1) + 64 < 2**31:
+            bp_bit_base = bp_bit_base.astype(np.int32)
         n_runs = out_start.shape[0]
         pad = pow2_bucket(n_runs) - n_runs
         n_pad = pow2_bucket(num_values)
@@ -428,8 +464,8 @@ class RunMerger:
             out_start = np.concatenate(
                 [out_start, np.full(pad, n_pad, np.int32)])
             rle_value = np.concatenate([rle_value, np.zeros(pad, np.int32)])
-            bp_bit_base = np.concatenate(
-                [bp_bit_base, np.zeros(pad, np.int64)])
+            bp_bit_base = np.concatenate(       # keep the int32 downcast
+                [bp_bit_base, np.zeros(pad, bp_bit_base.dtype)])
             is_rle = np.concatenate([is_rle, np.ones(pad, np.bool_)])
         words = _bytes_to_words(b"".join(self._bufs), bucket=True)
         out = _expand_runs(words, jnp.asarray(out_start),
@@ -469,7 +505,11 @@ def _expand_runs(words: jax.Array, out_start: jax.Array, rle_value: jax.Array,
     """
     idx = jnp.arange(n, dtype=jnp.int32)
     run = jnp.searchsorted(out_start, idx, side="right").astype(jnp.int32) - 1
-    base = bp_bit_base[run] + (idx - out_start[run]).astype(jnp.int64) * bit_width
+    # bp_bit_base arrives int32 when the stream is small enough (the common
+    # case) so the index math stays in native 32-bit lanes on TPU; int64
+    # (emulated) only for >256 MB merged streams.
+    base = bp_bit_base[run] + \
+        (idx - out_start[run]).astype(bp_bit_base.dtype) * bit_width
     word_idx = jnp.minimum((base >> 5).astype(jnp.int32),
                            words.shape[0] - 2)     # pad rows read zeros
     shift = (base & 31).astype(jnp.uint32)
@@ -674,8 +714,8 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
             if ptype == P_DATA_V2:
                 n_defined = num_values - dph[2]     # num_nulls is exact in v2
             else:
-                def_runs = parse_rle_runs(def_buf, 1, num_values)
-                n_defined = count_rle_ones(def_buf, def_runs, num_values)
+                def_runs, n_defined = _parse_runs_and_ones(def_buf, 1,
+                                                           num_values)
         else:
             n_defined = num_values
         pages.append(_PageSlice(row_base=row_base, num_values=num_values,

@@ -278,3 +278,42 @@ class TestRleKernel:
     def test_width_zero(self):
         got = np.asarray(decode_rle_bp(b"", 0, 17))
         np.testing.assert_array_equal(got, np.zeros(17, np.int32))
+
+    @pytest.mark.parametrize("bit_width", [1, 3, 8, 17])
+    def test_native_parser_matches_python(self, bit_width):
+        ffi = pytest.importorskip("spark_rapids_tpu.ffi")
+        try:
+            ffi.load()
+        except Exception:
+            pytest.skip("native host library unavailable")
+        from spark_rapids_tpu.io.parquet_native import count_rle_ones
+        rng = np.random.default_rng(bit_width)
+        hi = (1 << bit_width) - 1
+        plan = [("bp", 24), ("rle", 100), ("bp", 8), ("rle", 3), ("rle", 7)]
+        n = sum(c for _, c in plan)
+        values = rng.integers(0, hi + 1, n)
+        pos = 0
+        for kind, cnt in plan:          # RLE spans must be constant
+            if kind == "rle":
+                values[pos:pos + cnt] = values[pos]
+            pos += cnt
+        buf = self._encode(values, bit_width, plan)
+        py = parse_rle_runs(buf, bit_width, n)
+        nat, ones = ffi.parse_rle_runs(buf, bit_width, n)
+        for key in ("out_start", "count", "rle_value", "bp_bit_base",
+                    "is_rle"):
+            np.testing.assert_array_equal(nat[key], py[key], err_msg=key)
+        if bit_width == 1:
+            assert ones == count_rle_ones(buf, py, n) == int(values.sum())
+        else:
+            assert ones is None
+
+    def test_native_parser_exhausted_stream(self):
+        ffi = pytest.importorskip("spark_rapids_tpu.ffi")
+        try:
+            ffi.load()
+        except Exception:
+            pytest.skip("native host library unavailable")
+        buf = self._encode(np.ones(4, np.int64), 1, [("rle", 4)])
+        with pytest.raises(ValueError):
+            ffi.parse_rle_runs(buf, 1, 100)
