@@ -70,17 +70,30 @@ def padded_chars(col: Column) -> tuple[jax.Array, jax.Array]:
     the per-thread byte loops a GPU strings engine uses).  Pad bytes are 0
     and masked by ``lengths``.  One host sync for max_len.
     """
+    chars_t, lengths = padded_chars_t(col)
+    return chars_t.T, lengths
+
+
+def padded_chars_t(col: Column) -> tuple[jax.Array, jax.Array]:
+    """Transposed variant of :func:`padded_chars`: (max_len, rows) uint8.
+
+    The row-major (rows, max_len) layout lane-pads its trailing dim to 128
+    on TPU (up to ~7x memory/bandwidth tax for short strings); with rows in
+    the lane dimension the matrix is dense.  Preferred for scan-shaped
+    consumers (the regex DFA).
+    """
     offsets = col.offsets
     starts = offsets[:-1]
     lengths = (offsets[1:] - starts).astype(jnp.int32)
     n = lengths.shape[0]
     max_len = int(jnp.max(lengths)) if n else 0   # host sync
     if max_len == 0:
-        return jnp.zeros((n, 0), jnp.uint8), lengths
+        return jnp.zeros((0, n), jnp.uint8), lengths
     pos = jnp.arange(max_len, dtype=jnp.int32)
-    idx = starts[:, None] + pos[None, :]
+    idx = starts[None, :] + pos[:, None]
     flat = jnp.take(col.data, jnp.clip(idx, 0, max(col.data.shape[0] - 1, 0)))
-    return jnp.where(pos[None, :] < lengths[:, None], flat, jnp.uint8(0)), lengths
+    return jnp.where(pos[:, None] < lengths[None, :], flat, jnp.uint8(0)), \
+        lengths
 
 
 def _bool_col(mask: jax.Array, validity) -> Column:
@@ -119,69 +132,107 @@ def lower(col: Column) -> Column:
                   offsets=col.offsets, dtype=STRING)
 
 
-def _match_windows(col: Column, needle: str):
-    """(hit, n) where hit is the (rows, max_len) bool matrix of literal match
-    start positions, or (None, n) for the trivial empty-needle case."""
-    pat = np.frombuffer(needle.encode("utf-8"), np.uint8)
+def _row_ids(offsets: jax.Array, total: int) -> jax.Array:
+    """int32 row id per flat char position (scatter-indicator + prefix sum —
+    same O(total) formulation as :func:`_segment_gather`)."""
+    indicator = jnp.zeros(total, jnp.int32).at[
+        jnp.clip(offsets, 0, total - 1)].add(
+            jnp.where(offsets < total, 1, 0).astype(jnp.int32))
+    return jnp.cumsum(indicator) - 1
+
+
+def _flat_hits(col: Column, pat: np.ndarray) -> jax.Array:
+    """Bool per flat char position: a match of ``pat`` starts here, entirely
+    inside this row.
+
+    Operates on the FLAT char buffer — the (rows, max_len) padded matrix
+    lane-pads its trailing dim to 128 on TPU (up to ~7x bandwidth tax per
+    pass, times pattern length); flat 1-D passes avoid that entirely, at
+    m+4 elementwise sweeps + one gather.
+    """
+    data = col.data
+    total = data.shape[0]
     m = len(pat)
-    padded, lengths = padded_chars(col)
-    n, max_len = padded.shape
-    if m == 0 or m > max_len:
-        return (None if m == 0 else jnp.zeros((n, max(max_len, 1)), jnp.bool_)), n
-    ext = jnp.pad(padded, ((0, 0), (0, m)))
-    acc = jnp.ones((n, max_len), jnp.bool_)
+    ext = jnp.pad(data, (0, m))
+    match = jnp.ones(total, jnp.bool_)
     for k in range(m):
-        acc = acc & (ext[:, k:k + max_len] == pat[k])
-    pos = jnp.arange(max_len, dtype=jnp.int32)
-    return acc & (pos[None, :] <= (lengths[:, None] - m)), n
+        match = match & (ext[k:k + total] == pat[k])
+    row = _row_ids(col.offsets, total)
+    ends = jnp.take(col.offsets, row + 1)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    return match & (pos + m <= ends)
+
+
+def _per_row_any(hits: jax.Array, offsets: jax.Array) -> jax.Array:
+    prefix = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(hits.astype(jnp.int32))])
+    return (jnp.take(prefix, offsets[1:]) - jnp.take(prefix, offsets[:-1])) > 0
 
 
 def contains(col: Column, needle: str) -> Column:
     """Literal substring containment (cudf ``contains``)."""
-    hit, n = _match_windows(col, needle)
-    if hit is None:
+    pat = np.frombuffer(needle.encode("utf-8"), np.uint8)
+    n = col.size
+    if len(pat) == 0:
         return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
-    return _bool_col(jnp.any(hit, axis=1), col.validity)
+    if col.data.shape[0] == 0:
+        return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
+    hits = _flat_hits(col, pat)
+    return _bool_col(_per_row_any(hits, col.offsets), col.validity)
 
 
 def find(col: Column, needle: str) -> Column:
     """Byte position of the first occurrence, -1 if absent (cudf ``find``)."""
-    hit, n = _match_windows(col, needle)
-    if hit is None:
+    pat = np.frombuffer(needle.encode("utf-8"), np.uint8)
+    n = col.size
+    if len(pat) == 0:
         return Column(data=jnp.zeros(n, jnp.int32), validity=col.validity,
                       dtype=INT32)
-    first = jnp.argmax(hit, axis=1).astype(jnp.int32)
-    return Column(data=jnp.where(jnp.any(hit, axis=1), first, -1),
+    total = col.data.shape[0]
+    if total == 0:
+        return Column(data=jnp.full(n, -1, jnp.int32), validity=col.validity,
+                      dtype=INT32)
+    hits = _flat_hits(col, pat)
+    row = _row_ids(col.offsets, total)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    first = jnp.full(n, total, jnp.int32).at[row].min(
+        jnp.where(hits, pos, total))
+    starts = col.offsets[:-1]
+    return Column(data=jnp.where(first < total, first - starts, -1),
                   validity=col.validity, dtype=INT32)
+
+
+def _gather_window(col: Column, win_starts: jax.Array, m: int) -> jax.Array:
+    """(rows, m) char gather at per-row start positions (m is tiny)."""
+    idx = win_starts[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+    safe = jnp.clip(idx, 0, max(col.data.shape[0] - 1, 0))
+    return jnp.take(col.data, safe)
 
 
 def starts_with(col: Column, prefix: str) -> Column:
     pat = np.frombuffer(prefix.encode("utf-8"), np.uint8)
     m = len(pat)
-    padded, lengths = padded_chars(col)
-    n, max_len = padded.shape
     if m == 0:
-        return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
-    if m > max_len:
-        return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
-    ok = jnp.all(padded[:, :m] == pat, axis=1) & (lengths >= m)
+        return _bool_col(jnp.ones(col.size, jnp.bool_), col.validity)
+    if col.data.shape[0] == 0:
+        return _bool_col(jnp.zeros(col.size, jnp.bool_), col.validity)
+    lengths = col.offsets[1:] - col.offsets[:-1]
+    head = _gather_window(col, col.offsets[:-1], m)
+    ok = jnp.all(head == pat, axis=1) & (lengths >= m)
     return _bool_col(ok, col.validity)
 
 
 def ends_with(col: Column, suffix: str) -> Column:
     pat = np.frombuffer(suffix.encode("utf-8"), np.uint8)
     m = len(pat)
-    padded, lengths = padded_chars(col)
-    n, max_len = padded.shape
     if m == 0:
-        return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
-    if m > max_len:
-        return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
-    idx = jnp.clip(lengths[:, None] - m + jnp.arange(m, dtype=jnp.int32)[None, :],
-                   0, max_len - 1)
-    tail = jnp.take_along_axis(padded, idx, axis=1)       # one (n, m) gather
-    ok = jnp.all(tail == jnp.asarray(pat), axis=1)
-    return _bool_col(ok & (lengths >= m), col.validity)
+        return _bool_col(jnp.ones(col.size, jnp.bool_), col.validity)
+    if col.data.shape[0] == 0:
+        return _bool_col(jnp.zeros(col.size, jnp.bool_), col.validity)
+    lengths = col.offsets[1:] - col.offsets[:-1]
+    tail = _gather_window(col, col.offsets[1:] - m, m)
+    ok = jnp.all(tail == pat, axis=1) & (lengths >= m)
+    return _bool_col(ok, col.validity)
 
 
 def _segment_gather(data: jax.Array, src_starts: jax.Array,
@@ -202,12 +253,7 @@ def _segment_gather(data: jax.Array, src_starts: jax.Array,
     if total == 0:
         return jnp.zeros(0, jnp.uint8)
     pos = jnp.arange(total, dtype=jnp.int32)
-    # indicator[p] = number of rows starting at byte p (clip drops the
-    # terminal offset == total); row id = inclusive prefix count - 1.
-    indicator = jnp.zeros(total, jnp.int32).at[
-        jnp.clip(new_offsets, 0, total - 1)].add(
-            jnp.where(new_offsets < total, 1, 0).astype(jnp.int32))
-    row = jnp.cumsum(indicator) - 1
+    row = _row_ids(new_offsets, total)
     src = jnp.take(src_starts, row) + (pos - jnp.take(new_offsets, row))
     return jnp.take(data, src)
 
@@ -317,21 +363,99 @@ def contains_re(col: Column, pattern: str) -> Column:
     """Regex containment (cudf ``contains_re``): unanchored search unless the
     pattern carries ^/$ anchors."""
     from . import regex
-    rx = regex.compile(pattern)
-    padded, lengths = padded_chars(col)
-    return _bool_col(regex.run_dfa(rx, padded, lengths), col.validity)
+    chars_t, lengths = padded_chars_t(col)
+    return _bool_col(regex.matcher(pattern)(chars_t, lengths), col.validity)
 
 
 def matches_re(col: Column, pattern: str) -> Column:
     """Full-string regex match (anchored both ends)."""
     from . import regex
-    rx = regex.compile(pattern, full_match=True)
-    padded, lengths = padded_chars(col)
-    return _bool_col(regex.run_dfa(rx, padded, lengths), col.validity)
+    chars_t, lengths = padded_chars_t(col)
+    return _bool_col(regex.matcher(pattern, full_match=True)(chars_t, lengths),
+                     col.validity)
+
+
+def _like_tokens(pattern: str, escape: str):
+    """Tokenize a LIKE pattern into tagged tokens: ``("lit", text)``,
+    ``("%",)`` and ``("_",)``.  Tagging keeps escaped ``%``/``_`` (which
+    land inside literal text) distinguishable from the wildcards."""
+    tokens: list[tuple] = []
+    lit: list[str] = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            lit.append(pattern[i + 1])
+            i += 2
+            continue
+        if ch in ("%", "_"):
+            if lit:
+                tokens.append(("lit", "".join(lit)))
+                lit = []
+            tokens.append((ch,))
+        else:
+            lit.append(ch)
+        i += 1
+    if lit:
+        tokens.append(("lit", "".join(lit)))
+    return tokens
+
+
+def _like_fast_path(col: Column, tokens: list[str]):
+    """Dispatch the common LIKE shapes to literal kernels; None = no match.
+
+    Spark predicates are dominated by ``%lit%`` / ``lit%`` / ``%lit`` /
+    ``a%b`` / exact literals — all expressible as flat-buffer literal ops,
+    orders of magnitude cheaper than the byte-DFA the general translation
+    runs.  Patterns with ``_`` or interior literals between three+ ``%``
+    fall through to the regex path.
+    """
+    if ("_",) in tokens:
+        return None
+    lits = [t[1] for t in tokens if t[0] == "lit"]
+    pct = sum(1 for t in tokens if t[0] == "%")
+    if not lits:                                  # "", "%", "%%"...
+        if pct == 0:
+            lens = col.offsets[1:] - col.offsets[:-1]
+            return _bool_col(lens == 0, col.validity)
+        return _bool_col(jnp.ones(col.size, jnp.bool_), col.validity)
+    if len(lits) == 1:
+        lit = lits[0]
+        first_pct = tokens[0] == ("%",)
+        last_pct = tokens[-1] == ("%",)
+        if len(tokens) == 1:                      # exact literal
+            lens = col.offsets[1:] - col.offsets[:-1]
+            m = len(lit.encode("utf-8"))
+            eq = starts_with(col, lit)
+            return _bool_col((eq.data != 0) & (lens == m), col.validity)
+        if pct == len(tokens) - 1 and first_pct and last_pct:
+            return contains(col, lit)             # %lit% (any inner %s)
+        if len(tokens) == 2 and last_pct:
+            return starts_with(col, lit)          # lit%
+        if len(tokens) == 2 and first_pct:
+            return ends_with(col, lit)            # %lit
+    if len(lits) == 2 and len(tokens) == 3 and tokens[1] == ("%",) \
+            and tokens[0][0] == "lit" and tokens[-1][0] == "lit":
+        a, b = lits                               # a%b
+        ma = len(a.encode("utf-8"))
+        mb = len(b.encode("utf-8"))
+        lens = col.offsets[1:] - col.offsets[:-1]
+        ok = (starts_with(col, a).data != 0) & (ends_with(col, b).data != 0) \
+            & (lens >= ma + mb)
+        return _bool_col(ok, col.validity)
+    return None
 
 
 def like(col: Column, pattern: str, escape: str = "\\") -> Column:
-    """SQL LIKE (Spark semantics): ``%`` any run, ``_`` any char; full match."""
+    """SQL LIKE (Spark semantics): ``%`` any run, ``_`` any char; full match.
+
+    Common literal shapes (``%lit%``, ``lit%``, ``%lit``, ``a%b``, exact)
+    run as flat-buffer literal kernels; everything else compiles to the
+    byte-DFA regex engine.
+    """
+    fast = _like_fast_path(col, _like_tokens(pattern, escape))
+    if fast is not None:
+        return fast
     out = []
     i = 0
     specials = ".^$*+?{}[]|()\\"

@@ -162,3 +162,39 @@ class TestLike:
         c = scol(["é", "ab", "a"])
         assert st.like(c, "_").to_pylist() == [True, False, True]
         assert st.like(c, "__").to_pylist() == [False, True, False]
+
+    def test_like_fast_paths_match_regex_path(self):
+        # Every fast-path shape cross-checked against python fnmatch-style
+        # semantics on awkward data (empty strings, boundary-adjacent rows).
+        import re
+        vals = ["", "promo", "xpromo", "promox", "xpromox", "pro", "mo",
+                "promopromo", "p", None, "PROMO", "aXb", "ab", "a-b-c"]
+        c = scol(vals)
+        patterns = ["%promo%", "promo%", "%promo", "promo", "%", "",
+                    "a%b", "%%promo%%", "p%o"]
+        for pat in patterns:
+            rx = "^" + "".join("[\\s\\S]*" if ch == "%" else re.escape(ch)
+                               for ch in pat) + "$"
+            want = [None if v is None else bool(re.match(rx, v))
+                    for v in vals]
+            got = st.like(c, pat).to_pylist()
+            assert got == want, f"pattern {pat!r}: {got} != {want}"
+
+    def test_like_escaped_percent_is_literal(self):
+        c = scol(["%", "a", "", "x%y", "%abc"])
+        assert st.like(c, "\\%").to_pylist() == [True, False, False, False,
+                                                 False]
+        assert st.like(c, "%\\%%").to_pylist() == [True, False, False, True,
+                                                   True]
+        assert st.like(c, "\\%%").to_pylist() == [True, False, False, False,
+                                                  True]
+
+    def test_contains_does_not_match_across_row_boundary(self):
+        # "ab"+"cd" adjacent in the char buffer must not produce "bc".
+        c = scol(["ab", "cd", "bc"])
+        assert st.contains(c, "bc").to_pylist() == [False, False, True]
+        assert st.find(c, "bc").to_pylist() == [-1, -1, 0]
+
+    def test_find_positions(self):
+        c = scol(["hello", "xhello", "he", "", "oh hello hello"])
+        assert st.find(c, "hello").to_pylist() == [0, 1, -1, -1, 3]
