@@ -141,14 +141,14 @@ def _row_ids(offsets: jax.Array, total: int) -> jax.Array:
     return jnp.cumsum(indicator) - 1
 
 
-def _flat_hits(col: Column, pat: np.ndarray) -> jax.Array:
-    """Bool per flat char position: a match of ``pat`` starts here, entirely
-    inside this row.
+def _flat_hits(col: Column, pat: np.ndarray):
+    """Per flat char position: (match-starts-here bool, row id, position).
 
     Operates on the FLAT char buffer — the (rows, max_len) padded matrix
     lane-pads its trailing dim to 128 on TPU (up to ~7x bandwidth tax per
     pass, times pattern length); flat 1-D passes avoid that entirely, at
-    m+4 elementwise sweeps + one gather.
+    m+4 elementwise sweeps + one gather.  Row ids and positions are
+    returned so callers (``find``) don't recompute the O(total) passes.
     """
     data = col.data
     total = data.shape[0]
@@ -160,7 +160,7 @@ def _flat_hits(col: Column, pat: np.ndarray) -> jax.Array:
     row = _row_ids(col.offsets, total)
     ends = jnp.take(col.offsets, row + 1)
     pos = jnp.arange(total, dtype=jnp.int32)
-    return match & (pos + m <= ends)
+    return match & (pos + m <= ends), row, pos
 
 
 def _per_row_any(hits: jax.Array, offsets: jax.Array) -> jax.Array:
@@ -177,7 +177,7 @@ def contains(col: Column, needle: str) -> Column:
         return _bool_col(jnp.ones(n, jnp.bool_), col.validity)
     if col.data.shape[0] == 0:
         return _bool_col(jnp.zeros(n, jnp.bool_), col.validity)
-    hits = _flat_hits(col, pat)
+    hits, _, _ = _flat_hits(col, pat)
     return _bool_col(_per_row_any(hits, col.offsets), col.validity)
 
 
@@ -192,9 +192,7 @@ def find(col: Column, needle: str) -> Column:
     if total == 0:
         return Column(data=jnp.full(n, -1, jnp.int32), validity=col.validity,
                       dtype=INT32)
-    hits = _flat_hits(col, pat)
-    row = _row_ids(col.offsets, total)
-    pos = jnp.arange(total, dtype=jnp.int32)
+    hits, row, pos = _flat_hits(col, pat)
     first = jnp.full(n, total, jnp.int32).at[row].min(
         jnp.where(hits, pos, total))
     starts = col.offsets[:-1]

@@ -6,6 +6,8 @@ jax.sharding.Mesh; repartitioning is one lax.all_to_all under shard_map
 local kernels with zero host syncs inside the compiled program.
 """
 
+from .cluster import (ClusterInfo, init_cluster, make_flat_mesh,
+                      make_hybrid_mesh)
 from .dist_ops import dist_groupby, dist_join
 from .hashing import hash_columns, partition_ids
 from .mesh import AXIS, DistTable, collect, make_mesh, shard_table
@@ -13,11 +15,15 @@ from .shuffle import shuffle
 
 __all__ = [
     "AXIS",
+    "ClusterInfo",
     "DistTable",
     "collect",
     "dist_groupby",
     "dist_join",
     "hash_columns",
+    "init_cluster",
+    "make_flat_mesh",
+    "make_hybrid_mesh",
     "make_mesh",
     "partition_ids",
     "shard_table",

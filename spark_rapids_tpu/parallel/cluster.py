@@ -1,0 +1,148 @@
+"""Multi-host cluster bring-up and hybrid ICI/DCN meshes.
+
+The reference system's cross-process story is owned by Spark + the RAPIDS
+shuffle manager (UCX/NCCL bootstrap, executor registration — outside the
+reference repo; SURVEY.md §2.4).  The TPU-native equivalent is JAX's
+multi-controller runtime: every host runs the same program,
+``jax.distributed.initialize`` wires the coordination service, and device
+collectives ride ICI within a slice and DCN across slices.  This module is
+that bootstrap plus mesh topology helpers:
+
+  * :func:`init_cluster` — idempotent process-group bring-up.  With no
+    arguments it autodetects the environment (TPU pod metadata, or the
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``
+    env triple); single-process runs return immediately.  This plays the
+    role of the executor-registration step of the reference's shuffle
+    manager.
+  * :func:`make_hybrid_mesh` — a ``(dcn, ici)`` 2-D mesh: the inner axis
+    spans devices that share a slice (fast ICI collectives), the outer
+    axis crosses slices/hosts over DCN.  Shard model-parallel or
+    shuffle-heavy axes on ``ici``; only coarse repartitions on ``dcn``.
+  * :func:`make_flat_mesh` — a 1-D mesh (the engine's partition axis,
+    :mod:`.mesh`) ordered so ICI neighbors are adjacent: an
+    ``all_to_all`` over it keeps most traffic on-slice, the same locality
+    trick the RAPIDS shuffle manager plays with intra-node NVLink.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXIS
+
+_initialized = False
+
+
+@dataclass(frozen=True)
+class ClusterInfo:
+    """What this process sees after bring-up."""
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.process_count > 1
+
+
+def init_cluster(coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None) -> ClusterInfo:
+    """Bring up (or report) the multi-host process group.  Idempotent.
+
+    Explicit arguments win; otherwise the standard env triple is used when
+    present; otherwise cloud/pod autodetection is attempted only when the
+    environment looks multi-host.  Single-process runs skip initialization
+    entirely (devices are already visible).
+    """
+    global _initialized
+    if not _initialized:
+        coordinator_address = coordinator_address or \
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
+        if coordinator_address or (num_processes or 0) > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            _initialized = True
+        else:
+            # No explicit config: let JAX's cluster autodetection look at
+            # cloud/pod metadata (TPU pods, SLURM, ...).  On a plain single
+            # machine detection fails fast — that IS the single-process
+            # case, not an error.
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except Exception:
+                pass
+    return ClusterInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def _slice_index(dev) -> int:
+    """Best-effort slice id of a device: TPU slice_index where exposed,
+    else the owning process (CPU/GPU hosts: one 'slice' per process)."""
+    v = getattr(dev, "slice_index", None)
+    return int(v) if v is not None else int(dev.process_index)
+
+
+def _group_by_slice(devices: Sequence) -> list[list]:
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(_slice_index(d), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def make_hybrid_mesh(ici_axis: str = AXIS, dcn_axis: str = "dcn",
+                     devices: Optional[Sequence] = None,
+                     dcn_size: Optional[int] = None) -> Mesh:
+    """A 2-D ``(dcn, ici)`` mesh: inner axis on-slice, outer axis across.
+
+    ``dcn_size`` forces the outer-axis length (useful on a single host to
+    rehearse multi-slice sharding over the virtual CPU mesh); by default it
+    is the number of distinct slices (1 on a single slice → outer axis of
+    length 1, so shardings written for the hybrid mesh run unchanged).
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if dcn_size is not None:
+        if len(devices) % dcn_size:
+            raise ValueError(
+                f"{len(devices)} devices do not split into {dcn_size} slices")
+        grid = np.array(devices).reshape(dcn_size, -1)
+    else:
+        groups = _group_by_slice(devices)
+        per = {len(g) for g in groups}
+        if len(per) != 1:
+            raise ValueError(
+                f"uneven slices (sizes {sorted(per)}); pass dcn_size or a "
+                "device subset")
+        grid = np.array(groups)
+    return Mesh(grid, (dcn_axis, ici_axis))
+
+
+def make_flat_mesh(devices: Optional[Sequence] = None,
+                   axis_name: str = AXIS) -> Mesh:
+    """A 1-D engine mesh ordered slice-major (ICI neighbors adjacent).
+
+    The engine's distributed ops (:mod:`.shuffle`, :mod:`.dist_ops`) use a
+    1-D partition axis; ordering partitions slice-major means the bulk of
+    an ``all_to_all``'s pairwise traffic stays on-slice and only the
+    inter-block remainder crosses DCN.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    ordered = [d for group in _group_by_slice(devices) for d in group]
+    return Mesh(np.array(ordered), (axis_name,))

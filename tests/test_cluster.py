@@ -1,0 +1,78 @@
+"""Cluster bring-up + hybrid mesh tests (8-device virtual CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.parallel import (AXIS, collect, dist_groupby,
+                                       init_cluster, make_flat_mesh,
+                                       make_hybrid_mesh, shard_table, shuffle)
+
+
+class TestInitCluster:
+    def test_single_process_is_noop(self):
+        info = init_cluster()
+        assert info.process_index == 0
+        assert info.process_count == 1
+        assert info.global_device_count == len(jax.devices())
+        assert not info.is_multi_host
+        # Idempotent.
+        assert init_cluster() == info
+
+
+class TestHybridMesh:
+    def test_default_single_slice(self):
+        mesh = make_hybrid_mesh()
+        assert mesh.axis_names == ("dcn", AXIS)
+        assert mesh.shape["dcn"] == 1          # one process = one slice
+        assert mesh.shape[AXIS] == len(jax.devices())
+
+    def test_forced_dcn_size(self):
+        mesh = make_hybrid_mesh(dcn_size=2)
+        assert mesh.shape["dcn"] == 2
+        assert mesh.shape[AXIS] == len(jax.devices()) // 2
+
+    def test_bad_dcn_size(self):
+        with pytest.raises(ValueError):
+            make_hybrid_mesh(dcn_size=3)       # 8 devices don't split by 3
+
+    def test_hybrid_mesh_runs_collectives(self):
+        # A psum over each axis of the hybrid mesh must compile + run.
+        from jax.sharding import PartitionSpec
+        from jax import shard_map
+        mesh = make_hybrid_mesh(dcn_size=2)
+
+        def body(x):
+            local = jax.numpy.sum(x)                 # reduce own block
+            on_slice = jax.lax.psum(local, AXIS)     # ICI reduction
+            return jax.lax.psum(on_slice, "dcn")[None, None]   # DCN
+
+        f = shard_map(body, mesh=mesh,
+                      in_specs=PartitionSpec("dcn", AXIS),
+                      out_specs=PartitionSpec("dcn", AXIS))
+        x = np.arange(16.0).reshape(2, 8)
+        out = jax.jit(f)(x)                  # (dcn, ici) grid of scalars
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((2, 4), x.sum()))
+
+
+class TestFlatMesh:
+    def test_flat_mesh_drives_engine_ops(self):
+        mesh = make_flat_mesh()
+        assert mesh.axis_names == (AXIS,)
+        rng = np.random.default_rng(0)
+        n = 64
+        t = srt.Table([
+            ("k", Column.from_numpy(rng.integers(0, 5, n).astype(np.int64))),
+            ("v", Column.from_numpy(rng.integers(0, 10, n).astype(np.int64))),
+        ])
+        dist = shard_table(t, mesh)
+        shuffled = shuffle(dist, mesh, ["k"])
+        assert shuffled.num_rows() == n
+        g = collect(dist_groupby(dist, mesh, ["k"], [("v", "sum", "s")]))
+        host = {}
+        for k, v in zip(t["k"].to_pylist(), t["v"].to_pylist()):
+            host[k] = host.get(k, 0) + v
+        assert dict(zip(g["k"].to_pylist(), g["s"].to_pylist())) == host
