@@ -6,28 +6,38 @@ sort-based groupby and join (no hash tables), lax.sort multi-key sorting,
 searchsorted merge probes, prefix-sum expansions.
 """
 
-from . import reductions
+from . import datetime, reductions, window
 from .binary import binary_op, fill_null, if_else, is_null, is_valid, unary_op
 from .cast import cast
-from .filter import apply_boolean_mask, drop_nulls
+from .common import concat_columns, concat_tables
+from .filter import apply_boolean_mask, distinct, drop_nulls
 from .groupby import groupby, groupby_agg
 from .join import join
+from .search import is_in, lower_bound, upper_bound
 from .sort import sort_by, sorted_order
 
 __all__ = [
     "apply_boolean_mask",
     "binary_op",
     "cast",
+    "concat_columns",
+    "concat_tables",
+    "datetime",
+    "distinct",
     "drop_nulls",
     "fill_null",
     "groupby",
     "groupby_agg",
     "if_else",
+    "is_in",
     "is_null",
     "is_valid",
     "join",
+    "lower_bound",
     "reductions",
     "sort_by",
     "sorted_order",
     "unary_op",
+    "upper_bound",
+    "window",
 ]

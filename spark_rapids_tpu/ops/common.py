@@ -94,6 +94,20 @@ def concat_columns(pieces: list[Column]) -> Column:
     return Column(data=data, validity=validity, dtype=dtype)
 
 
+def concat_tables(tables: list) -> "Table":
+    """Row-wise table concatenation (cudf ``concatenate(tables)``); schemas
+    must match by name, order, and dtype."""
+    from ..table import Table
+    if not tables:
+        raise ValueError("concat_tables needs at least one table")
+    names = list(tables[0].names)
+    for t in tables[1:]:
+        if list(t.names) != names:
+            raise ValueError(f"schema mismatch: {list(t.names)} vs {names}")
+    return Table([(name, concat_columns([t[name] for t in tables]))
+                  for name in names])
+
+
 def grouping_columns(cols: list[Column]) -> list[Column]:
     """Map key columns to group/compare-friendly forms: STRING columns become
     lexicographically-ordered INT32 dictionary codes (validity preserved),

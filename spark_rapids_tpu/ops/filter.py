@@ -33,3 +33,25 @@ def drop_nulls(table: Table, subset=None) -> Table:
         if col.validity is not None:
             keep = keep & col.validity
     return table.gather(compact_indices(keep))
+
+
+def distinct(table: Table, subset=None) -> Table:
+    """Drop duplicate rows, keeping each key's FIRST occurrence in the
+    original row order (Spark ``dropDuplicates`` semantics; null == null
+    and NaN == NaN for key equality, as in grouping).
+
+    Sort-based: a stable multi-key sort clusters duplicates, adjacent
+    difference marks each cluster's head (the first original occurrence,
+    by stability), and the surviving row ids are re-sorted to restore
+    input order.
+    """
+    from .common import grouping_columns, null_safe_equal_adjacent
+    from .sort import sorted_order
+    names = list(table.names) if subset is None else list(subset)
+    keys = grouping_columns([table[name] for name in names])
+    perm = sorted_order(keys)
+    boundary = jnp.zeros(table.num_rows, jnp.bool_)
+    for col in keys:
+        boundary = boundary | null_safe_equal_adjacent(col.gather(perm))
+    survivors = jnp.take(perm, compact_indices(boundary))
+    return table.gather(jnp.sort(survivors))

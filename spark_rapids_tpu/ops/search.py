@@ -1,0 +1,68 @@
+"""Search ops: membership and sorted-bound probes (cuDF ``search.hpp``).
+
+TPU-first shapes: ``is_in`` is a binary search against a host-sorted needle
+set (no hash sets — sorted probes are the engine's standing replacement for
+scatter-addressed tables), ``lower_bound``/``upper_bound`` are vectorized
+``searchsorted`` over device columns.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..dtypes import BOOL8, INT32
+
+
+def is_in(col: Column, values) -> Column:
+    """Row-wise membership in ``values`` (cuDF ``contains(column, ...)``,
+    Spark ``IN``-list semantics for non-null rows; null rows stay null).
+
+    ``values`` may be a Python list / numpy array; for string columns a
+    list of strings.  Nulls inside ``values`` are ignored (a null row never
+    equals anything).
+    """
+    needles = [v for v in (values.tolist() if isinstance(values, np.ndarray)
+                           else list(values)) if v is not None]
+    if col.offsets is not None:
+        from .strings import dictionary_encode
+        codes, uniques = dictionary_encode(col)
+        lookup = {u: i for i, u in enumerate(uniques)}
+        wanted = sorted({lookup[v] for v in needles if v in lookup})
+        return is_in(codes, np.asarray(wanted, np.int32)) \
+            .with_validity(col.validity)
+    if not needles:
+        return Column(data=jnp.zeros(col.size, jnp.uint8),
+                      validity=col.validity, dtype=BOOL8)
+    np_needles = np.asarray(needles, col.dtype.np_dtype)
+    sorted_vals = jnp.asarray(np.sort(np_needles))
+    pos = jnp.searchsorted(sorted_vals, col.data)
+    safe = jnp.clip(pos, 0, sorted_vals.shape[0] - 1)
+    hit = jnp.take(sorted_vals, safe) == col.data
+    if col.dtype.is_floating and bool(np.isnan(np_needles).any()):
+        # NaN == NaN per the engine's grouping equality (ops/common.py) and
+        # Spark semantics; plain == would drop it.
+        hit = hit | jnp.isnan(col.data)
+    return Column(data=hit.astype(jnp.uint8), validity=col.validity,
+                  dtype=BOOL8)
+
+
+def lower_bound(haystack: Column, needles: Column) -> Column:
+    """First insertion index per needle into an ascending-sorted column."""
+    return _bound(haystack, needles, "left")
+
+
+def upper_bound(haystack: Column, needles: Column) -> Column:
+    """Last insertion index per needle into an ascending-sorted column."""
+    return _bound(haystack, needles, "right")
+
+
+def _bound(haystack: Column, needles: Column, side: str) -> Column:
+    if haystack.offsets is not None or needles.offsets is not None:
+        raise NotImplementedError("sorted bounds over string columns")
+    idx = jnp.searchsorted(haystack.data, needles.data, side=side)
+    return Column(data=idx.astype(jnp.int32), validity=needles.validity,
+                  dtype=INT32)

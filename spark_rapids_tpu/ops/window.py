@@ -1,0 +1,262 @@
+"""Window functions over partitions — sort-based, TPU-first.
+
+Spark's window functions (the workload the reference system accelerates via
+cuDF's rolling/window kernels, part of the capability envelope, SURVEY.md
+§2.3) reduce to a handful of primitives once rows are sorted by
+(partition keys, order keys):
+
+  * segment boundaries — adjacent-difference over the sorted partition
+    keys (shared with groupby, :mod:`.common`),
+  * per-segment positions/prefixes — global ``cumsum`` / running-max of
+    marked positions; no per-partition loops,
+  * whole-partition aggregates — one scatter-reduce keyed by segment id,
+  * intra-segment shifts — a global shift masked at segment boundaries.
+
+Every function returns results in the TABLE'S ORIGINAL row order (Spark
+semantics): the sort permutation is inverted with one scatter.
+
+Supported: ``row_number``, ``rank``, ``dense_rank``, ``lag``, ``lead``,
+and ``window_agg`` ("sum"/"min"/"max"/"count") over the running frame
+(unbounded preceding → current row) or the whole partition
+(``frame="partition"``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..dtypes import INT32, INT64
+from ..table import Table
+from .common import grouping_columns, null_safe_equal_adjacent
+from .groupby import _sum_dtype
+from .sort import sorted_order
+
+
+def _window_order(table: Table, partition_by: Sequence[str],
+                  order_by: Optional[Sequence[str]] = None,
+                  ascending: Optional[Sequence[bool]] = None):
+    """(perm, inverse-perm, partition-start bool, encoded order cols).
+
+    String keys are dictionary-encoded ONCE here (the host-side O(n) cost)
+    and the encoded columns are reused for the sort, the partition
+    boundaries, and — via the returned list — the order-change masks in
+    rank/dense_rank.
+    """
+    if not partition_by:
+        raise ValueError("partition_by must name at least one column")
+    part_cols = grouping_columns([table[name] for name in partition_by])
+    order_cols = grouping_columns([table[name] for name in (order_by or [])])
+    if ascending is not None and len(ascending) != len(order_cols):
+        raise ValueError("ascending must match order_by length")
+    asc = [True] * len(part_cols) + list(ascending or [True] * len(order_cols))
+    perm = sorted_order(part_cols + order_cols, ascending=asc)
+    n = perm.shape[0]
+    inv = jnp.zeros(n, jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    starts = jnp.zeros(n, jnp.bool_)
+    for col in part_cols:
+        starts = starts | null_safe_equal_adjacent(col.gather(perm))
+    return perm, inv, starts, order_cols
+
+
+def _segment_base(starts: jax.Array) -> jax.Array:
+    """Per sorted row: position of its partition's first row.
+
+    ``starts[0]`` is always True, so a running max of marked positions is
+    exactly the latest partition start at or before each row.
+    """
+    pos = jnp.arange(starts.shape[0], dtype=jnp.int32)
+    return jax.lax.associative_scan(jnp.maximum, jnp.where(starts, pos, 0))
+
+
+def row_number(table: Table, partition_by: Sequence[str],
+               order_by: Optional[Sequence[str]] = None,
+               ascending: Optional[Sequence[bool]] = None) -> Column:
+    """1-based position within the partition (Spark ``row_number()``)."""
+    _, inv, starts, _ = _window_order(table, partition_by, order_by,
+                                      ascending)
+    base = _segment_base(starts)
+    pos = jnp.arange(starts.shape[0], dtype=jnp.int32)
+    return Column(data=jnp.take(pos - base + 1, inv), dtype=INT32)
+
+
+def _order_change(order_cols, perm) -> jax.Array:
+    """Sorted-view mask: the ORDER key differs from the previous row's.
+    ``order_cols`` are the already-encoded columns from _window_order."""
+    change = jnp.zeros(perm.shape[0], jnp.bool_)
+    for col in order_cols:
+        change = change | null_safe_equal_adjacent(col.gather(perm))
+    return change
+
+
+def rank(table: Table, partition_by: Sequence[str],
+         order_by: Sequence[str],
+         ascending: Optional[Sequence[bool]] = None) -> Column:
+    """Spark ``rank()``: 1-based, ties share, gaps after ties."""
+    perm, inv, starts, order_cols = _window_order(table, partition_by,
+                                                  order_by, ascending)
+    n = starts.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    base = _segment_base(starts)
+    # rank = position of the latest order-change (or partition start) + 1,
+    # relative to the partition base.
+    marker = starts | _order_change(order_cols, perm)
+    latest = jax.lax.associative_scan(jnp.maximum,
+                                      jnp.where(marker, pos, 0))
+    return Column(data=jnp.take(latest - base + 1, inv), dtype=INT32)
+
+
+def dense_rank(table: Table, partition_by: Sequence[str],
+               order_by: Sequence[str],
+               ascending: Optional[Sequence[bool]] = None) -> Column:
+    """Spark ``dense_rank()``: 1-based, ties share, no gaps."""
+    perm, inv, starts, order_cols = _window_order(table, partition_by,
+                                                  order_by, ascending)
+    distinct = (starts | _order_change(order_cols, perm)).astype(jnp.int32)
+    cum = jnp.cumsum(distinct)
+    base = _segment_base(starts)
+    return Column(data=jnp.take(cum - jnp.take(cum, base) + 1, inv),
+                  dtype=INT32)
+
+
+def _shift(table: Table, value: str, partition_by, order_by, ascending,
+           offset: int, fill) -> Column:
+    col = table[value]
+    if col.offsets is not None:
+        raise NotImplementedError("lag/lead over string columns")
+    perm, inv, starts, _ = _window_order(table, partition_by, order_by,
+                                         ascending)
+    n = perm.shape[0]
+    sorted_col = col.gather(perm)
+    seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    pos = jnp.arange(n, dtype=jnp.int32)
+    src = pos - offset
+    src_safe = jnp.clip(src, 0, n - 1)
+    ok = (src >= 0) & (src < n) & (jnp.take(seg_id, src_safe) == seg_id)
+    data = jnp.take(sorted_col.data, src_safe, axis=0)
+    src_valid = jnp.ones(n, jnp.bool_) if sorted_col.validity is None \
+        else jnp.take(sorted_col.validity, src_safe)
+    if fill is not None:
+        data = jnp.where(ok, data, jnp.asarray(fill, data.dtype))
+        validity = jnp.where(ok, src_valid, True)
+    else:
+        data = jnp.where(ok, data, jnp.zeros((), data.dtype))
+        validity = ok & src_valid
+    validity = None if bool(jnp.all(validity)) else validity
+    return Column(data=jnp.take(data, inv, axis=0),
+                  validity=None if validity is None
+                  else jnp.take(validity, inv),
+                  dtype=col.dtype)
+
+
+def lag(table: Table, value: str, partition_by: Sequence[str],
+        order_by: Sequence[str], offset: int = 1,
+        ascending: Optional[Sequence[bool]] = None, fill=None) -> Column:
+    """Value ``offset`` rows earlier in the partition (null/fill outside)."""
+    return _shift(table, value, partition_by, order_by, ascending, offset,
+                  fill)
+
+
+def lead(table: Table, value: str, partition_by: Sequence[str],
+         order_by: Sequence[str], offset: int = 1,
+         ascending: Optional[Sequence[bool]] = None, fill=None) -> Column:
+    """Value ``offset`` rows later in the partition (null/fill outside)."""
+    return _shift(table, value, partition_by, order_by, ascending, -offset,
+                  fill)
+
+
+_WINDOW_AGGS = ("sum", "min", "max", "count")
+
+
+def window_agg(table: Table, value: str, how: str,
+               partition_by: Sequence[str],
+               order_by: Optional[Sequence[str]] = None,
+               ascending: Optional[Sequence[bool]] = None,
+               frame: str = "cumulative") -> Column:
+    """Windowed aggregation per partition.
+
+    ``frame="cumulative"``: unbounded preceding → current row, in order.
+    ``frame="partition"``: the whole partition's aggregate broadcast to
+    every row.  Null values never contribute; sum/min/max are null while
+    the frame holds no valid value (count is never null).
+    """
+    if how not in _WINDOW_AGGS:
+        raise ValueError(f"how must be one of {_WINDOW_AGGS}, got {how!r}")
+    if frame not in ("cumulative", "partition"):
+        raise ValueError(f"frame must be cumulative|partition, got {frame!r}")
+    col = table[value]
+    if col.offsets is not None:
+        raise NotImplementedError("window_agg over string columns")
+    perm, inv, starts, _ = _window_order(table, partition_by, order_by,
+                                         ascending)
+    n = perm.shape[0]
+    sorted_col = col.gather(perm)
+    valid = sorted_col.valid_mask()
+    seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    base = _segment_base(starts)
+
+    if how == "count":
+        out_dtype = INT64
+        contrib = valid.astype(jnp.int64)
+    elif how == "sum":
+        out_dtype = _sum_dtype(col.dtype)
+        contrib = jnp.where(valid, sorted_col.data, 0).astype(
+            out_dtype.jnp_dtype)
+    else:
+        out_dtype = col.dtype
+        if col.dtype.is_floating:
+            ident = np.inf if how == "min" else -np.inf
+        else:
+            info = np.iinfo(col.dtype.np_dtype)
+            ident = info.max if how == "min" else info.min
+        ident = jnp.asarray(ident, col.dtype.jnp_dtype)
+        contrib = jnp.where(valid, sorted_col.data, ident)
+
+    if frame == "partition":
+        if how in ("sum", "count"):
+            per_seg = jnp.zeros(n, contrib.dtype).at[seg_id].add(contrib)
+        elif how == "min":
+            per_seg = jnp.full(n, ident).at[seg_id].min(contrib)
+        else:
+            per_seg = jnp.full(n, ident).at[seg_id].max(contrib)
+        run = jnp.take(per_seg, seg_id)
+        seen = jnp.zeros(n, jnp.int32).at[seg_id].add(
+            valid.astype(jnp.int32))
+        seen = jnp.take(seen, seg_id)
+    else:
+        if how in ("sum", "count"):
+            cum = jnp.cumsum(contrib)
+            run = cum - jnp.take(cum, base) + jnp.take(contrib, base)
+        else:
+            # Segmented running min/max: Hillis-Steele with a same-segment
+            # guard (correct for idempotent ops).
+            run = contrib
+            pos = jnp.arange(n, dtype=jnp.int32)
+            shift = 1
+            while shift < n:
+                src = jnp.maximum(pos - shift, 0)
+                ok = (pos - shift >= 0) & (jnp.take(seg_id, src) == seg_id)
+                prev = jnp.take(run, src)
+                merged = jnp.minimum(run, prev) if how == "min" \
+                    else jnp.maximum(run, prev)
+                run = jnp.where(ok, merged, run)
+                shift <<= 1
+        vcum = jnp.cumsum(valid.astype(jnp.int32))
+        seen = vcum - jnp.take(vcum, base) + jnp.take(
+            valid.astype(jnp.int32), base)
+
+    if how == "count":
+        validity = None
+    else:
+        validity = None if bool(jnp.all(seen > 0)) else (seen > 0)
+        if validity is not None:
+            run = jnp.where(validity, run, jnp.zeros((), run.dtype))
+
+    return Column(data=jnp.take(run.astype(out_dtype.jnp_dtype), inv),
+                  validity=None if validity is None
+                  else jnp.take(validity, inv),
+                  dtype=out_dtype)

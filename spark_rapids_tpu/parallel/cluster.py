@@ -79,12 +79,19 @@ def init_cluster(coordinator_address: Optional[str] = None,
             # No explicit config: let JAX's cluster autodetection look at
             # cloud/pod metadata (TPU pods, SLURM, ...).  On a plain single
             # machine detection fails fast — that IS the single-process
-            # case, not an error.
+            # case, not an error; the failure is still surfaced as a
+            # warning so a pod job that degraded to single-process is
+            # diagnosable.  The attempt runs once per process (idempotence
+            # covers the failure path too — autodetection can involve
+            # cloud metadata probes worth not repeating).
+            _initialized = True
             try:
                 jax.distributed.initialize()
-                _initialized = True
-            except Exception:
-                pass
+            except Exception as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "cluster autodetection did not initialize a process "
+                    "group (single-process mode): %s", e)
     return ClusterInfo(
         process_index=jax.process_index(),
         process_count=jax.process_count(),

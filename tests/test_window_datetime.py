@@ -1,0 +1,203 @@
+"""Window functions, datetime extraction, search, and compaction ops."""
+
+import datetime as pydt
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu import ops
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.dtypes import DType, TypeId
+from spark_rapids_tpu.ops import window
+from spark_rapids_tpu.ops import datetime as sdt
+
+
+def sample_table():
+    return srt.Table.from_pydict({
+        "p": ["a", "a", "b", "a", "b", "b", "a"],
+        "o": [3, 1, 5, 1, 2, 2, None],
+        "v": [10, 20, 30, None, 50, 60, 70],
+    }, dtypes={"p": dt.STRING, "o": dt.INT64, "v": dt.INT64})
+
+
+class TestWindow:
+    # Sorted views (nulls first, stable):
+    #   partition a: row6(o=None,v=70), row1(o=1,v=20), row3(o=1,v=None),
+    #                row0(o=3,v=10)
+    #   partition b: row4(o=2,v=50), row5(o=2,v=60), row2(o=5,v=30)
+
+    def test_row_number(self):
+        t = sample_table()
+        rn = window.row_number(t, ["p"], ["o"]).to_pylist()
+        assert rn == [4, 2, 3, 3, 1, 2, 1]
+
+    def test_rank_and_dense_rank(self):
+        t = sample_table()
+        r = window.rank(t, ["p"], ["o"]).to_pylist()
+        d = window.dense_rank(t, ["p"], ["o"]).to_pylist()
+        assert r == [4, 2, 3, 2, 1, 1, 1]
+        assert d == [3, 2, 2, 2, 1, 1, 1]
+
+    def test_lag_lead(self):
+        t = sample_table()
+        lagv = window.lag(t, "v", ["p"], ["o"]).to_pylist()
+        leadv = window.lead(t, "v", ["p"], ["o"]).to_pylist()
+        assert lagv == [None, 70, 60, 20, None, 50, None]
+        assert leadv == [None, None, None, 10, 60, 30, 20]
+
+    def test_lag_fill(self):
+        # fill applies where the offset leaves the partition; null VALUES
+        # inside the partition stay null.
+        t = sample_table()
+        lagv = window.lag(t, "v", ["p"], ["o"], fill=-1).to_pylist()
+        assert lagv == [None, 70, 60, 20, -1, 50, -1]
+
+    def test_cumulative_sum_and_count(self):
+        t = sample_table()
+        s = window.window_agg(t, "v", "sum", ["p"], ["o"]).to_pylist()
+        c = window.window_agg(t, "v", "count", ["p"], ["o"]).to_pylist()
+        assert s == [100, 90, 140, 90, 50, 110, 70]
+        assert c == [3, 2, 3, 2, 1, 2, 1]
+
+    def test_cumulative_min_max(self):
+        t = sample_table()
+        mn = window.window_agg(t, "v", "min", ["p"], ["o"]).to_pylist()
+        mx = window.window_agg(t, "v", "max", ["p"], ["o"]).to_pylist()
+        assert mn == [10, 20, 30, 20, 50, 50, 70]
+        assert mx == [70, 70, 60, 70, 50, 60, 70]
+
+    def test_partition_frame(self):
+        t = sample_table()
+        s = window.window_agg(t, "v", "sum", ["p"],
+                              frame="partition").to_pylist()
+        assert s == [100, 100, 140, 100, 140, 140, 100]
+        mx = window.window_agg(t, "v", "max", ["p"],
+                               frame="partition").to_pylist()
+        assert mx == [70, 70, 60, 70, 60, 60, 70]
+
+    def test_all_null_partition_value(self):
+        t = srt.Table.from_pydict({
+            "p": [1, 1, 2], "v": [None, None, 5],
+        }, dtypes={"p": dt.INT64, "v": dt.INT64})
+        s = window.window_agg(t, "v", "sum", ["p"],
+                              frame="partition").to_pylist()
+        assert s == [None, None, 5]
+
+    def test_errors(self):
+        t = sample_table()
+        with pytest.raises(ValueError):
+            window.window_agg(t, "v", "median", ["p"])
+        with pytest.raises(ValueError):
+            window.window_agg(t, "v", "sum", ["p"], frame="rows")
+        with pytest.raises(ValueError):
+            window.row_number(t, [])
+
+
+class TestDatetime:
+    def _ts_col(self, dts, unit):
+        tid = {"s": TypeId.TIMESTAMP_SECONDS,
+               "ms": TypeId.TIMESTAMP_MILLISECONDS,
+               "us": TypeId.TIMESTAMP_MICROSECONDS}[unit]
+        scale = {"s": 1, "ms": 10**3, "us": 10**6}[unit]
+        epoch = pydt.datetime(1970, 1, 1)
+        vals = [int((d - epoch).total_seconds() * scale) for d in dts]
+        return Column.from_numpy(np.asarray(vals, np.int64),
+                                 dtype=DType(tid))
+
+    def test_civil_fields_vs_python(self):
+        rng = np.random.default_rng(4)
+        dts = [pydt.datetime(1970, 1, 1)
+               + pydt.timedelta(days=int(d), seconds=int(s))
+               for d, s in zip(rng.integers(-40000, 40000, 300),
+                               rng.integers(0, 86400, 300))]
+        col = self._ts_col(dts, "s")
+        for field, want in [
+            ("year", [d.year for d in dts]),
+            ("month", [d.month for d in dts]),
+            ("day", [d.day for d in dts]),
+            ("hour", [d.hour for d in dts]),
+            ("minute", [d.minute for d in dts]),
+            ("second", [d.second for d in dts]),
+            ("weekday", [d.isoweekday() for d in dts]),
+            ("day_of_year", [d.timetuple().tm_yday for d in dts]),
+        ]:
+            got = sdt.extract(col, field).to_pylist()
+            assert got == want, f"{field}: first diff at " \
+                f"{next(i for i in range(len(got)) if got[i] != want[i])}"
+
+    def test_subsecond_fields(self):
+        us = 3 * 10**6 + 123_456
+        col = Column.from_numpy(np.asarray([us], np.int64),
+                                dtype=DType(TypeId.TIMESTAMP_MICROSECONDS))
+        assert sdt.extract(col, "second").to_pylist() == [3]
+        assert sdt.extract(col, "millisecond").to_pylist() == [123]
+        assert sdt.extract(col, "microsecond").to_pylist() == [456]
+
+    def test_days_dtype(self):
+        col = Column.from_numpy(np.asarray([0, 19000, -1], np.int32),
+                                dtype=DType(TypeId.TIMESTAMP_DAYS))
+        assert sdt.year(col).to_pylist() == [1970, 2022, 1969]
+        assert sdt.extract(col, "day").to_pylist() == [1, 8, 31]
+        with pytest.raises(TypeError):
+            sdt.extract(col, "hour")
+
+    def test_non_timestamp_raises(self):
+        col = Column.from_numpy(np.arange(3, dtype=np.int64))
+        with pytest.raises(TypeError):
+            sdt.year(col)
+
+
+class TestSearchAndCompaction:
+    def test_is_in_ints(self):
+        col = Column.from_pylist([1, 5, None, 7, 2], dt.INT64)
+        got = ops.is_in(col, [2, 5, 99]).to_pylist()
+        assert got == [False, True, None, False, True]
+
+    def test_is_in_strings(self):
+        col = Column.from_pylist(["a", "b", None, "c"], dt.STRING)
+        got = ops.is_in(col, ["c", "a", "zz"]).to_pylist()
+        assert got == [True, False, None, True]
+
+    def test_is_in_empty_values(self):
+        col = Column.from_pylist([1, None], dt.INT64)
+        assert ops.is_in(col, []).to_pylist() == [False, None]
+
+    def test_bounds(self):
+        hay = Column.from_numpy(np.asarray([1, 3, 3, 7], np.int64))
+        needles = Column.from_numpy(np.asarray([0, 3, 8], np.int64))
+        assert ops.lower_bound(hay, needles).to_pylist() == [0, 1, 4]
+        assert ops.upper_bound(hay, needles).to_pylist() == [0, 3, 4]
+
+    def test_distinct_keeps_first_in_order(self):
+        t = srt.Table.from_pydict({
+            "k": [3, 1, 3, None, 1, None],
+            "v": [10, 20, 30, 40, 50, 60],
+        }, dtypes={"k": dt.INT64, "v": dt.INT64})
+        out = ops.distinct(t, subset=["k"])
+        assert out["k"].to_pylist() == [3, 1, None]
+        assert out["v"].to_pylist() == [10, 20, 40]
+
+    def test_distinct_all_columns(self):
+        t = srt.Table.from_pydict({
+            "a": [1, 1, 1], "b": [2, 2, 3],
+        }, dtypes={"a": dt.INT64, "b": dt.INT64})
+        out = ops.distinct(t)
+        assert out["a"].to_pylist() == [1, 1]
+        assert out["b"].to_pylist() == [2, 3]
+
+    def test_concat_tables(self):
+        t1 = srt.Table.from_pydict({"x": [1, 2], "s": ["a", "b"]},
+                                   dtypes={"x": dt.INT64, "s": dt.STRING})
+        t2 = srt.Table.from_pydict({"x": [None, 4], "s": [None, "d"]},
+                                   dtypes={"x": dt.INT64, "s": dt.STRING})
+        out = ops.concat_tables([t1, t2])
+        assert out["x"].to_pylist() == [1, 2, None, 4]
+        assert out["s"].to_pylist() == ["a", "b", None, "d"]
+
+    def test_concat_tables_schema_mismatch(self):
+        t1 = srt.Table.from_pydict({"x": [1]}, dtypes={"x": dt.INT64})
+        t2 = srt.Table.from_pydict({"y": [1]}, dtypes={"y": dt.INT64})
+        with pytest.raises(ValueError):
+            ops.concat_tables([t1, t2])
