@@ -506,16 +506,53 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
     chars = np.asarray(col.data, dtype=np.uint8)
     offsets = np.asarray(col.offsets)
     mask = None if col.validity is None else np.asarray(col.validity)
-    values = []
-    for i in range(len(offsets) - 1):
-        if mask is not None and not mask[i]:
-            values.append(b"")          # placeholder; row is null
-        else:
-            values.append(chars[offsets[i]:offsets[i + 1]].tobytes())
-    uniq, codes = np.unique(np.array(values, dtype=object), return_inverse=True)
+    n = len(offsets) - 1
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    if mask is not None:
+        lengths = np.where(mask, lengths, 0)     # null rows read as ""
+    max_len = int(lengths.max()) if n else 0
+
+    if max_len > 4096:
+        # Degenerate very-long-string case: padded matrix would be huge;
+        # fall back to the per-row object path.
+        values = []
+        for i in range(n):
+            if mask is not None and not mask[i]:
+                values.append(b"")
+            else:
+                values.append(chars[offsets[i]:offsets[i + 1]].tobytes())
+        uniq, codes = np.unique(np.array(values, dtype=object),
+                                return_inverse=True)
+        codes_col = Column(data=jnp.asarray(codes.astype(np.int32)),
+                           validity=col.validity, dtype=INT32)
+        return codes_col, [u.decode("utf-8") for u in uniq]
+
+    # Vectorized path: pad rows to a fixed-width byte matrix, append the
+    # length as a big-endian suffix (keeps strings containing NUL bytes
+    # distinct from shorter prefixes, and byte-order == lexicographic
+    # order since the pad byte 0 sorts below all content bytes), then one
+    # np.unique over a void view — all C-speed, no per-row Python.
+    pos = np.arange(max(max_len, 1), dtype=np.int64)[None, :]
+    if chars.size:
+        idx = np.minimum(offsets[:-1, None].astype(np.int64) + pos,
+                         chars.size - 1)
+        mat = chars[idx]
+    else:
+        mat = np.zeros((n, max(max_len, 1)), np.uint8)
+    mat[pos >= lengths[:, None]] = 0
+    key = np.concatenate(
+        [mat[:, :max_len],
+         lengths.astype(">u4").view(np.uint8).reshape(n, 4)], axis=1)
+    void = np.ascontiguousarray(key).view(f"V{max_len + 4}").ravel()
+    uniq_void, codes = np.unique(void, return_inverse=True)
+    uniques = []
+    for u in uniq_void:
+        raw = bytes(u)
+        ln = int.from_bytes(raw[max_len:], "big")
+        uniques.append(raw[:ln].decode("utf-8"))
     codes_col = Column(data=jnp.asarray(codes.astype(np.int32)),
                        validity=col.validity, dtype=INT32)
-    return codes_col, [u.decode("utf-8") for u in uniq]
+    return codes_col, uniques
 
 
 def fill_null_strings(col: Column, value: str) -> Column:
