@@ -512,9 +512,10 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
         lengths = np.where(mask, lengths, 0)     # null rows read as ""
     max_len = int(lengths.max()) if n else 0
 
-    if max_len > 4096:
-        # Degenerate very-long-string case: padded matrix would be huge;
-        # fall back to the per-row object path.
+    if n * max(max_len, 1) > (64 << 20):
+        # The padded matrix would exceed ~64 MB of cells (the int32 index
+        # matrix and byte matrix each scale with n*max_len); fall back to
+        # the per-row object path rather than ballooning host memory.
         values = []
         for i in range(n):
             if mask is not None and not mask[i]:
@@ -532,9 +533,9 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
     # distinct from shorter prefixes, and byte-order == lexicographic
     # order since the pad byte 0 sorts below all content bytes), then one
     # np.unique over a void view — all C-speed, no per-row Python.
-    pos = np.arange(max(max_len, 1), dtype=np.int64)[None, :]
+    pos = np.arange(max(max_len, 1), dtype=np.int32)[None, :]
     if chars.size:
-        idx = np.minimum(offsets[:-1, None].astype(np.int64) + pos,
+        idx = np.minimum(offsets[:-1, None].astype(np.int32) + pos,
                          chars.size - 1)
         mat = chars[idx]
     else:

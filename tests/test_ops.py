@@ -368,6 +368,25 @@ class TestStringKeys:
         c = Column.from_pylist(["a", None, "c"], dt.STRING)
         assert ops.fill_null(c, "x").to_pylist() == ["a", "x", "c"]
 
+    def test_groupby_string_value_count_first_last(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "s": ["a", None, "b"]},
+                              dtypes={"k": dt.INT64, "s": dt.STRING})
+        out = ops.groupby_agg(t, ["k"], [("s", "count", "c"),
+                                         ("s", "count_all", "ca"),
+                                         ("s", "first", "f"),
+                                         ("s", "last", "l")])
+        assert out["c"].to_pylist() == [1, 1]
+        assert out["ca"].to_pylist() == [2, 1]
+        assert out["f"].to_pylist() == ["a", "b"]
+        assert out["l"].to_pylist() == [None, "b"]
+
+    def test_groupby_string_value_sum_rejected(self):
+        t = Table.from_pydict({"k": [1], "s": ["a"]},
+                              dtypes={"k": dt.INT64, "s": dt.STRING})
+        import pytest
+        with pytest.raises(TypeError):
+            ops.groupby_agg(t, ["k"], [("s", "sum", "x")])
+
 
 class TestDecimalSemantics:
     def test_groupby_mean_applies_scale(self):
