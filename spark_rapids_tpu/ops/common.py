@@ -73,6 +73,28 @@ def null_safe_equal_at(ldata: jax.Array, lvalid, rdata: jax.Array, rvalid) -> ja
     return jnp.where(lv & rv, eq, ~lv & ~rv)
 
 
+def grouping_sort_operands(datas, valids) -> list[jax.Array]:
+    """lax.sort key operands for GROUPING semantics (traceable).
+
+    Two operands per key: a null rank (nulls first) and the value with
+    NaNs canonicalized and null rows masked to zero — so equality among
+    null rows is payload-independent (null == null) and NaN == NaN.  The
+    single definition shared by the groupby and join kernels; the sort
+    op's richer ordering options live in :func:`ops.sort.sort_operands`.
+    """
+    from .sort import _canonicalize_nan
+    n = datas[0].shape[0]
+    ops: list[jax.Array] = []
+    for d, v in zip(datas, valids):
+        rank = jnp.ones(n, jnp.uint8) if v is None else v.astype(jnp.uint8)
+        val = _canonicalize_nan(d)
+        if v is not None:
+            val = jnp.where(v, val, jnp.zeros((), val.dtype))
+        ops.append(rank)
+        ops.append(val)
+    return ops
+
+
 def concat_columns(pieces: list[Column]) -> Column:
     """Concatenate columns of one dtype (cudf ``concatenate`` equivalent).
 

@@ -186,17 +186,9 @@ def _groupby_sort(key_datas, key_valids, pay_datas, pay_valids):
     the masked order deterministic.  Returns (permutation, sorted payload
     (data, validity) pairs, group boundary, group count).
     """
-    from .sort import _canonicalize_nan
-    from .common import adjacent_differs
+    from .common import adjacent_differs, grouping_sort_operands
     n = key_datas[0].shape[0]
-    ops: list[jax.Array] = []
-    for d, v in zip(key_datas, key_valids):
-        rank = jnp.ones(n, jnp.uint8) if v is None else v.astype(jnp.uint8)
-        val = _canonicalize_nan(d)
-        if v is not None:
-            val = jnp.where(v, val, jnp.zeros((), val.dtype))
-        ops.append(rank)
-        ops.append(val)
+    ops = grouping_sort_operands(key_datas, key_valids)
     iota = jnp.arange(n, dtype=jnp.int32)
     flat_pay: list[jax.Array] = []
     for d, v in zip(pay_datas, pay_valids):

@@ -17,6 +17,7 @@ Output-size materialization: one host sync for the total match count
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -24,15 +25,14 @@ import jax.numpy as jnp
 
 from ..column import Column, all_null_column
 from ..table import Table
-from .common import compact_indices, grouping_columns, null_safe_equal_adjacent
-from .sort import sorted_order
+from .common import grouping_columns, pow2_bucket
 
 
 def _factorize_union(left: Table, right: Table, left_on: Sequence[str],
-                     right_on: Sequence[str]) -> tuple[jax.Array, jax.Array]:
-    """Dense group ids for the key tuples of both sides, consistent across
-    sides; rows with any null key get a non-matching sentinel (-1 left,
-    -2 right)."""
+                     right_on: Sequence[str]):
+    """Factorize + probe: returns (rorder, lo, counts) from the fused
+    kernel; rows with any null key get a non-matching sentinel (-1 left,
+    -2 right) so nulls never join."""
     n_left = left.num_rows
     merged_cols = []
     for lname, rname in zip(left_on, right_on):
@@ -51,22 +51,46 @@ def _factorize_union(left: Table, right: Table, left_on: Sequence[str],
             validity = jnp.concatenate([lc.valid_mask(), rc.valid_mask()])
         merged_cols.append(Column(data=data, validity=validity, dtype=lc.dtype))
     merged_cols = grouping_columns(merged_cols)   # strings -> dictionary codes
+    return _factorize_probe_kernel(
+        tuple(c.data for c in merged_cols),
+        tuple(c.validity for c in merged_cols),
+        n_left=n_left)
 
-    perm = sorted_order(merged_cols)
-    boundary = jnp.zeros(perm.shape[0], jnp.bool_)
-    for col in merged_cols:
-        boundary = boundary | null_safe_equal_adjacent(col.gather(perm))
+
+@functools.partial(jax.jit, static_argnames=("n_left",))
+def _factorize_probe_kernel(key_datas, key_valids, *, n_left):
+    """ONE program: factorize both sides' key tuples to dense group ids
+    (sort + boundary + inverse scatter, null rows masked and sentineled),
+    then probe the right side (argsort + two searchsorteds).  The eager
+    form paid a dispatch per step; fused it is one device execution per
+    join schema.  Returns (rorder, lo, counts).
+    """
+    from .common import adjacent_differs, grouping_sort_operands
+    n = key_datas[0].shape[0]
+    ops_list = grouping_sort_operands(key_datas, key_valids)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(ops_list + [iota], dimension=0, is_stable=True,
+                              num_keys=len(ops_list))
+    perm = sorted_all[-1]
+    boundary = jnp.zeros(n, jnp.bool_)
+    for op in sorted_all[:-1]:
+        boundary = boundary | adjacent_differs(op)
     gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    gid = jnp.zeros(perm.shape[0], jnp.int32).at[perm].set(gid_sorted)
+    gid = jnp.zeros(n, jnp.int32).at[perm].set(gid_sorted)
 
-    any_null = jnp.zeros(perm.shape[0], jnp.bool_)
-    for col in merged_cols:
-        if col.validity is not None:
-            any_null = any_null | ~col.validity
-    gid = jnp.where(any_null,
-                    jnp.where(jnp.arange(gid.shape[0]) < n_left, -1, -2),
-                    gid)
-    return gid[:n_left], gid[n_left:]
+    any_null = jnp.zeros(n, jnp.bool_)
+    for v in key_valids:
+        if v is not None:
+            any_null = any_null | ~v
+    gid = jnp.where(any_null, jnp.where(iota < n_left, -1, -2), gid)
+
+    lgid, rgid = gid[:n_left], gid[n_left:]
+    rorder = jnp.argsort(rgid, stable=True).astype(jnp.int32)
+    rgid_sorted = jnp.take(rgid, rorder)
+    lo = jnp.searchsorted(rgid_sorted, lgid, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rgid_sorted, lgid, side="right").astype(jnp.int32)
+    counts = (hi - lo).astype(jnp.int64)
+    return rorder, lo, counts
 
 
 def _suffix_overlaps(left: Table, right: Table, drop_right: set[str],
@@ -99,19 +123,14 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
     if not left_on or not right_on or len(left_on) != len(right_on):
         raise ValueError("join keys: pass `on=` or matching left_on/right_on")
 
-    lgid, rgid = _factorize_union(left, right, left_on, right_on)
-
-    # Sort the right side's group ids once; probe with searchsorted.
-    rorder = jnp.argsort(rgid, stable=True)
-    rgid_sorted = rgid[rorder]
-    lo = jnp.searchsorted(rgid_sorted, lgid, side="left")
-    hi = jnp.searchsorted(rgid_sorted, lgid, side="right")
-    counts = (hi - lo).astype(jnp.int64)
+    rorder, lo, counts = _factorize_union(left, right, left_on, right_on)
 
     if how == "semi":
-        return left.gather(compact_indices(counts > 0))
+        from .filter import _compact_table
+        return _compact_table(left, counts > 0)
     if how == "anti":
-        return left.gather(compact_indices(counts == 0))
+        from .filter import _compact_table
+        return _compact_table(left, counts == 0)
 
     keep_right_gid_cols = set()
     if on is not None:
@@ -119,34 +138,102 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
     left_out, right_names = _suffix_overlaps(left, right, keep_right_gid_cols,
                                              suffixes)
 
-    if how == "left":
-        out_counts = jnp.maximum(counts, 1)
-        if right.num_rows == 0:   # degenerate: all-null right side
-            cols = [(n, c) for n, c in left_out.items()]
-            for src_name, out_name in right_names:
-                cols.append((out_name,
-                             all_null_column(right[src_name].dtype, left.num_rows)))
-            return Table(cols)
-    else:
-        out_counts = counts
-    out_starts = jnp.cumsum(out_counts) - out_counts      # exclusive prefix sum
-    total = int(out_counts.sum())                         # host sync
+    left_join = how == "left"
+    if left_join and right.num_rows == 0:   # degenerate: all-null right side
+        cols = [(n, c) for n, c in left_out.items()]
+        for src_name, out_name in right_names:
+            cols.append((out_name,
+                         all_null_column(right[src_name].dtype, left.num_rows)))
+        return Table(cols)
 
-    pos = jnp.arange(total, dtype=jnp.int64)
-    # left row for each output position
-    bounds = out_starts + out_counts                      # == inclusive cumsum
-    lrow = jnp.searchsorted(bounds, pos, side="right").astype(jnp.int32)
-    k = pos - out_starts[lrow]
-    rpos = lo[lrow] + k
-    matched = counts[lrow] > 0
-    rrow = rorder[jnp.clip(rpos, 0, max(rgid_sorted.shape[0] - 1, 0))]
+    out_counts = jnp.maximum(counts, 1) if left_join else counts
+    total = int(out_counts.sum())                         # the one host sync
+    if total == 0:
+        cols = [(n, Column(data=jnp.zeros(0, c.dtype.jnp_dtype), dtype=c.dtype)
+                 if c.offsets is None else c.gather(jnp.zeros(0, jnp.int32)))
+                for n, c in left_out.items()]
+        for src_name, out_name in right_names:
+            c = right[src_name]
+            cols.append((out_name, c.gather(jnp.zeros(0, jnp.int32))))
+        return Table(cols)
+    bucket = pow2_bucket(total)
 
+    lfixed = [(n, c) for n, c in left_out.items() if c.offsets is None]
+    rfixed = [(s, o) for s, o in right_names
+              if right[s].offsets is None]
+    lrow, rrow, matched, ldatas, lvalids, rdatas, rvalids = _expand_kernel(
+        lo, counts, rorder,
+        tuple(c.data for _, c in lfixed),
+        tuple(c.validity for _, c in lfixed),
+        tuple(right[s].data for s, _ in rfixed),
+        tuple(right[s].validity for s, _ in rfixed),
+        bucket=bucket, left_join=left_join)
+
+    cols_by_name: dict[str, Column] = {}
+    for (name, col), d, v in zip(lfixed, ldatas, lvalids):
+        cols_by_name[name] = Column(
+            data=d[:total], validity=None if v is None else v[:total],
+            dtype=col.dtype)
+    for (src_name, out_name), d, v in zip(rfixed, rdatas, rvalids):
+        validity = v[:total] if v is not None else None
+        if left_join:
+            m = matched[:total]
+            validity = m if validity is None else (validity & m)
+        cols_by_name[out_name] = Column(data=d[:total], validity=validity,
+                                        dtype=right[src_name].dtype)
+
+    lrow_t = rrow_t = None
     cols: list[tuple[str, Column]] = []
     for name, col in left_out.items():
-        cols.append((name, col.gather(lrow)))
+        if col.offsets is None:
+            cols.append((name, cols_by_name[name]))
+        else:
+            if lrow_t is None:
+                lrow_t = lrow[:total]
+            cols.append((name, col.gather(lrow_t)))
     for src_name, out_name in right_names:
-        g = right[src_name].gather(rrow)
-        if how == "left":
-            g = g.with_validity(g.valid_mask() & matched)
-        cols.append((out_name, g))
+        col = right[src_name]
+        if col.offsets is None:
+            cols.append((out_name, cols_by_name[out_name]))
+        else:
+            if rrow_t is None:
+                rrow_t = rrow[:total]
+            g = col.gather(rrow_t)
+            if left_join:
+                g = g.with_validity(g.valid_mask() & matched[:total])
+            cols.append((out_name, g))
     return Table(cols)
+
+
+@functools.partial(jax.jit, static_argnames=("bucket", "left_join"))
+def _expand_kernel(lo, counts, rorder, ldatas, lvalids, rdatas, rvalids, *,
+                   bucket, left_join):
+    """Match expansion + every fixed-width output gather in ONE program.
+
+    The per-output left row id is recovered with the scatter-indicator +
+    prefix-sum trick (O(output) instead of a log-factor searchsorted);
+    output arrays are padded to the pow2 ``bucket`` so one compile serves
+    many match totals.
+    """
+    n_left = counts.shape[0]
+    out_counts = jnp.maximum(counts, 1) if left_join else counts
+    out_starts = (jnp.cumsum(out_counts) - out_counts).astype(jnp.int32)
+    pos = jnp.arange(bucket, dtype=jnp.int32)
+    # Scatter EVERY row's start (zero-output rows stack on the next start);
+    # the prefix count - 1 then yields the LAST row starting at or before
+    # each position — exactly the owning row (same trick as the strings
+    # engine's _row_ids).
+    indicator = jnp.zeros(bucket, jnp.int32).at[
+        jnp.clip(out_starts, 0, bucket - 1)].add(
+            jnp.where(out_starts < bucket, 1, 0).astype(jnp.int32))
+    lrow = jnp.clip(jnp.cumsum(indicator) - 1, 0, n_left - 1)
+    k = pos - jnp.take(out_starts, lrow)
+    rpos = jnp.take(lo, lrow) + k
+    matched = jnp.take(counts, lrow) > 0
+    nr = max(rorder.shape[0], 1)
+    rrow = jnp.take(rorder, jnp.clip(rpos, 0, nr - 1))
+    out_l = tuple(jnp.take(d, lrow, axis=0) for d in ldatas)
+    out_lv = tuple(None if v is None else jnp.take(v, lrow) for v in lvalids)
+    out_r = tuple(jnp.take(d, rrow, axis=0) for d in rdatas)
+    out_rv = tuple(None if v is None else jnp.take(v, rrow) for v in rvalids)
+    return lrow, rrow, matched, out_l, out_lv, out_r, out_rv

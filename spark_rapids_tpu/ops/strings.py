@@ -512,10 +512,9 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
         lengths = np.where(mask, lengths, 0)     # null rows read as ""
     max_len = int(lengths.max()) if n else 0
 
-    if n * max(max_len, 1) > (64 << 20):
-        # The padded matrix would exceed ~64 MB of cells (the int32 index
-        # matrix and byte matrix each scale with n*max_len); fall back to
-        # the per-row object path rather than ballooning host memory.
+    if n * (max_len + 4) > (2 << 30):
+        # The key matrix itself would exceed ~2 GB of host RAM; fall back
+        # to the per-row object path rather than risking a MemoryError.
         values = []
         for i in range(n):
             if mask is not None and not mask[i]:
@@ -532,18 +531,24 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
     # length as a big-endian suffix (keeps strings containing NUL bytes
     # distinct from shorter prefixes, and byte-order == lexicographic
     # order since the pad byte 0 sorts below all content bytes), then one
-    # np.unique over a void view — all C-speed, no per-row Python.
+    # np.unique over a void view — all C-speed, no per-row Python.  The
+    # matrix fills in row chunks so the index/mask TEMPORARIES stay
+    # bounded; only the final key matrix is n*(max_len+4) bytes.
+    key = np.zeros((n, max_len + 4), np.uint8)
+    key[:, max_len:] = lengths.astype(">u4").view(np.uint8).reshape(n, 4)
     pos = np.arange(max(max_len, 1), dtype=np.int32)[None, :]
-    if chars.size:
-        idx = np.minimum(offsets[:-1, None].astype(np.int32) + pos,
-                         chars.size - 1)
-        mat = chars[idx]
-    else:
-        mat = np.zeros((n, max(max_len, 1)), np.uint8)
-    mat[pos >= lengths[:, None]] = 0
-    key = np.concatenate(
-        [mat[:, :max_len],
-         lengths.astype(">u4").view(np.uint8).reshape(n, 4)], axis=1)
+    chunk = max(1, (64 << 20) // max(max_len, 1))
+    for lo_i in range(0, n, chunk):
+        hi_i = min(lo_i + chunk, n)
+        if chars.size:
+            idx = np.minimum(
+                offsets[lo_i:hi_i, None].astype(np.int32) + pos,
+                chars.size - 1)
+            mat = chars[idx]
+        else:
+            mat = np.zeros((hi_i - lo_i, max(max_len, 1)), np.uint8)
+        mat[pos >= lengths[lo_i:hi_i, None]] = 0
+        key[lo_i:hi_i, :max_len] = mat[:, :max_len]
     void = np.ascontiguousarray(key).view(f"V{max_len + 4}").ravel()
     uniq_void, codes = np.unique(void, return_inverse=True)
     uniques = []
