@@ -1,0 +1,25 @@
+"""Whole-plan compilation: one XLA program per query pipeline.
+
+The TPU-first execution layer above the eager ops (:mod:`..ops`): a
+:class:`Plan` describes a filter → project → group-by → sort → limit
+pipeline which compiles (and jit-caches per input signature) into a single
+fused device program carrying a selection mask instead of compacting, so
+no host round trip happens until the caller materializes the result.  See
+:mod:`.plan` for the execution model and :mod:`.compile` for the kernels.
+
+    from spark_rapids_tpu.exec import col, plan
+
+    q1 = (plan()
+          .filter(col("shipdate") <= 10_500)
+          .with_columns(disc_price=col("price") * (1 - col("disc")))
+          .groupby_agg(["flag", "status"],
+                       [("qty", "sum", "sum_qty"),
+                        ("disc_price", "sum", "revenue")])
+          .sort_by(["flag", "status"]))
+    out = q1.run(lineitem)          # ONE device program + one final sync
+"""
+
+from .expr import Col, Expr, Lit, col, lit
+from .plan import Plan, plan
+
+__all__ = ["Col", "Expr", "Lit", "Plan", "col", "lit", "plan"]

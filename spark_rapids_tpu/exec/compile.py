@@ -1,0 +1,683 @@
+"""Plan binder, compiler, and executor.
+
+Turns a :class:`..exec.plan.Plan` plus a bound input :class:`..table.Table`
+into ONE jitted XLA program (cached per (plan, input signature)), then
+materializes the result with at most one host sync.
+
+Execution state inside the traced program is ``(columns, selection)``:
+
+* ``columns`` — dict of fixed-width :class:`..column.Column` (strings never
+  enter the program; see below),
+* ``selection`` — optional bool vector marking live rows.  A filter ANDs
+  into it; group-by consumes it; sort orders live rows first; only
+  materialization compacts.
+
+Strings are handled by *indirection*, the TPU answer to variable-width
+data in a static-shape program:
+
+* a string **group-by / sort key** is dictionary-encoded at bind time
+  (host-assisted, cached per device buffer) — the program sees INT32
+  codes whose order is lexicographic, and materialization decodes;
+* a string **payload** is represented by a hidden ``__rowid__`` column;
+  ``first``/``last`` aggregate the rowid, and materialization gathers the
+  actual strings once, at final (small) sizes.
+
+Group-by strategy (chosen statically at bind time per key set):
+
+* **dense**: every key has a static inclusive (lo, hi) domain — from an
+  explicit hint, a bool dtype, a dictionary, or a cached one-sync stats
+  probe (:mod:`.stats`) — and the cell-product is ≤
+  ``dense_groupby_max_cells``.  Group id = direct cell index; aggregation
+  = masked reductions over a (cells, rows) broadcast.  No sort, no sync.
+* **sorted**: the general path — one multi-operand ``lax.sort`` clusters
+  keys (live rows first), segmented associative scans reduce runs, and
+  outputs stay padded at the input length with a live-group selection.
+
+The reference's counterpart machinery is cuDF's hash groupby + Spark's
+codegen'd aggregate (capability envelope, SURVEY.md §2.3); both assume
+cheap device scatters and cheap host round trips — the two things a TPU
+plan must avoid, which is why this file exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..dtypes import BOOL8, INT32, INT64, DType, TypeId
+from ..table import Table
+from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
+from .expr import Col, evaluate
+from .plan import (FilterStep, GroupAggStep, LimitStep, Plan, ProjectStep,
+                   SortStep)
+
+#: Max dense group-by cells. Aggregation traffic scales with cells x rows
+#: (each reduction streams a (cells, rows) broadcast), so past a few
+#: hundred cells the sorted path wins; 256 keeps the dense path within
+#: ~2x of its cells=8 cost at 4M rows on v5e.
+DENSE_MAX_CELLS = 256
+
+_ROWID = "__rowid__"
+
+
+# ---------------------------------------------------------------------------
+# bind-time metadata
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _KeyMeta:
+    """Static description of one group-by key at its step."""
+    name: str
+    lo: int                      # inclusive; 0 for dict codes
+    hi: int                      # inclusive
+    nullable: bool
+    #: dictionary tuple for string keys (None for numeric); static so it can
+    #: key the compile cache, used only at materialization.
+    dictionary: Optional[tuple[str, ...]]
+    dtype: DType
+
+
+@dataclass(frozen=True)
+class _GroupMeta:
+    dense: bool
+    keys: tuple[_KeyMeta, ...]
+    #: cells per key (dense): domain size + null slot.
+    sizes: tuple[int, ...]
+    cells: int
+
+
+@dataclass(frozen=True)
+class _ColInfo:
+    """Static per-column signature of the bound input."""
+    name: str
+    type_id: int
+    scale: int
+    nullable: bool
+    string: bool
+
+
+# dictionary-encode cache keyed on the (chars, offsets, validity) buffer
+# identities — all three define string content+nulls (see stats._CACHE for
+# why sharing any one buffer must not alias cache entries).
+_DICT_CACHE: dict = {}
+
+
+def _dict_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
+    from .stats import _guarded_cache_get, _guarded_cache_put
+    buffers = tuple(b for b in (col.data, col.offsets, col.validity)
+                    if b is not None)
+    key = tuple(id(b) for b in buffers)
+    hit = _guarded_cache_get(_DICT_CACHE, key, buffers)
+    if hit is not None:
+        return hit
+    from ..ops.strings import dictionary_encode
+    codes, uniq = dictionary_encode(col)
+    result = (codes, tuple(uniq))
+    _guarded_cache_put(_DICT_CACHE, key, buffers, result)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# binder
+# ---------------------------------------------------------------------------
+
+class _Bound:
+    """Everything needed to run a plan against one input signature."""
+
+    def __init__(self, plan: Plan, table: Table):
+        self.plan = plan
+        self.n = table.num_rows
+        self.input_names = tuple(table.names)
+        self.exec_cols: dict[str, Column] = {}   # traced program inputs
+        self.string_cols: dict[str, Column] = {} # gathered at materialize
+        self.dictionaries: dict[str, tuple[str, ...]] = {}
+        #: plan steps with string aggregations rewritten to rowid/validity
+        #: surrogates (what the traced program actually executes).
+        self.steps: tuple = ()
+        self.group_metas: list[_GroupMeta] = []
+        self._build(table)
+
+    def _build(self, table: Table) -> None:
+        plan = self.plan
+        # Which input string columns are used as group/sort keys? They get
+        # dictionary codes; other strings ride as rowid indirection.
+        key_names: set[str] = set()
+        for step in plan.steps:
+            if isinstance(step, GroupAggStep):
+                key_names.update(step.keys)
+            elif isinstance(step, SortStep):
+                key_names.update(step.by)
+
+        need_rowid = False
+        for name, c in table.items():
+            if c.offsets is None:
+                self.exec_cols[name] = c
+                continue
+            if name in key_names:
+                codes, uniq = _dict_encode_cached(c)
+                self.exec_cols[name] = codes
+                self.dictionaries[name] = uniq
+            else:
+                self.string_cols[name] = c
+                need_rowid = True
+        if need_rowid:
+            self.exec_cols[_ROWID] = Column(
+                data=jnp.arange(self.n, dtype=jnp.int32), dtype=INT32)
+
+        # Rewrite string aggregations and track which state columns still
+        # hold unchanged input values (so group-key domains may be probed
+        # from the input table).
+        passthrough: set[str] = set(self.exec_cols)
+        steps: list = []
+        for step in plan.steps:
+            self._check_string_refs(step)
+            if isinstance(step, ProjectStep):
+                redefined = {nm for nm, e in step.cols
+                             if not (isinstance(e, Col) and e.name == nm)}
+                passthrough -= redefined
+                if step.narrow:
+                    passthrough &= ({nm for nm, _ in step.cols} | {_ROWID})
+                steps.append(step)
+            elif isinstance(step, GroupAggStep):
+                step = self._rewrite_string_aggs(step)
+                self.group_metas.append(
+                    self._group_meta(step, table, passthrough))
+                steps.append(step)
+                passthrough = set(step.keys)
+            else:
+                steps.append(step)
+        self.steps = tuple(steps)
+
+    def _check_string_refs(self, step) -> None:
+        """String columns never enter the traced program, so expressions
+        may not reference them — except a bare passthrough select (the
+        rowid indirection carries those)."""
+        from .expr import references
+        exprs = []
+        if isinstance(step, FilterStep):
+            exprs = [step.pred]
+        elif isinstance(step, ProjectStep):
+            exprs = [e for nm, e in step.cols
+                     if not (isinstance(e, Col) and e.name == nm)]
+        for e in exprs:
+            bad = references(e) & set(self.string_cols)
+            if bad:
+                raise TypeError(
+                    f"string column(s) {sorted(bad)} cannot be used in plan "
+                    f"expressions (strings pass through plans by indirection; "
+                    f"compute string predicates eagerly with ops.strings and "
+                    f"feed the result in as a column)")
+
+    def _rewrite_string_aggs(self, step: GroupAggStep) -> GroupAggStep:
+        """String value columns can't flow through the program; rewrite
+        their aggregations onto fixed-width surrogates."""
+        new_aggs: list[tuple[str, str, str]] = []
+        changed = False
+        for value_name, how, out_name in step.aggs:
+            if value_name not in self.string_cols:
+                new_aggs.append((value_name, how, out_name))
+                continue
+            changed = True
+            src = self.string_cols[value_name]
+            if how in ("first", "last"):
+                if _ROWID not in self.exec_cols:
+                    self.exec_cols[_ROWID] = Column(
+                        data=jnp.arange(self.n, dtype=jnp.int32), dtype=INT32)
+                new_aggs.append(
+                    (_ROWID, how, f"__strref__:{value_name}:{out_name}"))
+            elif how in ("count", "count_all"):
+                surrogate = f"__valid__:{value_name}"
+                if surrogate not in self.exec_cols:
+                    self.exec_cols[surrogate] = Column(
+                        data=src.valid_mask().astype(jnp.int8),
+                        validity=src.validity, dtype=DType(TypeId.INT8))
+                new_aggs.append((surrogate, how, out_name))
+            else:
+                raise TypeError(
+                    f"aggregation {how!r} is not defined for strings "
+                    f"(column {value_name!r})")
+        if not changed:
+            return step
+        return GroupAggStep(step.keys, tuple(new_aggs), step.domains)
+
+    def _group_meta(self, step: GroupAggStep, table: Table,
+                    passthrough: set[str]) -> _GroupMeta:
+        from .stats import column_int_range
+        keys: list[_KeyMeta] = []
+        dense = True
+        sizes: list[int] = []
+        for name, hint in zip(step.keys, step.domains):
+            dictionary = self.dictionaries.get(name)
+            # Metadata may only come from the input binding when the key
+            # still holds unchanged input values; a redefined key's
+            # nullability/dtype are unknown at bind time (nullable=True is
+            # the safe superset: the null slot just stays empty).
+            src = table[name] if (name in table and name in passthrough) else None
+            col = self.exec_cols.get(name) if name in passthrough else None
+            nullable = col.validity is not None if col is not None else True
+            dtype = col.dtype if col is not None else INT64
+            lo = hi = 0
+            if dictionary is not None and name in passthrough:
+                lo, hi = 0, max(len(dictionary) - 1, 0)
+            elif hint is not None:
+                lo, hi = hint
+            elif src is not None and src.dtype == BOOL8:
+                lo, hi = 0, 1
+            elif (src is not None and src.offsets is None
+                  and src.dtype.is_integer and not src.dtype.is_decimal
+                  and not src.dtype.is_timestamp):
+                rng = column_int_range(src)
+                if rng is None or rng[1] - rng[0] + 1 > DENSE_MAX_CELLS:
+                    dense = False
+                else:
+                    lo, hi = rng
+            else:
+                dense = False
+            size = (hi - lo + 1) + (1 if nullable else 0)
+            sizes.append(size)
+            keys.append(_KeyMeta(name, lo, hi, nullable,
+                                 dictionary if name in self.dictionaries else None,
+                                 dtype))
+        cells = 1
+        for s in sizes:
+            cells *= s
+        if cells > DENSE_MAX_CELLS:
+            dense = False
+        return _GroupMeta(dense, tuple(keys), tuple(sizes), cells)
+
+    def signature(self):
+        cols = tuple(_ColInfo(n, int(c.dtype.type_id), c.dtype.scale,
+                              c.validity is not None, c.offsets is not None)
+                     for n, c in self.exec_cols.items())
+        return (self.steps, self.n, cols, tuple(self.group_metas))
+
+
+# ---------------------------------------------------------------------------
+# traced step kernels
+# ---------------------------------------------------------------------------
+
+def _trace_filter(cols, sel, step: FilterStep):
+    pred = evaluate(step.pred, cols)
+    keep = pred.data.astype(jnp.bool_)
+    if pred.validity is not None:
+        keep = keep & pred.validity
+    return cols, keep if sel is None else (sel & keep)
+
+
+def _trace_project(cols, sel, step: ProjectStep):
+    new = dict(cols) if not step.narrow else {}
+    if step.narrow and _ROWID in cols:
+        new[_ROWID] = cols[_ROWID]
+    for name, e in step.cols:
+        if isinstance(e, Col) and e.name == name and name not in cols:
+            continue          # deferred string passthrough (rowid-carried)
+        out = evaluate(e, cols)
+        if not isinstance(out, Column):       # bare literal select
+            raise TypeError(f"projection {name!r} is not a column expression")
+        new[name] = out
+    return new, sel
+
+
+def _trace_sort(cols, sel, step: SortStep):
+    from ..ops.sort import sort_operands
+    n = next(iter(cols.values())).size
+    key_cols = [cols[k] for k in step.by]
+    ops_list = sort_operands(key_cols, list(step.ascending),
+                             list(step.nulls_first))
+    if sel is not None:
+        ops_list = [jnp.where(sel, jnp.uint8(0), jnp.uint8(1))] + ops_list
+    payload: list[jax.Array] = []
+    layout: list[tuple[str, bool]] = []      # (name, has_validity)
+    for name, c in cols.items():
+        payload.append(c.data)
+        has_v = c.validity is not None
+        if has_v:
+            payload.append(c.validity)
+        layout.append((name, has_v))
+    if sel is not None:
+        payload.append(sel)
+    sorted_all = jax.lax.sort(ops_list + payload, dimension=0,
+                              is_stable=True, num_keys=len(ops_list))
+    rest = list(sorted_all[len(ops_list):])
+    out: dict[str, Column] = {}
+    i = 0
+    for name, has_v in layout:
+        d = rest[i]; i += 1
+        v = None
+        if has_v:
+            v = rest[i]; i += 1
+        out[name] = Column(data=d, validity=v, dtype=cols[name].dtype)
+    new_sel = rest[i] if sel is not None else None
+    return out, new_sel
+
+
+def _trace_limit(cols, sel, step: LimitStep):
+    n = next(iter(cols.values())).size
+    k = min(step.k, n)
+    if sel is not None:
+        # Compact live rows to the front (stable), then take k.
+        order = jnp.argsort(~sel, stable=True)
+        idx = order[:k]
+        out = {name: Column(data=jnp.take(c.data, idx),
+                            validity=None if c.validity is None
+                            else jnp.take(c.validity, idx),
+                            dtype=c.dtype)
+               for name, c in cols.items()}
+        return out, jnp.take(sel, idx)
+    out = {name: Column(data=c.data[:k],
+                        validity=None if c.validity is None else c.validity[:k],
+                        dtype=c.dtype)
+           for name, c in cols.items()}
+    return out, None
+
+
+# -- group-by: dense-domain path --------------------------------------------
+
+def _dense_slot(col: Column, km: _KeyMeta) -> jax.Array:
+    v = col.data.astype(jnp.int32) - jnp.int32(km.lo)
+    if km.nullable:
+        v = v + 1
+        if col.validity is not None:
+            v = jnp.where(col.validity, v, 0)
+    return v
+
+
+def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
+    n = next(iter(cols.values())).size
+    G = meta.cells
+    strides = []
+    s = 1
+    for size in reversed(meta.sizes):
+        strides.append(s)
+        s *= size
+    strides = list(reversed(strides))        # key-major lexicographic
+
+    gid = jnp.zeros(n, jnp.int32)
+    for km, stride in zip(meta.keys, strides):
+        gid = gid + _dense_slot(cols[km.name], km) * jnp.int32(stride)
+    if sel is not None:
+        gid = jnp.where(sel, gid, jnp.int32(G))   # dead rows match no cell
+    oh = gid[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]   # (G, n)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    counts_all = jnp.sum(oh, axis=1, dtype=jnp.int64)
+
+    out: dict[str, Column] = {}
+    cell = jnp.arange(G, dtype=jnp.int32)
+    for km, stride, size in zip(meta.keys, strides, meta.sizes):
+        key_dtype = cols[km.name].dtype
+        slot = (cell // jnp.int32(stride)) % jnp.int32(size)
+        if km.nullable:
+            data = (jnp.int32(km.lo) + slot - 1)
+            validity = slot > 0
+        else:
+            data = jnp.int32(km.lo) + slot
+            validity = None
+        out[km.name] = Column(data=data.astype(key_dtype.jnp_dtype),
+                              validity=validity, dtype=key_dtype)
+
+    # Per-value-column shared pieces (valid-count), computed once.
+    valid_counts: dict[str, jax.Array] = {}
+
+    def vcount(name: str) -> jax.Array:
+        if name not in valid_counts:
+            c = cols[name]
+            m = oh if c.validity is None else (oh & c.validity[None, :])
+            valid_counts[name] = jnp.sum(m, axis=1, dtype=jnp.int64)
+        return valid_counts[name]
+
+    def masked(name: str, fill) -> jax.Array:
+        c = cols[name]
+        m = oh if c.validity is None else (oh & c.validity[None, :])
+        return jnp.where(m, c.data[None, :], fill)
+
+    def sums(name: str, acc_jnp) -> jax.Array:
+        return jnp.sum(masked(name, jnp.zeros((), acc_jnp)).astype(acc_jnp),
+                       axis=1)
+
+    for value_name, how, out_name in step.aggs:
+        c = cols[value_name]
+        dtype = c.dtype
+        out_dtype = _agg_out_dtype(dtype, how)
+        has_valid = None
+        if how == "count_all":
+            data = counts_all
+        elif how == "count":
+            data = vcount(value_name)
+        elif how in ("first", "last"):
+            # row position of the group's first/last live row
+            pos = jnp.where(oh, iota[None, :], jnp.int32(n))
+            idx = (jnp.min(pos, axis=1) if how == "first"
+                   else jnp.max(jnp.where(oh, iota[None, :], jnp.int32(-1)),
+                                axis=1))
+            idx = jnp.clip(idx, 0, n - 1)
+            data = jnp.take(c.data, idx)
+            has_valid = (jnp.take(c.validity, idx) if c.validity is not None
+                         else None)
+        elif how == "sum":
+            acc = _sum_dtype(dtype)
+            data = sums(value_name, acc.jnp_dtype)
+            has_valid = vcount(value_name) > 0
+        elif how in ("mean", "var", "std"):
+            acc = _sum_dtype(dtype)
+            fsums = sums(value_name, acc.jnp_dtype).astype(jnp.float64)
+            scale_factor = 10.0 ** dtype.scale if dtype.is_decimal else 1.0
+            fsums = fsums * scale_factor
+            fcounts = vcount(value_name).astype(jnp.float64)
+            if how == "mean":
+                data = fsums / jnp.maximum(fcounts, 1.0)
+                has_valid = vcount(value_name) > 0
+            else:
+                sq = masked(value_name, jnp.zeros((), jnp.float64)).astype(
+                    jnp.float64) * scale_factor
+                sumsq = jnp.sum(sq * sq, axis=1)
+                denom = jnp.maximum(fcounts - 1.0, 1.0)
+                var = (sumsq - fsums * fsums / jnp.maximum(fcounts, 1.0)) / denom
+                var = jnp.maximum(var, 0.0)
+                data = var if how == "var" else jnp.sqrt(var)
+                has_valid = vcount(value_name) > 1
+        else:                                 # min / max
+            ident = _minmax_identity(dtype, how == "min")
+            m = masked(value_name, ident)
+            data = m.min(axis=1) if how == "min" else m.max(axis=1)
+            has_valid = vcount(value_name) > 0
+        out[out_name] = Column(data=data.astype(out_dtype.jnp_dtype),
+                               validity=has_valid, dtype=out_dtype)
+
+    return out, counts_all > 0
+
+
+# -- group-by: sorted fallback path ------------------------------------------
+
+def _trace_group_sorted(cols, sel, step: GroupAggStep, meta: _GroupMeta):
+    from .sorted_group import sorted_group_agg
+    return sorted_group_agg(cols, sel, step)
+
+
+# ---------------------------------------------------------------------------
+# program assembly + cache
+# ---------------------------------------------------------------------------
+
+_COMPILED: dict = {}
+
+
+def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...]):
+    """Build the traced function for a plan (independent of concrete data)."""
+
+    def program(cols: dict[str, Column]):
+        sel = None
+        gi = 0
+        for step in steps:
+            if isinstance(step, FilterStep):
+                cols, sel = _trace_filter(cols, sel, step)
+            elif isinstance(step, ProjectStep):
+                cols, sel = _trace_project(cols, sel, step)
+            elif isinstance(step, GroupAggStep):
+                meta = group_metas[gi]
+                gi += 1
+                if meta.dense:
+                    cols, sel = _trace_group_dense(cols, sel, step, meta)
+                else:
+                    cols, sel = _trace_group_sorted(cols, sel, step, meta)
+            elif isinstance(step, SortStep):
+                cols, sel = _trace_sort(cols, sel, step)
+            elif isinstance(step, LimitStep):
+                cols, sel = _trace_limit(cols, sel, step)
+            else:
+                raise TypeError(f"unknown plan step {step!r}")
+        return cols, sel
+
+    return jax.jit(program)
+
+
+def _compiled_for(bound: _Bound):
+    key = bound.signature()
+    fn = _COMPILED.get(key)
+    if fn is None:
+        fn = _assemble(bound.steps, tuple(bound.group_metas))
+        _COMPILED[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# execution + materialization
+# ---------------------------------------------------------------------------
+
+def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
+    """Output column order, derived statically (jit pytrees sort dict keys,
+    so insertion order must be reconstructed from the plan)."""
+    order = list(initial)
+    for step in steps:
+        if isinstance(step, ProjectStep):
+            if step.narrow:
+                order = [nm for nm, _ in step.cols]
+            else:
+                for nm, _ in step.cols:
+                    if nm not in order:
+                        order.append(nm)
+        elif isinstance(step, GroupAggStep):
+            order = list(step.keys) + [out for _, _, out in step.aggs]
+    return tuple(order)
+
+
+def run_plan_padded(plan: Plan, table: Table):
+    if table.num_rows == 0:
+        return run_plan_eager(plan, table), None
+    bound = _Bound(plan, table)
+    fn = _compiled_for(bound)
+    out_cols, sel = fn(bound.exec_cols)
+    t = _rebuild(bound, out_cols)
+    sel_col = None if sel is None else Column(data=sel.astype(jnp.uint8),
+                                              dtype=BOOL8)
+    return t, sel_col
+
+
+def run_plan(plan: Plan, table: Table) -> Table:
+    if table.num_rows == 0:
+        return run_plan_eager(plan, table)
+    bound = _Bound(plan, table)
+    fn = _compiled_for(bound)
+    out_cols, sel = fn(bound.exec_cols)
+    if sel is None:
+        return _rebuild(bound, out_cols)
+    from ..ops.common import pow2_bucket
+    count = int(jnp.sum(sel))                     # THE host sync
+    n = next(iter(out_cols.values())).size
+    bucket = min(pow2_bucket(count), n)
+    from ..ops.filter import _compact_kernel
+    names = list(out_cols)
+    idx, datas, valids = _compact_kernel(
+        sel, tuple(out_cols[nm].data for nm in names),
+        tuple(out_cols[nm].validity for nm in names), bucket=bucket)
+    sliced = {nm: Column(data=d[:count],
+                         validity=None if v is None else v[:count],
+                         dtype=out_cols[nm].dtype)
+              for nm, d, v in zip(names, datas, valids)}
+    return _rebuild(bound, sliced)
+
+
+def _rebuild(bound: _Bound, out_cols: dict[str, Column]) -> Table:
+    """Materialize program outputs: decode dictionary keys, gather deferred
+    string payloads by rowid, drop hidden columns, and restore the
+    user-visible column order (jit pytrees sort dict keys)."""
+    from ..ops.strings import strings_from_pylist
+    rowid = out_cols.get(_ROWID)
+    result: dict[str, Column] = {}
+    for name, c in out_cols.items():
+        if name == _ROWID or name.startswith("__valid__:"):
+            continue
+        if name in bound.dictionaries:
+            uniq = bound.dictionaries[name]
+            dict_col = strings_from_pylist(list(uniq))
+            codes = jnp.clip(c.data.astype(jnp.int32), 0,
+                             max(len(uniq) - 1, 0))
+            s = dict_col.gather(codes)
+            if c.validity is not None:
+                s = Column(data=s.data, offsets=s.offsets,
+                           validity=c.validity
+                           if s.validity is None else (s.validity & c.validity),
+                           dtype=s.dtype)
+            result[name] = s
+        elif name.startswith("__strref__:"):
+            _, src_name, out_name = name.split(":", 2)
+            src = bound.string_cols[src_name]
+            idx = jnp.clip(c.data.astype(jnp.int32), 0, bound.n - 1)
+            s = src.gather(idx)
+            if c.validity is not None:
+                s = Column(data=s.data, offsets=s.offsets,
+                           validity=c.validity if s.validity is None
+                           else (s.validity & c.validity), dtype=s.dtype)
+            result[out_name] = s
+        else:
+            result[name] = c
+    # Deferred whole-column strings (no groupby consumed them): gather by
+    # surviving rowids — only those the plan's final schema keeps (a
+    # narrowing select drops the rest).
+    order = _final_order(bound.plan.steps, bound.input_names)
+    if rowid is not None and bound.string_cols:
+        idx = rowid.data.astype(jnp.int32)
+        for name, src in bound.string_cols.items():
+            if name not in result and name in order:
+                result[name] = src.gather(idx)
+    ordered = [nm for nm in order if nm in result]
+    ordered += [nm for nm in result if nm not in ordered]
+    return Table([(nm, result[nm]) for nm in ordered])
+
+
+# ---------------------------------------------------------------------------
+# eager fallback (empty inputs; also the test oracle)
+# ---------------------------------------------------------------------------
+
+def run_plan_eager(plan: Plan, table: Table) -> Table:
+    """Execute a plan step-by-step with the eager ops layer.
+
+    Semantics oracle for the compiled path (used directly for empty
+    inputs, where XLA shapes degenerate)."""
+    from .. import ops
+
+    t = table
+    for step in plan.steps:
+        if isinstance(step, FilterStep):
+            env = dict(t.items())
+            t = ops.apply_boolean_mask(t, evaluate(step.pred, env))
+        elif isinstance(step, ProjectStep):
+            env = dict(t.items())
+            if step.narrow:
+                t = Table([(nm, evaluate(e, env)) for nm, e in step.cols])
+            else:
+                for nm, e in step.cols:
+                    t = t.with_column(nm, evaluate(e, env))
+        elif isinstance(step, GroupAggStep):
+            t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
+        elif isinstance(step, SortStep):
+            t = ops.sort_by(t, list(step.by), list(step.ascending),
+                            list(step.nulls_first))
+        elif isinstance(step, LimitStep):
+            k = min(step.k, t.num_rows)
+            t = t.gather(jnp.arange(k, dtype=jnp.int32))
+        else:
+            raise TypeError(f"unknown plan step {step!r}")
+    return t

@@ -1,0 +1,204 @@
+"""Expression IR for compiled plans.
+
+Hashable, immutable expression trees over column references and literals.
+The plan compiler (:mod:`.compile`) evaluates them during ``jax.jit``
+tracing by dispatching to the eager ops layer (:mod:`..ops.binary`), so
+null-propagation and type-promotion semantics have exactly one definition
+in the engine — an expression evaluated inside a compiled plan produces
+bit-identical results to the same chain of eager calls.
+
+Why a distinct IR instead of tracing user lambdas: expressions are part of
+the *compile-cache key*.  Two plans with the same expression tree over the
+same schema share one compiled XLA program (the reference system leans on
+the same property — Spark physical plans are cached per-query-shape and
+drive precompiled kernels; SURVEY.md §2.3).
+
+Equality note: ``__eq__`` keeps structural dataclass semantics (required
+for hashing/caching); *comparison predicates* are built with the ordered
+operators (``<``, ``<=``, ...) or the named methods ``eq()`` / ``ne()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Union
+
+from ..column import Column
+
+Scalar = Union[int, float, bool]
+
+
+class Expr:
+    """Base expression node (hashable; operator overloads build trees)."""
+
+    # arithmetic ----------------------------------------------------------
+    def __add__(self, other):
+        return BinOp("add", self, _wrap(other))
+
+    def __radd__(self, other):
+        return BinOp("add", _wrap(other), self)
+
+    def __sub__(self, other):
+        return BinOp("sub", self, _wrap(other))
+
+    def __rsub__(self, other):
+        return BinOp("sub", _wrap(other), self)
+
+    def __mul__(self, other):
+        return BinOp("mul", self, _wrap(other))
+
+    def __rmul__(self, other):
+        return BinOp("mul", _wrap(other), self)
+
+    def __truediv__(self, other):
+        return BinOp("truediv", self, _wrap(other))
+
+    def __rtruediv__(self, other):
+        return BinOp("truediv", _wrap(other), self)
+
+    def __floordiv__(self, other):
+        return BinOp("floordiv", self, _wrap(other))
+
+    def __mod__(self, other):
+        return BinOp("mod", self, _wrap(other))
+
+    def __neg__(self):
+        return UnOp("neg", self)
+
+    def __abs__(self):
+        return UnOp("abs", self)
+
+    # comparisons (ordered operators only — see module doc) --------------
+    def __lt__(self, other):
+        return BinOp("lt", self, _wrap(other))
+
+    def __le__(self, other):
+        return BinOp("le", self, _wrap(other))
+
+    def __gt__(self, other):
+        return BinOp("gt", self, _wrap(other))
+
+    def __ge__(self, other):
+        return BinOp("ge", self, _wrap(other))
+
+    def eq(self, other) -> "Expr":
+        return BinOp("eq", self, _wrap(other))
+
+    def ne(self, other) -> "Expr":
+        return BinOp("ne", self, _wrap(other))
+
+    # boolean -------------------------------------------------------------
+    def __and__(self, other):
+        return BinOp("and", self, _wrap(other))
+
+    def __or__(self, other):
+        return BinOp("or", self, _wrap(other))
+
+    def __invert__(self):
+        return UnOp("not", self)
+
+    # null tests ----------------------------------------------------------
+    def is_null(self) -> "Expr":
+        return UnOp("is_null", self)
+
+    def is_valid(self) -> "Expr":
+        return UnOp("is_valid", self)
+
+    def fill_null(self, value: Scalar) -> "Expr":
+        return FillNull(self, value)
+
+
+@dataclass(frozen=True)
+class Col(Expr):
+    """Reference to a column of the current plan state by name."""
+    name: str
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    """Scalar literal (int/float/bool)."""
+    value: Scalar
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class UnOp(Expr):
+    op: str
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class FillNull(Expr):
+    operand: Expr
+    value: Scalar
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value: Scalar) -> Lit:
+    return Lit(value)
+
+
+def _wrap(x) -> Expr:
+    if isinstance(x, Expr):
+        return x
+    if isinstance(x, (bool, int, float)):
+        return Lit(x)
+    raise TypeError(f"cannot use {type(x).__name__} in a plan expression "
+                    f"(wrap columns with col(), scalars are auto-wrapped)")
+
+
+def references(expr: Expr) -> set[str]:
+    """Column names referenced by an expression tree."""
+    if isinstance(expr, Col):
+        return {expr.name}
+    if isinstance(expr, Lit):
+        return set()
+    if isinstance(expr, FillNull):
+        return references(expr.operand)
+    if isinstance(expr, UnOp):
+        return references(expr.operand)
+    if isinstance(expr, BinOp):
+        return references(expr.left) | references(expr.right)
+    raise TypeError(f"not an expression: {expr!r}")
+
+
+def evaluate(expr: Expr, env: dict[str, Column]) -> Column:
+    """Evaluate an expression tree against named columns (trace-safe).
+
+    Dispatches to the eager ops layer so semantics are single-sourced;
+    under ``jax.jit`` tracing this builds the fused program.
+    """
+    from ..ops.binary import binary_op, fill_null, is_null, is_valid, unary_op
+
+    if isinstance(expr, Col):
+        try:
+            return env[expr.name]
+        except KeyError:
+            raise KeyError(f"column {expr.name!r} not in plan state "
+                           f"(have {sorted(env)})") from None
+    if isinstance(expr, Lit):
+        return expr.value            # binary_op accepts scalars directly
+    if isinstance(expr, FillNull):
+        return fill_null(evaluate(expr.operand, env), expr.value)
+    if isinstance(expr, UnOp):
+        operand = evaluate(expr.operand, env)
+        if not isinstance(operand, Column):
+            raise TypeError(f"unary {expr.op!r} needs a column operand")
+        if expr.op == "is_null":
+            return is_null(operand)
+        if expr.op == "is_valid":
+            return is_valid(operand)
+        return unary_op(operand, expr.op)
+    if isinstance(expr, BinOp):
+        return binary_op(evaluate(expr.left, env),
+                         evaluate(expr.right, env), expr.op)
+    raise TypeError(f"not an expression: {expr!r}")

@@ -1,0 +1,195 @@
+"""Sync-free sort-based group-by for compiled plans (the general path).
+
+The eager sort-based groupby (:mod:`..ops.groupby`) materializes the group
+count on the host to produce exact-shaped outputs.  Inside a compiled plan
+that sync is not available, so this kernel keeps everything padded at the
+input length ``n`` and returns a live-group selection vector instead:
+
+1. one stable multi-operand ``lax.sort`` clusters rows by key, with a
+   leading selection rank so filtered-out rows sink to the end, and every
+   needed payload (group keys for reconstruction, aggregation values, the
+   hidden rowid) riding as extra operands — the same fused-sort shape the
+   eager path measured fastest;
+2. group boundaries come from adjacent-difference over the sorted key
+   operands, masked to live rows;
+3. per-group reductions are **inclusive segmented scans**
+   (``lax.associative_scan`` restarting at boundaries) read off at each
+   group's last row — no ``segment_sum`` scatters, which the TPU memory
+   system punishes;
+4. group start/end positions materialize as padded ``(n,)`` arrays via a
+   value-sort of ``where(boundary, row, n)`` — ascending true starts
+   first, ``n`` padding after — so outputs are plain gathers.
+
+Slots past the true group count hold garbage and are dropped by the
+returned selection; downstream plan steps (sort/limit) and
+materialization handle them uniformly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..column import Column
+from ..ops.common import adjacent_differs, grouping_sort_operands
+from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
+from .plan import GroupAggStep
+
+
+def _segmented_scan(vals: jax.Array, boundary: jax.Array, combine):
+    """Inclusive segmented scan: restarts at rows where ``boundary``."""
+    def op(a, b):
+        va, ba = a
+        vb, bb = b
+        return jnp.where(bb, vb, combine(va, vb)), ba | bb
+    out, _ = jax.lax.associative_scan(op, (vals, boundary))
+    return out
+
+
+def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
+    n = next(iter(cols.values())).size
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    key_cols = [cols[k] for k in step.keys]
+    key_ops = grouping_sort_operands(
+        tuple(c.data for c in key_cols),
+        tuple(c.validity for c in key_cols))
+    ops_list = list(key_ops)
+    if sel is not None:
+        ops_list = [jnp.where(sel, jnp.uint8(0), jnp.uint8(1))] + ops_list
+
+    # Payload columns: keys (for output reconstruction) + distinct agg
+    # value columns. Each contributes data (+ validity when present).
+    pay_names: list[str] = []
+    for k in step.keys:
+        pay_names.append(k)
+    for value_name, _, _ in step.aggs:
+        if value_name not in pay_names:
+            pay_names.append(value_name)
+    payload: list[jax.Array] = []
+    layout: list[bool] = []
+    for nm in pay_names:
+        c = cols[nm]
+        payload.append(c.data)
+        has_v = c.validity is not None
+        if has_v:
+            payload.append(c.validity)
+        layout.append(has_v)
+
+    sorted_all = jax.lax.sort(ops_list + payload, dimension=0,
+                              is_stable=True, num_keys=len(ops_list))
+    live = (sorted_all[0] == 0) if sel is not None else jnp.ones(n, jnp.bool_)
+    sorted_keys = sorted_all[(1 if sel is not None else 0):len(ops_list)]
+    rest = list(sorted_all[len(ops_list):])
+    sorted_cols: dict[str, Column] = {}
+    i = 0
+    for nm, has_v in zip(pay_names, layout):
+        d = rest[i]; i += 1
+        v = None
+        if has_v:
+            v = rest[i]; i += 1
+        sorted_cols[nm] = Column(data=d, validity=v, dtype=cols[nm].dtype)
+
+    boundary = jnp.zeros(n, jnp.bool_)
+    for op_arr in sorted_keys:
+        boundary = boundary | adjacent_differs(op_arr)
+    boundary = boundary & live
+
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    sel_out = iota < num_groups
+
+    # Padded per-group start rows (ascending true starts, then n-padding),
+    # then end rows; scans read at ends are exact because dead rows carry
+    # reduction identities.
+    starts = jax.lax.sort(
+        [jnp.where(boundary, iota, jnp.int32(n))], dimension=0,
+        is_stable=False, num_keys=1)[0]
+    ends = jnp.concatenate([starts[1:], jnp.array([n], jnp.int32)]) - 1
+    ends = jnp.clip(ends, 0, n - 1)
+    g_starts = jnp.clip(starts, 0, n - 1)
+
+    # Last LIVE row per group (for `last`): segmented running max of the
+    # live row position.
+    last_live = _segmented_scan(jnp.where(live, iota, jnp.int32(-1)),
+                                boundary, jnp.maximum)
+    last_pos = jnp.clip(jnp.take(last_live, ends), 0, n - 1)
+
+    out: dict[str, Column] = {}
+    for km_name in step.keys:
+        c = sorted_cols[km_name]
+        out[km_name] = Column(
+            data=jnp.take(c.data, g_starts),
+            validity=None if c.validity is None
+            else jnp.take(c.validity, g_starts),
+            dtype=c.dtype)
+
+    # Shared per-value-column live-valid counts.
+    count_cache: dict[str, jax.Array] = {}
+
+    def vcounts(nm: str) -> jax.Array:
+        if nm not in count_cache:
+            c = sorted_cols[nm]
+            ok = live if c.validity is None else (live & c.validity)
+            scan = _segmented_scan(ok.astype(jnp.int64), boundary, jnp.add)
+            count_cache[nm] = jnp.take(scan, ends)
+        return count_cache[nm]
+
+    def scan_sum(nm: str, acc_jnp, square: bool = False) -> jax.Array:
+        c = sorted_cols[nm]
+        ok = live if c.validity is None else (live & c.validity)
+        v = jnp.where(ok, c.data, jnp.zeros((), c.data.dtype)).astype(acc_jnp)
+        if square:
+            v = v * v
+        return jnp.take(_segmented_scan(v, boundary, jnp.add), ends)
+
+    for value_name, how, out_name in step.aggs:
+        c = sorted_cols[value_name]
+        dtype = c.dtype
+        out_dtype = _agg_out_dtype(dtype, how)
+        has_valid = None
+        if how == "count_all":
+            scan = _segmented_scan(live.astype(jnp.int64), boundary, jnp.add)
+            data = jnp.take(scan, ends)
+        elif how == "count":
+            data = vcounts(value_name)
+        elif how == "first":
+            data = jnp.take(c.data, g_starts)
+            has_valid = (None if c.validity is None
+                         else jnp.take(c.validity, g_starts))
+        elif how == "last":
+            data = jnp.take(c.data, last_pos)
+            has_valid = (None if c.validity is None
+                         else jnp.take(c.validity, last_pos))
+        elif how == "sum":
+            acc = _sum_dtype(dtype)
+            data = scan_sum(value_name, acc.jnp_dtype)
+            has_valid = vcounts(value_name) > 0
+        elif how in ("mean", "var", "std"):
+            acc = _sum_dtype(dtype)
+            scale_factor = 10.0 ** dtype.scale if dtype.is_decimal else 1.0
+            fsums = scan_sum(value_name, acc.jnp_dtype).astype(
+                jnp.float64) * scale_factor
+            fcounts = vcounts(value_name).astype(jnp.float64)
+            if how == "mean":
+                data = fsums / jnp.maximum(fcounts, 1.0)
+                has_valid = vcounts(value_name) > 0
+            else:
+                sumsq = scan_sum(value_name, jnp.float64,
+                                 square=True) * (scale_factor * scale_factor)
+                denom = jnp.maximum(fcounts - 1.0, 1.0)
+                var = (sumsq - fsums * fsums
+                       / jnp.maximum(fcounts, 1.0)) / denom
+                var = jnp.maximum(var, 0.0)
+                data = var if how == "var" else jnp.sqrt(var)
+                has_valid = vcounts(value_name) > 1
+        else:                                  # min / max
+            ident = _minmax_identity(dtype, how == "min")
+            ok = live if c.validity is None else (live & c.validity)
+            v = jnp.where(ok, c.data, ident)
+            combine = jnp.minimum if how == "min" else jnp.maximum
+            data = jnp.take(_segmented_scan(v, boundary, combine), ends)
+            has_valid = vcounts(value_name) > 0
+        out[out_name] = Column(data=data.astype(out_dtype.jnp_dtype),
+                               validity=has_valid, dtype=out_dtype)
+
+    return out, sel_out

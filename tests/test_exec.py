@@ -1,0 +1,298 @@
+"""Whole-plan compiler tests.
+
+Oracle strategy: every compiled plan's result must equal the same pipeline
+executed step-by-step through the eager ops layer
+(``exec.compile.run_plan_eager``) — the engine's semantics live in one
+place and the compiled path must reproduce them exactly, including null
+propagation, group ordering (sorted keys, nulls first), and dtypes.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.exec import col, lit, plan
+from spark_rapids_tpu.exec.compile import run_plan_eager
+
+
+def _mixed_table(rng, n=1000, with_strings=False, key_span=5):
+    cols = [
+        ("k1", Column.from_numpy(
+            rng.integers(0, key_span, n).astype(np.int8),
+            validity=rng.random(n) > 0.1)),
+        ("k2", Column.from_numpy(rng.integers(0, 2, n).astype(np.bool_))),
+        ("v64", Column.from_numpy(
+            rng.integers(-1000, 1000, n).astype(np.int64),
+            validity=rng.random(n) > 0.15)),
+        ("f64", Column.from_numpy(rng.normal(size=n),
+                                  validity=rng.random(n) > 0.2)),
+        ("f32", Column.from_numpy(rng.normal(size=n).astype(np.float32))),
+        ("dec", Column.from_numpy(rng.integers(-9999, 9999, n).astype(np.int32),
+                                  dtype=dt.decimal32(-2))),
+    ]
+    if with_strings:
+        words = ["alpha", "beta", "gamma", "delta", ""]
+        vals = [None if rng.random() < 0.1 else words[rng.integers(0, 5)]
+                for _ in range(n)]
+        cols.append(("s", Column.from_pylist(vals, dt.STRING)))
+    return Table(cols)
+
+
+def _check(p, t, **kw):
+    got = p.run(t)
+    want = run_plan_eager(p, t)
+    assert_tables_equal(want, got, **kw)
+
+
+class TestFilterProject:
+    def test_filter_only(self, rng):
+        t = _mixed_table(rng)
+        _check(plan().filter(col("v64") > 0), t)
+
+    def test_filter_null_pred_drops(self, rng):
+        t = _mixed_table(rng)
+        # v64 has nulls -> predicate null -> row dropped
+        _check(plan().filter(col("v64") <= lit(50)), t)
+
+    def test_project_arithmetic(self, rng):
+        t = _mixed_table(rng)
+        # Tolerance: under jit XLA may fuse mul+add into FMA, legally
+        # changing the last ulp vs the eager unfused evaluation.
+        _check(plan().with_columns(z=col("f64") * (1 - col("f32")) + 2.0), t,
+               rtol=1e-12, atol=1e-12)
+
+    def test_select_narrow(self, rng):
+        t = _mixed_table(rng)
+        _check(plan().select("k1", ("twice", col("v64") * 2)), t)
+
+    def test_filter_then_project_chain(self, rng):
+        t = _mixed_table(rng)
+        p = (plan().filter((col("k1") < 4) & (col("f64") > -1.0))
+             .with_columns(q=col("v64") + 1))
+        _check(p, t)
+
+    def test_no_steps_identity(self, rng):
+        t = _mixed_table(rng)
+        _check(plan(), t)
+
+    def test_empty_table(self, rng):
+        t = _mixed_table(rng, n=1).gather(np.zeros(0, np.int32))
+        out = plan().filter(col("v64") > 0).run(t)
+        assert out.num_rows == 0
+
+    def test_strings_pass_through_filter(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        got = plan().filter(col("v64") > 0).run(t)
+        want = run_plan_eager(plan().filter(col("v64") > 0), t)
+        assert_tables_equal(want, got)
+
+
+class TestGroupByDense:
+    def test_dense_sums(self, rng):
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k1"], [("v64", "sum", "s"),
+                                        ("f64", "sum", "fs")])
+        _check(p, t, rtol=1e-12, atol=1e-9)
+
+    def test_dense_all_aggs(self, rng):
+        t = _mixed_table(rng)
+        aggs = [("v64", h, f"v_{h}") for h in
+                ("count", "count_all", "sum", "min", "max", "mean",
+                 "first", "last", "var", "std")]
+        p = plan().groupby_agg(["k1", "k2"], aggs)
+        _check(p, t, rtol=1e-9, atol=1e-9)
+
+    def test_dense_decimal(self, rng):
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k2"], [("dec", "sum", "ds"),
+                                        ("dec", "mean", "dm")])
+        _check(p, t, rtol=1e-12, atol=1e-12)
+
+    def test_dense_after_filter(self, rng):
+        t = _mixed_table(rng)
+        p = (plan().filter(col("f64") > 0)
+             .groupby_agg(["k1"], [("v64", "sum", "s"),
+                                   ("v64", "count", "c")]))
+        _check(p, t)
+
+    def test_explicit_domain(self, rng):
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k1"], [("v64", "sum", "s")],
+                               domains={"k1": (0, 4)})
+        _check(p, t)
+
+    def test_groupby_then_sort(self, rng):
+        t = _mixed_table(rng)
+        p = (plan()
+             .filter(col("v64") > -500)
+             .with_columns(w=col("f64") * 2.0)
+             .groupby_agg(["k1", "k2"], [("w", "sum", "ws"),
+                                         ("v64", "mean", "vm"),
+                                         ("v64", "count", "n")])
+             .sort_by(["k1", "k2"]))
+        _check(p, t, rtol=1e-9, atol=1e-9)
+
+    def test_string_key_dense(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        p = plan().groupby_agg(["s"], [("v64", "sum", "vs"),
+                                       ("v64", "count", "n")])
+        _check(p, t)
+
+    def test_string_first_last_count(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        p = plan().groupby_agg(["k2"], [("s", "first", "sf"),
+                                        ("s", "last", "sl"),
+                                        ("s", "count", "sc")])
+        _check(p, t)
+
+    def test_string_bad_agg_raises(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        with pytest.raises(TypeError, match="not defined for strings"):
+            plan().groupby_agg(["k2"], [("s", "sum", "x")]).run(t)
+
+
+class TestGroupBySorted:
+    """Wide-domain keys force the sorted fallback."""
+
+    def _wide_table(self, rng, n=2000):
+        return Table([
+            ("k", Column.from_numpy(
+                rng.integers(0, 100_000, n).astype(np.int64),
+                validity=rng.random(n) > 0.1)),
+            ("kf", Column.from_numpy(rng.integers(0, 3, n).astype(np.float64))),
+            ("v", Column.from_numpy(rng.integers(-50, 50, n).astype(np.int64),
+                                    validity=rng.random(n) > 0.2)),
+            ("f", Column.from_numpy(rng.normal(size=n))),
+        ])
+
+    def test_sorted_path_taken(self, rng):
+        from spark_rapids_tpu.exec.compile import _Bound
+        t = self._wide_table(rng)
+        p = plan().groupby_agg(["k"], [("v", "sum", "s")])
+        assert not _Bound(p, t).group_metas[0].dense
+
+    def test_sorted_all_aggs(self, rng):
+        t = self._wide_table(rng)
+        aggs = [("v", h, f"v_{h}") for h in
+                ("count", "count_all", "sum", "min", "max", "mean",
+                 "first", "last", "var", "std")]
+        p = plan().groupby_agg(["k"], aggs)
+        _check(p, t, rtol=1e-9, atol=1e-9)
+
+    def test_float_key_sorted(self, rng):
+        t = self._wide_table(rng)
+        p = plan().groupby_agg(["kf"], [("f", "sum", "fs")])
+        _check(p, t, rtol=1e-12, atol=1e-9)
+
+    def test_sorted_after_filter_with_sort(self, rng):
+        t = self._wide_table(rng)
+        p = (plan().filter(col("v") > 0)
+             .groupby_agg(["k"], [("f", "sum", "fs"), ("v", "count", "n")])
+             .sort_by(["k"]))
+        _check(p, t, rtol=1e-12, atol=1e-9)
+
+    def test_multi_key_mixed_domains(self, rng):
+        t = self._wide_table(rng)
+        p = plan().groupby_agg(["k", "kf"], [("v", "sum", "s")])
+        _check(p, t)
+
+
+class TestSortLimit:
+    def test_sort_desc_nulls(self, rng):
+        t = _mixed_table(rng)
+        p = plan().sort_by(["k1", "v64"], ascending=[False, True])
+        _check(p, t)
+
+    def test_sort_after_filter(self, rng):
+        t = _mixed_table(rng)
+        p = plan().filter(col("k1") < 3).sort_by(["v64"])
+        _check(p, t)
+
+    def test_limit_after_sort(self, rng):
+        t = _mixed_table(rng)
+        p = plan().filter(col("f64") > 0).sort_by(["v64"]).limit(17)
+        _check(p, t)
+
+    def test_limit_no_sel(self, rng):
+        t = _mixed_table(rng)
+        _check(plan().limit(5), t)
+
+    def test_sort_by_string_key(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        p = plan().sort_by(["s", "v64"])
+        _check(p, t)
+
+
+class TestStringHandling:
+    def test_select_string_passthrough(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        p = plan().filter(col("v64") > 0).select("s", "v64")
+        _check(p, t)
+
+    def test_string_in_expression_raises(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        with pytest.raises(TypeError, match="cannot be used in plan"):
+            plan().filter(col("s").is_null()).run(t)
+        with pytest.raises(TypeError, match="cannot be used in plan"):
+            plan().with_columns(z=col("s")).run(t)
+
+    def test_narrow_select_drops_strings(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        out = plan().select("k1").run(t)
+        assert out.names == ("k1",)
+
+
+class TestCaching:
+    def test_compiled_program_reused(self, rng):
+        from spark_rapids_tpu.exec import compile as C
+        t = _mixed_table(rng)
+        p = plan().filter(col("v64") > 0).groupby_agg(
+            ["k1"], [("v64", "sum", "s")])
+        p.run(t)
+        n_before = len(C._COMPILED)
+        p2 = plan().filter(col("v64") > 0).groupby_agg(
+            ["k1"], [("v64", "sum", "s")])
+        p2.run(t)
+        assert len(C._COMPILED) == n_before
+
+    def test_stats_probe_cached(self, rng):
+        from spark_rapids_tpu.exec.stats import column_int_range
+        t = _mixed_table(rng)
+        r1 = column_int_range(t["k1"])
+        r2 = column_int_range(t["k1"])
+        assert r1 == r2 and r1 is not None
+
+    def test_stats_cache_validity_aware(self, rng):
+        # Same data buffer, different validity -> must NOT share a cache
+        # entry (a mask can hide the extremes).
+        from spark_rapids_tpu.exec.stats import column_int_range
+        data = np.array([0, 1, 2, 100], np.int64)
+        full = Column.from_numpy(data)
+        masked = Column.from_numpy(data,
+                                   validity=np.array([1, 1, 1, 0], np.bool_))
+        masked = Column(data=full.data, validity=masked.validity,
+                        dtype=full.dtype)          # share the device buffer
+        assert column_int_range(masked) == (0, 2)
+        assert column_int_range(full) == (0, 100)
+
+    def test_redefined_key_uses_safe_metadata(self, rng):
+        # A projected (redefined) key must not inherit the input column's
+        # nullability; explicit domain + nulls from a nullable operand.
+        t = _mixed_table(rng)
+        p = (plan()
+             .with_columns(k1=col("k1") + col("v64") * 0)   # nulls from v64
+             .groupby_agg(["k1"], [("f32", "count", "n")],
+                          domains={"k1": (0, 4)}))
+        _check(p, t)
+
+    def test_run_padded_no_sync(self, rng):
+        t = _mixed_table(rng)
+        p = plan().filter(col("v64") > 0)
+        padded, sel = p.run_padded(t)
+        assert padded.num_rows == t.num_rows
+        assert sel is not None
+        keep = np.asarray(sel.data).astype(bool)
+        want = run_plan_eager(p, t)
+        assert int(keep.sum()) == want.num_rows
