@@ -52,8 +52,8 @@ from ..dtypes import BOOL8, INT32, INT64, DType, TypeId
 from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate
-from .plan import (FilterStep, GroupAggStep, LimitStep, Plan, ProjectStep,
-                   SortStep)
+from .plan import (FilterStep, GroupAggStep, JoinStep, LimitStep, Plan,
+                   ProjectStep, SortStep)
 
 #: Max dense group-by cells. Aggregation traffic scales with cells x rows
 #: (each reduction streams a (cells, rows) broadcast), so past a few
@@ -62,6 +62,15 @@ from .plan import (FilterStep, GroupAggStep, LimitStep, Plan, ProjectStep,
 DENSE_MAX_CELLS = 256
 
 _ROWID = "__rowid__"
+
+
+class _JoinMarkerT:
+    """Data-free stand-in for JoinStep in compiled-program assembly."""
+    def __repr__(self):
+        return "<join>"
+
+
+_JOIN_MARKER = _JoinMarkerT()
 
 
 # ---------------------------------------------------------------------------
@@ -133,12 +142,24 @@ class _Bound:
         self.n = table.num_rows
         self.input_names = tuple(table.names)
         self.exec_cols: dict[str, Column] = {}   # traced program inputs
+        #: non-row-aligned program inputs (join probe structures, build-side
+        #: payload columns) — kept out of the row-state dict so row-wise
+        #: steps (sort/limit) never touch them.
+        self.side_inputs: dict[str, Column] = {}
         self.string_cols: dict[str, Column] = {} # gathered at materialize
         self.dictionaries: dict[str, tuple[str, ...]] = {}
+        #: hidden join-rowid column -> [(build string Column, out name)]
+        self.join_string_srcs: dict[str, list] = {}
+        #: state column -> (source Column, forced_nullable) for group-key
+        #: domain probing: join payloads map to their (small) build-side
+        #: column so the stats probe stays cheap and dense grouping works
+        #: on joined keys; left joins force the null slot.
+        self.probe_sources: dict[str, tuple[Column, bool]] = {}
         #: plan steps with string aggregations rewritten to rowid/validity
         #: surrogates (what the traced program actually executes).
         self.steps: tuple = ()
         self.group_metas: list[_GroupMeta] = []
+        self.join_metas: list = []
         self._build(table)
 
     def _build(self, table: Table) -> None:
@@ -172,6 +193,7 @@ class _Bound:
         # hold unchanged input values (so group-key domains may be probed
         # from the input table).
         passthrough: set[str] = set(self.exec_cols)
+        current_names = list(self.exec_cols) + list(self.string_cols)
         steps: list = []
         for step in plan.steps:
             self._check_string_refs(step)
@@ -179,8 +201,19 @@ class _Bound:
                 redefined = {nm for nm, e in step.cols
                              if not (isinstance(e, Col) and e.name == nm)}
                 passthrough -= redefined
+                for nm in redefined:
+                    self.probe_sources.pop(nm, None)
                 if step.narrow:
                     passthrough &= ({nm for nm, _ in step.cols} | {_ROWID})
+                    kept = {nm for nm, _ in step.cols}
+                    self.probe_sources = {
+                        k: v for k, v in self.probe_sources.items()
+                        if k in kept}
+                    current_names = [nm for nm, _ in step.cols]
+                else:
+                    for nm, _ in step.cols:
+                        if nm not in current_names:
+                            current_names.append(nm)
                 steps.append(step)
             elif isinstance(step, GroupAggStep):
                 step = self._rewrite_string_aggs(step)
@@ -188,6 +221,20 @@ class _Bound:
                     self._group_meta(step, table, passthrough))
                 steps.append(step)
                 passthrough = set(step.keys)
+                self.probe_sources = {}
+                current_names = (list(step.keys)
+                                 + [out for _, _, out in step.aggs])
+            elif isinstance(step, JoinStep):
+                from .join import bind_join
+                meta = bind_join(self, step, len(self.join_metas),
+                                 current_names)
+                self.join_metas.append(meta)
+                for side_name, out in meta.pays:
+                    self.probe_sources[out] = (
+                        self.side_inputs[side_name], step.how == "left")
+                current_names += [out for _, out in meta.pays]
+                current_names += [out for _, out in meta.str_pays]
+                steps.append(step)
             else:
                 steps.append(step)
         self.steps = tuple(steps)
@@ -252,14 +299,25 @@ class _Bound:
         sizes: list[int] = []
         for name, hint in zip(step.keys, step.domains):
             dictionary = self.dictionaries.get(name)
-            # Metadata may only come from the input binding when the key
-            # still holds unchanged input values; a redefined key's
-            # nullability/dtype are unknown at bind time (nullable=True is
-            # the safe superset: the null slot just stays empty).
-            src = table[name] if (name in table and name in passthrough) else None
+            # Metadata may only come from a bind-time-known source: an
+            # unchanged input column, or a join payload's (small)
+            # build-side column.  A redefined key's nullability/dtype are
+            # unknown at bind time (nullable=True is the safe superset:
+            # the null slot just stays empty).
+            if name in table and name in passthrough:
+                src, forced_null = table[name], False
+            elif name in self.probe_sources:
+                src, forced_null = self.probe_sources[name]
+            else:
+                src, forced_null = None, True
             col = self.exec_cols.get(name) if name in passthrough else None
-            nullable = col.validity is not None if col is not None else True
-            dtype = col.dtype if col is not None else INT64
+            if col is not None:
+                nullable = col.validity is not None
+            elif src is not None:
+                nullable = forced_null or src.validity is not None
+            else:
+                nullable = True
+            dtype = (col or src).dtype if (col or src) is not None else INT64
             lo = hi = 0
             if dictionary is not None and name in passthrough:
                 lo, hi = 0, max(len(dictionary) - 1, 0)
@@ -289,11 +347,24 @@ class _Bound:
             dense = False
         return _GroupMeta(dense, tuple(keys), tuple(sizes), cells)
 
+    def assembly_steps(self) -> tuple:
+        """Steps with JoinStep replaced by a data-free marker: the traced
+        program reads everything it needs from the side inputs and the
+        static JoinMeta, so neither the compile-cache key nor the compiled
+        closure may pin the build Table's device buffers (two build tables
+        with identical signatures correctly share one program)."""
+        return tuple(_JOIN_MARKER if isinstance(s, JoinStep) else s
+                     for s in self.steps)
+
     def signature(self):
         cols = tuple(_ColInfo(n, int(c.dtype.type_id), c.dtype.scale,
                               c.validity is not None, c.offsets is not None)
                      for n, c in self.exec_cols.items())
-        return (self.steps, self.n, cols, tuple(self.group_metas))
+        side = tuple((n, int(c.dtype.type_id), int(c.data.shape[0]),
+                      c.validity is not None)
+                     for n, c in self.side_inputs.items())
+        return (self.assembly_steps(), self.n, cols, side,
+                tuple(self.group_metas), tuple(self.join_metas))
 
 
 # ---------------------------------------------------------------------------
@@ -377,13 +448,30 @@ def _trace_limit(cols, sel, step: LimitStep):
 
 # -- group-by: dense-domain path --------------------------------------------
 
-def _dense_slot(col: Column, km: _KeyMeta) -> jax.Array:
-    v = col.data.astype(jnp.int32) - jnp.int32(km.lo)
+def _dense_slot(col: Column, km: _KeyMeta) -> tuple[jax.Array, jax.Array]:
+    """(slot, in-domain mask).  Rows whose key value falls outside the
+    static (lo, hi) domain — only possible with a user-supplied hint that
+    under-covers, since probed/dictionary domains are exact — are masked
+    out rather than allowed to alias into neighboring cells."""
+    raw = col.data
+    ok = (raw >= jnp.asarray(km.lo, raw.dtype)) & \
+         (raw <= jnp.asarray(km.hi, raw.dtype))
+    v = raw.astype(jnp.int32) - jnp.int32(km.lo)
     if km.nullable:
         v = v + 1
         if col.validity is not None:
             v = jnp.where(col.validity, v, 0)
-    return v
+            ok = ok | ~col.validity        # null rows use the null slot
+    return v, ok
+
+
+#: Rows per dense-aggregation scan chunk.  The aggregation runs as ONE
+#: lax.scan pass with (cells,)-shaped accumulator carries: the scan body is
+#: a small XLA graph compiled once (a flat (cells, rows) broadcast
+#: formulation measured 234s-to-timeout XLA *compile* times at ~136 cells
+#: on v5e; runtime was never the problem), and the (cells, chunk)
+#: broadcasts live in VMEM instead of HBM.
+DENSE_CHUNK_ROWS = 131072
 
 
 def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
@@ -397,13 +485,121 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
     strides = list(reversed(strides))        # key-major lexicographic
 
     gid = jnp.zeros(n, jnp.int32)
+    in_domain = jnp.ones(n, jnp.bool_)
     for km, stride in zip(meta.keys, strides):
-        gid = gid + _dense_slot(cols[km.name], km) * jnp.int32(stride)
-    if sel is not None:
-        gid = jnp.where(sel, gid, jnp.int32(G))   # dead rows match no cell
-    oh = gid[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]   # (G, n)
-    iota = jnp.arange(n, dtype=jnp.int32)
-    counts_all = jnp.sum(oh, axis=1, dtype=jnp.int64)
+        slot, ok = _dense_slot(cols[km.name], km)
+        gid = gid + slot * jnp.int32(stride)
+        in_domain = in_domain & ok
+    live = in_domain if sel is None else (sel & in_domain)
+    gid = jnp.where(live, gid, jnp.int32(G))      # dead rows match no cell
+
+    # Which accumulators does each distinct value column need?
+    #   count (valid rows), sum, sumsq, min, max, firstpos, lastpos
+    needs: dict[str, set] = {}
+    for value_name, how, _ in step.aggs:
+        need = needs.setdefault(value_name, set())
+        if how == "count":
+            need.add("count")
+        elif how == "sum":
+            need.update(("sum", "count"))
+        elif how == "mean":
+            need.update(("sum", "count"))
+        elif how in ("var", "std"):
+            need.update(("sum", "sumsq", "count"))
+        elif how == "min":
+            need.update(("min", "count"))
+        elif how == "max":
+            need.update(("max", "count"))
+        elif how == "first":
+            need.add("firstpos")
+        elif how == "last":
+            need.add("lastpos")
+
+    # Pad to a chunk multiple; padded rows get gid=G (match nothing).
+    B = min(DENSE_CHUNK_ROWS, max(n, 1))
+    n_pad = -n % B
+    npad = n + n_pad
+
+    def padded(arr, fill):
+        if n_pad == 0:
+            return arr
+        return jnp.concatenate(
+            [arr, jnp.full(n_pad, fill, arr.dtype)])
+
+    gid_p = padded(gid, jnp.int32(G)).reshape(-1, B)
+    iota_p = padded(jnp.arange(n, dtype=jnp.int32),
+                    jnp.int32(0)).reshape(-1, B)
+    xs: dict[str, jax.Array] = {"gid": gid_p, "iota": iota_p}
+    init: dict[str, jax.Array] = {"count_all": jnp.zeros(G, jnp.int64)}
+    for vn, need in needs.items():
+        c = cols[vn]
+        key = vn
+        xs["v:" + key] = padded(c.data, jnp.zeros((), c.data.dtype)
+                                ).reshape(-1, B)
+        if c.validity is not None:
+            xs["m:" + key] = padded(c.validity, False).reshape(-1, B)
+        if "count" in need:
+            init["count:" + key] = jnp.zeros(G, jnp.int64)
+        if "sum" in need:
+            init["sum:" + key] = jnp.zeros(G, _sum_dtype(c.dtype).jnp_dtype)
+        if "sumsq" in need:
+            init["sumsq:" + key] = jnp.zeros(G, jnp.float64)
+        if "min" in need:
+            init["min:" + key] = jnp.full(
+                G, _minmax_identity(c.dtype, True), c.data.dtype)
+        if "max" in need:
+            init["max:" + key] = jnp.full(
+                G, _minmax_identity(c.dtype, False), c.data.dtype)
+        if "firstpos" in need:
+            init["firstpos:" + key] = jnp.full(G, npad, jnp.int32)
+        if "lastpos" in need:
+            init["lastpos:" + key] = jnp.full(G, -1, jnp.int32)
+
+    cell_ids = jnp.arange(G, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        oh = chunk["gid"][None, :] == cell_ids[:, None]       # (G, B)
+        out = dict(acc)
+        out["count_all"] = acc["count_all"] + jnp.sum(
+            oh, axis=1, dtype=jnp.int64)
+        for vn, need in needs.items():
+            c = cols[vn]
+            v = chunk["v:" + vn]
+            m = oh if c.validity is None else (oh & chunk["m:" + vn][None, :])
+            if "count" in need:
+                out["count:" + vn] = acc["count:" + vn] + jnp.sum(
+                    m, axis=1, dtype=jnp.int64)
+            if "sum" in need:
+                acc_dt = acc["sum:" + vn].dtype
+                out["sum:" + vn] = acc["sum:" + vn] + jnp.where(
+                    m, v[None, :], jnp.zeros((), v.dtype)
+                ).astype(acc_dt).sum(axis=1)
+            if "sumsq" in need:
+                fv = v.astype(jnp.float64)
+                out["sumsq:" + vn] = acc["sumsq:" + vn] + jnp.where(
+                    m, (fv * fv)[None, :], 0.0).sum(axis=1)
+            if "min" in need:
+                out["min:" + vn] = jnp.minimum(
+                    acc["min:" + vn],
+                    jnp.where(m, v[None, :],
+                              _minmax_identity(c.dtype, True)).min(axis=1))
+            if "max" in need:
+                out["max:" + vn] = jnp.maximum(
+                    acc["max:" + vn],
+                    jnp.where(m, v[None, :],
+                              _minmax_identity(c.dtype, False)).max(axis=1))
+            if "firstpos" in need:
+                pos = jnp.where(oh, chunk["iota"][None, :], jnp.int32(npad))
+                out["firstpos:" + vn] = jnp.minimum(
+                    acc["firstpos:" + vn], pos.min(axis=1))
+            if "lastpos" in need:
+                pos = jnp.where(oh, chunk["iota"][None, :], jnp.int32(-1))
+                out["lastpos:" + vn] = jnp.maximum(
+                    acc["lastpos:" + vn], pos.max(axis=1))
+        return out, None
+
+    acc, _ = jax.lax.scan(body, init, xs)
+    counts_all = acc["count_all"]
 
     out: dict[str, Column] = {}
     cell = jnp.arange(G, dtype=jnp.int32)
@@ -419,25 +615,6 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
         out[km.name] = Column(data=data.astype(key_dtype.jnp_dtype),
                               validity=validity, dtype=key_dtype)
 
-    # Per-value-column shared pieces (valid-count), computed once.
-    valid_counts: dict[str, jax.Array] = {}
-
-    def vcount(name: str) -> jax.Array:
-        if name not in valid_counts:
-            c = cols[name]
-            m = oh if c.validity is None else (oh & c.validity[None, :])
-            valid_counts[name] = jnp.sum(m, axis=1, dtype=jnp.int64)
-        return valid_counts[name]
-
-    def masked(name: str, fill) -> jax.Array:
-        c = cols[name]
-        m = oh if c.validity is None else (oh & c.validity[None, :])
-        return jnp.where(m, c.data[None, :], fill)
-
-    def sums(name: str, acc_jnp) -> jax.Array:
-        return jnp.sum(masked(name, jnp.zeros((), acc_jnp)).astype(acc_jnp),
-                       axis=1)
-
     for value_name, how, out_name in step.aggs:
         c = cols[value_name]
         dtype = c.dtype
@@ -446,44 +623,36 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
         if how == "count_all":
             data = counts_all
         elif how == "count":
-            data = vcount(value_name)
+            data = acc["count:" + value_name]
         elif how in ("first", "last"):
-            # row position of the group's first/last live row
-            pos = jnp.where(oh, iota[None, :], jnp.int32(n))
-            idx = (jnp.min(pos, axis=1) if how == "first"
-                   else jnp.max(jnp.where(oh, iota[None, :], jnp.int32(-1)),
-                                axis=1))
+            idx = (acc["firstpos:" + value_name] if how == "first"
+                   else acc["lastpos:" + value_name])
             idx = jnp.clip(idx, 0, n - 1)
             data = jnp.take(c.data, idx)
             has_valid = (jnp.take(c.validity, idx) if c.validity is not None
                          else None)
         elif how == "sum":
-            acc = _sum_dtype(dtype)
-            data = sums(value_name, acc.jnp_dtype)
-            has_valid = vcount(value_name) > 0
+            data = acc["sum:" + value_name]
+            has_valid = acc["count:" + value_name] > 0
         elif how in ("mean", "var", "std"):
-            acc = _sum_dtype(dtype)
-            fsums = sums(value_name, acc.jnp_dtype).astype(jnp.float64)
             scale_factor = 10.0 ** dtype.scale if dtype.is_decimal else 1.0
-            fsums = fsums * scale_factor
-            fcounts = vcount(value_name).astype(jnp.float64)
+            fsums = acc["sum:" + value_name].astype(jnp.float64) * scale_factor
+            fcounts = acc["count:" + value_name].astype(jnp.float64)
             if how == "mean":
                 data = fsums / jnp.maximum(fcounts, 1.0)
-                has_valid = vcount(value_name) > 0
+                has_valid = acc["count:" + value_name] > 0
             else:
-                sq = masked(value_name, jnp.zeros((), jnp.float64)).astype(
-                    jnp.float64) * scale_factor
-                sumsq = jnp.sum(sq * sq, axis=1)
+                sumsq = acc["sumsq:" + value_name] * (scale_factor
+                                                      * scale_factor)
                 denom = jnp.maximum(fcounts - 1.0, 1.0)
-                var = (sumsq - fsums * fsums / jnp.maximum(fcounts, 1.0)) / denom
+                var = (sumsq - fsums * fsums
+                       / jnp.maximum(fcounts, 1.0)) / denom
                 var = jnp.maximum(var, 0.0)
                 data = var if how == "var" else jnp.sqrt(var)
-                has_valid = vcount(value_name) > 1
+                has_valid = acc["count:" + value_name] > 1
         else:                                 # min / max
-            ident = _minmax_identity(dtype, how == "min")
-            m = masked(value_name, ident)
-            data = m.min(axis=1) if how == "min" else m.max(axis=1)
-            has_valid = vcount(value_name) > 0
+            data = acc[how + ":" + value_name]
+            has_valid = acc["count:" + value_name] > 0
         out[out_name] = Column(data=data.astype(out_dtype.jnp_dtype),
                                validity=has_valid, dtype=out_dtype)
 
@@ -503,13 +672,20 @@ def _trace_group_sorted(cols, sel, step: GroupAggStep, meta: _GroupMeta):
 
 _COMPILED: dict = {}
 
+#: dictionary tuple -> device strings column of the uniques, so repeat
+#: materializations of a string-keyed plan skip the host rebuild +
+#: host-to-device transfer.
+_DECODED_DICTS: dict = {}
 
-def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...]):
+
+def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
+              join_metas: tuple):
     """Build the traced function for a plan (independent of concrete data)."""
+    from .join import trace_join
 
-    def program(cols: dict[str, Column]):
+    def program(cols: dict[str, Column], side: dict[str, Column]):
         sel = None
-        gi = 0
+        gi = ji = 0
         for step in steps:
             if isinstance(step, FilterStep):
                 cols, sel = _trace_filter(cols, sel, step)
@@ -522,6 +698,9 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...]):
                     cols, sel = _trace_group_dense(cols, sel, step, meta)
                 else:
                     cols, sel = _trace_group_sorted(cols, sel, step, meta)
+            elif step is _JOIN_MARKER:
+                cols, sel = trace_join(cols, sel, side, join_metas[ji])
+                ji += 1
             elif isinstance(step, SortStep):
                 cols, sel = _trace_sort(cols, sel, step)
             elif isinstance(step, LimitStep):
@@ -537,7 +716,8 @@ def _compiled_for(bound: _Bound):
     key = bound.signature()
     fn = _COMPILED.get(key)
     if fn is None:
-        fn = _assemble(bound.steps, tuple(bound.group_metas))
+        fn = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
+                       tuple(bound.join_metas))
         _COMPILED[key] = fn
     return fn
 
@@ -560,6 +740,9 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
                         order.append(nm)
         elif isinstance(step, GroupAggStep):
             order = list(step.keys) + [out for _, _, out in step.aggs]
+        elif isinstance(step, JoinStep) and step.how in ("inner", "left"):
+            order += [nm for nm in step.table.names
+                      if nm != step.right_on and nm not in order]
     return tuple(order)
 
 
@@ -568,7 +751,7 @@ def run_plan_padded(plan: Plan, table: Table):
         return run_plan_eager(plan, table), None
     bound = _Bound(plan, table)
     fn = _compiled_for(bound)
-    out_cols, sel = fn(bound.exec_cols)
+    out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
     t = _rebuild(bound, out_cols)
     sel_col = None if sel is None else Column(data=sel.astype(jnp.uint8),
                                               dtype=BOOL8)
@@ -580,7 +763,7 @@ def run_plan(plan: Plan, table: Table) -> Table:
         return run_plan_eager(plan, table)
     bound = _Bound(plan, table)
     fn = _compiled_for(bound)
-    out_cols, sel = fn(bound.exec_cols)
+    out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
     if sel is None:
         return _rebuild(bound, out_cols)
     from ..ops.common import pow2_bucket
@@ -609,9 +792,24 @@ def _rebuild(bound: _Bound, out_cols: dict[str, Column]) -> Table:
     for name, c in out_cols.items():
         if name == _ROWID or name.startswith("__valid__:"):
             continue
+        if name in bound.join_string_srcs:
+            # Hidden join rowid: gather each build-side string payload at
+            # the final (small) size; unmatched rows are null.
+            for src, out_name in bound.join_string_srcs[name]:
+                idx = jnp.clip(c.data.astype(jnp.int32), 0,
+                               max(src.size - 1, 0))
+                g = src.gather(idx)
+                v = g.valid_mask() if c.validity is None else (
+                    g.valid_mask() & c.validity)
+                result[out_name] = Column(data=g.data, offsets=g.offsets,
+                                          validity=v, dtype=g.dtype)
+            continue
         if name in bound.dictionaries:
             uniq = bound.dictionaries[name]
-            dict_col = strings_from_pylist(list(uniq))
+            dict_col = _DECODED_DICTS.get(uniq)
+            if dict_col is None:
+                dict_col = strings_from_pylist(list(uniq))
+                _DECODED_DICTS[uniq] = dict_col
             codes = jnp.clip(c.data.astype(jnp.int32), 0,
                              max(len(uniq) - 1, 0))
             s = dict_col.gather(codes)
@@ -672,6 +870,14 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
                     t = t.with_column(nm, evaluate(e, env))
         elif isinstance(step, GroupAggStep):
             t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
+        elif isinstance(step, JoinStep):
+            joined = ops.join(t, step.table, left_on=[step.left_on],
+                              right_on=[step.right_on], how=step.how)
+            if (step.how in ("inner", "left")
+                    and step.left_on != step.right_on
+                    and step.right_on in joined):
+                joined = joined.drop([step.right_on])
+            t = joined
         elif isinstance(step, SortStep):
             t = ops.sort_by(t, list(step.by), list(step.ascending),
                             list(step.nulls_first))

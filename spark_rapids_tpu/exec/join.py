@@ -1,0 +1,221 @@
+"""Broadcast join inside compiled plans.
+
+The TPU re-architecture of the Spark broadcast hash join (probe side
+streams, build side is small and replicated).  A hash table is the wrong
+tool on TPU — random scatters to build, random gathers to probe; instead
+the binder turns the build side into one of two probe structures, chosen
+statically at bind time and cached per build-key buffer identity:
+
+* **direct** — build keys span a small static range: an int32 slot array
+  of size (hi-lo+1) maps key-lo → build row (-1 = absent).  Probing is a
+  single vectorized gather; O(1) per probe row, no hashing.
+* **search** — general integer keys: the build keys are pre-sorted and the
+  probe runs a vectorized binary search (``jnp.searchsorted``, log2(D)
+  small-table gathers).
+
+Both run sync-free inside the plan program.  Build keys must be unique
+(dimension-table contract — checked at bind); many-to-many joins with
+data-dependent expansion stay in the eager layer (ops.join, which the
+reference's cuDF hash join envelope maps to).
+
+Null semantics: null probe keys and null build keys never match
+(Spark/cuDF equi-join); a left join nulls the build payloads of unmatched
+rows, inner/semi drop them via the selection mask, anti keeps exactly
+them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..dtypes import BOOL8, INT32
+from .plan import JoinStep
+
+#: Max slot-array cells for the direct probe (int32 => 16 MB at the cap).
+DIRECT_PROBE_MAX = 1 << 22
+
+
+@dataclass(frozen=True)
+class JoinMeta:
+    """Static join description (part of the compile-cache key)."""
+    index: int
+    how: str
+    left_on: str
+    mode: str                            # "direct" | "search"
+    lo: int
+    hi: int
+    dim_rows: int
+    #: build rows with a non-null key (0 => nothing can ever match)
+    valid_keys: int
+    #: build key type id (probe key must match exactly)
+    key_type_id: int
+    key_scale: int
+    #: fixed-width build payloads: (side-input name, output name)
+    pays: tuple[tuple[str, str], ...]
+    #: string build payloads: (build column name, output name)
+    str_pays: tuple[tuple[str, str], ...]
+    #: hidden state column carrying matched build row ids (None when no
+    #: string payloads need late gathering)
+    rowid_name: Optional[str]
+
+
+# probe-structure cache: build key column buffers -> (mode, lo, hi, arrays)
+_PROBE_CACHE: dict = {}
+
+
+def _build_probe(key: Column):
+    """(mode, lo, hi, side arrays) for a build-side key column; cached."""
+    from .stats import _guarded_cache_get, _guarded_cache_put
+    buffers = ((key.data,) if key.validity is None
+               else (key.data, key.validity))
+    cache_key = tuple(id(b) for b in buffers)
+    hit = _guarded_cache_get(_PROBE_CACHE, cache_key, buffers)
+    if hit is not None:
+        return hit
+
+    np_keys = np.asarray(key.data)
+    rows = np.arange(np_keys.shape[0], dtype=np.int32)
+    if key.validity is not None:
+        m = np.asarray(key.validity)
+        np_keys, rows = np_keys[m], rows[m]
+    if np_keys.size == 0:
+        result = ("search", 0, 0,
+                  {"keys": jnp.zeros(0, key.data.dtype),
+                   "rows": jnp.zeros(0, jnp.int32)})
+        _guarded_cache_put(_PROBE_CACHE, cache_key, buffers, result)
+        return result
+    if np.unique(np_keys).size != np_keys.size:
+        raise ValueError(
+            "broadcast join requires unique build-side keys "
+            "(use the eager ops.join for many-to-many joins)")
+    lo, hi = int(np_keys.min()), int(np_keys.max())
+    span = hi - lo + 1
+    if span <= DIRECT_PROBE_MAX:
+        lookup = np.full(span, -1, np.int32)
+        lookup[(np_keys - lo).astype(np.int64)] = rows
+        result = ("direct", lo, hi, {"lookup": jnp.asarray(lookup)})
+    else:
+        order = np.argsort(np_keys, kind="stable")
+        result = ("search", lo, hi,
+                  {"keys": jnp.asarray(np_keys[order]),
+                   "rows": jnp.asarray(rows[order].astype(np.int32))})
+    _guarded_cache_put(_PROBE_CACHE, cache_key, buffers, result)
+    return result
+
+
+def bind_join(bound, step: JoinStep, index: int,
+              current_names: list[str]) -> JoinMeta:
+    """Register side inputs on ``bound`` and produce the static meta."""
+    dim = step.table
+    if (step.left_on in bound.string_cols
+            or step.left_on in bound.dictionaries):
+        raise TypeError(
+            f"broadcast join probe key {step.left_on!r} is a string column; "
+            f"dictionary-encode both sides or use the eager ops.join")
+    if step.right_on not in dim:
+        raise KeyError(f"build-side key {step.right_on!r} not in "
+                       f"{list(dim.names)}")
+    key = dim[step.right_on]
+    if key.offsets is not None or key.dtype.is_floating:
+        raise TypeError(
+            f"broadcast join keys must be integer-typed "
+            f"({step.right_on!r} is {key.dtype.type_id.name}); "
+            f"dictionary-encode strings or use the eager ops.join")
+
+    mode, lo, hi, arrays = _build_probe(key)
+    valid_keys = (dim.num_rows if key.validity is None
+                  else int(np.asarray(key.validity).sum()))
+    prefix = f"__join{index}__"
+    for nm, arr in arrays.items():
+        bound.side_inputs[prefix + nm] = Column(
+            data=arr, dtype=INT32 if arr.dtype == jnp.int32 else key.dtype)
+
+    pays: list[tuple[str, str]] = []
+    str_pays: list[tuple[str, str]] = []
+    rowid_name = None
+    if step.how in ("inner", "left"):
+        for name, c in dim.items():
+            if name == step.right_on:
+                continue
+            if name in current_names:
+                raise ValueError(
+                    f"join output column {name!r} collides with an existing "
+                    f"column; rename one side first")
+            if c.offsets is None:
+                side_name = prefix + "pay__" + name
+                bound.side_inputs[side_name] = c
+                pays.append((side_name, name))
+            else:
+                str_pays.append((name, name))
+        if str_pays:
+            rowid_name = prefix + "rowid"
+            bound.join_string_srcs[rowid_name] = [
+                (dim[src], out) for src, out in str_pays]
+
+    return JoinMeta(index, step.how, step.left_on, mode, lo, hi,
+                    dim.num_rows, valid_keys, int(key.dtype.type_id),
+                    key.dtype.scale, tuple(pays), tuple(str_pays),
+                    rowid_name)
+
+
+def trace_join(cols, sel, side, meta: JoinMeta):
+    """Traced probe + payload attach (runs inside the plan program)."""
+    k = cols[meta.left_on]
+    if (int(k.dtype.type_id) != meta.key_type_id
+            or k.dtype.scale != meta.key_scale):
+        raise TypeError(
+            f"join key dtype mismatch: probe {meta.left_on!r} is "
+            f"{k.dtype!r}, build key type id is {meta.key_type_id} "
+            f"(cast first)")
+    kd = k.data
+    in_range = (kd >= jnp.asarray(meta.lo, kd.dtype)) & \
+               (kd <= jnp.asarray(meta.hi, kd.dtype))
+    if k.validity is not None:
+        in_range = in_range & k.validity
+    prefix = f"__join{meta.index}__"
+
+    if meta.valid_keys == 0:
+        dimrow = jnp.zeros(kd.shape[0], jnp.int32)
+        found = jnp.zeros(kd.shape[0], jnp.bool_)
+    elif meta.mode == "direct":
+        lookup = side[prefix + "lookup"].data
+        span = meta.hi - meta.lo + 1
+        slot = jnp.clip((kd - jnp.asarray(meta.lo, kd.dtype)).astype(jnp.int32),
+                        0, span - 1)
+        dimrow = jnp.take(lookup, slot)
+        found = in_range & (dimrow >= 0)
+    else:
+        skeys = side[prefix + "keys"].data
+        srows = side[prefix + "rows"].data
+        d = skeys.shape[0]
+        pos = jnp.clip(jnp.searchsorted(skeys, kd).astype(jnp.int32),
+                       0, d - 1)
+        found = in_range & (jnp.take(skeys, pos) == kd)
+        dimrow = jnp.take(srows, pos)
+    dimrow = jnp.clip(dimrow, 0, max(meta.dim_rows - 1, 0))
+
+    if meta.how == "semi":
+        return cols, found if sel is None else (sel & found)
+    if meta.how == "anti":
+        return cols, (~found) if sel is None else (sel & ~found)
+
+    new = dict(cols)
+    for side_name, out_name in meta.pays:
+        pay = side[side_name]
+        data = jnp.take(pay.data, dimrow, axis=0)
+        validity = (None if pay.validity is None
+                    else jnp.take(pay.validity, dimrow))
+        if meta.how == "left":
+            validity = found if validity is None else (validity & found)
+        new[out_name] = Column(data=data, validity=validity, dtype=pay.dtype)
+    if meta.rowid_name is not None:
+        new[meta.rowid_name] = Column(data=dimrow, validity=found,
+                                      dtype=INT32)
+    if meta.how == "inner":
+        sel = found if sel is None else (sel & found)
+    return new, sel

@@ -65,6 +65,21 @@ class GroupAggStep:
 
 
 @dataclass(frozen=True)
+class JoinStep:
+    """Broadcast equi-join against a small bound build-side table.
+
+    The build table rides inside the step (identity-hashed: rebinding the
+    same Table object reuses the compiled program); its keys must be
+    unique — the dimension-table contract of a Spark broadcast hash join.
+    General many-to-many joins (data-dependent output size) stay in the
+    eager layer (:func:`...ops.join.join`)."""
+    table: object                      # Table (identity hash/eq)
+    left_on: str
+    right_on: str
+    how: str                           # inner | left | semi | anti
+
+
+@dataclass(frozen=True)
 class SortStep:
     by: tuple[str, ...]
     ascending: tuple[bool, ...]
@@ -76,7 +91,8 @@ class LimitStep:
     k: int
 
 
-Step = Union[FilterStep, ProjectStep, GroupAggStep, SortStep, LimitStep]
+Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep, SortStep,
+             LimitStep]
 
 
 @dataclass(frozen=True)
@@ -109,7 +125,10 @@ class Plan:
 
         ``domains`` optionally pins a key's inclusive (lo, hi) value range,
         enabling the dense no-sort path without a stats probe (the way a
-        Spark plan provider would pass catalog statistics down).
+        Spark plan provider would pass catalog statistics down).  A hint
+        must cover the key's actual values: rows outside the hinted range
+        belong to no group and are dropped (never aliased into another
+        cell).
         """
         keys = tuple(keys)
         for _, how, _ in aggs:
@@ -118,6 +137,25 @@ class Plan:
                                  f"(have {PLAN_AGGS})")
         dom = tuple((domains or {}).get(k) for k in keys)
         return Plan(self.steps + (GroupAggStep(keys, tuple(aggs), dom),))
+
+    def join_broadcast(self, table: Table, on: Optional[str] = None,
+                       left_on: Optional[str] = None,
+                       right_on: Optional[str] = None,
+                       how: str = "inner") -> "Plan":
+        """Join against a broadcast build-side ``table`` with unique keys.
+
+        ``how``: "inner", "left", "semi" (probe rows with a match), or
+        "anti" (probe rows without one).  The build side's non-key columns
+        are appended to the schema (name collisions are an error — rename
+        first); its key column is dropped (it equals the probe key).
+        """
+        if how not in ("inner", "left", "semi", "anti"):
+            raise ValueError(f"unsupported join type {how!r}")
+        if on is not None:
+            left_on = right_on = on
+        if not left_on or not right_on:
+            raise ValueError("join keys: pass `on=` or left_on/right_on")
+        return Plan(self.steps + (JoinStep(table, left_on, right_on, how),))
 
     def sort_by(self, by: Union[str, Sequence[str]],
                 ascending: Optional[Sequence[bool]] = None,

@@ -36,13 +36,28 @@ from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .plan import GroupAggStep
 
 
-def _segmented_scan(vals: jax.Array, boundary: jax.Array, combine):
-    """Inclusive segmented scan: restarts at rows where ``boundary``."""
+_COMBINES = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+
+def _segmented_scan_multi(fields: dict[str, tuple[jax.Array, str]],
+                          boundary: jax.Array) -> dict[str, jax.Array]:
+    """ONE inclusive segmented scan over every (array, combine-kind) field.
+
+    All per-group reductions share a single ``associative_scan`` (restart
+    at ``boundary``): one scan over a pytree instead of one scan per
+    aggregate — the XLA graph for an unrolled log-depth scan at millions
+    of rows is big enough that per-aggregate scans measured minutes of
+    *compile* time."""
+    kinds = {k: kind for k, (_, kind) in fields.items()}
+
     def op(a, b):
         va, ba = a
         vb, bb = b
-        return jnp.where(bb, vb, combine(va, vb)), ba | bb
-    out, _ = jax.lax.associative_scan(op, (vals, boundary))
+        out = {k: jnp.where(bb, vb[k], _COMBINES[kinds[k]](va[k], vb[k]))
+               for k in va}
+        return out, ba | bb
+    out, _ = jax.lax.associative_scan(
+        op, ({k: arr for k, (arr, _) in fields.items()}, boundary))
     return out
 
 
@@ -108,11 +123,53 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
     ends = jnp.clip(ends, 0, n - 1)
     g_starts = jnp.clip(starts, 0, n - 1)
 
-    # Last LIVE row per group (for `last`): segmented running max of the
-    # live row position.
-    last_live = _segmented_scan(jnp.where(live, iota, jnp.int32(-1)),
-                                boundary, jnp.maximum)
-    last_pos = jnp.clip(jnp.take(last_live, ends), 0, n - 1)
+    # Collect every needed per-group reduction as a field of ONE segmented
+    # scan (see _segmented_scan_multi).
+    fields: dict[str, tuple[jax.Array, str]] = {}
+
+    def lives(nm: str) -> jax.Array:
+        c = sorted_cols[nm]
+        return live if c.validity is None else (live & c.validity)
+
+    need_last = False
+    for value_name, how, _ in step.aggs:
+        c = sorted_cols[value_name]
+        if how == "count_all" and "ca" not in fields:
+            fields["ca"] = (live.astype(jnp.int64), "add")
+        elif how == "count":
+            fields.setdefault("cnt:" + value_name,
+                              (lives(value_name).astype(jnp.int64), "add"))
+        elif how == "last":
+            need_last = True
+        elif how == "first":
+            pass
+        elif how in ("sum", "mean", "var", "std"):
+            acc = _sum_dtype(c.dtype)
+            ok = lives(value_name)
+            v = jnp.where(ok, c.data,
+                          jnp.zeros((), c.data.dtype)).astype(acc.jnp_dtype)
+            fields.setdefault("sum:" + value_name, (v, "add"))
+            fields.setdefault("cnt:" + value_name,
+                              (ok.astype(jnp.int64), "add"))
+            if how in ("var", "std"):
+                fv = jnp.where(ok, c.data, jnp.zeros((), c.data.dtype)
+                               ).astype(jnp.float64)
+                fields.setdefault("sumsq:" + value_name, (fv * fv, "add"))
+        else:                                  # min / max
+            ident = _minmax_identity(c.dtype, how == "min")
+            ok = lives(value_name)
+            fields.setdefault(
+                how + ":" + value_name,
+                (jnp.where(ok, c.data, ident), how))
+            fields.setdefault("cnt:" + value_name,
+                              (ok.astype(jnp.int64), "add"))
+    if need_last:
+        fields["lastlive"] = (jnp.where(live, iota, jnp.int32(-1)), "max")
+
+    scans = (_segmented_scan_multi(fields, boundary) if fields else {})
+    at_ends = {k: jnp.take(v, ends) for k, v in scans.items()}
+    last_pos = (jnp.clip(at_ends["lastlive"], 0, n - 1) if need_last
+                else None)
 
     out: dict[str, Column] = {}
     for km_name in step.keys:
@@ -123,35 +180,15 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
             else jnp.take(c.validity, g_starts),
             dtype=c.dtype)
 
-    # Shared per-value-column live-valid counts.
-    count_cache: dict[str, jax.Array] = {}
-
-    def vcounts(nm: str) -> jax.Array:
-        if nm not in count_cache:
-            c = sorted_cols[nm]
-            ok = live if c.validity is None else (live & c.validity)
-            scan = _segmented_scan(ok.astype(jnp.int64), boundary, jnp.add)
-            count_cache[nm] = jnp.take(scan, ends)
-        return count_cache[nm]
-
-    def scan_sum(nm: str, acc_jnp, square: bool = False) -> jax.Array:
-        c = sorted_cols[nm]
-        ok = live if c.validity is None else (live & c.validity)
-        v = jnp.where(ok, c.data, jnp.zeros((), c.data.dtype)).astype(acc_jnp)
-        if square:
-            v = v * v
-        return jnp.take(_segmented_scan(v, boundary, jnp.add), ends)
-
     for value_name, how, out_name in step.aggs:
         c = sorted_cols[value_name]
         dtype = c.dtype
         out_dtype = _agg_out_dtype(dtype, how)
         has_valid = None
         if how == "count_all":
-            scan = _segmented_scan(live.astype(jnp.int64), boundary, jnp.add)
-            data = jnp.take(scan, ends)
+            data = at_ends["ca"]
         elif how == "count":
-            data = vcounts(value_name)
+            data = at_ends["cnt:" + value_name]
         elif how == "first":
             data = jnp.take(c.data, g_starts)
             has_valid = (None if c.validity is None
@@ -161,34 +198,28 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
             has_valid = (None if c.validity is None
                          else jnp.take(c.validity, last_pos))
         elif how == "sum":
-            acc = _sum_dtype(dtype)
-            data = scan_sum(value_name, acc.jnp_dtype)
-            has_valid = vcounts(value_name) > 0
+            data = at_ends["sum:" + value_name]
+            has_valid = at_ends["cnt:" + value_name] > 0
         elif how in ("mean", "var", "std"):
-            acc = _sum_dtype(dtype)
             scale_factor = 10.0 ** dtype.scale if dtype.is_decimal else 1.0
-            fsums = scan_sum(value_name, acc.jnp_dtype).astype(
+            fsums = at_ends["sum:" + value_name].astype(
                 jnp.float64) * scale_factor
-            fcounts = vcounts(value_name).astype(jnp.float64)
+            fcounts = at_ends["cnt:" + value_name].astype(jnp.float64)
             if how == "mean":
                 data = fsums / jnp.maximum(fcounts, 1.0)
-                has_valid = vcounts(value_name) > 0
+                has_valid = at_ends["cnt:" + value_name] > 0
             else:
-                sumsq = scan_sum(value_name, jnp.float64,
-                                 square=True) * (scale_factor * scale_factor)
+                sumsq = at_ends["sumsq:" + value_name] * (scale_factor
+                                                          * scale_factor)
                 denom = jnp.maximum(fcounts - 1.0, 1.0)
                 var = (sumsq - fsums * fsums
                        / jnp.maximum(fcounts, 1.0)) / denom
                 var = jnp.maximum(var, 0.0)
                 data = var if how == "var" else jnp.sqrt(var)
-                has_valid = vcounts(value_name) > 1
+                has_valid = at_ends["cnt:" + value_name] > 1
         else:                                  # min / max
-            ident = _minmax_identity(dtype, how == "min")
-            ok = live if c.validity is None else (live & c.validity)
-            v = jnp.where(ok, c.data, ident)
-            combine = jnp.minimum if how == "min" else jnp.maximum
-            data = jnp.take(_segmented_scan(v, boundary, combine), ends)
-            has_valid = vcounts(value_name) > 0
+            data = at_ends[how + ":" + value_name]
+            has_valid = at_ends["cnt:" + value_name] > 0
         out[out_name] = Column(data=data.astype(out_dtype.jnp_dtype),
                                validity=has_valid, dtype=out_dtype)
 

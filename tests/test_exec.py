@@ -199,6 +199,138 @@ class TestGroupBySorted:
         _check(p, t)
 
 
+class TestBroadcastJoin:
+    def _dim(self, rng, d=50, dense=True, with_strings=False):
+        keys = (np.arange(d, dtype=np.int64) * (1 if dense else 1000) + 3)
+        cols = [
+            ("dk", Column.from_numpy(keys)),
+            ("dv", Column.from_numpy(rng.normal(size=d),
+                                     validity=rng.random(d) > 0.1)),
+        ]
+        if with_strings:
+            cols.append(("dname", Column.from_pylist(
+                [f"name_{i}" if i % 7 else None for i in range(d)],
+                dt.STRING)))
+        return Table(cols)
+
+    def _fact(self, rng, n=2000, hi=80):
+        return Table([
+            ("fk", Column.from_numpy(rng.integers(0, hi, n).astype(np.int64),
+                                     validity=rng.random(n) > 0.1)),
+            ("fv", Column.from_numpy(rng.normal(size=n))),
+        ])
+
+    def test_inner_direct(self, rng):
+        f, d = self._fact(rng), self._dim(rng)
+        p = plan().join_broadcast(d, left_on="fk", right_on="dk")
+        _check(p, f)
+
+    def test_left_direct(self, rng):
+        f, d = self._fact(rng), self._dim(rng)
+        p = plan().join_broadcast(d, left_on="fk", right_on="dk", how="left")
+        _check(p, f)
+
+    def test_semi_anti(self, rng):
+        f, d = self._fact(rng), self._dim(rng)
+        for how in ("semi", "anti"):
+            p = plan().join_broadcast(d, left_on="fk", right_on="dk", how=how)
+            _check(p, f)
+
+    def test_search_mode(self, rng):
+        from spark_rapids_tpu.exec.compile import _Bound
+        f = self._fact(rng, hi=50_000)
+        d = self._dim(rng, dense=False)          # keys spread over ~50k*1000
+        import spark_rapids_tpu.exec.join as J
+        old = J.DIRECT_PROBE_MAX
+        J.DIRECT_PROBE_MAX = 1024                 # force search mode
+        try:
+            p = plan().join_broadcast(d, left_on="fk", right_on="dk")
+            b = _Bound(p, f)
+            assert b.join_metas[0].mode == "search"
+            _check(p, f)
+        finally:
+            J.DIRECT_PROBE_MAX = old
+
+    def test_join_string_payload(self, rng):
+        f, d = self._fact(rng), self._dim(rng, with_strings=True)
+        for how in ("inner", "left"):
+            p = plan().join_broadcast(d, left_on="fk", right_on="dk", how=how)
+            _check(p, f)
+
+    def test_join_then_groupby(self, rng):
+        f, d = self._fact(rng), self._dim(rng)
+        p = (plan().join_broadcast(d, left_on="fk", right_on="dk")
+             .with_columns(z=col("fv") * col("dv").fill_null(0.0))
+             .groupby_agg(["fk"], [("z", "sum", "zs")], domains={"fk": (0, 79)})
+             .sort_by(["fk"]))
+        _check(p, f, rtol=1e-9, atol=1e-9)
+
+    def test_duplicate_build_keys_raise(self, rng):
+        f = self._fact(rng)
+        d = Table([("dk", Column.from_numpy(np.array([1, 1, 2], np.int64))),
+                   ("dv", Column.from_numpy(np.ones(3)))])
+        with pytest.raises(ValueError, match="unique build-side keys"):
+            plan().join_broadcast(d, left_on="fk", right_on="dk").run(f)
+
+    def test_collision_raises(self, rng):
+        f = self._fact(rng)
+        d = Table([("dk", Column.from_numpy(np.arange(5, dtype=np.int64))),
+                   ("fv", Column.from_numpy(np.ones(5)))])
+        with pytest.raises(ValueError, match="collides"):
+            plan().join_broadcast(d, left_on="fk", right_on="dk").run(f)
+
+    def test_all_null_build_keys(self, rng):
+        # Non-empty build side whose keys are ALL null: nothing matches.
+        f = self._fact(rng, n=100)
+        d = Table([("dk", Column.from_pylist([None, None], dt.INT64)),
+                   ("dv", Column.from_numpy(np.ones(2)))])
+        for how in ("inner", "left", "semi", "anti"):
+            p = plan().join_broadcast(d, left_on="fk", right_on="dk", how=how)
+            _check(p, f)
+
+    def test_probe_key_dtype_mismatch_raises(self, rng):
+        f = Table([("fk", Column.from_numpy(np.array([1.5, 2.0]))),
+                   ("fv", Column.from_numpy(np.ones(2)))])
+        d = Table([("dk", Column.from_numpy(np.arange(5, dtype=np.int64))),
+                   ("dv", Column.from_numpy(np.ones(5)))])
+        with pytest.raises(TypeError, match="dtype mismatch"):
+            plan().join_broadcast(d, left_on="fk", right_on="dk").run(f)
+
+    def test_string_probe_key_raises_even_as_sort_key(self, rng):
+        f = _mixed_table(rng, n=50, with_strings=True)
+        d = Table([("dk", Column.from_numpy(np.arange(5, dtype=np.int64))),
+                   ("dv", Column.from_numpy(np.ones(5)))])
+        p = (plan().sort_by(["s"])
+             .join_broadcast(d, left_on="s", right_on="dk"))
+        with pytest.raises(TypeError, match="string"):
+            p.run(f)
+
+    def test_under_covering_domain_drops_rows(self, rng):
+        # Explicit hint (0, 2) but k1 holds values up to 4: rows outside
+        # the hinted domain are dropped, never aliased into other cells.
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k1"], [("v64", "sum", "s"),
+                                        ("v64", "count", "n")],
+                               domains={"k1": (0, 2)})
+        got = p.run(t)
+        # nulls keep their own group; only out-of-domain VALUES drop (the
+        # fill_null keeps null rows past the oracle's filter).
+        in_dom = (col("k1").fill_null(0) >= 0) & (col("k1").fill_null(0) <= 2)
+        want = run_plan_eager(
+            plan().filter(in_dom)
+            .groupby_agg(["k1"], [("v64", "sum", "s"), ("v64", "count", "n")]),
+            t)
+        assert_tables_equal(want, got)
+
+    def test_null_keys_never_match(self, rng):
+        f = Table([("fk", Column.from_pylist([1, None, 3, 99], dt.INT64)),
+                   ("fv", Column.from_numpy(np.ones(4)))])
+        d = Table([("dk", Column.from_pylist([1, 3, None], dt.INT64)),
+                   ("dv", Column.from_numpy(np.arange(3.0)))])
+        p = plan().join_broadcast(d, left_on="fk", right_on="dk", how="left")
+        _check(p, f)
+
+
 class TestSortLimit:
     def test_sort_desc_nulls(self, rng):
         t = _mixed_table(rng)
