@@ -101,6 +101,85 @@ def main():
     print(json.dumps({"metric": "fact_dim_join_agg_4M",
                       "value": round(N / dt_j, 1), "unit": "rows/sec"}))
 
+    bench_plans(lineitem, fact, dim)
+
+
+def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
+    """Device-chained throughput of a compiled plan (zero host syncs in
+    the loop: each iteration's input derives from the previous output on
+    device) plus the materializing ``run`` form (one sync)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec.compile import _Bound, _compiled_for
+
+    n = table.num_rows
+    bound = _Bound(p, table)
+    fn = _compiled_for(bound)
+
+    @jax.jit
+    def perturb(x, leaf):
+        return x + (leaf.ravel()[-1:].astype(x.dtype) * 0 +
+                    (leaf.ravel()[-1:] != 0).astype(x.dtype))
+
+    cols = dict(bound.exec_cols)
+    out_cols, _ = fn(cols, bound.side_inputs)
+    leaf = out_cols[leaf_col].data
+    cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
+                             dtype=cols[chain_col].dtype)
+    out_cols, _ = fn(cols, bound.side_inputs)
+    leaf = out_cols[leaf_col].data
+    _ = np.asarray(leaf[-1:])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
+                                 dtype=cols[chain_col].dtype)
+        out_cols, _ = fn(cols, bound.side_inputs)
+        leaf = out_cols[leaf_col].data
+    _ = np.asarray(leaf[-1:])
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({"metric": f"{name}_plan_chained",
+                      "value": round(n / dt, 1), "unit": "rows/sec"}))
+
+    p.run(table)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        p.run(table)
+    dt = (time.perf_counter() - t0) / 3
+    print(json.dumps({"metric": f"{name}_plan_run",
+                      "value": round(n / dt, 1), "unit": "rows/sec"}))
+
+
+def bench_plans(lineitem, fact, dim):
+    """Whole-plan-compiler forms of the same two query shapes."""
+    from spark_rapids_tpu.exec import col, plan
+
+    q1 = (plan()
+          .filter(col("shipdate") <= 10_500)
+          .with_columns(disc_price=col("price") * (1 - col("disc")))
+          .with_columns(charge=col("disc_price") * (1 + col("tax")))
+          .groupby_agg(["flag", "status"],
+                       [("qty", "sum", "sum_qty"),
+                        ("price", "sum", "sum_price"),
+                        ("disc_price", "sum", "sum_disc_price"),
+                        ("charge", "sum", "sum_charge"),
+                        ("qty", "mean", "avg_qty"),
+                        ("disc", "mean", "avg_disc"),
+                        ("qty", "count", "n")])
+          .sort_by(["flag", "status"]))
+    _bench_compiled("tpch_q1_4M", q1, lineitem,
+                    chain_col="qty", leaf_col="sum_qty")
+
+    pj = (plan()
+          .join_broadcast(dim.rename({"k": "dk"}), left_on="k",
+                          right_on="dk")
+          .groupby_agg(["cat"], [("rev", "sum", "rev_sum"),
+                                 ("rev", "count", "n")])
+          .sort_by(["cat"]))
+    _bench_compiled("fact_dim_join_agg_4M", pj, fact,
+                    chain_col="rev", leaf_col="rev_sum")
+
 
 if __name__ == "__main__":
     main()

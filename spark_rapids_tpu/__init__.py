@@ -25,6 +25,7 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 from . import dtypes  # noqa: E402
+from . import exec  # noqa: E402  (whole-plan compiler)
 from .column import Column  # noqa: E402
 from .table import Table, assert_tables_equal  # noqa: E402
 from .dtypes import DType, TypeId  # noqa: E402
@@ -38,5 +39,6 @@ __all__ = [
     "TypeId",
     "assert_tables_equal",
     "dtypes",
+    "exec",
     "__version__",
 ]

@@ -137,10 +137,13 @@ def _dict_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
 class _Bound:
     """Everything needed to run a plan against one input signature."""
 
-    def __init__(self, plan: Plan, table: Table):
+    def __init__(self, plan: Plan, table: Table, probe_mask=None):
         self.plan = plan
         self.n = table.num_rows
         self.input_names = tuple(table.names)
+        #: restricts stats probes to live rows (a DistTable's row mask —
+        #: zero-filled padding slots must not widen key domains)
+        self.probe_mask = probe_mask
         self.exec_cols: dict[str, Column] = {}   # traced program inputs
         #: non-row-aligned program inputs (join probe structures, build-side
         #: payload columns) — kept out of the row-state dict so row-wise
@@ -328,7 +331,10 @@ class _Bound:
             elif (src is not None and src.offsets is None
                   and src.dtype.is_integer and not src.dtype.is_decimal
                   and not src.dtype.is_timestamp):
-                rng = column_int_range(src)
+                mask = (self.probe_mask
+                        if src.size == self.n and self.probe_mask is not None
+                        else None)
+                rng = column_int_range(src, extra_mask=mask)
                 if rng is None or rng[1] - rng[0] + 1 > DENSE_MAX_CELLS:
                     dense = False
                 else:
@@ -474,7 +480,23 @@ def _dense_slot(col: Column, km: _KeyMeta) -> tuple[jax.Array, jax.Array]:
 DENSE_CHUNK_ROWS = 131072
 
 
-def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
+def _psum_gather(v: jax.Array, axis: str, axis_size: int) -> jax.Array:
+    """all_gather expressed as one psum: shard i contributes row i of a
+    zero (P, ...) buffer.  The target TPU compile stack lowers only SUM
+    all-reduces (pmin/pmax/all_gather fail AOT lowering), so every
+    cross-shard merge must reduce to psum; the buffers here are
+    (shards, cells)-sized — bytes, not rows."""
+    idx = jax.lax.axis_index(axis)
+    buf = jnp.zeros((axis_size,) + v.shape, v.dtype).at[idx].set(v)
+    return jax.lax.psum(buf, axis)
+
+
+def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
+                       axis: Optional[str] = None,
+                       axis_size: int = 1):
+    """Dense-cell aggregation; with ``axis`` the accumulators are merged
+    across mesh shards by psum-based collectives — the whole distributed
+    group-by is (cells,)-sized traffic, no shuffle."""
     n = next(iter(cols.values())).size
     G = meta.cells
     strides = []
@@ -599,6 +621,21 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta):
         return out, None
 
     acc, _ = jax.lax.scan(body, init, xs)
+    if axis is not None:
+        merged = {}
+        for k, v in acc.items():
+            if k.startswith("min:"):
+                merged[k] = _psum_gather(v, axis, axis_size).min(axis=0)
+            elif k.startswith("max:"):
+                merged[k] = _psum_gather(v, axis, axis_size).max(axis=0)
+            elif k.startswith("firstpos:") or k.startswith("lastpos:"):
+                raise TypeError(
+                    "first/last aggregations are not defined across shards "
+                    "(row positions are shard-local); aggregate locally or "
+                    "drop them from the distributed plan")
+            else:                       # count_all / count / sum / sumsq
+                merged[k] = jax.lax.psum(v, axis)
+        acc = merged
     counts_all = acc["count_all"]
 
     out: dict[str, Column] = {}
@@ -679,13 +716,23 @@ _DECODED_DICTS: dict = {}
 
 
 def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
-              join_metas: tuple):
-    """Build the traced function for a plan (independent of concrete data)."""
+              join_metas: tuple, axis: Optional[str] = None,
+              axis_size: int = 1):
+    """Build the traced function for a plan (independent of concrete data).
+
+    With ``axis`` the program runs per-shard under ``shard_map`` over
+    row-sharded inputs: the first (dense) group-by merges its accumulators
+    with mesh collectives, after which state is replicated and every later
+    step runs identically on all shards.  Steps that would need a global
+    view of still-sharded rows raise at trace time.
+    """
     from .join import trace_join
 
-    def program(cols: dict[str, Column], side: dict[str, Column]):
-        sel = None
+    def program(cols: dict[str, Column], side: dict[str, Column],
+                init_sel=None):
+        sel = init_sel
         gi = ji = 0
+        sharded = axis is not None
         for step in steps:
             if isinstance(step, FilterStep):
                 cols, sel = _trace_filter(cols, sel, step)
@@ -694,22 +741,40 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
             elif isinstance(step, GroupAggStep):
                 meta = group_metas[gi]
                 gi += 1
-                if meta.dense:
-                    cols, sel = _trace_group_dense(cols, sel, step, meta)
-                else:
+                if not meta.dense:
+                    if sharded:
+                        raise TypeError(
+                            "distributed plans need a dense-domain group-by "
+                            "(small static key domains); use "
+                            "parallel.dist_groupby for the shuffle-based "
+                            "general case")
                     cols, sel = _trace_group_sorted(cols, sel, step, meta)
+                else:
+                    cols, sel = _trace_group_dense(
+                        cols, sel, step, meta,
+                        axis=axis if sharded else None,
+                        axis_size=axis_size)
+                sharded = False
             elif step is _JOIN_MARKER:
                 cols, sel = trace_join(cols, sel, side, join_metas[ji])
                 ji += 1
             elif isinstance(step, SortStep):
+                if sharded:
+                    raise TypeError(
+                        "global sort of still-sharded rows is not supported "
+                        "in a distributed plan; aggregate first")
                 cols, sel = _trace_sort(cols, sel, step)
             elif isinstance(step, LimitStep):
+                if sharded:
+                    raise TypeError(
+                        "limit over still-sharded rows is not supported in "
+                        "a distributed plan; aggregate first")
                 cols, sel = _trace_limit(cols, sel, step)
             else:
                 raise TypeError(f"unknown plan step {step!r}")
         return cols, sel
 
-    return jax.jit(program)
+    return program if axis is not None else jax.jit(program)
 
 
 def _compiled_for(bound: _Bound):
@@ -764,6 +829,12 @@ def run_plan(plan: Plan, table: Table) -> Table:
     bound = _Bound(plan, table)
     fn = _compiled_for(bound)
     out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
+    return materialize(bound, out_cols, sel)
+
+
+def materialize(bound: _Bound, out_cols: dict[str, Column], sel) -> Table:
+    """Compact padded program outputs (ONE host sync when ``sel`` is set)
+    and rebuild the user-visible table."""
     if sel is None:
         return _rebuild(bound, out_cols)
     from ..ops.common import pow2_bucket

@@ -191,6 +191,14 @@ class Plan:
         from .compile import run_plan_padded
         return run_plan_padded(self, table)
 
+    def run_dist(self, dist, mesh):
+        """Execute against a row-sharded :class:`..parallel.mesh.DistTable`
+        over ``mesh``: the per-shard program runs under ``shard_map`` and
+        the dense group-by merges with mesh collectives (no shuffle).  See
+        :mod:`.dist` for the plan-shape contract."""
+        from .dist import run_plan_dist
+        return run_plan_dist(self, dist, mesh)
+
 
 def plan() -> Plan:
     """Start an empty pipeline: ``plan().filter(...).groupby_agg(...)``."""

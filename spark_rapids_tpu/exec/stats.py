@@ -45,15 +45,19 @@ def _guarded_cache_put(cache: dict, key, buffers, value) -> None:
     cache[key] = (refs, value)
 
 
-def column_int_range(col: Column) -> Optional[tuple[int, int]]:
+def column_int_range(col: Column,
+                     extra_mask=None) -> Optional[tuple[int, int]]:
     """(min, max) over valid rows of an integer/bool column, cached.
 
+    ``extra_mask`` restricts the probe to its True rows (a sharded
+    table's live-row mask: padding slots must not widen the domain).
     Returns None for empty/all-null columns (no dense domain exists).
-    Costs one host sync on first probe of a given (data, validity) buffer
-    pair.
+    Costs one host sync on first probe of a given (data, validity[,
+    mask]) buffer set.
     """
     data = col.data
-    buffers = (data,) if col.validity is None else (data, col.validity)
+    buffers = tuple(b for b in (data, col.validity, extra_mask)
+                    if b is not None)
     key = tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_CACHE, key, buffers)
     if hit is not None:
@@ -62,6 +66,8 @@ def column_int_range(col: Column) -> Optional[tuple[int, int]]:
     if col.size == 0:
         return None
     valid = col.validity
+    if extra_mask is not None:
+        valid = extra_mask if valid is None else (valid & extra_mask)
     if valid is not None:
         lo = jnp.min(jnp.where(valid, data, jnp.iinfo(data.dtype).max))
         hi = jnp.max(jnp.where(valid, data, jnp.iinfo(data.dtype).min))
