@@ -53,6 +53,18 @@ def rows_impl() -> str:
     return val
 
 
+def dense_groupby_max_cells() -> int:
+    """Cell cap for the plan compiler's dense group-by path (beyond it the
+    sorted fallback wins); tune per workload with SRT_DENSE_MAX_CELLS."""
+    raw = os.environ.get("SRT_DENSE_MAX_CELLS")
+    if raw is None:
+        return 256
+    val = int(raw)
+    if val < 1:
+        raise ValueError(f"SRT_DENSE_MAX_CELLS must be >= 1, got {val}")
+    return val
+
+
 def native_lib_override() -> str | None:
     """Explicit native-library path, or None for the packaged/dev build."""
     return os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB") or None
@@ -88,5 +100,6 @@ def knob_table() -> dict[str, str]:
     """Current values of every knob (for diagnostics / bug reports)."""
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_LEAK_DEBUG",
-             "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL")
+             "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL",
+             "SRT_DENSE_MAX_CELLS")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -55,11 +55,12 @@ from .expr import Col, evaluate
 from .plan import (FilterStep, GroupAggStep, JoinStep, LimitStep, Plan,
                    ProjectStep, SortStep)
 
-#: Max dense group-by cells. Aggregation traffic scales with cells x rows
-#: (each reduction streams a (cells, rows) broadcast), so past a few
-#: hundred cells the sorted path wins; 256 keeps the dense path within
-#: ~2x of its cells=8 cost at 4M rows on v5e.
-DENSE_MAX_CELLS = 256
+def _dense_max_cells() -> int:
+    """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
+    Aggregation work scales with cells x rows, so past a few hundred
+    cells the sorted path wins."""
+    from ..config import dense_groupby_max_cells
+    return dense_groupby_max_cells()
 
 _ROWID = "__rowid__"
 
@@ -286,6 +287,13 @@ class _Bound:
                         data=src.valid_mask().astype(jnp.int8),
                         validity=src.validity, dtype=DType(TypeId.INT8))
                 new_aggs.append((surrogate, how, out_name))
+            elif how == "nunique":
+                # Distinct strings == distinct dictionary codes.
+                surrogate = f"__codes__:{value_name}"
+                if surrogate not in self.exec_cols:
+                    codes, _uniq = _dict_encode_cached(src)
+                    self.exec_cols[surrogate] = codes
+                new_aggs.append((surrogate, how, out_name))
             else:
                 raise TypeError(
                     f"aggregation {how!r} is not defined for strings "
@@ -298,7 +306,9 @@ class _Bound:
                     passthrough: set[str]) -> _GroupMeta:
         from .stats import column_int_range
         keys: list[_KeyMeta] = []
-        dense = True
+        # nunique needs its own (keys, value) sort order; the sorted path
+        # hosts it.
+        dense = not any(how == "nunique" for _, how, _ in step.aggs)
         sizes: list[int] = []
         for name, hint in zip(step.keys, step.domains):
             dictionary = self.dictionaries.get(name)
@@ -328,14 +338,16 @@ class _Bound:
                 lo, hi = hint
             elif src is not None and src.dtype == BOOL8:
                 lo, hi = 0, 1
-            elif (src is not None and src.offsets is None
+            elif (dense and src is not None and src.offsets is None
                   and src.dtype.is_integer and not src.dtype.is_decimal
                   and not src.dtype.is_timestamp):
+                # Probe only while dense is still possible — each first
+                # probe is a blocking host sync.
                 mask = (self.probe_mask
                         if src.size == self.n and self.probe_mask is not None
                         else None)
                 rng = column_int_range(src, extra_mask=mask)
-                if rng is None or rng[1] - rng[0] + 1 > DENSE_MAX_CELLS:
+                if rng is None or rng[1] - rng[0] + 1 > _dense_max_cells():
                     dense = False
                 else:
                     lo, hi = rng
@@ -349,7 +361,7 @@ class _Bound:
         cells = 1
         for s in sizes:
             cells *= s
-        if cells > DENSE_MAX_CELLS:
+        if cells > _dense_max_cells():
             dense = False
         return _GroupMeta(dense, tuple(keys), tuple(sizes), cells)
 
@@ -387,8 +399,13 @@ def _trace_filter(cols, sel, step: FilterStep):
 
 def _trace_project(cols, sel, step: ProjectStep):
     new = dict(cols) if not step.narrow else {}
-    if step.narrow and _ROWID in cols:
-        new[_ROWID] = cols[_ROWID]
+    if step.narrow:
+        # Hidden engine columns (rowid indirection, string-agg surrogates,
+        # join rowids) always survive narrowing — they carry state the
+        # user-visible schema doesn't show.
+        for nm in cols:
+            if nm.startswith("__"):
+                new[nm] = cols[nm]
     for name, e in step.cols:
         if isinstance(e, Col) and e.name == name and name not in cols:
             continue          # deferred string passthrough (rowid-carried)

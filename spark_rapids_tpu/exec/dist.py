@@ -51,6 +51,12 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     """Execute ``plan`` against a row-sharded table on ``mesh``."""
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
+    if dist.num_rows() == 0:
+        # Degenerate shapes break trace-time assumptions (and the probe
+        # under an all-False mask); mirror run_plan's eager fallback.
+        from ..parallel.mesh import collect
+        from .compile import run_plan_eager
+        return run_plan_eager(plan, collect(dist))
     table = dist.table
     bound = _Bound(plan, table, probe_mask=dist.row_mask)
     if bound.string_cols or bound.dictionaries:

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
-from ..ops.common import adjacent_differs, grouping_sort_operands
+from ..ops.common import (adjacent_differs, distinct_run_heads,
+                          grouping_sort_operands)
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .plan import GroupAggStep
 
@@ -61,6 +62,43 @@ def _segmented_scan_multi(fields: dict[str, tuple[jax.Array, str]],
     return out
 
 
+def _nunique_padded(cols: dict[str, Column], sel, key_names,
+                    value_name: str) -> jax.Array:
+    """Per-group distinct non-null value counts, padded to n, in group-rank
+    order (sorted keys — aligned with the main kernel's output slots).
+
+    Own ``lax.sort`` over (selection, keys..., value): a distinct-run head
+    is a live, valid row whose (key, value) pair differs from its
+    predecessor."""
+    n = next(iter(cols.values())).size
+    iota = jnp.arange(n, dtype=jnp.int32)
+    key_cols = [cols[k] for k in key_names]
+    key_ops = grouping_sort_operands(
+        tuple(c.data for c in key_cols),
+        tuple(c.validity for c in key_cols))
+    vcol = cols[value_name]
+    val_ops = grouping_sort_operands((vcol.data,), (vcol.validity,))
+    ops_list = list(key_ops) + list(val_ops)
+    if sel is not None:
+        ops_list = [jnp.where(sel, jnp.uint8(0), jnp.uint8(1))] + ops_list
+    sorted_all = jax.lax.sort(ops_list, dimension=0, is_stable=False,
+                              num_keys=len(ops_list))
+    off = 1 if sel is not None else 0
+    live = (sorted_all[0] == 0) if sel is not None else None
+    key_boundary, head = distinct_run_heads(
+        sorted_all[off:off + len(key_ops)],
+        sorted_all[off + len(key_ops):], live=live)
+
+    scans = _segmented_scan_multi(
+        {"h": (head.astype(jnp.int64), "add")}, key_boundary)
+    starts = jax.lax.sort(
+        [jnp.where(key_boundary, iota, jnp.int32(n))], dimension=0,
+        is_stable=False, num_keys=1)[0]
+    ends = jnp.clip(jnp.concatenate(
+        [starts[1:], jnp.array([n], jnp.int32)]) - 1, 0, n - 1)
+    return jnp.take(scans["h"], ends)
+
+
 def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
     n = next(iter(cols.values())).size
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -78,8 +116,10 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
     pay_names: list[str] = []
     for k in step.keys:
         pay_names.append(k)
-    for value_name, _, _ in step.aggs:
-        if value_name not in pay_names:
+    non_nunique = {vn for vn, how, _ in step.aggs if how != "nunique"}
+    for value_name, how, _ in step.aggs:
+        # nunique re-sorts its value column in its own kernel
+        if value_name not in pay_names and value_name in non_nunique:
             pay_names.append(value_name)
     payload: list[jax.Array] = []
     layout: list[bool] = []
@@ -133,6 +173,8 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
 
     need_last = False
     for value_name, how, _ in step.aggs:
+        if how == "nunique":
+            continue
         c = sorted_cols[value_name]
         if how == "count_all" and "ca" not in fields:
             fields["ca"] = (live.astype(jnp.int64), "add")
@@ -180,7 +222,15 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
             else jnp.take(c.validity, g_starts),
             dtype=c.dtype)
 
+    nunique_cache: dict[str, jax.Array] = {}
     for value_name, how, out_name in step.aggs:
+        if how == "nunique":
+            if value_name not in nunique_cache:
+                nunique_cache[value_name] = _nunique_padded(
+                    cols, sel, step.keys, value_name)
+            out[out_name] = Column(data=nunique_cache[value_name],
+                                   dtype=_agg_out_dtype(None, "nunique"))
+            continue
         c = sorted_cols[value_name]
         dtype = c.dtype
         out_dtype = _agg_out_dtype(dtype, how)

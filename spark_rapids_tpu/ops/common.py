@@ -95,6 +95,32 @@ def grouping_sort_operands(datas, valids) -> list[jax.Array]:
     return ops
 
 
+def distinct_run_heads(sorted_key_ops, sorted_val_ops, live=None):
+    """(group boundary, distinct-value head) masks over rows sorted by
+    (keys..., value) grouping operands.
+
+    The single definition of nunique equality (null == null, NaN == NaN
+    via the grouping operands; null VALUES excluded — cuDF default),
+    shared by the eager groupby kernel and the plan compiler's sorted
+    kernel.  A head is a live, valid row whose (key, value) pair differs
+    from its predecessor.  ``live`` masks filtered-out rows (they must be
+    sorted to the end by a leading rank operand).
+    """
+    n = sorted_val_ops[0].shape[0]
+    key_boundary = jnp.zeros(n, jnp.bool_)
+    for op in sorted_key_ops:
+        key_boundary = key_boundary | adjacent_differs(op)
+    if live is not None:
+        key_boundary = key_boundary & live
+    pair_boundary = key_boundary
+    for op in sorted_val_ops:
+        pair_boundary = pair_boundary | adjacent_differs(op)
+    valid = sorted_val_ops[0] == 1          # value null-rank: 1 = valid
+    if live is not None:
+        valid = valid & live
+    return key_boundary, pair_boundary & valid
+
+
 def concat_columns(pieces: list[Column]) -> Column:
     """Concatenate columns of one dtype (cudf ``concatenate`` equivalent).
 

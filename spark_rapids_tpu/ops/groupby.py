@@ -31,7 +31,7 @@ from .common import grouping_columns, pow2_bucket
 
 #: Aggregations supported (cuDF basic set).
 AGGS = ("count", "count_all", "sum", "min", "max", "mean", "first", "last",
-        "var", "std")
+        "var", "std", "nunique")
 
 
 def _sum_dtype(dtype: DType) -> DType:
@@ -114,6 +114,8 @@ def groupby_agg(table: Table, keys: Sequence[str],
 
     for value_name, how, _ in aggs:
         col = table[value_name]
+        if how == "nunique":
+            continue                      # dedicated kernel (own sort order)
         if col.offsets is not None:
             if how in ("first", "last"):
                 continue
@@ -142,6 +144,8 @@ def groupby_agg(table: Table, keys: Sequence[str],
     spec = []
     for value_name, how, _ in aggs:
         col = table[value_name]
+        if how == "nunique":
+            continue
         if col.offsets is not None:
             if how in ("count", "count_all"):
                 spec.append((pay_names.index(f"__validity__:{value_name}"),
@@ -164,6 +168,15 @@ def groupby_agg(table: Table, keys: Sequence[str],
     ri = 0
     for value_name, how, out_name in aggs:
         col = table[value_name]
+        if how == "nunique":
+            vcol = grouping_columns([col])[0]
+            counts = _groupby_nunique(
+                tuple(kc.data for kc in key_cols),
+                tuple(kc.validity for kc in key_cols),
+                vcol.data, vcol.validity, seg_count=seg_count)
+            out.append((out_name, Column(data=counts[:num_groups],
+                                         dtype=INT64)))
+            continue
         if col.offsets is not None and how in ("first", "last"):
             idx = starts if how == "first" else ends
             out.append((out_name, col.gather(jnp.take(perm, idx))))
@@ -218,6 +231,31 @@ def _groupby_sort(key_datas, key_valids, pay_datas, pay_valids):
     return perm, tuple(sorted_pay), boundary, count
 
 
+@functools.partial(jax.jit, static_argnames=("seg_count",))
+def _groupby_nunique(key_datas, key_valids, value_data, value_valid, *,
+                     seg_count):
+    """Distinct non-null values per group (cuDF ``nunique``, nulls
+    excluded).
+
+    Own sort order — (keys..., value) — so it cannot ride the shared
+    groupby sort: a distinct-run head is a VALID row whose (key, value)
+    pair differs from the previous row; per-group counts are segment sums
+    of head flags.  Group order matches the main groupby kernel (sorted
+    keys), so results align positionally."""
+    from .common import distinct_run_heads, grouping_sort_operands
+    key_ops = grouping_sort_operands(key_datas, key_valids)
+    val_ops = grouping_sort_operands((value_data,), (value_valid,))
+    sorted_all = jax.lax.sort(key_ops + val_ops, dimension=0, is_stable=False,
+                              num_keys=len(key_ops) + len(val_ops))
+    key_boundary, head = distinct_run_heads(
+        sorted_all[:len(key_ops)], sorted_all[len(key_ops):])
+
+    group_id = jnp.cumsum(key_boundary.astype(jnp.int32)) - 1
+    return jax.ops.segment_sum(head.astype(jnp.int64), group_id,
+                               num_segments=seg_count,
+                               indices_are_sorted=True)
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "seg_count"))
 def _groupby_aggregate(sorted_pay, boundary, *, spec, seg_count):
     """All aggregates in one program at the bucketed group count.
@@ -243,7 +281,7 @@ def _groupby_aggregate(sorted_pay, boundary, *, spec, seg_count):
 
 def _agg_out_dtype(dtype: DType, how: str) -> DType:
     """Result dtype per aggregation (host-side; mirrors _segment_agg)."""
-    if how in ("count", "count_all"):
+    if how in ("count", "count_all", "nunique"):
         return INT64
     if how == "sum":
         return _sum_dtype(dtype)
@@ -259,7 +297,7 @@ def _empty_result(table: Table, keys: Sequence[str],
         out.append((k, table[k]))
     for value_name, how, out_name in aggs:
         src = table[value_name]
-        if how in ("count", "count_all"):
+        if how in ("count", "count_all", "nunique"):
             dtype = INT64
         elif how == "sum":
             dtype = _sum_dtype(src.dtype)

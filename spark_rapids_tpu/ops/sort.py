@@ -53,6 +53,11 @@ def sort_operands(columns: Sequence[Column], ascending: Sequence[bool],
         val = _canonicalize_nan(col.data)
         if not asc:
             val = _descending_key(val)
+        if col.validity is not None:
+            # Null rows' payloads are undefined; mask them to a constant so
+            # ordering among nulls falls through to the NEXT key (and then
+            # to stability), never to garbage bytes.
+            val = jnp.where(col.validity, val, jnp.zeros((), val.dtype))
         ops.append(null_rank)
         ops.append(val)
     return ops

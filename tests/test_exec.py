@@ -198,6 +198,30 @@ class TestGroupBySorted:
         p = plan().groupby_agg(["k", "kf"], [("v", "sum", "s")])
         _check(p, t)
 
+    def test_nunique_forces_sorted_path(self, rng):
+        from spark_rapids_tpu.exec.compile import _Bound
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k1"], [("v64", "nunique", "nv"),
+                                        ("v64", "sum", "s")])
+        assert not _Bound(p, t).group_metas[0].dense
+        _check(p, t)
+
+    def test_nunique_with_filter_and_strings(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        p = (plan().filter(col("f64") > 0)
+             .groupby_agg(["k2"], [("s", "nunique", "ns"),
+                                   ("v64", "nunique", "nv")]))
+        _check(p, t)
+
+    def test_narrow_select_keeps_agg_surrogates(self, rng):
+        # A narrowing select before the group-by must not drop the hidden
+        # __codes__/__valid__ surrogate columns string aggs depend on.
+        t = _mixed_table(rng, with_strings=True)
+        p = (plan().select("k1", "s")
+             .groupby_agg(["k1"], [("s", "nunique", "ns"),
+                                   ("s", "count", "sc")]))
+        _check(p, t)
+
 
 class TestBroadcastJoin:
     def _dim(self, rng, d=50, dense=True, with_strings=False):

@@ -117,6 +117,15 @@ class TestDistPlans:
         assert_tables_equal(want, got1)
         assert_tables_equal(want, got2)
 
+    def test_empty_dist_table(self, rng, mesh):
+        # shard_table pads an empty table to capacity with zero live rows;
+        # the runner must fall back to the eager empty result, not raise.
+        t = _table(rng, n=16).gather(np.zeros(0, np.int32))
+        d0 = shard_table(t, mesh, capacity=2)
+        p = plan().groupby_agg(["k1"], [("v", "sum", "s")])
+        out = p.run_dist(d0, mesh)
+        assert out.num_rows == 0
+
     def test_first_across_shards_raises(self, rng, mesh):
         t = _table(rng)
         p = plan().groupby_agg(["k1"], [("v", "first", "vf")])

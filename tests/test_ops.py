@@ -163,6 +163,18 @@ class TestSort:
         assert got["b"] == exp["b"].tolist()
 
 
+class TestNullTieBreak:
+    def test_secondary_key_orders_null_primary_rows(self):
+        # Among rows whose PRIMARY key is null, ordering must fall through
+        # to the secondary key — not to the null rows' undefined payloads.
+        t = Table.from_pydict(
+            {"a": [None, None, None, 1], "b": [3, 1, 2, 0]},
+            dtypes={"a": dt.INT64, "b": dt.INT32})
+        out = ops.sort_by(t, ["a", "b"]).to_pydict()
+        assert out["a"] == [None, None, None, 1]
+        assert out["b"] == [1, 2, 3, 0]
+
+
 class TestGroupBy:
     def test_basic_aggs(self):
         t = Table.from_pydict({"k": [1, 2, 1, 2, 1], "v": [10, 20, 30, None, 50]},
@@ -197,6 +209,43 @@ class TestGroupBy:
         out = ops.groupby(t, "k").agg({"v": ["first", "last"]})
         assert out.to_pydict()["v_first"] == [10, 30]
         assert out.to_pydict()["v_last"] == [20, 30]
+
+    def test_nunique(self):
+        t = Table.from_pydict(
+            {"k": [1, 1, 1, 2, 2, None, None],
+             "v": [10, 10, 20, 30, None, 10, None]},
+            dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby(t, "k").agg({"v": ["nunique", "count"]})
+        # null key rows form their own group; null VALUES are excluded
+        # from the distinct count (cuDF nunique default).
+        assert out.to_pydict() == {
+            "k": [None, 1, 2],
+            "v_nunique": [1, 2, 1],
+            "v_count": [1, 3, 1],
+        }
+
+    def test_nunique_random_vs_numpy(self, rng=None):
+        import numpy as np
+        rng = np.random.default_rng(11)
+        n = 5000
+        k = rng.integers(0, 40, n)
+        v = rng.integers(0, 25, n)
+        vmask = rng.random(n) > 0.2
+        t = Table([
+            ("k", Column.from_numpy(k.astype(np.int64))),
+            ("v", Column.from_numpy(v.astype(np.int64), validity=vmask)),
+        ])
+        out = ops.groupby_agg(t, ["k"], [("v", "nunique", "nv")]).to_pydict()
+        for key, got in zip(out["k"], out["nv"]):
+            want = len(set(v[(k == key) & vmask]))
+            assert got == want, (key, got, want)
+
+    def test_nunique_strings(self):
+        t = Table.from_pydict(
+            {"k": [1, 1, 1, 2], "s": ["a", "b", "a", None]},
+            dtypes={"k": dt.INT32, "s": dt.STRING})
+        out = ops.groupby_agg(t, ["k"], [("s", "nunique", "ns")])
+        assert out.to_pydict()["ns"] == [2, 0]
 
     def test_multi_key(self):
         t = Table.from_pydict({"a": [1, 1, 2, 2], "b": [1, 2, 1, 1],
