@@ -1,0 +1,156 @@
+"""TPC-DS query-shape battery over the whole-plan compiler.
+
+Scaffolding toward BASELINE.json config #5 ("distributed shuffle: full
+TPC-DS SF1000 99-query sweep"): synthetic columns with TPC-DS-like
+cardinalities, and a battery of the query *shapes* that dominate the
+suite — star-join aggregations, multi-bucket scans, count-distinct — each
+compiled to one XLA program and measured with the tunnel-safe protocol
+(device-chained inputs, one host-read fence; see BASELINE.md).
+
+Every shape prints one JSON line: {"metric", "value", "unit"}.
+
+Scale with SRT_BENCH_ROWS (default 4M fact rows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N = int(os.environ.get("SRT_BENCH_ROWS", 4_000_000))
+REPS = 8
+
+
+def make_data(rng):
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+
+    # store_sales-ish fact: surrogate keys into small dims, measures.
+    fact = srt.Table([
+        ("date_sk", Column.from_numpy(rng.integers(0, 1826, N).astype(np.int64))),
+        ("item_sk", Column.from_numpy(rng.integers(0, 18000, N).astype(np.int64))),
+        ("store_sk", Column.from_numpy(rng.integers(0, 100, N).astype(np.int8))),
+        ("qty", Column.from_numpy(rng.integers(1, 100, N).astype(np.int64),
+                                  validity=rng.random(N) > 0.04)),
+        ("price", Column.from_numpy(np.round(rng.uniform(1, 300, N), 2))),
+        ("profit", Column.from_numpy(rng.normal(20, 40, N))),
+    ])
+    date_dim = srt.Table([
+        ("d_date_sk", Column.from_numpy(np.arange(1826, dtype=np.int64))),
+        ("d_year", Column.from_numpy(
+            (2019 + np.arange(1826) // 365).astype(np.int32))),
+        ("d_moy", Column.from_numpy(
+            (1 + (np.arange(1826) // 30) % 12).astype(np.int8))),
+    ])
+    item_dim = srt.Table([
+        ("i_item_sk", Column.from_numpy(np.arange(18000, dtype=np.int64))),
+        ("i_brand_id", Column.from_numpy(
+            rng.integers(0, 120, 18000).astype(np.int32))),
+        ("i_category_id", Column.from_numpy(
+            rng.integers(0, 10, 18000).astype(np.int8))),
+    ])
+    return fact, date_dim, item_dim
+
+
+def bench_shape(name, p, table, chain_col, leaf_col):
+    import jax
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec.compile import _Bound, _compiled_for
+
+    bound = _Bound(p, table)
+    fn = _compiled_for(bound)
+
+    @jax.jit
+    def perturb(x, leaf):
+        return x + (leaf.ravel()[-1:].astype(x.dtype) * 0 +
+                    (leaf.ravel()[-1:] != 0).astype(x.dtype))
+
+    cols = dict(bound.exec_cols)
+    out_cols, _ = fn(cols, bound.side_inputs)
+    leaf = out_cols[leaf_col].data
+    cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
+                             validity=cols[chain_col].validity,
+                             dtype=cols[chain_col].dtype)
+    out_cols, _ = fn(cols, bound.side_inputs)
+    leaf = out_cols[leaf_col].data
+    _ = np.asarray(leaf.ravel()[-1:])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
+                                 validity=cols[chain_col].validity,
+                                 dtype=cols[chain_col].dtype)
+        out_cols, _ = fn(cols, bound.side_inputs)
+        leaf = out_cols[leaf_col].data
+    _ = np.asarray(leaf.ravel()[-1:])
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": name, "value": round(N / dt, 1),
+                      "unit": "rows/sec"}), flush=True)
+
+
+def main():
+    from spark_rapids_tpu.exec import col, plan
+
+    rng = np.random.default_rng(42)
+    fact, date_dim, item_dim = make_data(rng)
+
+    # q3 shape: star join (2 dims) -> filter -> groupby brand -> sort+limit
+    q3 = (plan()
+          .join_broadcast(date_dim, left_on="date_sk", right_on="d_date_sk")
+          .join_broadcast(item_dim, left_on="item_sk", right_on="i_item_sk")
+          .filter((col("d_year").eq(2021)) & (col("i_category_id").eq(3)))
+          .groupby_agg(["d_year", "i_brand_id"],
+                       [("profit", "sum", "sum_agg")])
+          .sort_by(["sum_agg", "i_brand_id"], ascending=[False, True])
+          .limit(100))
+    bench_shape("tpcds_q3_shape", q3, fact, "profit", "sum_agg")
+
+    # q7 shape: star join -> filter -> 4 avgs by category
+    q7 = (plan()
+          .join_broadcast(date_dim, left_on="date_sk", right_on="d_date_sk")
+          .join_broadcast(item_dim, left_on="item_sk", right_on="i_item_sk")
+          .filter(col("d_year").eq(2020))
+          .groupby_agg(["i_category_id"],
+                       [("qty", "mean", "agg1"),
+                        ("price", "mean", "agg2"),
+                        ("profit", "mean", "agg3"),
+                        ("qty", "count", "n")])
+          .sort_by(["i_category_id"]))
+    bench_shape("tpcds_q7_shape", q7, fact, "profit", "agg3")
+
+    # q28 shape: bucketed global aggregates (constant-key dense groupby)
+    q28 = (plan()
+           .filter((col("qty") >= 10) & (col("qty") <= 30))
+           .with_columns(bucket=col("qty") // 5)
+           .groupby_agg(["bucket"],
+                        [("price", "mean", "avg_p"),
+                         ("price", "count", "cnt"),
+                         ("price", "nunique", "distinct_p")],
+                        domains={"bucket": (2, 6)}))
+    bench_shape("tpcds_q28_shape", q28, fact, "price", "avg_p")
+
+    # q88 shape: many-bucket count scan (store x time-slot counts)
+    q88 = (plan()
+           .filter(col("qty") > 2)
+           .groupby_agg(["store_sk", "date_sk"], [("qty", "count", "n")],
+                        domains={"date_sk": (0, 1825)}))
+    bench_shape("tpcds_q88_shape_sorted", q88, fact, "qty", "n")
+
+    # q95-ish: join + count distinct items per store
+    q95 = (plan()
+           .join_broadcast(date_dim, left_on="date_sk", right_on="d_date_sk")
+           .filter(col("d_moy") <= 6)
+           .groupby_agg(["store_sk"],
+                        [("item_sk", "nunique", "distinct_items"),
+                         ("price", "sum", "total")]))
+    bench_shape("tpcds_q95_shape_nunique", q95, fact, "price", "total")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,26 @@ import jax as _jax
 # Must be set before any array is created.
 _jax.config.update("jax_enable_x64", True)
 
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache (config.compile_cache_dir): per-schema
+    query programs cost minutes to compile on TPU and sub-second on a
+    cross-process cache hit."""
+    from .config import compile_cache_dir
+    path = compile_cache_dir()
+    if path is None or _jax.config.jax_compilation_cache_dir:
+        return                        # disabled, or the user already chose
+    try:
+        import os as _os
+        _os.makedirs(path, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", path)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except OSError:
+        pass                          # unwritable cache home: run uncached
+
+
+_enable_compile_cache()
+
 from . import dtypes  # noqa: E402
 from . import exec  # noqa: E402  (whole-plan compiler)
 from .column import Column  # noqa: E402

@@ -53,6 +53,25 @@ def rows_impl() -> str:
     return val
 
 
+def compile_cache_dir() -> str | None:
+    """Persistent XLA compilation-cache directory, or None to disable.
+
+    Default: ``~/.cache/spark_rapids_tpu/xla``.  Set ``SRT_COMPILE_CACHE``
+    to a path to relocate it or to ``0``/``off`` to disable.  The engine's
+    compile-once execution model leans on this hard: per-schema query
+    programs measured minutes of XLA compile on TPU (BASELINE.md) and are
+    sub-second on a cache hit across processes — the analog of the
+    reference build's configure-once native cache (build-libcudf.xml:23-30).
+    """
+    raw = os.environ.get("SRT_COMPILE_CACHE")
+    if raw is not None and raw.strip().lower() in ("0", "off", "false", ""):
+        return None
+    if raw:
+        return raw
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "spark_rapids_tpu", "xla")
+
+
 def dense_groupby_max_cells() -> int:
     """Cell cap for the plan compiler's dense group-by path (beyond it the
     sorted fallback wins); tune per workload with SRT_DENSE_MAX_CELLS."""
@@ -101,5 +120,5 @@ def knob_table() -> dict[str, str]:
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_LEAK_DEBUG",
              "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL",
-             "SRT_DENSE_MAX_CELLS")
+             "SRT_DENSE_MAX_CELLS", "SRT_COMPILE_CACHE")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -42,6 +42,22 @@ from .plan import GroupAggStep, Plan
 
 _DIST_COMPILED: dict = {}
 
+# live-count cache per row-mask buffer identity: the empty-input guard
+# needs one host sync, but steady-state repeat runs over the same
+# DistTable must stay sync-free.
+_LIVE_COUNT: dict = {}
+
+
+def _live_count_cached(row_mask) -> int:
+    from .stats import _guarded_cache_get, _guarded_cache_put
+    key = (id(row_mask),)
+    hit = _guarded_cache_get(_LIVE_COUNT, key, (row_mask,))
+    if hit is not None:
+        return hit
+    count = int(jnp.sum(row_mask))
+    _guarded_cache_put(_LIVE_COUNT, key, (row_mask,), count)
+    return count
+
 
 def _ends_replicated(bound: _Bound) -> bool:
     return any(isinstance(s, GroupAggStep) for s in bound.steps)
@@ -51,7 +67,7 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     """Execute ``plan`` against a row-sharded table on ``mesh``."""
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
-    if dist.num_rows() == 0:
+    if _live_count_cached(dist.row_mask) == 0:
         # Degenerate shapes break trace-time assumptions (and the probe
         # under an all-False mask); mirror run_plan's eager fallback.
         from ..parallel.mesh import collect

@@ -118,14 +118,20 @@ def _unpacker(schema: tuple[DType, ...]):
 # -- public API ---------------------------------------------------------------
 
 def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
-            check_row_width: bool = True) -> list[RowBlob]:
-    """Convert a fixed-width table to row blobs.
+            check_row_width: bool = True) -> list:
+    """Convert a table to row blobs.
 
-    Returns one :class:`RowBlob` per batch; multiple blobs only when the total
-    byte size would exceed ``max_batch_bytes`` (reference contract:
+    Fixed-width schemas produce :class:`RowBlob`\\ s; schemas with string
+    columns produce :class:`.varwidth.VarRowBlob`\\ s (beyond the
+    reference, which fails on variable width — row_conversion.cu:514-516).
+    Returns one blob per batch; multiple blobs only when the total byte
+    size would exceed ``max_batch_bytes`` (reference contract:
     RowConversion.java:32-48).
     """
     schema = tuple(table.schema())
+    if any(dt.is_string for dt in schema):
+        from .varwidth import to_var_rows
+        return to_var_rows(table, max_batch_bytes=max_batch_bytes)
     layout, pack = _packer(schema)
     if check_row_width and layout.row_size > MAX_ROW_WIDTH:
         raise ValueError(
@@ -163,13 +169,21 @@ def from_rows(blobs: Union[Sequence[RowBlob], RowBlob], schema: Sequence[DType],
     ``to_rows`` time, as in RowConversionTest.java:46-49).  Multiple blobs are
     concatenated in order (the reference's batched-output inverse).
     """
-    if isinstance(blobs, RowBlob):
+    from .varwidth import VarRowBlob, unpack_var_rows
+    if isinstance(blobs, (RowBlob, VarRowBlob)):
         blobs = [blobs]
     schema = tuple(schema)
     if names is None:
         names = [f"c{i}" for i in range(len(schema))]
     elif len(names) != len(schema):
         raise ValueError(f"{len(names)} names for {len(schema)} schema columns")
+    if any(dt.is_string for dt in schema):
+        from ..ops.common import concat_tables
+        from .varwidth import empty_var_table
+        if not blobs:
+            return empty_var_table(schema, names)
+        parts = [unpack_var_rows(b, schema, names) for b in blobs]
+        return parts[0] if len(parts) == 1 else concat_tables(parts)
     layout, unpack = _unpacker(schema)
     W = layout.row_size // 4
     if not blobs:
