@@ -33,6 +33,15 @@ def _enable_compile_cache() -> None:
     path = compile_cache_dir()
     if path is None or _jax.config.jax_compilation_cache_dir:
         return                        # disabled, or the user already chose
+    platforms = _jax.config.jax_platforms or ""
+    if not platforms or platforms.startswith("cpu"):
+        # Cache only explicitly-configured accelerator platforms: CPU
+        # compiles are cheap, and XLA:CPU AOT artifacts bake in exact host
+        # machine features — reloading them on a slightly different host
+        # (shared ~/.cache, container images) warns about and risks
+        # SIGILL.  An unset platform may resolve to CPU, so it stays
+        # uncached too.
+        return
     try:
         import os as _os
         _os.makedirs(path, exist_ok=True)

@@ -130,7 +130,15 @@ def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
     """
     schema = tuple(table.schema())
     if any(dt.is_string for dt in schema):
-        from .varwidth import to_var_rows
+        from .varwidth import compute_var_layout, to_var_rows
+        if check_row_width:
+            fixed_size = compute_var_layout(schema).fixed.row_size
+            if fixed_size > MAX_ROW_WIDTH:
+                raise ValueError(
+                    f"Fixed row part {fixed_size} exceeds the "
+                    f"{MAX_ROW_WIDTH}-byte row format limit (pass "
+                    f"check_row_width=False to lift; the variable section "
+                    f"is exempt — rows are unbounded by design there)")
         return to_var_rows(table, max_batch_bytes=max_batch_bytes)
     layout, pack = _packer(schema)
     if check_row_width and layout.row_size > MAX_ROW_WIDTH:

@@ -48,6 +48,15 @@ from .image import pack_words, unpack_words
 _U32 = jnp.uint32
 
 
+def _pow2(n: int) -> int:
+    """Round up to a power of two — jitted pack/unpack programs are cached
+    per padded size class, so a stream of different-sized batches doesn't
+    recompile (minutes each on TPU) or grow the program cache unboundedly."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
 @dataclass(frozen=True)
 class VarLayout:
     """Static layout facts for a schema with string columns."""
@@ -137,8 +146,13 @@ def _row_var_geometry(layout: VarLayout, table: Table):
         at = at + ln
     var_total = at - layout.fixed.row_size
     row_sizes = layout.fixed.row_size + ((var_total + 7) & ~7)
+    # int64 offsets: a >2 GB total must surface for batching, not wrap
+    # (int32 cumsum overflow would silently corrupt); chunked_cumsum
+    # because whole-array cumsum is a compile/runtime cliff at millions of
+    # rows (ops.common.chunked_cumsum docstring).
+    from ..ops.common import chunked_cumsum
     row_offsets = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(row_sizes).astype(jnp.int32)])
+        [jnp.zeros(1, jnp.int64), chunked_cumsum(row_sizes.astype(jnp.int64))])
     return lens, starts, row_sizes, row_offsets
 
 
@@ -208,8 +222,11 @@ def pack_var_rows(table: Table) -> VarRowBlob:
     """Serialize a table with string columns into one variable-width blob.
 
     One host sync (the total byte size — inherently data dependent, like
-    the reference's batch sizing at row_conversion.cu:476-511).
+    the reference's batch sizing at row_conversion.cu:476-511).  Raises
+    when the blob would exceed the 2**31-byte contract — batch first
+    (``to_var_rows``).
     """
+    from .layout import MAX_BATCH_BYTES
     schema = tuple(table.schema())
     layout = compute_var_layout(schema)
     if table.num_rows == 0:
@@ -217,9 +234,14 @@ def pack_var_rows(table: Table) -> VarRowBlob:
                           offsets=jnp.zeros(1, jnp.int32))
     lens, starts, row_sizes, row_offsets = _row_var_geometry(layout, table)
     total_bytes = int(row_offsets[-1])                # the host sync
+    if total_bytes > MAX_BATCH_BYTES:
+        raise ValueError(
+            f"row blob would be {total_bytes} bytes (> 2**31-1); split into "
+            f"batches via to_rows/to_var_rows")
+    row_offsets = row_offsets.astype(jnp.int32)
     total_words = max(total_bytes // 4, 1)
 
-    _, pack = _var_packer(schema, total_words)
+    _, pack = _var_packer(schema, _pow2(total_words))
     str_offsets, str_chars = [], []
     for i in layout.var_cols:
         c = table.columns[i]
@@ -230,21 +252,25 @@ def pack_var_rows(table: Table) -> VarRowBlob:
     valids = tuple(c.valid_mask() for c in table.columns)
     words = pack(datas, valids, tuple(str_offsets), tuple(str_chars),
                  row_offsets, tuple(lens), tuple(starts))
-    return VarRowBlob(words=words, offsets=row_offsets)
+    return VarRowBlob(words=words[:total_words], offsets=row_offsets)
 
 
 @functools.lru_cache(maxsize=None)
-def _var_unpacker(schema: tuple[DType, ...], total_words: int, n: int,
-                  char_counts: tuple[int, ...]):
+def _var_unpacker(schema: tuple[DType, ...], words_bucket: int, n: int,
+                  char_buckets: tuple[int, ...]):
+    """Jitted unpack for one (schema, pow2-padded sizes) class.  Char
+    buffers come back padded to their bucket; the caller slices to the
+    exact counts it already synced."""
     layout = compute_var_layout(schema)
     Wf = layout.fixed.row_size // 4
 
     @jax.jit
     def unpack(words, row_offsets):
+        from ..ops.common import chunked_cumsum
         word_off = row_offsets // 4
         # Fixed part: gather each row's fixed words into the (Wf, n) image.
         idx = word_off[:-1][None, :] + jnp.arange(Wf, dtype=jnp.int32)[:, None]
-        image = jnp.take(words, jnp.clip(idx, 0, max(total_words - 1, 0)))
+        image = jnp.take(words, jnp.clip(idx, 0, max(words_bucket - 1, 0)))
         datas, valids = unpack_words(layout.fixed, image)
 
         # Parse string slots.
@@ -256,23 +282,20 @@ def _var_unpacker(schema: tuple[DType, ...], total_words: int, n: int,
             flen = jnp.where(valids[i], flen, 0)
             out_offsets = jnp.concatenate(
                 [jnp.zeros(1, jnp.int32),
-                 jnp.cumsum(flen).astype(jnp.int32)])
-            total_chars = char_counts[j]
+                 chunked_cumsum(flen)])
             # char c of the output buffer -> (row, intra) -> source byte
-            cpos = jnp.arange(max(total_chars, 1), dtype=jnp.int32)
+            cpos = jnp.arange(char_buckets[j], dtype=jnp.int32)
             crow = jnp.clip(
                 jnp.searchsorted(out_offsets, cpos,
                                  side="right").astype(jnp.int32) - 1,
-                0, n - 1) if n else jnp.zeros(max(total_chars, 1), jnp.int32)
+                0, n - 1) if n else jnp.zeros(char_buckets[j], jnp.int32)
             intra = cpos - jnp.take(out_offsets, crow)
             src_byte = (jnp.take(row_offsets[:-1], crow)
                         + jnp.take(foff, crow) + intra)
             w = jnp.take(words, jnp.clip(src_byte // 4, 0,
-                                         max(total_words - 1, 0)))
+                                         max(words_bucket - 1, 0)))
             ch = ((w >> ((src_byte % 4).astype(_U32) * _U32(8)))
                   & _U32(0xFF)).astype(jnp.uint8)
-            if total_chars == 0:
-                ch = ch[:0]
             outs.append((out_offsets, ch))
         return datas, valids, outs
 
@@ -351,14 +374,21 @@ def unpack_var_rows(blob: VarRowBlob, schema: Sequence[DType],
     # sums are exact.
     char_counts = tuple(int(s) for s in jax.device_get(sums)) if sums else ()
 
-    _, unpack = _var_unpacker(schema, total_words, n, char_counts)
-    datas, valids, str_outs = unpack(blob.words, blob.offsets)
+    words_bucket = _pow2(max(total_words, 1))
+    char_buckets = tuple(_pow2(max(c, 1)) for c in char_counts)
+    words = blob.words
+    if words.shape[0] < words_bucket:
+        words = jnp.concatenate(
+            [words, jnp.zeros(words_bucket - words.shape[0], _U32)])
+    _, unpack = _var_unpacker(schema, words_bucket, n, char_buckets)
+    datas, valids, str_outs = unpack(words, blob.offsets)
 
     columns = []
     si = 0
     for i, (name, dt) in enumerate(zip(names, schema)):
         if dt.is_string:
             out_offsets, chars = str_outs[si]
+            chars = chars[:char_counts[si]]
             si += 1
             validity = valids[i]
             columns.append((name, Column(data=chars, offsets=out_offsets,

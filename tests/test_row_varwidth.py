@@ -138,3 +138,40 @@ class TestVarRows:
     def test_fixed_only_schema_rejected(self):
         with pytest.raises(ValueError, match="no variable-width"):
             compute_var_layout((dt.INT64, dt.INT32))
+
+    def test_row_width_check_applies_to_fixed_part(self, rng):
+        cols = [(f"c{i}", Column.from_numpy(np.zeros(4, np.int64)))
+                for i in range(140)]                # fixed part > 1 KB
+        cols.append(("s", Column.from_pylist(["a"] * 4, dt.STRING)))
+        t = Table(cols)
+        with pytest.raises(ValueError, match="row format limit"):
+            convert.to_rows(t)
+        blobs = convert.to_rows(t, check_row_width=False)
+        back = convert.from_rows(blobs, [c.dtype for c in t.columns],
+                                 names=list(t.names))
+        assert_tables_equal(t, back)
+
+    def test_program_cache_bucketed(self, rng):
+        # Different batch sizes within one pow2 class share the jitted
+        # programs (a stream of batches must not recompile per size).
+        from spark_rapids_tpu.rows import varwidth as vw
+        t1 = _mixed_table(rng, n=200)
+        t2 = _mixed_table(rng, n=205)
+        convert.from_rows(convert.to_rows(t1), [c.dtype for c in t1.columns])
+        packs = vw._var_packer.cache_info().currsize
+        unpacks = vw._var_unpacker.cache_info().currsize
+        convert.from_rows(convert.to_rows(t2), [c.dtype for c in t2.columns])
+        assert vw._var_packer.cache_info().currsize == packs
+        # unpacker also keys on n (row count) which differs here; but char
+        # buckets/word buckets must not add entries beyond that
+        assert vw._var_unpacker.cache_info().currsize <= unpacks + 1
+
+
+class TestChunkedCumsum:
+    def test_matches_numpy(self, rng):
+        from spark_rapids_tpu.ops.common import chunked_cumsum
+        for n in (0, 1, 7, 62500, 62501, 200_003):
+            x = rng.integers(-5, 9, n)
+            got = np.asarray(chunked_cumsum(
+                Column.from_numpy(x.astype(np.int64)).data))
+            np.testing.assert_array_equal(got, np.cumsum(x))
