@@ -33,15 +33,24 @@ def _enable_compile_cache() -> None:
     path = compile_cache_dir()
     if path is None or _jax.config.jax_compilation_cache_dir:
         return                        # disabled, or the user already chose
+    # Cache accelerator platforms only: CPU compiles are cheap, and
+    # XLA:CPU AOT artifacts bake in exact host machine features —
+    # reloading them on a slightly different host (shared ~/.cache,
+    # container images) warns about and risks SIGILL.
     platforms = _jax.config.jax_platforms or ""
-    if not platforms or platforms.startswith("cpu"):
-        # Cache only explicitly-configured accelerator platforms: CPU
-        # compiles are cheap, and XLA:CPU AOT artifacts bake in exact host
-        # machine features — reloading them on a slightly different host
-        # (shared ~/.cache, container images) warns about and risks
-        # SIGILL.  An unset platform may resolve to CPU, so it stays
-        # uncached too.
-        return
+    if platforms:
+        # Explicit priority list: the first entry wins backend selection.
+        if platforms.split(",")[0].strip() == "cpu":
+            return
+    else:
+        # Unset: resolve the backend (the common TPU-host default).  This
+        # initializes the runtime, which package users pay on first array
+        # creation anyway.
+        try:
+            if _jax.default_backend() == "cpu":
+                return
+        except Exception:
+            return
     try:
         import os as _os
         _os.makedirs(path, exist_ok=True)

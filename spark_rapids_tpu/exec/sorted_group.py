@@ -37,58 +37,13 @@ from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .plan import GroupAggStep
 
 
-_COMBINES = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
-
-#: Rows per segmented-scan chunk.  One lax.scan over chunks with carried
-#: open-segment values; each chunk runs a LOCAL associative_scan.  Both a
-#: whole-array associative_scan and jnp.cumsum at 4M rows measured
-#: minutes of XLA compile (and cumsum ~400 ms/run) on v5e — the chunked
-#: form compiles in seconds and runs ~75 ms for four fields at 4M.
-SEG_CHUNK_ROWS = 62500
-
-
 def _segmented_scan_multi(fields: dict[str, tuple[jax.Array, str]],
                           boundary: jax.Array) -> dict[str, jax.Array]:
-    """ONE inclusive segmented scan over every (array, combine-kind) field
-    (restart at ``boundary``), shared by all aggregates of a group-by.
-
-    Chunked: ``lax.scan`` over row chunks carrying each field's running
-    open-segment value; the local scan restarts it wherever a boundary has
-    been *seen* within the chunk."""
-    kinds = {k: kind for k, (_, kind) in fields.items()}
-    n = boundary.shape[0]
-    B = min(SEG_CHUNK_ROWS, max(n, 1))
-    pad = -n % B
-    npad = n + pad
-
-    def padded(arr, fill):
-        if pad == 0:
-            return arr
-        return jnp.concatenate([arr, jnp.full(pad, fill, arr.dtype)])
-
-    b2 = padded(boundary, True).reshape(-1, B)
-    v2 = {k: padded(arr, jnp.zeros((), arr.dtype)).reshape(-1, B)
-          for k, (arr, _) in fields.items()}
-
-    def local_op(a, b):
-        va, ba = a
-        vb, bb = b
-        out = {k: jnp.where(bb, vb[k], _COMBINES[kinds[k]](va[k], vb[k]))
-               for k in va}
-        return out, ba | bb
-
-    def body(carry, xs):
-        bc, vc = xs
-        local, _ = jax.lax.associative_scan(local_op, (vc, bc))
-        seen = jax.lax.associative_scan(jnp.logical_or, bc)
-        out = {k: jnp.where(seen, local[k],
-                            _COMBINES[kinds[k]](carry[k], local[k]))
-               for k in vc}
-        return {k: out[k][-1] for k in out}, out
-
-    init = {k: jnp.zeros((), arr.dtype) for k, (arr, _) in fields.items()}
-    _, out = jax.lax.scan(body, init, (b2, v2))
-    return {k: o.reshape(npad)[:n] for k, o in out.items()}
+    """ONE inclusive segmented scan serving all of a group-by's aggregates
+    (the shared chunked implementation lives in ops.common — see
+    chunked_segmented_scan for the compile-time story)."""
+    from ..ops.common import chunked_segmented_scan
+    return chunked_segmented_scan(fields, boundary)
 
 
 def _nunique_padded(cols: dict[str, Column], sel, key_names,

@@ -1,0 +1,21 @@
+"""Workload models: reusable compiled-plan templates.
+
+The reference has **no model families** — it is a columnar
+data-processing library, not an ML framework (SURVEY.md §0, §2.4 verify
+this against the full tree).  The closest notion of a "model" in this
+domain is a *query shape*: the handful of physical-plan skeletons that
+dominate analytic suites like TPC-DS.  This package provides those as
+parameterized :class:`~spark_rapids_tpu.exec.Plan` builders so hosts can
+instantiate, compile once, and run them over any matching schema —
+locally (``.run``), sync-free (``.run_padded``), or distributed
+(``.run_dist``).
+
+See ``benchmarks/bench_tpcds_shapes.py`` for measured throughput of each
+shape at 4M rows on TPU v5e.
+"""
+
+from .query_shapes import (star_join_agg, bucketed_scan_agg,
+                           distinct_count_per_group)
+
+__all__ = ["star_join_agg", "bucketed_scan_agg",
+           "distinct_count_per_group"]

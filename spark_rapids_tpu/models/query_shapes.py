@@ -1,0 +1,67 @@
+"""Parameterized physical-plan skeletons for the dominant analytic shapes.
+
+Each builder returns a :class:`~spark_rapids_tpu.exec.Plan`; plans are
+hashable, so repeated instantiation with the same arguments reuses the
+compiled program (per input signature).  These are the TPU-native
+equivalents of the canned physical plans the reference system's host
+(Spark + spark-rapids) produces for star-schema queries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..exec import Expr, Plan, col, plan
+from ..table import Table
+
+
+def star_join_agg(dims: Sequence[tuple[Table, str, str]],
+                  filters: Optional[Expr],
+                  group_keys: Sequence[str],
+                  aggs: Sequence[tuple[str, str, str]],
+                  order_by: Optional[Sequence[str]] = None,
+                  limit: Optional[int] = None,
+                  domains: Optional[dict] = None) -> Plan:
+    """Fact table ⋈ broadcast dimensions → filter → group-by → sort/limit.
+
+    The TPC-DS q3/q7/q42/q52... family: ``dims`` is a list of
+    ``(dim_table, fact_key, dim_key)``; dimension keys must be unique
+    (broadcast-join contract).
+    """
+    p = plan()
+    for dim, left_on, right_on in dims:
+        p = p.join_broadcast(dim, left_on=left_on, right_on=right_on)
+    if filters is not None:
+        p = p.filter(filters)
+    p = p.groupby_agg(list(group_keys), list(aggs), domains=domains)
+    if order_by:
+        p = p.sort_by(list(order_by))
+    if limit is not None:
+        p = p.limit(limit)
+    return p
+
+
+def bucketed_scan_agg(pred: Expr, bucket_expr: Expr, bucket_name: str,
+                      bucket_domain: tuple[int, int],
+                      aggs: Sequence[tuple[str, str, str]]) -> Plan:
+    """Filter → derived bucket column → dense group-by (q28/q88 family:
+    global aggregates over value buckets, no sort needed)."""
+    return (plan()
+            .filter(pred)
+            .with_columns(**{bucket_name: bucket_expr})
+            .groupby_agg([bucket_name], list(aggs),
+                         domains={bucket_name: bucket_domain}))
+
+
+def distinct_count_per_group(group_keys: Sequence[str],
+                             distinct_col: str,
+                             extra_aggs: Sequence[tuple[str, str, str]] = (),
+                             filters: Optional[Expr] = None) -> Plan:
+    """Count-distinct per group (q14/q95 family), plus optional extra
+    aggregates over the same keys."""
+    p = plan()
+    if filters is not None:
+        p = p.filter(filters)
+    aggs = [(distinct_col, "nunique", f"distinct_{distinct_col}")]
+    aggs += list(extra_aggs)
+    return p.groupby_agg(list(group_keys), aggs)

@@ -95,33 +95,70 @@ def grouping_sort_operands(datas, valids) -> list[jax.Array]:
     return ops
 
 
-#: Rows per chunk for chunked prefix sums (see chunked_cumsum).
-CUMSUM_CHUNK_ROWS = 62500
+#: Rows per chunk for chunked (segmented) prefix scans.  62500 x 64
+#: chunks measured best at 4M rows on v5e; shared by every scan below so
+#: there is exactly one constant to retune.
+SCAN_CHUNK_ROWS = 62500
+
+_SCAN_COMBINES = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+
+def chunked_segmented_scan(fields: dict, boundary) -> dict:
+    """Inclusive segmented scan over every ``{name: (array, kind)}`` field
+    (kinds: add/min/max), restarting where ``boundary`` is True.
+
+    ONE ``lax.scan`` over row chunks carrying each field's running
+    open-segment value; each chunk runs a local ``associative_scan`` and
+    splices the carry in before its first boundary.  Whole-array
+    ``associative_scan`` and ``jnp.cumsum`` at millions of rows measured
+    minutes of XLA *compile* time (cumsum also ~435 ms/run) on v5e; the
+    chunked form compiles in seconds and runs ~75 ms for four fields at
+    4M rows (BASELINE.md).
+    """
+    kinds = {k: kind for k, (_, kind) in fields.items()}
+    n = boundary.shape[0]
+    B = min(SCAN_CHUNK_ROWS, max(n, 1))
+    pad = -n % B
+    npad = n + pad
+
+    def padded(arr, fill):
+        if pad == 0:
+            return arr
+        return jnp.concatenate([arr, jnp.full(pad, fill, arr.dtype)])
+
+    b2 = padded(boundary, True).reshape(-1, B)
+    v2 = {k: padded(arr, jnp.zeros((), arr.dtype)).reshape(-1, B)
+          for k, (arr, _) in fields.items()}
+
+    def local_op(a, b):
+        va, ba = a
+        vb, bb = b
+        out = {k: jnp.where(bb, vb[k], _SCAN_COMBINES[kinds[k]](va[k], vb[k]))
+               for k in va}
+        return out, ba | bb
+
+    def body(carry, xs):
+        bc, vc = xs
+        local, _ = jax.lax.associative_scan(local_op, (vc, bc))
+        seen = jax.lax.associative_scan(jnp.logical_or, bc)
+        out = {k: jnp.where(seen, local[k],
+                            _SCAN_COMBINES[kinds[k]](carry[k], local[k]))
+               for k in vc}
+        return {k: out[k][-1] for k in out}, out
+
+    init = {k: jnp.zeros((), arr.dtype) for k, (arr, _) in fields.items()}
+    _, out = jax.lax.scan(body, init, (b2, v2))
+    return {k: o.reshape(npad)[:n] for k, o in out.items()}
 
 
 def chunked_cumsum(x: jax.Array) -> jax.Array:
-    """Inclusive prefix sum via lax.scan over chunks with a carried total.
-
-    Whole-array ``jnp.cumsum`` (and ``associative_scan``) at millions of
-    rows measured minutes of XLA *compile* time (and ~435 ms/run) on TPU
-    v5e; the chunked form's scan body compiles once and runs in tens of
-    milliseconds (BASELINE.md).  Semantically identical to
-    ``jnp.cumsum(x)``.
-    """
+    """``jnp.cumsum(x)`` as the degenerate (no-boundary) chunked scan."""
     n = x.shape[0]
-    B = min(CUMSUM_CHUNK_ROWS, max(n, 1))
-    pad = -n % B
-    xp = x if pad == 0 else jnp.concatenate(
-        [x, jnp.zeros(pad, x.dtype)])
-    x2 = xp.reshape(-1, B)
-
-    def body(carry, chunk):
-        local = jax.lax.associative_scan(jnp.add, chunk)
-        out = local + carry
-        return out[-1], out
-
-    _, out = jax.lax.scan(body, jnp.zeros((), x.dtype), x2)
-    return out.reshape(-1)[:n]
+    if n == 0:
+        return x
+    out = chunked_segmented_scan({"s": (x, "add")},
+                                 jnp.zeros(n, jnp.bool_))
+    return out["s"]
 
 
 def distinct_run_heads(sorted_key_ops, sorted_val_ops, live=None):

@@ -241,17 +241,40 @@ def pack_var_rows(table: Table) -> VarRowBlob:
     row_offsets = row_offsets.astype(jnp.int32)
     total_words = max(total_bytes // 4, 1)
 
+    # Pad every data-dependent input shape to its pow2 class so the jitted
+    # pack specializes per size class, not per batch (a batch stream must
+    # not recompile — minutes each on TPU).  Padded rows have empty offset
+    # ranges (repeated totals), so they contribute to no output word, and
+    # the output is zeroed past the true total anyway.
+    n = table.num_rows
+    nb = _pow2(n)
+
+    def pad_rows(arr, fill):
+        if nb == n:
+            return arr
+        return jnp.concatenate([arr, jnp.full(nb - n, fill, arr.dtype)])
+
     _, pack = _var_packer(schema, _pow2(total_words))
     str_offsets, str_chars = [], []
     for i in layout.var_cols:
         c = table.columns[i]
-        str_offsets.append(c.offsets[:-1].astype(jnp.int32))
-        str_chars.append(c.data)
-    datas = tuple(c.data if c.offsets is None else jnp.zeros(0, jnp.uint8)
+        str_offsets.append(pad_rows(c.offsets[:-1].astype(jnp.int32), 0))
+        cb = _pow2(max(int(c.data.shape[0]), 1))
+        chars = c.data
+        if chars.shape[0] < cb:
+            chars = jnp.concatenate(
+                [chars, jnp.zeros(cb - chars.shape[0], chars.dtype)])
+        str_chars.append(chars)
+    datas = tuple(pad_rows(c.data, jnp.zeros((), c.data.dtype))
+                  if c.offsets is None else jnp.zeros(0, jnp.uint8)
                   for c in table.columns)
-    valids = tuple(c.valid_mask() for c in table.columns)
+    valids = tuple(pad_rows(c.valid_mask(), False) for c in table.columns)
+    ro_padded = (row_offsets if nb == n else jnp.concatenate(
+        [row_offsets, jnp.full(nb - n, row_offsets[-1], jnp.int32)]))
     words = pack(datas, valids, tuple(str_offsets), tuple(str_chars),
-                 row_offsets, tuple(lens), tuple(starts))
+                 ro_padded,
+                 tuple(pad_rows(ln, 0) for ln in lens),
+                 tuple(pad_rows(st, 0) for st in starts))
     return VarRowBlob(words=words[:total_words], offsets=row_offsets)
 
 

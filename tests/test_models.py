@@ -1,0 +1,57 @@
+"""Query-shape template tests (models/): each template must equal the
+hand-built plan's eager oracle."""
+
+import numpy as np
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu.exec import col
+from spark_rapids_tpu.exec.compile import run_plan_eager
+from spark_rapids_tpu.models import (bucketed_scan_agg,
+                                     distinct_count_per_group, star_join_agg)
+
+
+def _fact(rng, n=2000):
+    return Table([
+        ("dk", Column.from_numpy(rng.integers(0, 50, n).astype(np.int64))),
+        ("g", Column.from_numpy(rng.integers(0, 4, n).astype(np.int8))),
+        ("v", Column.from_numpy(rng.normal(size=n))),
+        ("q", Column.from_numpy(rng.integers(1, 40, n).astype(np.int64))),
+    ])
+
+
+def _dim(rng, d=50):
+    return Table([
+        ("k", Column.from_numpy(np.arange(d, dtype=np.int64))),
+        ("cat", Column.from_numpy(rng.integers(0, 6, d).astype(np.int8))),
+    ])
+
+
+class TestQueryShapes:
+    def test_star_join_agg(self, rng):
+        f, d = _fact(rng), _dim(rng)
+        p = star_join_agg(
+            dims=[(d, "dk", "k")],
+            filters=col("q") > 5,
+            group_keys=["cat"],
+            aggs=[("v", "sum", "vs"), ("v", "count", "n")],
+            order_by=["cat"], limit=10)
+        assert_tables_equal(run_plan_eager(p, f), p.run(f),
+                            rtol=1e-9, atol=1e-9)
+
+    def test_bucketed_scan_agg(self, rng):
+        f = _fact(rng)
+        p = bucketed_scan_agg(
+            pred=(col("q") >= 5) & (col("q") <= 25),
+            bucket_expr=col("q") // 5, bucket_name="b",
+            bucket_domain=(1, 5),
+            aggs=[("v", "mean", "m"), ("v", "count", "n")])
+        assert_tables_equal(run_plan_eager(p, f), p.run(f),
+                            rtol=1e-9, atol=1e-9)
+
+    def test_distinct_count_per_group(self, rng):
+        f = _fact(rng)
+        p = distinct_count_per_group(
+            ["g"], "dk", extra_aggs=[("v", "sum", "vs")],
+            filters=col("q") > 2)
+        assert_tables_equal(run_plan_eager(p, f), p.run(f),
+                            rtol=1e-9, atol=1e-9)
