@@ -26,38 +26,15 @@ _jax.config.update("jax_enable_x64", True)
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compile cache (config.compile_cache_dir): per-schema
-    query programs cost minutes to compile on TPU and sub-second on a
-    cross-process cache hit."""
-    from .config import compile_cache_dir
-    path = compile_cache_dir()
-    if path is None or _jax.config.jax_compilation_cache_dir:
-        return                        # disabled, or the user already chose
-    # Cache accelerator platforms only: CPU compiles are cheap, and
-    # XLA:CPU AOT artifacts bake in exact host machine features —
-    # reloading them on a slightly different host (shared ~/.cache,
-    # container images) warns about and risks SIGILL.
+    """Import-time persistent-compile-cache setup for EXPLICIT accelerator
+    platforms; the unset-platform case is resolved lazily at the engine's
+    first compile (config.ensure_compile_cache) because resolving the
+    backend at import would initialize XLA before a multi-host user can
+    call ``jax.distributed.initialize`` (parallel.cluster.init_cluster)."""
     platforms = _jax.config.jax_platforms or ""
-    if platforms:
-        # Explicit priority list: the first entry wins backend selection.
-        if platforms.split(",")[0].strip() == "cpu":
-            return
-    else:
-        # Unset: resolve the backend (the common TPU-host default).  This
-        # initializes the runtime, which package users pay on first array
-        # creation anyway.
-        try:
-            if _jax.default_backend() == "cpu":
-                return
-        except Exception:
-            return
-    try:
-        import os as _os
-        _os.makedirs(path, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", path)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except OSError:
-        pass                          # unwritable cache home: run uncached
+    if platforms and platforms.split(",")[0].strip() != "cpu":
+        from .config import ensure_compile_cache
+        ensure_compile_cache(resolve_backend=False)
 
 
 _enable_compile_cache()

@@ -72,6 +72,52 @@ def compile_cache_dir() -> str | None:
                         "spark_rapids_tpu", "xla")
 
 
+_CACHE_DECIDED = False
+
+
+def ensure_compile_cache(resolve_backend: bool = True) -> None:
+    """Enable the persistent XLA compile cache (idempotent, lazy-safe).
+
+    Called at import for explicitly-configured accelerator platforms, and
+    lazily from the engine's compile entry points otherwise — by the time
+    the engine compiles anything, a multi-host user has already run
+    ``jax.distributed.initialize``, so resolving the backend here is safe
+    (at import it would not be).  CPU stays uncached: its AOT artifacts
+    bake in exact host machine features and risk SIGILL from a shared
+    cache directory.
+    """
+    global _CACHE_DECIDED
+    if _CACHE_DECIDED:
+        return
+    import jax
+    path = compile_cache_dir()
+    if path is None or jax.config.jax_compilation_cache_dir:
+        _CACHE_DECIDED = True
+        return
+    platforms = jax.config.jax_platforms or ""
+    if platforms:
+        if platforms.split(",")[0].strip() == "cpu":
+            _CACHE_DECIDED = True
+            return
+    elif resolve_backend:
+        try:
+            if jax.default_backend() == "cpu":
+                _CACHE_DECIDED = True
+                return
+        except Exception:
+            _CACHE_DECIDED = True
+            return
+    else:
+        return                      # undecidable without backend init
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except OSError:
+        pass                        # unwritable cache home: run uncached
+    _CACHE_DECIDED = True
+
+
 def dense_groupby_max_cells() -> int:
     """Cell cap for the plan compiler's dense group-by path (beyond it the
     sorted fallback wins); tune per workload with SRT_DENSE_MAX_CELLS."""

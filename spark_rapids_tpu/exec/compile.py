@@ -795,6 +795,8 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
 
 
 def _compiled_for(bound: _Bound):
+    from ..config import ensure_compile_cache
+    ensure_compile_cache()
     key = bound.signature()
     fn = _COMPILED.get(key)
     if fn is None:
@@ -931,6 +933,57 @@ def _rebuild(bound: _Bound, out_cols: dict[str, Column]) -> Table:
     ordered = [nm for nm in order if nm in result]
     ordered += [nm for nm in result if nm not in ordered]
     return Table([(nm, result[nm]) for nm in ordered])
+
+
+def explain_plan(plan: Plan, table: Table) -> str:
+    """Human-readable bound physical plan (see Plan.explain)."""
+    bound = _Bound(plan, table)
+    lines = [f"Plan over {table.num_rows} rows x "
+             f"{table.num_columns} cols"]
+    if bound.dictionaries:
+        lines.append(f"  strings dictionary-encoded as keys: "
+                     f"{sorted(bound.dictionaries)}")
+    if bound.string_cols:
+        lines.append(f"  strings via rowid indirection: "
+                     f"{sorted(bound.string_cols)}")
+    gi = ji = 0
+    for step in bound.steps:
+        if isinstance(step, FilterStep):
+            lines.append(f"  Filter[{step.pred!r}] -> selection mask")
+        elif isinstance(step, ProjectStep):
+            kind = "Select" if step.narrow else "Project"
+            lines.append(f"  {kind}[{', '.join(nm for nm, _ in step.cols)}]")
+        elif isinstance(step, GroupAggStep):
+            meta = bound.group_metas[gi]
+            gi += 1
+            if meta.dense:
+                doms = ", ".join(
+                    f"{km.name}:[{km.lo},{km.hi}]"
+                    + ("+null" if km.nullable else "")
+                    for km in meta.keys)
+                lines.append(f"  GroupBy[dense, {meta.cells} cells; {doms}] "
+                             f"aggs={[h for _, h, _ in step.aggs]}")
+            else:
+                lines.append(
+                    f"  GroupBy[sorted: multi-key sort + segmented scans] "
+                    f"keys={list(step.keys)} "
+                    f"aggs={[h for _, h, _ in step.aggs]}")
+        elif isinstance(step, JoinStep):
+            meta = bound.join_metas[ji]
+            ji += 1
+            lines.append(
+                f"  BroadcastJoin[{meta.how}, probe={meta.mode}, "
+                f"build={meta.dim_rows} rows, keys [{meta.lo},{meta.hi}]] "
+                f"on {meta.left_on}")
+        elif isinstance(step, SortStep):
+            lines.append(f"  Sort[{', '.join(step.by)}]")
+        elif isinstance(step, LimitStep):
+            lines.append(f"  Limit[{step.k}]")
+    lines.append("  Materialize[compact by selection; "
+                 + ("1 host sync]" if any(
+                     isinstance(s, (FilterStep, GroupAggStep, JoinStep))
+                     for s in bound.steps) else "0 host syncs]"))
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -191,6 +191,13 @@ class Plan:
         from .compile import run_plan_padded
         return run_plan_padded(self, table)
 
+    def explain(self, table: Table) -> str:
+        """Bound physical-plan description (Spark ``explain()`` analog):
+        which group-by strategy each step takes (dense cells vs sorted),
+        resolved key domains, join probe modes, string handling."""
+        from .compile import explain_plan
+        return explain_plan(self, table)
+
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`
         over ``mesh``: the per-shard program runs under ``shard_map`` and

@@ -105,7 +105,9 @@ _SCAN_COMBINES = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
 
 def chunked_segmented_scan(fields: dict, boundary) -> dict:
     """Inclusive segmented scan over every ``{name: (array, kind)}`` field
-    (kinds: add/min/max), restarting where ``boundary`` is True.
+    (kinds: add/min/max), restarting where ``boundary`` is True;
+    ``boundary=None`` statically selects the plain (unsegmented) scan —
+    no boundary plumbing is traced at all.
 
     ONE ``lax.scan`` over row chunks carrying each field's running
     open-segment value; each chunk runs a local ``associative_scan`` and
@@ -116,6 +118,8 @@ def chunked_segmented_scan(fields: dict, boundary) -> dict:
     4M rows (BASELINE.md).
     """
     kinds = {k: kind for k, (_, kind) in fields.items()}
+    if boundary is None:
+        return _chunked_plain_scan(fields, kinds)
     n = boundary.shape[0]
     B = min(SCAN_CHUNK_ROWS, max(n, 1))
     pad = -n % B
@@ -151,14 +155,44 @@ def chunked_segmented_scan(fields: dict, boundary) -> dict:
     return {k: o.reshape(npad)[:n] for k, o in out.items()}
 
 
+def _chunked_plain_scan(fields: dict, kinds: dict) -> dict:
+    """Unsegmented variant: combine scan with one scalar carry per field."""
+    n = next(iter(fields.values()))[0].shape[0]
+    B = min(SCAN_CHUNK_ROWS, max(n, 1))
+    pad = -n % B
+    npad = n + pad
+
+    def padded(arr):
+        if pad == 0:
+            return arr
+        # zero is the identity for the only supported kind (add), and the
+        # tail is sliced off before anyone reads it anyway
+        return jnp.concatenate([arr, jnp.zeros(pad, arr.dtype)])
+
+    v2 = {k: padded(arr).reshape(-1, B) for k, (arr, _) in fields.items()}
+
+    def body(carry, vc):
+        out = {k: _SCAN_COMBINES[kinds[k]](
+            jax.lax.associative_scan(_SCAN_COMBINES[kinds[k]], vc[k]),
+            carry[k]) for k in vc}
+        return {k: out[k][-1] for k in out}, out
+
+    init = {}
+    for k, (arr, _) in fields.items():
+        if kinds[k] == "add":
+            init[k] = jnp.zeros((), arr.dtype)
+        else:
+            raise ValueError("unsegmented min/max scans need an identity; "
+                             "pass an explicit boundary instead")
+    _, out = jax.lax.scan(body, init, v2)
+    return {k: o.reshape(npad)[:n] for k, o in out.items()}
+
+
 def chunked_cumsum(x: jax.Array) -> jax.Array:
     """``jnp.cumsum(x)`` as the degenerate (no-boundary) chunked scan."""
-    n = x.shape[0]
-    if n == 0:
+    if x.shape[0] == 0:
         return x
-    out = chunked_segmented_scan({"s": (x, "add")},
-                                 jnp.zeros(n, jnp.bool_))
-    return out["s"]
+    return chunked_segmented_scan({"s": (x, "add")}, None)["s"]
 
 
 def distinct_run_heads(sorted_key_ops, sorted_val_ops, live=None):

@@ -128,6 +128,8 @@ def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
     size would exceed ``max_batch_bytes`` (reference contract:
     RowConversion.java:32-48).
     """
+    from ..config import ensure_compile_cache
+    ensure_compile_cache()
     schema = tuple(table.schema())
     if any(dt.is_string for dt in schema):
         from .varwidth import compute_var_layout, to_var_rows
@@ -177,6 +179,8 @@ def from_rows(blobs: Union[Sequence[RowBlob], RowBlob], schema: Sequence[DType],
     ``to_rows`` time, as in RowConversionTest.java:46-49).  Multiple blobs are
     concatenated in order (the reference's batched-output inverse).
     """
+    from ..config import ensure_compile_cache
+    ensure_compile_cache()
     from .varwidth import VarRowBlob, unpack_var_rows
     if isinstance(blobs, (RowBlob, VarRowBlob)):
         blobs = [blobs]

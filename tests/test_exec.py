@@ -400,6 +400,25 @@ class TestStringHandling:
         assert out.names == ("k1",)
 
 
+class TestExplain:
+    def test_explain_strategies(self, rng):
+        t = _mixed_table(rng, with_strings=True)
+        d = Table([("dk", Column.from_numpy(np.arange(5, dtype=np.int8))),
+                   ("w", Column.from_numpy(np.ones(5)))])
+        p = (plan().join_broadcast(d, left_on="k1", right_on="dk", how="left")
+             .filter(col("v64") > 0)
+             .groupby_agg(["k1"], [("v64", "sum", "s")])
+             .sort_by(["k1"]).limit(3))
+        text = p.explain(t)
+        assert "BroadcastJoin[left, probe=direct" in text
+        assert "GroupBy[dense" in text
+        assert "Sort[k1]" in text and "Limit[3]" in text
+        assert "1 host sync" in text
+        # wide keys -> sorted strategy is reported
+        p2 = plan().groupby_agg(["v64"], [("f64", "nunique", "n")])
+        assert "GroupBy[sorted" in p2.explain(t)
+
+
 class TestCaching:
     def test_compiled_program_reused(self, rng):
         from spark_rapids_tpu.exec import compile as C

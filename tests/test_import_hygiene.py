@@ -1,0 +1,27 @@
+"""Import-time behavior contracts.
+
+``import spark_rapids_tpu`` must not initialize the XLA backend: a
+multi-host user has to be able to call ``jax.distributed.initialize``
+(via ``parallel.init_cluster``) AFTER importing the package, and backend
+init forecloses that (jax raises).  The persistent-compile-cache setup is
+therefore import-time only for explicitly-configured accelerator
+platforms and otherwise deferred to the engine's first compile.
+"""
+
+import subprocess
+import sys
+
+
+def test_import_does_not_initialize_backend():
+    code = (
+        "import jax\n"
+        "import spark_rapids_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), \\\n"
+        "    'importing spark_rapids_tpu initialized the XLA backend'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "clean" in out.stdout
