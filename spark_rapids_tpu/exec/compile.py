@@ -53,7 +53,7 @@ from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate
 from .plan import (FilterStep, GroupAggStep, JoinStep, LimitStep, Plan,
-                   ProjectStep, SortStep)
+                   ProjectStep, SortStep, WindowStep)
 
 def _dense_max_cells() -> int:
     """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
@@ -176,6 +176,9 @@ class _Bound:
                 key_names.update(step.keys)
             elif isinstance(step, SortStep):
                 key_names.update(step.by)
+            elif isinstance(step, WindowStep):
+                key_names.update(step.partition_by)
+                key_names.update(step.order_by)
 
         need_rowid = False
         for name, c in table.items():
@@ -228,6 +231,19 @@ class _Bound:
                 self.probe_sources = {}
                 current_names = (list(step.keys)
                                  + [out for _, _, out in step.aggs])
+            elif isinstance(step, WindowStep):
+                if step.value is not None and (
+                        step.value in self.string_cols
+                        or step.value in self.dictionaries):
+                    raise TypeError(
+                        f"window function over string column "
+                        f"{step.value!r} is not supported")
+                if step.out in current_names:
+                    passthrough.discard(step.out)
+                    self.probe_sources.pop(step.out, None)
+                else:
+                    current_names.append(step.out)
+                steps.append(step)
             elif isinstance(step, JoinStep):
                 from .join import bind_join
                 meta = bind_join(self, step, len(self.join_metas),
@@ -775,6 +791,14 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
             elif step is _JOIN_MARKER:
                 cols, sel = trace_join(cols, sel, side, join_metas[ji])
                 ji += 1
+            elif isinstance(step, WindowStep):
+                if sharded:
+                    raise TypeError(
+                        "window functions over still-sharded rows are not "
+                        "supported in a distributed plan (partitions span "
+                        "shards); aggregate first or window locally")
+                from .window import trace_window
+                cols, sel = trace_window(cols, sel, step)
             elif isinstance(step, SortStep):
                 if sharded:
                     raise TypeError(
@@ -827,6 +851,9 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
         elif isinstance(step, JoinStep) and step.how in ("inner", "left"):
             order += [nm for nm in step.table.names
                       if nm != step.right_on and nm not in order]
+        elif isinstance(step, WindowStep):
+            if step.out not in order:
+                order.append(step.out)
     return tuple(order)
 
 
@@ -975,6 +1002,12 @@ def explain_plan(plan: Plan, table: Table) -> str:
                 f"  BroadcastJoin[{meta.how}, probe={meta.mode}, "
                 f"build={meta.dim_rows} rows, keys [{meta.lo},{meta.hi}]] "
                 f"on {meta.left_on}")
+        elif isinstance(step, WindowStep):
+            lines.append(
+                f"  Window[{step.func} -> {step.out}; partition by "
+                f"{', '.join(step.partition_by)}"
+                + (f"; order by {', '.join(step.order_by)}"
+                   if step.order_by else "") + "]")
         elif isinstance(step, SortStep):
             lines.append(f"  Sort[{', '.join(step.by)}]")
         elif isinstance(step, LimitStep):
@@ -1019,6 +1052,32 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
                     and step.right_on in joined):
                 joined = joined.drop([step.right_on])
             t = joined
+        elif isinstance(step, WindowStep):
+            from ..ops import window as W
+            if step.func == "row_number":
+                c = W.row_number(t, list(step.partition_by),
+                                 list(step.order_by) or None,
+                                 list(step.ascending) or None)
+            elif step.func == "rank":
+                c = W.rank(t, list(step.partition_by), list(step.order_by),
+                           list(step.ascending) or None)
+            elif step.func == "dense_rank":
+                c = W.dense_rank(t, list(step.partition_by),
+                                 list(step.order_by),
+                                 list(step.ascending) or None)
+            elif step.func in ("lag", "lead"):
+                f = W.lag if step.func == "lag" else W.lead
+                c = f(t, step.value, list(step.partition_by),
+                      list(step.order_by), offset=step.offset,
+                      ascending=list(step.ascending) or None,
+                      fill=step.fill)
+            else:
+                c = W.window_agg(t, step.value, step.func,
+                                 list(step.partition_by),
+                                 list(step.order_by) or None,
+                                 list(step.ascending) or None,
+                                 frame=step.frame)
+            t = t.with_column(step.out, c)
         elif isinstance(step, SortStep):
             t = ops.sort_by(t, list(step.by), list(step.ascending),
                             list(step.nulls_first))

@@ -80,6 +80,23 @@ class JoinStep:
 
 
 @dataclass(frozen=True)
+class WindowStep:
+    """One window-function column (Spark OVER clause).
+
+    ``func``: row_number | rank | dense_rank | lag | lead | sum | min |
+    max | count (the latter four take ``frame`` cumulative/partition)."""
+    out: str
+    func: str
+    partition_by: tuple[str, ...]
+    order_by: tuple[str, ...]
+    ascending: tuple[bool, ...]
+    value: Optional[str]
+    offset: int
+    fill: Optional[float]
+    frame: str
+
+
+@dataclass(frozen=True)
 class SortStep:
     by: tuple[str, ...]
     ascending: tuple[bool, ...]
@@ -91,8 +108,11 @@ class LimitStep:
     k: int
 
 
-Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep, SortStep,
-             LimitStep]
+Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep, WindowStep,
+             SortStep, LimitStep]
+
+WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
+                "sum", "min", "max", "count")
 
 
 @dataclass(frozen=True)
@@ -156,6 +176,46 @@ class Plan:
         if not left_on or not right_on:
             raise ValueError("join keys: pass `on=` or left_on/right_on")
         return Plan(self.steps + (JoinStep(table, left_on, right_on, how),))
+
+    def window(self, out: str, func: str,
+               partition_by: Sequence[str] | str,
+               order_by: Sequence[str] | str = (),
+               ascending: Optional[Sequence[bool]] = None,
+               value: Optional[str] = None, offset: int = 1,
+               fill: Optional[float] = None,
+               frame: str = "cumulative") -> "Plan":
+        """Append a window-function column (Spark ``f() OVER (PARTITION BY
+        ... ORDER BY ...)``); filtered-out rows never participate.
+
+        ``value`` names the input column for lag/lead/sum/min/max/count;
+        ``frame`` is "cumulative" (unbounded preceding → current row) or
+        "partition" (whole-partition aggregate broadcast) for the
+        aggregate funcs.
+        """
+        if func not in WINDOW_FUNCS:
+            raise ValueError(f"unsupported window function {func!r} "
+                             f"(have {WINDOW_FUNCS})")
+        if isinstance(partition_by, str):
+            partition_by = [partition_by]
+        if isinstance(order_by, str):
+            order_by = [order_by]
+        if not partition_by:
+            raise ValueError("partition_by must name at least one column")
+        if func in ("rank", "dense_rank", "lag", "lead") and not order_by:
+            raise ValueError(f"{func} needs order_by")
+        if func in ("lag", "lead", "sum", "min", "max", "count") \
+                and value is None:
+            raise ValueError(f"{func} needs value=")
+        if frame not in ("cumulative", "partition"):
+            raise ValueError(f"frame must be cumulative|partition, "
+                             f"got {frame!r}")
+        if ascending is None:
+            ascending = [True] * len(order_by)
+        elif len(ascending) != len(order_by):
+            raise ValueError("ascending must match order_by length")
+        return Plan(self.steps + (WindowStep(
+            out, func, tuple(partition_by), tuple(order_by),
+            tuple(ascending), value, int(offset), fill, frame),))
 
     def sort_by(self, by: Union[str, Sequence[str]],
                 ascending: Optional[Sequence[bool]] = None,

@@ -1,0 +1,178 @@
+"""Window functions inside compiled plans (Spark OVER clauses).
+
+Same primitives as the eager window layer (:mod:`..ops.window` — sorted
+partitions, segment boundaries, running scans) re-expressed for the plan
+program's constraints:
+
+* the selection mask participates — filtered-out rows sort to the end,
+  never contribute, and never break a live partition (Spark computes
+  windows after WHERE);
+* all running reductions use the shared chunked segmented scan
+  (:func:`...ops.common.chunked_segmented_scan`) — whole-array
+  ``associative_scan``/``cumsum`` are compile-time cliffs at millions of
+  rows;
+* the original row order is restored with a second ``lax.sort`` keyed on
+  the carried row ids (the eager layer's inverse-permutation scatter is
+  hostile to TPU inside a fused program).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+from ..dtypes import INT32, INT64
+from ..ops.common import adjacent_differs, chunked_cumsum, \
+    chunked_segmented_scan, grouping_sort_operands
+from ..ops.groupby import _sum_dtype
+from .plan import WindowStep
+
+
+def _sorted_view(cols, sel, step: WindowStep):
+    """Sort by (selection, partition keys, order keys); returns the pieces
+    every window function needs, in sorted space."""
+    from ..ops.sort import sort_operands
+    n = next(iter(cols.values())).size
+    part_cols = [cols[k] for k in step.partition_by]
+    part_ops = grouping_sort_operands(
+        tuple(c.data for c in part_cols),
+        tuple(c.validity for c in part_cols))
+    order_ops = sort_operands([cols[k] for k in step.order_by],
+                              list(step.ascending),
+                              list(step.ascending))   # Spark null default
+    ops_list = list(part_ops) + list(order_ops)
+    if sel is not None:
+        ops_list = [jnp.where(sel, jnp.uint8(0), jnp.uint8(1))] + ops_list
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    payload = [iota]
+    vcol = cols[step.value] if step.value is not None else None
+    if vcol is not None:
+        payload.append(vcol.data)
+        if vcol.validity is not None:
+            payload.append(vcol.validity)
+    sorted_all = jax.lax.sort(ops_list + payload, dimension=0,
+                              is_stable=True, num_keys=len(ops_list))
+    off = 1 if sel is not None else 0
+    live = (sorted_all[0] == 0) if sel is not None else jnp.ones(n, jnp.bool_)
+    part_sorted = sorted_all[off:off + len(part_ops)]
+    order_sorted = sorted_all[off + len(part_ops):len(ops_list)]
+    rest = sorted_all[len(ops_list):]
+    row_ids = rest[0]
+    svalue = svalid = None
+    if vcol is not None:
+        svalue = rest[1]
+        svalid = rest[2] if vcol.validity is not None else None
+
+    starts = jnp.zeros(n, jnp.bool_)
+    for op in part_sorted:
+        starts = starts | adjacent_differs(op)
+    starts = starts & live
+    order_change = starts
+    for op in order_sorted:
+        order_change = order_change | adjacent_differs(op)
+    order_change = order_change & live
+    return (n, live, starts, order_change, row_ids, svalue, svalid,
+            iota, vcol)
+
+
+def _seg_base(starts, pos):
+    """Per sorted row: position of its partition's first row."""
+    return chunked_segmented_scan(
+        {"b": (jnp.where(starts, pos, 0), "max")}, starts)["b"]
+
+
+def trace_window(cols, sel, step: WindowStep):
+    (n, live, starts, order_change, row_ids, svalue, svalid, pos,
+     vcol) = _sorted_view(cols, sel, step)
+
+    out_validity_sorted = None
+    if step.func == "row_number":
+        base = _seg_base(starts, pos)
+        data = (pos - base + 1).astype(jnp.int32)
+        out_dtype = INT32
+    elif step.func == "rank":
+        base = _seg_base(starts, pos)
+        latest = chunked_segmented_scan(
+            {"m": (jnp.where(order_change, pos, 0), "max")}, starts)["m"]
+        data = (latest - base + 1).astype(jnp.int32)
+        out_dtype = INT32
+    elif step.func == "dense_rank":
+        data = chunked_segmented_scan(
+            {"d": (order_change.astype(jnp.int32), "add")},
+            starts)["d"]
+        out_dtype = INT32
+    elif step.func in ("lag", "lead"):
+        offset = step.offset if step.func == "lag" else -step.offset
+        seg_id = chunked_cumsum(starts.astype(jnp.int32)) - 1
+        src = pos - jnp.int32(offset)
+        src_safe = jnp.clip(src, 0, n - 1)
+        ok = ((src >= 0) & (src < n)
+              & (jnp.take(seg_id, src_safe) == seg_id)
+              & jnp.take(live, src_safe))
+        data = jnp.take(svalue, src_safe)
+        src_valid = (jnp.ones(n, jnp.bool_) if svalid is None
+                     else jnp.take(svalid, src_safe))
+        if step.fill is not None:
+            data = jnp.where(ok, data,
+                             jnp.asarray(step.fill, data.dtype))
+            out_validity_sorted = jnp.where(ok, src_valid, True)
+        else:
+            data = jnp.where(ok, data, jnp.zeros((), data.dtype))
+            out_validity_sorted = ok & src_valid
+        out_dtype = vcol.dtype
+    else:                                  # sum / min / max / count
+        valid = live if svalid is None else (live & svalid)
+        how = step.func
+        if how == "count":
+            out_dtype = INT64
+            contrib = valid.astype(jnp.int64)
+            kind = "add"
+        elif how == "sum":
+            out_dtype = _sum_dtype(vcol.dtype)
+            contrib = jnp.where(valid, svalue, 0).astype(out_dtype.jnp_dtype)
+            kind = "add"
+        else:
+            out_dtype = vcol.dtype
+            if vcol.dtype.is_floating:
+                ident = np.inf if how == "min" else -np.inf
+            else:
+                info = np.iinfo(vcol.dtype.np_dtype)
+                ident = info.max if how == "min" else info.min
+            ident = jnp.asarray(ident, vcol.dtype.jnp_dtype)
+            contrib = jnp.where(valid, svalue, ident)
+            kind = how
+        fields = {"v": (contrib, kind),
+                  "seen": (valid.astype(jnp.int64), "add")}
+        scans = chunked_segmented_scan(fields, starts)
+        run, seen = scans["v"], scans["seen"]
+        if step.frame == "partition":
+            # Broadcast the value at each partition's END back to all its
+            # rows: end position via a reversed-space segment base.
+            ends_marker = jnp.concatenate(
+                [starts[1:], jnp.ones(1, jnp.bool_)])
+            rev_starts = jnp.flip(ends_marker)
+            rev_base = _seg_base(rev_starts, pos)
+            end_pos = (n - 1) - jnp.flip(rev_base)
+            run = jnp.take(run, end_pos)
+            seen = jnp.take(seen, end_pos)
+        data = run
+        if how == "count":
+            out_validity_sorted = None
+        else:
+            out_validity_sorted = seen > 0
+
+    # Restore original row order: one sort keyed on the carried row ids.
+    back = [row_ids, data]
+    if out_validity_sorted is not None:
+        back.append(out_validity_sorted)
+    restored = jax.lax.sort(back, dimension=0, is_stable=False, num_keys=1)
+    out_data = restored[1]
+    out_valid = restored[2] if out_validity_sorted is not None else None
+
+    new = dict(cols)
+    new[step.out] = Column(data=out_data.astype(out_dtype.jnp_dtype),
+                           validity=out_valid, dtype=out_dtype)
+    return new, sel

@@ -32,7 +32,8 @@ import numpy as np
 from ..column import Column
 from ..dtypes import INT32, INT64
 from ..table import Table
-from .common import grouping_columns, null_safe_equal_adjacent
+from .common import (chunked_cumsum, chunked_segmented_scan,
+                     grouping_columns, null_safe_equal_adjacent)
 from .groupby import _sum_dtype
 from .sort import sorted_order
 
@@ -70,7 +71,8 @@ def _segment_base(starts: jax.Array) -> jax.Array:
     exactly the latest partition start at or before each row.
     """
     pos = jnp.arange(starts.shape[0], dtype=jnp.int32)
-    return jax.lax.associative_scan(jnp.maximum, jnp.where(starts, pos, 0))
+    return chunked_segmented_scan(
+        {"b": (jnp.where(starts, pos, 0), "max")}, starts)["b"]
 
 
 def row_number(table: Table, partition_by: Sequence[str],
@@ -105,8 +107,8 @@ def rank(table: Table, partition_by: Sequence[str],
     # rank = position of the latest order-change (or partition start) + 1,
     # relative to the partition base.
     marker = starts | _order_change(order_cols, perm)
-    latest = jax.lax.associative_scan(jnp.maximum,
-                                      jnp.where(marker, pos, 0))
+    latest = chunked_segmented_scan(
+        {"m": (jnp.where(marker, pos, 0), "max")}, starts)["m"]
     return Column(data=jnp.take(latest - base + 1, inv), dtype=INT32)
 
 
@@ -117,7 +119,7 @@ def dense_rank(table: Table, partition_by: Sequence[str],
     perm, inv, starts, order_cols = _window_order(table, partition_by,
                                                   order_by, ascending)
     distinct = (starts | _order_change(order_cols, perm)).astype(jnp.int32)
-    cum = jnp.cumsum(distinct)
+    cum = chunked_cumsum(distinct)
     base = _segment_base(starts)
     return Column(data=jnp.take(cum - jnp.take(cum, base) + 1, inv),
                   dtype=INT32)
@@ -132,7 +134,7 @@ def _shift(table: Table, value: str, partition_by, order_by, ascending,
                                          ascending)
     n = perm.shape[0]
     sorted_col = col.gather(perm)
-    seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    seg_id = chunked_cumsum(starts.astype(jnp.int32)) - 1
     pos = jnp.arange(n, dtype=jnp.int32)
     src = pos - offset
     src_safe = jnp.clip(src, 0, n - 1)
@@ -196,8 +198,7 @@ def window_agg(table: Table, value: str, how: str,
     n = perm.shape[0]
     sorted_col = col.gather(perm)
     valid = sorted_col.valid_mask()
-    seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
-    base = _segment_base(starts)
+    seg_id = chunked_cumsum(starts.astype(jnp.int32)) - 1
 
     if how == "count":
         out_dtype = INT64
@@ -228,26 +229,11 @@ def window_agg(table: Table, value: str, how: str,
             valid.astype(jnp.int32))
         seen = jnp.take(seen, seg_id)
     else:
-        if how in ("sum", "count"):
-            cum = jnp.cumsum(contrib)
-            run = cum - jnp.take(cum, base) + jnp.take(contrib, base)
-        else:
-            # Segmented running min/max: Hillis-Steele with a same-segment
-            # guard (correct for idempotent ops).
-            run = contrib
-            pos = jnp.arange(n, dtype=jnp.int32)
-            shift = 1
-            while shift < n:
-                src = jnp.maximum(pos - shift, 0)
-                ok = (pos - shift >= 0) & (jnp.take(seg_id, src) == seg_id)
-                prev = jnp.take(run, src)
-                merged = jnp.minimum(run, prev) if how == "min" \
-                    else jnp.maximum(run, prev)
-                run = jnp.where(ok, merged, run)
-                shift <<= 1
-        vcum = jnp.cumsum(valid.astype(jnp.int32))
-        seen = vcum - jnp.take(vcum, base) + jnp.take(
-            valid.astype(jnp.int32), base)
+        kind = "add" if how in ("sum", "count") else how
+        scans = chunked_segmented_scan(
+            {"v": (contrib, kind),
+             "seen": (valid.astype(jnp.int32), "add")}, starts)
+        run, seen = scans["v"], scans["seen"]
 
     if how == "count":
         validity = None

@@ -22,6 +22,11 @@ class Table:
     """Immutable ordered mapping of column name -> Column."""
 
     def __init__(self, columns: Union[Mapping[str, Column], Sequence[tuple[str, Column]]]):
+        # Every eager workflow funnels through Table construction, so this
+        # is the layer-wide hook for the lazily-decided persistent compile
+        # cache (decided once; a flag check afterwards).
+        from .config import ensure_compile_cache
+        ensure_compile_cache()
         if isinstance(columns, Mapping):
             items = list(columns.items())
         else:

@@ -1,0 +1,111 @@
+"""In-plan window function tests; oracle = the eager window layer via
+run_plan_eager (test_window_datetime.py pins the eager semantics)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.exec.compile import run_plan_eager
+
+
+def _table(rng, n=800):
+    return Table([
+        ("p", Column.from_numpy(rng.integers(0, 7, n).astype(np.int8),
+                                validity=rng.random(n) > 0.1)),
+        ("o", Column.from_numpy(rng.integers(0, 40, n).astype(np.int32))),
+        ("v", Column.from_numpy(rng.integers(-50, 50, n).astype(np.int64),
+                                validity=rng.random(n) > 0.2)),
+        ("f", Column.from_numpy(rng.normal(size=n))),
+    ])
+
+
+def _check(p, t, **kw):
+    assert_tables_equal(run_plan_eager(p, t), p.run(t), **kw)
+
+
+class TestPlanWindows:
+    def test_row_number(self, rng):
+        t = _table(rng)
+        _check(plan().window("rn", "row_number", "p", "o"), t)
+
+    def test_rank_dense_rank(self, rng):
+        t = _table(rng)
+        p = (plan().window("r", "rank", ["p"], ["o"])
+             .window("dr", "dense_rank", ["p"], ["o"]))
+        _check(p, t)
+
+    def test_rank_descending(self, rng):
+        t = _table(rng)
+        _check(plan().window("r", "rank", ["p"], ["o"],
+                             ascending=[False]), t)
+
+    def test_lag_lead(self, rng):
+        t = _table(rng)
+        p = (plan().window("lg", "lag", ["p"], ["o"], value="v")
+             .window("ld", "lead", ["p"], ["o"], value="v", offset=2)
+             .window("lf", "lag", ["p"], ["o"], value="v", fill=-1.0))
+        _check(p, t)
+
+    def test_running_aggs(self, rng):
+        t = _table(rng)
+        p = (plan().window("rs", "sum", ["p"], ["o"], value="v")
+             .window("rc", "count", ["p"], ["o"], value="v")
+             .window("rmin", "min", ["p"], ["o"], value="v")
+             .window("rmax", "max", ["p"], ["o"], value="v"))
+        _check(p, t)
+
+    def test_partition_frame(self, rng):
+        t = _table(rng)
+        p = (plan().window("ts", "sum", ["p"], value="f",
+                           frame="partition")
+             .window("tc", "count", ["p"], value="v", frame="partition"))
+        _check(p, t, rtol=1e-12, atol=1e-12)
+
+    def test_window_after_filter_excludes_rows(self, rng):
+        t = _table(rng)
+        p = (plan().filter(col("v") > 0)
+             .window("rn", "row_number", ["p"], ["o"])
+             .window("rs", "sum", ["p"], ["o"], value="v"))
+        _check(p, t)
+
+    def test_window_then_filter_on_result(self, rng):
+        # top-2-per-partition: the classic rank-filter shape
+        t = _table(rng)
+        p = (plan().window("rn", "row_number", ["p"], ["o"])
+             .filter(col("rn") <= 2)
+             .sort_by(["p", "rn"]))
+        _check(p, t)
+
+    def test_multi_partition_keys(self, rng):
+        t = _table(rng)
+        p = plan().window("rn", "row_number", ["p", "o"], ["v"])
+        _check(p, t)
+
+    def test_validation(self, rng):
+        with pytest.raises(ValueError, match="needs order_by"):
+            plan().window("r", "rank", ["p"])
+        with pytest.raises(ValueError, match="needs value"):
+            plan().window("s", "sum", ["p"])
+        with pytest.raises(ValueError, match="unsupported window"):
+            plan().window("x", "median", ["p"])
+        with pytest.raises(ValueError, match="partition_by"):
+            plan().window("rn", "row_number", [], ["o"])
+        with pytest.raises(ValueError, match="ascending must match"):
+            plan().window("r", "rank", ["p"], ["o", "v"], ascending=[False])
+
+    def test_string_window_value_raises(self, rng):
+        t = _table(rng)
+        svals = ["a", "b", "c", "d"] * (t.num_rows // 4)
+        t = t.with_column("s", Column.from_pylist(svals, dt.STRING))
+        # even when the string is also a sort/order key (dict-encoded)
+        p = (plan().sort_by(["s"])
+             .window("prev", "lag", ["p"], ["s"], value="s"))
+        with pytest.raises(TypeError, match="string"):
+            p.run(t)
+
+    def test_explain_mentions_window(self, rng):
+        t = _table(rng)
+        p = plan().window("rn", "row_number", ["p"], ["o"])
+        assert "Window[row_number -> rn" in p.explain(t)
