@@ -151,6 +151,15 @@ def main():
                          ("price", "sum", "total")]))
     bench_shape("tpcds_q95_shape_nunique", q95, fact, "price", "total")
 
+    # q67-ish: windowed top-k — rank rows per store by profit, keep top 10
+    q67 = (plan()
+           .filter(col("qty") > 0)
+           .window("rk", "row_number", ["store_sk"], ["profit"],
+                   ascending=[False])
+           .filter(col("rk") <= 10)
+           .sort_by(["store_sk", "rk"]))
+    bench_shape("tpcds_q67_shape_window", q67, fact, "profit", "rk")
+
 
 if __name__ == "__main__":
     main()

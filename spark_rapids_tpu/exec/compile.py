@@ -51,7 +51,7 @@ from ..column import Column
 from ..dtypes import BOOL8, INT32, INT64, DType, TypeId
 from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
-from .expr import Col, evaluate
+from .expr import Col, evaluate, render
 from .plan import (FilterStep, GroupAggStep, JoinStep, LimitStep, Plan,
                    ProjectStep, SortStep, WindowStep)
 
@@ -976,7 +976,7 @@ def explain_plan(plan: Plan, table: Table) -> str:
     gi = ji = 0
     for step in bound.steps:
         if isinstance(step, FilterStep):
-            lines.append(f"  Filter[{step.pred!r}] -> selection mask")
+            lines.append(f"  Filter[{render(step.pred)}] -> selection mask")
         elif isinstance(step, ProjectStep):
             kind = "Select" if step.narrow else "Project"
             lines.append(f"  {kind}[{', '.join(nm for nm, _ in step.cols)}]")

@@ -156,6 +156,34 @@ def _wrap(x) -> Expr:
                     f"(wrap columns with col(), scalars are auto-wrapped)")
 
 
+_OP_SYMBOLS = {"add": "+", "sub": "-", "mul": "*", "truediv": "/",
+               "floordiv": "//", "mod": "%", "pow": "**",
+               "eq": "=", "ne": "!=", "lt": "<", "le": "<=",
+               "gt": ">", "ge": ">=", "and": "&", "or": "|"}
+
+
+def render(expr: Expr) -> str:
+    """Compact SQL-ish rendering for Plan.explain()."""
+    if isinstance(expr, Col):
+        return expr.name
+    if isinstance(expr, Lit):
+        return repr(expr.value)
+    if isinstance(expr, FillNull):
+        return f"coalesce({render(expr.operand)}, {expr.value!r})"
+    if isinstance(expr, UnOp):
+        if expr.op == "is_null":
+            return f"({render(expr.operand)} IS NULL)"
+        if expr.op == "is_valid":
+            return f"({render(expr.operand)} IS NOT NULL)"
+        if expr.op == "not":
+            return f"(NOT {render(expr.operand)})"
+        return f"{expr.op}({render(expr.operand)})"
+    if isinstance(expr, BinOp):
+        sym = _OP_SYMBOLS.get(expr.op, expr.op)
+        return f"({render(expr.left)} {sym} {render(expr.right)})"
+    return repr(expr)
+
+
 def references(expr: Expr) -> set[str]:
     """Column names referenced by an expression tree."""
     if isinstance(expr, Col):
