@@ -322,9 +322,10 @@ class _Bound:
                     passthrough: set[str]) -> _GroupMeta:
         from .stats import column_int_range
         keys: list[_KeyMeta] = []
-        # nunique needs its own (keys, value) sort order; the sorted path
-        # hosts it.
-        dense = not any(how == "nunique" for _, how, _ in step.aggs)
+        # nunique/median need their own (keys, value) sort order; the
+        # sorted path hosts them.
+        dense = not any(how in ("nunique", "median")
+                        for _, how, _ in step.aggs)
         sizes: list[int] = []
         for name, hint in zip(step.keys, step.domains):
             dictionary = self.dictionaries.get(name)

@@ -38,7 +38,7 @@ from .expr import Col, Expr, col, lit  # noqa: F401 (re-exported)
 
 #: Aggregations supported in compiled plans (mirrors ops.groupby.AGGS).
 PLAN_AGGS = ("count", "count_all", "sum", "min", "max", "mean", "first",
-             "last", "var", "std", "nunique")
+             "last", "var", "std", "nunique", "median")
 
 
 @dataclass(frozen=True)

@@ -87,6 +87,48 @@ def _nunique_padded(cols: dict[str, Column], sel, key_names,
     return jnp.take(scans["h"], ends)
 
 
+def _median_padded(cols: dict[str, Column], sel, key_names,
+                   value_name: str, ends) -> tuple[jax.Array, jax.Array]:
+    """Per-group linear-interpolated median, padded to n, group-rank
+    aligned (see _nunique_padded for why the side sort's segments match
+    the caller's ``ends``).  Returns (float64 medians, validity)."""
+    n = next(iter(cols.values())).size
+    key_cols = [cols[k] for k in key_names]
+    key_ops = grouping_sort_operands(
+        tuple(c.data for c in key_cols),
+        tuple(c.validity for c in key_cols))
+    vcol = cols[value_name]
+    val_ops = grouping_sort_operands((vcol.data,), (vcol.validity,))
+    ops_list = list(key_ops) + list(val_ops)
+    if sel is not None:
+        ops_list = [jnp.where(sel, jnp.uint8(0), jnp.uint8(1))] + ops_list
+    sorted_all = jax.lax.sort(ops_list + [vcol.data], dimension=0,
+                              is_stable=False, num_keys=len(ops_list))
+    off = 1 if sel is not None else 0
+    live = (sorted_all[0] == 0) if sel is not None else jnp.ones(n, jnp.bool_)
+    key_boundary = jnp.zeros(n, jnp.bool_)
+    for op in sorted_all[off:off + len(key_ops)]:
+        key_boundary = key_boundary | adjacent_differs(op)
+    key_boundary = key_boundary & live
+    valid_sorted = (sorted_all[off + len(key_ops)] == 1) & live
+    svalues = sorted_all[-1]
+
+    scans = _segmented_scan_multi(
+        {"nl": ((live & ~valid_sorted).astype(jnp.int32), "add"),
+         "vc": (valid_sorted.astype(jnp.int32), "add")}, key_boundary)
+    nulls = jnp.take(scans["nl"], ends)
+    vcount = jnp.take(scans["vc"], ends)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), ends[:-1] + 1])
+    run0 = starts + nulls
+    lo = jnp.clip(run0 + jnp.maximum(vcount - 1, 0) // 2, 0, n - 1)
+    hi = jnp.clip(run0 + vcount // 2, 0, n - 1)
+    med = (jnp.take(svalues, lo).astype(jnp.float64)
+           + jnp.take(svalues, hi).astype(jnp.float64)) / 2.0
+    if vcol.dtype.is_decimal:
+        med = med * (10.0 ** vcol.dtype.scale)
+    return med, vcount > 0
+
+
 def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
     n = next(iter(cols.values())).size
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -104,10 +146,11 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
     pay_names: list[str] = []
     for k in step.keys:
         pay_names.append(k)
-    non_nunique = {vn for vn, how, _ in step.aggs if how != "nunique"}
+    main_pay = {vn for vn, how, _ in step.aggs
+                if how not in ("nunique", "median")}
     for value_name, how, _ in step.aggs:
-        # nunique re-sorts its value column in its own kernel
-        if value_name not in pay_names and value_name in non_nunique:
+        # nunique/median re-sort their value column in their own kernels
+        if value_name not in pay_names and value_name in main_pay:
             pay_names.append(value_name)
     payload: list[jax.Array] = []
     layout: list[bool] = []
@@ -161,7 +204,7 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
 
     need_last = False
     for value_name, how, _ in step.aggs:
-        if how == "nunique":
+        if how in ("nunique", "median"):
             continue
         c = sorted_cols[value_name]
         if how == "count_all" and "ca" not in fields:
@@ -211,6 +254,7 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
             dtype=c.dtype)
 
     nunique_cache: dict[str, jax.Array] = {}
+    median_cache: dict[str, tuple] = {}
     for value_name, how, out_name in step.aggs:
         if how == "nunique":
             if value_name not in nunique_cache:
@@ -218,6 +262,14 @@ def sorted_group_agg(cols: dict[str, Column], sel, step: GroupAggStep):
                     cols, sel, step.keys, value_name, ends=ends)
             out[out_name] = Column(data=nunique_cache[value_name],
                                    dtype=_agg_out_dtype(None, "nunique"))
+            continue
+        if how == "median":
+            if value_name not in median_cache:
+                median_cache[value_name] = _median_padded(
+                    cols, sel, step.keys, value_name, ends=ends)
+            med, ok = median_cache[value_name]
+            out[out_name] = Column(data=med, validity=ok,
+                                   dtype=_agg_out_dtype(None, "median"))
             continue
         c = sorted_cols[value_name]
         dtype = c.dtype

@@ -31,7 +31,7 @@ from .common import grouping_columns, pow2_bucket
 
 #: Aggregations supported (cuDF basic set).
 AGGS = ("count", "count_all", "sum", "min", "max", "mean", "first", "last",
-        "var", "std", "nunique")
+        "var", "std", "nunique", "median")
 
 
 def _sum_dtype(dtype: DType) -> DType:
@@ -114,8 +114,8 @@ def groupby_agg(table: Table, keys: Sequence[str],
 
     for value_name, how, _ in aggs:
         col = table[value_name]
-        if how == "nunique":
-            continue                      # dedicated kernel (own sort order)
+        if how in ("nunique", "median"):
+            continue                      # dedicated kernels (own sort order)
         if col.offsets is not None:
             if how in ("first", "last"):
                 continue
@@ -144,7 +144,7 @@ def groupby_agg(table: Table, keys: Sequence[str],
     spec = []
     for value_name, how, _ in aggs:
         col = table[value_name]
-        if how == "nunique":
+        if how in ("nunique", "median"):
             continue
         if col.offsets is not None:
             if how in ("count", "count_all"):
@@ -176,6 +176,19 @@ def groupby_agg(table: Table, keys: Sequence[str],
                 vcol.data, vcol.validity, seg_count=seg_count)
             out.append((out_name, Column(data=counts[:num_groups],
                                          dtype=INT64)))
+            continue
+        if how == "median":
+            if col.offsets is not None:
+                raise TypeError(f"median is not defined for strings "
+                                f"(column {value_name!r})")
+            med, ok = _groupby_median(
+                tuple(kc.data for kc in key_cols),
+                tuple(kc.validity for kc in key_cols),
+                col.data, col.validity, seg_count=seg_count,
+                scale=col.dtype.scale if col.dtype.is_decimal else 0)
+            out.append((out_name, Column(data=med[:num_groups],
+                                         validity=ok[:num_groups],
+                                         dtype=FLOAT64)))
             continue
         if col.offsets is not None and how in ("first", "last"):
             idx = starts if how == "first" else ends
@@ -229,6 +242,51 @@ def _groupby_sort(key_datas, key_valids, pay_datas, pay_valids):
         boundary = boundary | adjacent_differs(sorted_ops[2 * k + 1])
     count = jnp.sum(boundary.astype(jnp.int32))
     return perm, tuple(sorted_pay), boundary, count
+
+
+@functools.partial(jax.jit, static_argnames=("seg_count", "scale"))
+def _groupby_median(key_datas, key_valids, value_data, value_valid, *,
+                    seg_count, scale):
+    """Per-group median with linear interpolation (cuDF groupby median):
+    sort by (keys..., value), locate each group's valid run, average the
+    two middle elements.  Null values are excluded; all-null groups are
+    null.  Returns (float64 medians, validity)."""
+    from .common import (adjacent_differs, chunked_cumsum,
+                         grouping_sort_operands)
+    n = value_data.shape[0]
+    key_ops = grouping_sort_operands(key_datas, key_valids)
+    val_ops = grouping_sort_operands((value_data,), (value_valid,))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(key_ops + val_ops + [iota], dimension=0,
+                              is_stable=False,
+                              num_keys=len(key_ops) + len(val_ops))
+    perm = sorted_all[-1]
+    key_boundary = jnp.zeros(n, jnp.bool_)
+    for op in sorted_all[:len(key_ops)]:
+        key_boundary = key_boundary | adjacent_differs(op)
+    valid_sorted = sorted_all[len(key_ops)] == 1   # value null-rank
+    group_id = chunked_cumsum(key_boundary.astype(jnp.int32)) - 1
+
+    starts = jnp.nonzero(key_boundary, size=seg_count,
+                         fill_value=n)[0].astype(jnp.int32)
+    nulls = jax.ops.segment_sum((~valid_sorted).astype(jnp.int32), group_id,
+                                num_segments=seg_count,
+                                indices_are_sorted=True)
+    vcount = jax.ops.segment_sum(valid_sorted.astype(jnp.int32), group_id,
+                                 num_segments=seg_count,
+                                 indices_are_sorted=True)
+    # valid run of group g: [starts + nulls, starts + nulls + vcount)
+    # (value grouping operands rank nulls first within the key group)
+    run0 = starts + nulls
+    lo = run0 + jnp.maximum(vcount - 1, 0) // 2
+    hi = run0 + vcount // 2
+    sorted_vals = jnp.take(value_data, jnp.take(
+        perm, jnp.clip(jnp.stack([lo, hi]), 0, max(n - 1, 0))))
+    med = (sorted_vals[0].astype(jnp.float64)
+           + sorted_vals[1].astype(jnp.float64)) / 2.0
+    if scale:
+        med = med * (10.0 ** scale)
+    return med, vcount > 0
 
 
 @functools.partial(jax.jit, static_argnames=("seg_count",))
@@ -285,7 +343,7 @@ def _agg_out_dtype(dtype: DType, how: str) -> DType:
         return INT64
     if how == "sum":
         return _sum_dtype(dtype)
-    if how in ("mean", "var", "std"):
+    if how in ("mean", "var", "std", "median"):
         return FLOAT64
     return dtype                    # min/max/first/last keep the input type
 
@@ -301,7 +359,7 @@ def _empty_result(table: Table, keys: Sequence[str],
             dtype = INT64
         elif how == "sum":
             dtype = _sum_dtype(src.dtype)
-        elif how in ("mean", "var", "std"):
+        elif how in ("mean", "var", "std", "median"):
             dtype = FLOAT64
         else:
             dtype = src.dtype

@@ -206,6 +206,22 @@ class TestGroupBySorted:
         assert not _Bound(p, t).group_metas[0].dense
         _check(p, t)
 
+    def test_median_plan_matches_eager(self, rng):
+        t = self._wide_table(rng)
+        p = (plan().filter(col("v") > -40)
+             .groupby_agg(["k"], [("f", "median", "fm"),
+                                  ("v", "median", "vm"),
+                                  ("v", "sum", "vs")])
+             .sort_by(["k"]).limit(200))
+        _check(p, t, rtol=1e-12, atol=1e-12)
+
+    def test_median_forces_sorted_path(self, rng):
+        from spark_rapids_tpu.exec.compile import _Bound
+        t = _mixed_table(rng)
+        p = plan().groupby_agg(["k1"], [("f64", "median", "m")])
+        assert not _Bound(p, t).group_metas[0].dense
+        _check(p, t, rtol=1e-12, atol=1e-12)
+
     def test_nunique_with_filter_and_strings(self, rng):
         t = _mixed_table(rng, with_strings=True)
         p = (plan().filter(col("f64") > 0)

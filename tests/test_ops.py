@@ -240,6 +240,32 @@ class TestGroupBy:
             want = len(set(v[(k == key) & vmask]))
             assert got == want, (key, got, want)
 
+    def test_median_random_vs_numpy(self):
+        import numpy as np
+        rng = np.random.default_rng(13)
+        n = 4000
+        k = rng.integers(0, 30, n)
+        v = rng.normal(size=n)
+        vmask = rng.random(n) > 0.25
+        t = Table([
+            ("k", Column.from_numpy(k.astype(np.int64))),
+            ("v", Column.from_numpy(v, validity=vmask)),
+        ])
+        out = ops.groupby_agg(t, ["k"], [("v", "median", "m")]).to_pydict()
+        for key, got in zip(out["k"], out["m"]):
+            vals = v[(k == key) & vmask]
+            want = float(np.median(vals)) if vals.size else None
+            if want is None:
+                assert got is None
+            else:
+                assert got == pytest.approx(want, rel=1e-12), key
+
+    def test_median_all_null_group(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "v": [None, None, 7]},
+                              dtypes={"k": dt.INT32, "v": dt.INT64})
+        out = ops.groupby_agg(t, ["k"], [("v", "median", "m")]).to_pydict()
+        assert out["m"] == [None, 7.0]
+
     def test_nunique_strings(self):
         t = Table.from_pydict(
             {"k": [1, 1, 1, 2], "s": ["a", "b", "a", None]},
