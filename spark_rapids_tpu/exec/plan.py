@@ -158,6 +158,16 @@ class Plan:
         dom = tuple((domains or {}).get(k) for k in keys)
         return Plan(self.steps + (GroupAggStep(keys, tuple(aggs), dom),))
 
+    def distinct(self, *keys: str,
+                 domains: Optional[dict[str, tuple[int, int]]] = None
+                 ) -> "Plan":
+        """Unique combinations of ``keys`` (Spark ``dropDuplicates`` on a
+        key subset, output narrowed to the keys), as a group-by with no
+        aggregates — dense-domain keys need no sort at all."""
+        if not keys:
+            raise ValueError("distinct needs at least one key column")
+        return self.groupby_agg(list(keys), [], domains=domains)
+
     def join_broadcast(self, table: Table, on: Optional[str] = None,
                        left_on: Optional[str] = None,
                        right_on: Optional[str] = None,

@@ -134,6 +134,15 @@ class TestGroupByDense:
              .sort_by(["k1", "k2"]))
         _check(p, t, rtol=1e-9, atol=1e-9)
 
+    def test_distinct_dense_and_sorted(self, rng):
+        t = _mixed_table(rng)
+        for p in (plan().distinct("k1", "k2").sort_by(["k1", "k2"]),
+                  plan().filter(col("f64") > 0).distinct("v64")
+                  .sort_by(["v64"])):
+            got = p.run(t)
+            want = run_plan_eager(p, t)
+            assert_tables_equal(want, got)
+
     def test_string_key_dense(self, rng):
         t = _mixed_table(rng, with_strings=True)
         p = plan().groupby_agg(["s"], [("v64", "sum", "vs"),
