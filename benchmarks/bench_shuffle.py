@@ -98,6 +98,35 @@ def main():
                       "value": round(n / dt, 1), "unit": "rows/sec",
                       "devices": n_dev}))
 
+    # Distributed PLAN (shuffle-free): per-shard filter + dense group-by,
+    # (cells,)-sized psum merge — the exec-layer path (exec/dist.py).
+    from spark_rapids_tpu.exec import col, plan
+    small = srt.Table([
+        ("key", Column.from_numpy(
+            (np.asarray(table["key"].data) % 199).astype(np.int64))),
+        ("val", table["val"]),
+    ])
+    p = (plan().filter(col("val") < 900)
+         .groupby_agg(["key"], [("val", "sum", "s"), ("val", "count", "c")],
+                      domains={"key": (0, 198)})
+         .sort_by(["key"]))
+    sdist = shard_table(small, mesh)
+    out = p.run_dist(sdist, mesh)
+    bump = int(out.to_pydict()["c"][0]) & 1
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        sdist2 = shard_table(srt.Table([
+            ("key", Column(data=small["key"].data * 1 + 0 * bump,
+                           dtype=small["key"].dtype)),
+            ("val", Column(data=small["val"].data + bump,
+                           dtype=small["val"].dtype))]), mesh)
+        out = p.run_dist(sdist2, mesh)
+        bump = int(out.to_pydict()["c"][0]) & 1
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"metric": f"dist_plan_dense_groupby_{n_dev}dev",
+                      "value": round(n / dt, 1), "unit": "rows/sec",
+                      "devices": n_dev}))
+
 
 if __name__ == "__main__":
     main()
