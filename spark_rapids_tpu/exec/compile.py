@@ -851,7 +851,7 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
             order = list(step.keys) + [out for _, _, out in step.aggs]
         elif isinstance(step, JoinStep) and step.how in ("inner", "left"):
             order += [nm for nm in step.table.names
-                      if nm != step.right_on and nm not in order]
+                      if nm not in step.right_on and nm not in order]
         elif isinstance(step, WindowStep):
             if step.out not in order:
                 order.append(step.out)
@@ -999,10 +999,11 @@ def explain_plan(plan: Plan, table: Table) -> str:
         elif isinstance(step, JoinStep):
             meta = bound.join_metas[ji]
             ji += 1
+            keys = ", ".join(
+                f"{km.probe_name}:[{km.lo},{km.hi}]" for km in meta.keys)
             lines.append(
                 f"  BroadcastJoin[{meta.how}, probe={meta.mode}, "
-                f"build={meta.dim_rows} rows, keys [{meta.lo},{meta.hi}]] "
-                f"on {meta.left_on}")
+                f"build={meta.dim_rows} rows] on {keys}")
         elif isinstance(step, WindowStep):
             lines.append(
                 f"  Window[{step.func} -> {step.out}; partition by "
@@ -1046,12 +1047,17 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
         elif isinstance(step, GroupAggStep):
             t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
         elif isinstance(step, JoinStep):
-            joined = ops.join(t, step.table, left_on=[step.left_on],
-                              right_on=[step.right_on], how=step.how)
-            if (step.how in ("inner", "left")
-                    and step.left_on != step.right_on
-                    and step.right_on in joined):
-                joined = joined.drop([step.right_on])
+            # Rename build keys to hidden temporaries first so a build-key
+            # name equal to a PROBE column can never be suffix-renamed by
+            # the eager join (the compiled path always drops build keys).
+            hidden = {rn: f"__rk{i}__" for i, rn in enumerate(step.right_on)}
+            build = step.table.rename(hidden)
+            joined = ops.join(t, build, left_on=list(step.left_on),
+                              right_on=[hidden[rn] for rn in step.right_on],
+                              how=step.how)
+            if step.how in ("inner", "left"):
+                joined = joined.drop(
+                    [h for h in hidden.values() if h in joined])
             t = joined
         elif isinstance(step, WindowStep):
             from ..ops import window as W

@@ -13,15 +13,21 @@ statically at bind time and cached per build-key buffer identity:
   probe runs a vectorized binary search (``jnp.searchsorted``, log2(D)
   small-table gathers).
 
-Both run sync-free inside the plan program.  Build keys must be unique
-(dimension-table contract — checked at bind); many-to-many joins with
-data-dependent expansion stay in the eager layer (ops.join, which the
-reference's cuDF hash join envelope maps to).
+Composite (multi-column) keys are **bit-packed** into one int64 probe
+word at bind time: each key contributes ``ceil(log2(span+1))`` bits at a
+static shift, derived from the build side's value ranges — the probe side
+computes the same packing in-program and out-of-range values can never
+alias (they fail the per-key range mask first).
 
-Null semantics: null probe keys and null build keys never match
-(Spark/cuDF equi-join); a left join nulls the build payloads of unmatched
-rows, inner/semi drop them via the selection mask, anti keeps exactly
-them.
+Both probes run sync-free inside the plan program.  Build keys must be
+unique (dimension-table contract — checked at bind); many-to-many joins
+with data-dependent expansion stay in the eager layer (ops.join, which
+the reference's cuDF hash join envelope maps to).
+
+Null semantics: a null in ANY probe or build key column means the row
+never matches (Spark/cuDF equi-join); a left join nulls the build
+payloads of unmatched rows, inner/semi drop them via the selection mask,
+anti keeps exactly them.
 """
 
 from __future__ import annotations
@@ -33,11 +39,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..column import Column
-from ..dtypes import BOOL8, INT32
+from ..dtypes import INT32, INT64
 from .plan import JoinStep
 
 #: Max slot-array cells for the direct probe (int32 => 16 MB at the cap).
 DIRECT_PROBE_MAX = 1 << 22
+
+#: Max total bits for a packed composite key (int64, sign bit spared).
+MAX_PACKED_BITS = 62
+
+
+@dataclass(frozen=True)
+class JoinKeyMeta:
+    """One column of a (possibly composite) join key."""
+    probe_name: str
+    lo: int                              # build-side min (valid rows)
+    hi: int                              # build-side max
+    shift: int                           # bit position in the packed word
+    type_id: int                         # probe dtype must match exactly
+    scale: int
 
 
 @dataclass(frozen=True)
@@ -45,16 +65,12 @@ class JoinMeta:
     """Static join description (part of the compile-cache key)."""
     index: int
     how: str
-    left_on: str
+    keys: tuple[JoinKeyMeta, ...]
     mode: str                            # "direct" | "search"
-    lo: int
-    hi: int
+    packed_hi: int                       # max packed key value
     dim_rows: int
-    #: build rows with a non-null key (0 => nothing can ever match)
+    #: build rows where every key column is non-null (0 => no matches)
     valid_keys: int
-    #: build key type id (probe key must match exactly)
-    key_type_id: int
-    key_scale: int
     #: fixed-width build payloads: (side-input name, output name)
     pays: tuple[tuple[str, str], ...]
     #: string build payloads: (build column name, output name)
@@ -64,46 +80,73 @@ class JoinMeta:
     rowid_name: Optional[str]
 
 
-# probe-structure cache: build key column buffers -> (mode, lo, hi, arrays)
+# probe-structure cache: build key column buffers -> (key metas sans
+# probe names, mode, packed_hi, arrays)
 _PROBE_CACHE: dict = {}
 
 
-def _build_probe(key: Column):
-    """(mode, lo, hi, side arrays) for a build-side key column; cached."""
+def _build_probe(key_cols: list[Column]):
+    """(per-key (lo, hi, shift), mode, packed_hi, side arrays); cached per
+    build key buffer identities."""
     from .stats import _guarded_cache_get, _guarded_cache_put
-    buffers = ((key.data,) if key.validity is None
-               else (key.data, key.validity))
+    buffers = tuple(b for c in key_cols
+                    for b in (c.data, c.validity) if b is not None)
     cache_key = tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_PROBE_CACHE, cache_key, buffers)
     if hit is not None:
         return hit
 
-    np_keys = np.asarray(key.data)
-    rows = np.arange(np_keys.shape[0], dtype=np.int32)
-    if key.validity is not None:
-        m = np.asarray(key.validity)
-        np_keys, rows = np_keys[m], rows[m]
-    if np_keys.size == 0:
-        result = ("search", 0, 0,
-                  {"keys": jnp.zeros(0, key.data.dtype),
+    n = key_cols[0].size
+    valid = np.ones(n, np.bool_)
+    for c in key_cols:
+        if c.validity is not None:
+            valid &= np.asarray(c.validity)
+    rows = np.arange(n, dtype=np.int32)[valid]
+    np_keys = [np.asarray(c.data)[valid] for c in key_cols]
+
+    if rows.size == 0:
+        result = ((tuple((0, 0, 0) for _ in key_cols)), "search", 0, 0,
+                  {"keys": jnp.zeros(0, jnp.int64),
                    "rows": jnp.zeros(0, jnp.int32)})
         _guarded_cache_put(_PROBE_CACHE, cache_key, buffers, result)
         return result
-    if np.unique(np_keys).size != np_keys.size:
+
+    los = [int(k.min()) for k in np_keys]
+    his = [int(k.max()) for k in np_keys]
+    bits = [max(int(hi - lo).bit_length(), 1)
+            for lo, hi in zip(los, his)]
+    if sum(bits) > MAX_PACKED_BITS:
+        raise ValueError(
+            f"composite join key needs {sum(bits)} bits packed "
+            f"(> {MAX_PACKED_BITS}); use the eager ops.join")
+    shifts = []
+    at = 0
+    for b in reversed(bits):             # last key = least significant
+        shifts.append(at)
+        at += b
+    shifts = list(reversed(shifts))
+
+    packed = np.zeros(rows.size, np.int64)
+    for k, lo, sh in zip(np_keys, los, shifts):
+        packed |= (k.astype(np.int64) - lo) << sh
+    if np.unique(packed).size != packed.size:
         raise ValueError(
             "broadcast join requires unique build-side keys "
             "(use the eager ops.join for many-to-many joins)")
-    lo, hi = int(np_keys.min()), int(np_keys.max())
-    span = hi - lo + 1
-    if span <= DIRECT_PROBE_MAX:
-        lookup = np.full(span, -1, np.int32)
-        lookup[(np_keys - lo).astype(np.int64)] = rows
-        result = ("direct", lo, hi, {"lookup": jnp.asarray(lookup)})
+    packed_hi = int(packed.max())
+
+    if packed_hi + 1 <= DIRECT_PROBE_MAX:
+        lookup = np.full(packed_hi + 1, -1, np.int32)
+        lookup[packed] = rows
+        arrays = {"lookup": jnp.asarray(lookup)}
+        mode = "direct"
     else:
-        order = np.argsort(np_keys, kind="stable")
-        result = ("search", lo, hi,
-                  {"keys": jnp.asarray(np_keys[order]),
-                   "rows": jnp.asarray(rows[order].astype(np.int32))})
+        order = np.argsort(packed, kind="stable")
+        arrays = {"keys": jnp.asarray(packed[order]),
+                  "rows": jnp.asarray(rows[order])}
+        mode = "search"
+    result = (tuple(zip(los, his, shifts)), mode, packed_hi,
+              int(rows.size), arrays)
     _guarded_cache_put(_PROBE_CACHE, cache_key, buffers, result)
     return result
 
@@ -112,35 +155,40 @@ def bind_join(bound, step: JoinStep, index: int,
               current_names: list[str]) -> JoinMeta:
     """Register side inputs on ``bound`` and produce the static meta."""
     dim = step.table
-    if (step.left_on in bound.string_cols
-            or step.left_on in bound.dictionaries):
-        raise TypeError(
-            f"broadcast join probe key {step.left_on!r} is a string column; "
-            f"dictionary-encode both sides or use the eager ops.join")
-    if step.right_on not in dim:
-        raise KeyError(f"build-side key {step.right_on!r} not in "
-                       f"{list(dim.names)}")
-    key = dim[step.right_on]
-    if key.offsets is not None or key.dtype.is_floating:
-        raise TypeError(
-            f"broadcast join keys must be integer-typed "
-            f"({step.right_on!r} is {key.dtype.type_id.name}); "
-            f"dictionary-encode strings or use the eager ops.join")
+    key_cols = []
+    for ln, rn in zip(step.left_on, step.right_on):
+        if ln in bound.string_cols or ln in bound.dictionaries:
+            raise TypeError(
+                f"broadcast join probe key {ln!r} is a string column; "
+                f"dictionary-encode both sides or use the eager ops.join")
+        if rn not in dim:
+            raise KeyError(f"build-side key {rn!r} not in "
+                           f"{list(dim.names)}")
+        c = dim[rn]
+        if c.offsets is not None or c.dtype.is_floating:
+            raise TypeError(
+                f"broadcast join keys must be integer-typed "
+                f"({rn!r} is {c.dtype.type_id.name}); "
+                f"dictionary-encode strings or use the eager ops.join")
+        key_cols.append(c)
 
-    mode, lo, hi, arrays = _build_probe(key)
-    valid_keys = (dim.num_rows if key.validity is None
-                  else int(np.asarray(key.validity).sum()))
+    spans, mode, packed_hi, valid_keys, arrays = _build_probe(key_cols)
     prefix = f"__join{index}__"
     for nm, arr in arrays.items():
         bound.side_inputs[prefix + nm] = Column(
-            data=arr, dtype=INT32 if arr.dtype == jnp.int32 else key.dtype)
+            data=arr, dtype=INT32 if arr.dtype == jnp.int32 else INT64)
 
+    key_metas = tuple(
+        JoinKeyMeta(ln, lo, hi, sh, int(c.dtype.type_id), c.dtype.scale)
+        for ln, c, (lo, hi, sh) in zip(step.left_on, key_cols, spans))
+
+    right_keys = set(step.right_on)
     pays: list[tuple[str, str]] = []
     str_pays: list[tuple[str, str]] = []
     rowid_name = None
     if step.how in ("inner", "left"):
         for name, c in dim.items():
-            if name == step.right_on:
+            if name in right_keys:
                 continue
             if name in current_names:
                 raise ValueError(
@@ -157,45 +205,54 @@ def bind_join(bound, step: JoinStep, index: int,
             bound.join_string_srcs[rowid_name] = [
                 (dim[src], out) for src, out in str_pays]
 
-    return JoinMeta(index, step.how, step.left_on, mode, lo, hi,
-                    dim.num_rows, valid_keys, int(key.dtype.type_id),
-                    key.dtype.scale, tuple(pays), tuple(str_pays),
+    return JoinMeta(index, step.how, key_metas, mode, packed_hi,
+                    dim.num_rows, valid_keys, tuple(pays), tuple(str_pays),
                     rowid_name)
 
 
 def trace_join(cols, sel, side, meta: JoinMeta):
     """Traced probe + payload attach (runs inside the plan program)."""
-    k = cols[meta.left_on]
-    if (int(k.dtype.type_id) != meta.key_type_id
-            or k.dtype.scale != meta.key_scale):
-        raise TypeError(
-            f"join key dtype mismatch: probe {meta.left_on!r} is "
-            f"{k.dtype!r}, build key type id is {meta.key_type_id} "
-            f"(cast first)")
-    kd = k.data
-    in_range = (kd >= jnp.asarray(meta.lo, kd.dtype)) & \
-               (kd <= jnp.asarray(meta.hi, kd.dtype))
-    if k.validity is not None:
-        in_range = in_range & k.validity
+    n = next(iter(cols.values())).size
+    packed = jnp.zeros(n, jnp.int64)
+    in_range = jnp.ones(n, jnp.bool_)
+    for km in meta.keys:
+        k = cols[km.probe_name]
+        if (int(k.dtype.type_id) != km.type_id
+                or k.dtype.scale != km.scale):
+            raise TypeError(
+                f"join key dtype mismatch: probe {km.probe_name!r} is "
+                f"{k.dtype!r}, build key type id is {km.type_id} "
+                f"(cast first)")
+        kd = k.data
+        ok = (kd >= jnp.asarray(km.lo, kd.dtype)) & \
+             (kd <= jnp.asarray(km.hi, kd.dtype))
+        if k.validity is not None:
+            ok = ok & k.validity
+        in_range = in_range & ok
+        part = (jnp.clip(kd, jnp.asarray(km.lo, kd.dtype),
+                         jnp.asarray(km.hi, kd.dtype)).astype(jnp.int64)
+                - km.lo) << km.shift
+        packed = packed | part
     prefix = f"__join{meta.index}__"
 
     if meta.valid_keys == 0:
-        dimrow = jnp.zeros(kd.shape[0], jnp.int32)
-        found = jnp.zeros(kd.shape[0], jnp.bool_)
+        dimrow = jnp.zeros(n, jnp.int32)
+        found = jnp.zeros(n, jnp.bool_)
     elif meta.mode == "direct":
         lookup = side[prefix + "lookup"].data
-        span = meta.hi - meta.lo + 1
-        slot = jnp.clip((kd - jnp.asarray(meta.lo, kd.dtype)).astype(jnp.int32),
-                        0, span - 1)
+        slot = jnp.clip(packed, 0, meta.packed_hi).astype(jnp.int32)
         dimrow = jnp.take(lookup, slot)
-        found = in_range & (dimrow >= 0)
+        # per-key in-range probes can still PACK above the max observed
+        # build packing; without this guard the clip would collapse them
+        # onto the build row holding the max packed key
+        found = in_range & (packed <= meta.packed_hi) & (dimrow >= 0)
     else:
         skeys = side[prefix + "keys"].data
         srows = side[prefix + "rows"].data
         d = skeys.shape[0]
-        pos = jnp.clip(jnp.searchsorted(skeys, kd).astype(jnp.int32),
+        pos = jnp.clip(jnp.searchsorted(skeys, packed).astype(jnp.int32),
                        0, d - 1)
-        found = in_range & (jnp.take(skeys, pos) == kd)
+        found = in_range & (jnp.take(skeys, pos) == packed)
         dimrow = jnp.take(srows, pos)
     dimrow = jnp.clip(dimrow, 0, max(meta.dim_rows - 1, 0))
 

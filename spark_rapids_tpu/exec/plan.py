@@ -69,13 +69,14 @@ class JoinStep:
     """Broadcast equi-join against a small bound build-side table.
 
     The build table rides inside the step (identity-hashed: rebinding the
-    same Table object reuses the compiled program); its keys must be
-    unique — the dimension-table contract of a Spark broadcast hash join.
-    General many-to-many joins (data-dependent output size) stay in the
-    eager layer (:func:`...ops.join.join`)."""
+    same Table object reuses the compiled program); its (possibly
+    composite) keys must be unique — the dimension-table contract of a
+    Spark broadcast hash join.  General many-to-many joins
+    (data-dependent output size) stay in the eager layer
+    (:func:`...ops.join.join`)."""
     table: object                      # Table (identity hash/eq)
-    left_on: str
-    right_on: str
+    left_on: tuple[str, ...]
+    right_on: tuple[str, ...]
     how: str                           # inner | left | semi | anti
 
 
@@ -168,16 +169,19 @@ class Plan:
             raise ValueError("distinct needs at least one key column")
         return self.groupby_agg(list(keys), [], domains=domains)
 
-    def join_broadcast(self, table: Table, on: Optional[str] = None,
-                       left_on: Optional[str] = None,
-                       right_on: Optional[str] = None,
+    def join_broadcast(self, table: Table,
+                       on: Optional[Sequence[str] | str] = None,
+                       left_on: Optional[Sequence[str] | str] = None,
+                       right_on: Optional[Sequence[str] | str] = None,
                        how: str = "inner") -> "Plan":
-        """Join against a broadcast build-side ``table`` with unique keys.
+        """Join against a broadcast build-side ``table`` with unique keys
+        (single or composite — composite keys are bit-packed into one
+        probe word at bind time).
 
         ``how``: "inner", "left", "semi" (probe rows with a match), or
         "anti" (probe rows without one).  The build side's non-key columns
         are appended to the schema (name collisions are an error — rename
-        first); its key column is dropped (it equals the probe key).
+        first); its key columns are dropped (they equal the probe keys).
         """
         if how not in ("inner", "left", "semi", "anti"):
             raise ValueError(f"unsupported join type {how!r}")
@@ -185,7 +189,14 @@ class Plan:
             left_on = right_on = on
         if not left_on or not right_on:
             raise ValueError("join keys: pass `on=` or left_on/right_on")
-        return Plan(self.steps + (JoinStep(table, left_on, right_on, how),))
+        if isinstance(left_on, str):
+            left_on = [left_on]
+        if isinstance(right_on, str):
+            right_on = [right_on]
+        if len(left_on) != len(right_on):
+            raise ValueError("left_on/right_on must have the same length")
+        return Plan(self.steps + (JoinStep(table, tuple(left_on),
+                                           tuple(right_on), how),))
 
     def window(self, out: str, func: str,
                partition_by: Sequence[str] | str,

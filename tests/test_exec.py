@@ -371,6 +371,98 @@ class TestBroadcastJoin:
             t)
         assert_tables_equal(want, got)
 
+    def test_composite_key_join(self, rng):
+        n = 1500
+        d = 60
+        a = np.repeat(np.arange(6), 10)
+        b = np.tile(np.arange(10), 6)
+        dim = Table([
+            ("da", Column.from_numpy(a.astype(np.int64))),
+            ("db", Column.from_numpy(b.astype(np.int16))),
+            ("w", Column.from_numpy(rng.normal(size=d))),
+        ])
+        f = Table([
+            ("fa", Column.from_numpy(rng.integers(0, 8, n).astype(np.int64),
+                                     validity=rng.random(n) > 0.1)),
+            ("fb", Column.from_numpy(rng.integers(0, 12, n).astype(np.int16))),
+            ("v", Column.from_numpy(rng.normal(size=n))),
+        ])
+        for how in ("inner", "left", "semi", "anti"):
+            p = plan().join_broadcast(dim, left_on=["fa", "fb"],
+                                      right_on=["da", "db"], how=how)
+            _check(p, f)
+
+    def test_composite_key_search_mode(self, rng):
+        import spark_rapids_tpu.exec.join as J
+        from spark_rapids_tpu.exec.compile import _Bound
+        n, d = 500, 40
+        dim = Table([
+            ("da", Column.from_numpy(
+                (np.arange(d) * 100_000).astype(np.int64))),
+            ("db", Column.from_numpy(np.arange(d).astype(np.int64))),
+            ("w", Column.from_numpy(np.ones(d))),
+        ])
+        f = Table([
+            ("fa", Column.from_numpy(
+                (rng.integers(0, 50, n) * 100_000).astype(np.int64))),
+            ("fb", Column.from_numpy(rng.integers(0, 50, n).astype(np.int64))),
+        ])
+        old = J.DIRECT_PROBE_MAX
+        J.DIRECT_PROBE_MAX = 64
+        try:
+            p = plan().join_broadcast(dim, left_on=["fa", "fb"],
+                                      right_on=["da", "db"])
+            assert _Bound(p, f).join_metas[0].mode == "search"
+            _check(p, f)
+        finally:
+            J.DIRECT_PROBE_MAX = old
+
+    def test_composite_no_alias_above_packed_hi(self, rng):
+        # Review repro: per-key-in-range probe (1,5) packs to 13 >
+        # packed_hi=8; the direct lookup must MISS, not clip onto the
+        # build row holding the max packed key.
+        dim = Table([
+            ("da", Column.from_numpy(np.array([0, 1], np.int64))),
+            ("db", Column.from_numpy(np.array([5, 0], np.int64))),
+            ("w", Column.from_numpy(np.array([10.0, 20.0]))),
+        ])
+        f = Table([
+            ("fa", Column.from_numpy(np.array([1, 0, 1], np.int64))),
+            ("fb", Column.from_numpy(np.array([5, 5, 0], np.int64))),
+        ])
+        p = plan().join_broadcast(dim, left_on=["fa", "fb"],
+                                  right_on=["da", "db"])
+        _check(p, f)
+        got = p.run(f)
+        assert got.to_pydict() == {"fa": [0, 1], "fb": [5, 0],
+                                   "w": [10.0, 20.0]}
+
+    def test_composite_build_key_name_collides_with_probe_col(self, rng):
+        # build key named like a PROBE column: compiled drops it; the
+        # eager oracle must agree (no suffix-renamed leftovers).
+        dim = Table([
+            ("fb", Column.from_numpy(np.arange(4, dtype=np.int64))),
+            ("da", Column.from_numpy(np.arange(4, dtype=np.int64))),
+            ("w", Column.from_numpy(np.ones(4))),
+        ])
+        f = Table([
+            ("fa", Column.from_numpy(np.array([0, 1, 2], np.int64))),
+            ("fb", Column.from_numpy(np.array([0, 1, 9], np.int64))),
+        ])
+        p = plan().join_broadcast(dim, left_on=["fa", "fb"],
+                                  right_on=["da", "fb"], how="left")
+        _check(p, f)
+
+    def test_composite_duplicate_keys_raise(self, rng):
+        f = self._fact(rng)
+        dim = Table([
+            ("da", Column.from_numpy(np.array([1, 1, 2], np.int64))),
+            ("db", Column.from_numpy(np.array([5, 5, 6], np.int64))),
+            ("w", Column.from_numpy(np.ones(3)))])
+        with pytest.raises(ValueError, match="unique build-side keys"):
+            plan().join_broadcast(dim, left_on=["fk", "fk"],
+                                  right_on=["da", "db"]).run(f)
+
     def test_null_keys_never_match(self, rng):
         f = Table([("fk", Column.from_pylist([1, None, 3, 99], dt.INT64)),
                    ("fv", Column.from_numpy(np.ones(4)))])
