@@ -488,6 +488,12 @@ def _trace_limit(cols, sel, step: LimitStep):
 
 # -- group-by: dense-domain path --------------------------------------------
 
+def _int32_holds(km: _KeyMeta) -> bool:
+    """True when the key's (lo, hi) domain bounds both fit in int32, i.e.
+    slot math can run in widened int32 exactly (the common case)."""
+    return -(1 << 31) <= km.lo and km.hi < (1 << 31)
+
+
 def _dense_slot(col: Column, km: _KeyMeta) -> tuple[jax.Array, jax.Array]:
     """(slot, in-domain mask).  Rows whose key value falls outside the
     static (lo, hi) domain — only possible with a user-supplied hint that
@@ -496,7 +502,17 @@ def _dense_slot(col: Column, km: _KeyMeta) -> tuple[jax.Array, jax.Array]:
     raw = col.data
     ok = (raw >= jnp.asarray(km.lo, raw.dtype)) & \
          (raw <= jnp.asarray(km.hi, raw.dtype))
-    v = raw.astype(jnp.int32) - jnp.int32(km.lo)
+    if _int32_holds(km):
+        # lo/hi fit in int32: widen first so narrow keys (int8 spanning
+        # -128..127 has a 256-wide residual that int8 cannot hold) never
+        # wrap during the subtraction.
+        v = raw.astype(jnp.int32) - jnp.int32(km.lo)
+    else:
+        # lo/hi exceed the int32 range (int64/uint timestamps clustered
+        # around 2**40): subtract in the key's native dtype — the
+        # *residual* always fits in int32 (span <= _dense_max_cells).
+        # Out-of-domain rows may wrap here; ``ok`` masks them below.
+        v = (raw - jnp.asarray(km.lo, raw.dtype)).astype(jnp.int32)
     if km.nullable:
         v = v + 1
         if col.validity is not None:
@@ -677,12 +693,18 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
     for km, stride, size in zip(meta.keys, strides, meta.sizes):
         key_dtype = cols[km.name].dtype
         slot = (cell // jnp.int32(stride)) % jnp.int32(size)
-        if km.nullable:
-            data = (jnp.int32(km.lo) + slot - 1)
-            validity = slot > 0
+        # Reconstruction mirrors _dense_slot: int32 math when lo/hi fit
+        # (narrow dtypes' residuals would wrap natively), otherwise the
+        # key's native dtype (lo itself exceeds int32).  The null slot's
+        # wrapped value (slot-1 == -1 cast unsigned) sits under
+        # validity=False and is never observed.
+        adj = (slot - 1) if km.nullable else slot
+        if _int32_holds(km):
+            data = jnp.int32(km.lo) + adj
         else:
-            data = jnp.int32(km.lo) + slot
-            validity = None
+            data = (jnp.asarray(km.lo, key_dtype.jnp_dtype)
+                    + adj.astype(key_dtype.jnp_dtype))
+        validity = (slot > 0) if km.nullable else None
         out[km.name] = Column(data=data.astype(key_dtype.jnp_dtype),
                               validity=validity, dtype=key_dtype)
 

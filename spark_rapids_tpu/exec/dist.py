@@ -4,7 +4,9 @@ The TPU answer to how spark-rapids runs a physical plan across executors:
 instead of shuffling rows between workers over UCX, a distributed plan
 runs the SAME per-shard program on every device under ``shard_map`` and
 merges only the (cells,)-sized dense group-by accumulators with mesh
-collectives (``psum``/``pmin``/``pmax``) — for the aggregation queries
+collectives — every merge (min/max included, via the psum-gather trick
+in compile.py) is expressed as a SUM all-reduce because that is the one
+collective the target TPU stack lowers — for the aggregation queries
 that dominate TPC-DS, cross-device traffic is a few kilobytes riding ICI
 regardless of row count, and there is no shuffle at all.
 

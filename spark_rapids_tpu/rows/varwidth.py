@@ -279,19 +279,27 @@ def pack_var_rows(table: Table) -> VarRowBlob:
 
 
 @functools.lru_cache(maxsize=None)
-def _var_unpacker(schema: tuple[DType, ...], words_bucket: int, n: int,
-                  char_buckets: tuple[int, ...]):
-    """Jitted unpack for one (schema, pow2-padded sizes) class.  Char
-    buffers come back padded to their bucket; the caller slices to the
-    exact counts it already synced."""
+def _var_unpacker(schema: tuple[DType, ...], words_bucket: int,
+                  rows_bucket: int, char_buckets: tuple[int, ...]):
+    """Jitted unpack for one (schema, pow2-padded sizes) class.
+
+    Keyed on the pow2 *row bucket*, not the exact row count, matching the
+    pack side's size-class design: a stream of distinct blob sizes reuses
+    one compiled program per class instead of recompiling per blob.  The
+    caller pads ``row_offsets`` to the bucket (repeating the final offset)
+    and passes ``row_live`` so the padded tail — whose gathered slot words
+    are garbage — contributes zero string length and is sliced off on
+    return.  Char buffers come back padded to their bucket; the caller
+    slices to the exact counts it already synced.
+    """
     layout = compute_var_layout(schema)
     Wf = layout.fixed.row_size // 4
 
     @jax.jit
-    def unpack(words, row_offsets):
+    def unpack(words, row_offsets, row_live):
         from ..ops.common import chunked_cumsum
         word_off = row_offsets // 4
-        # Fixed part: gather each row's fixed words into the (Wf, n) image.
+        # Fixed part: gather each row's fixed words into the (Wf, nb) image.
         idx = word_off[:-1][None, :] + jnp.arange(Wf, dtype=jnp.int32)[:, None]
         image = jnp.take(words, jnp.clip(idx, 0, max(words_bucket - 1, 0)))
         datas, valids = unpack_words(layout.fixed, image)
@@ -302,16 +310,18 @@ def _var_unpacker(schema: tuple[DType, ...], words_bucket: int, n: int,
             slot = lax.bitcast_convert_type(datas[i], jnp.uint64)
             flen = (slot >> jnp.uint64(32)).astype(jnp.int32)
             foff = (slot & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
-            flen = jnp.where(valids[i], flen, 0)
+            flen = jnp.where(valids[i] & row_live, flen, 0)
             out_offsets = jnp.concatenate(
                 [jnp.zeros(1, jnp.int32),
                  chunked_cumsum(flen)])
-            # char c of the output buffer -> (row, intra) -> source byte
+            # char c of the output buffer -> (row, intra) -> source byte.
+            # Padded rows have zero length, so every cpos below the true
+            # char total resolves to a live row.
             cpos = jnp.arange(char_buckets[j], dtype=jnp.int32)
             crow = jnp.clip(
                 jnp.searchsorted(out_offsets, cpos,
                                  side="right").astype(jnp.int32) - 1,
-                0, n - 1) if n else jnp.zeros(char_buckets[j], jnp.int32)
+                0, rows_bucket - 1)
             intra = cpos - jnp.take(out_offsets, crow)
             src_byte = (jnp.take(row_offsets[:-1], crow)
                         + jnp.take(foff, crow) + intra)
@@ -398,13 +408,21 @@ def unpack_var_rows(blob: VarRowBlob, schema: Sequence[DType],
     char_counts = tuple(int(s) for s in jax.device_get(sums)) if sums else ()
 
     words_bucket = _pow2(max(total_words, 1))
+    rows_bucket = _pow2(n)
     char_buckets = tuple(_pow2(max(c, 1)) for c in char_counts)
     words = blob.words
     if words.shape[0] < words_bucket:
         words = jnp.concatenate(
             [words, jnp.zeros(words_bucket - words.shape[0], _U32)])
-    _, unpack = _var_unpacker(schema, words_bucket, n, char_buckets)
-    datas, valids, str_outs = unpack(words, blob.offsets)
+    # Pad offsets to the row bucket (repeat the final offset: empty ranges)
+    # so one compiled unpack serves every blob in the size class.
+    offsets = blob.offsets
+    if n < rows_bucket:
+        offsets = jnp.concatenate(
+            [offsets, jnp.full(rows_bucket - n, offsets[-1], offsets.dtype)])
+    row_live = jnp.arange(rows_bucket, dtype=jnp.int32) < jnp.int32(n)
+    _, unpack = _var_unpacker(schema, words_bucket, rows_bucket, char_buckets)
+    datas, valids, str_outs = unpack(words, offsets, row_live)
 
     columns = []
     si = 0
@@ -413,10 +431,12 @@ def unpack_var_rows(blob: VarRowBlob, schema: Sequence[DType],
             out_offsets, chars = str_outs[si]
             chars = chars[:char_counts[si]]
             si += 1
-            validity = valids[i]
-            columns.append((name, Column(data=chars, offsets=out_offsets,
+            validity = valids[i][:n]
+            columns.append((name, Column(data=chars,
+                                         offsets=out_offsets[:n + 1],
                                          validity=validity, dtype=STRING)))
         else:
-            columns.append((name, Column(data=datas[i], validity=valids[i],
+            columns.append((name, Column(data=datas[i][:n],
+                                         validity=valids[i][:n],
                                          dtype=dt)))
     return Table(columns)

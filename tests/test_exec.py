@@ -123,6 +123,41 @@ class TestGroupByDense:
                                domains={"k1": (0, 4)})
         _check(p, t)
 
+    def test_dense_int64_keys_beyond_int32(self, rng):
+        # An int64 key clustered far outside the int32 range but with a
+        # small span is still dense-eligible; slot math must subtract lo
+        # in the key's native dtype (not via an int32 cast of lo, which
+        # overflows at trace time).
+        n = 500
+        base = 1 << 40
+        keys = base + rng.integers(0, 7, n).astype(np.int64)
+        t = Table([
+            ("k", Column.from_numpy(keys, validity=rng.random(n) > 0.1)),
+            ("v", Column.from_numpy(
+                rng.integers(-100, 100, n).astype(np.int64))),
+        ])
+        p = plan().groupby_agg(["k"], [("v", "sum", "s"),
+                                       ("v", "min", "lo"),
+                                       ("v", "max", "hi")])
+        out = p.run(t)
+        assert "dense" in p.explain(t)
+        _check(p, t)
+        got_keys = [k for k in out["k"].to_pylist() if k is not None]
+        assert all(base <= k < base + 7 for k in got_keys)
+
+    def test_dense_int8_full_span(self, rng):
+        # Full -128..127 domain: the 256-wide residual exceeds int8 range,
+        # so slot math must widen to int32 before subtracting lo.
+        n = 300
+        t = Table([
+            ("k", Column.from_numpy(rng.integers(-128, 128, n).astype(np.int8))),
+            ("v", Column.from_numpy(
+                rng.integers(-100, 100, n).astype(np.int64))),
+        ])
+        p = plan().groupby_agg(["k"], [("v", "sum", "s")])
+        assert "dense" in p.explain(t)
+        _check(p, t)
+
     def test_groupby_then_sort(self, rng):
         t = _mixed_table(rng)
         p = (plan()
