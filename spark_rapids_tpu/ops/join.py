@@ -30,9 +30,9 @@ from .common import grouping_columns, pow2_bucket
 
 def _factorize_union(left: Table, right: Table, left_on: Sequence[str],
                      right_on: Sequence[str]):
-    """Factorize + probe: returns (rorder, lo, counts) from the fused
-    kernel; rows with any null key get a non-matching sentinel (-1 left,
-    -2 right) so nulls never join."""
+    """Factorize + probe: returns (rorder, lo, counts, rmatched) from the
+    fused kernel; rows with any null key get a non-matching sentinel
+    (-1 left, -2 right) so nulls never join."""
     n_left = left.num_rows
     merged_cols = []
     for lname, rname in zip(left_on, right_on):
@@ -63,7 +63,9 @@ def _factorize_probe_kernel(key_datas, key_valids, *, n_left):
     (sort + boundary + inverse scatter, null rows masked and sentineled),
     then probe the right side (argsort + two searchsorteds).  The eager
     form paid a dispatch per step; fused it is one device execution per
-    join schema.  Returns (rorder, lo, counts).
+    join schema.  Returns (rorder, lo, counts, rmatched) — ``rmatched``
+    (does any left row share this right row's key?) feeds the
+    unmatched-right tail of full/right outer joins.
     """
     from .common import adjacent_differs, grouping_sort_operands
     n = key_datas[0].shape[0]
@@ -90,7 +92,13 @@ def _factorize_probe_kernel(key_datas, key_valids, *, n_left):
     lo = jnp.searchsorted(rgid_sorted, lgid, side="left").astype(jnp.int32)
     hi = jnp.searchsorted(rgid_sorted, lgid, side="right").astype(jnp.int32)
     counts = (hi - lo).astype(jnp.int64)
-    return rorder, lo, counts
+    # Reverse probe: the -2 sentinel of null-key right rows never appears
+    # in lgid (left nulls are -1), so null right keys are never matched.
+    lgid_sorted = jax.lax.sort([lgid], dimension=0, num_keys=1)[0]
+    r_lo = jnp.searchsorted(lgid_sorted, rgid, side="left")
+    r_hi = jnp.searchsorted(lgid_sorted, rgid, side="right")
+    rmatched = r_hi > r_lo
+    return rorder, lo, counts, rmatched
 
 
 def _suffix_overlaps(left: Table, right: Table, drop_right: set[str],
@@ -111,10 +119,18 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
          how: str = "inner", suffixes: tuple[str, str] = ("_x", "_y")) -> Table:
     """Equi-join two tables.
 
-    ``how``: "inner", "left", "semi" (left rows with a match), or
-    "anti" (left rows without a match).
+    ``how``: "inner", "left", "right", "full" (alias "outer"), "semi"
+    (left rows with a match), or "anti" (left rows without a match).
+
+    Full/right outer append the unmatched right rows after the expansion
+    rows, with all-null left columns; when ``on=`` names shared keys, the
+    deduplicated key column is coalesced from the right side for those
+    rows (Spark USING-join / pandas merge semantics).  Null keys never
+    match on either side (they surface as unmatched rows in outer joins).
     """
-    if how not in ("inner", "left", "semi", "anti"):
+    if how == "outer":
+        how = "full"
+    if how not in ("inner", "left", "right", "full", "semi", "anti"):
         raise ValueError(f"unsupported join type {how!r}")
     if on is not None:
         if isinstance(on, str):
@@ -123,7 +139,8 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
     if not left_on or not right_on or len(left_on) != len(right_on):
         raise ValueError("join keys: pass `on=` or matching left_on/right_on")
 
-    rorder, lo, counts = _factorize_union(left, right, left_on, right_on)
+    rorder, lo, counts, rmatched = _factorize_union(left, right,
+                                                    left_on, right_on)
 
     if how == "semi":
         from .filter import _compact_table
@@ -137,8 +154,13 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
         keep_right_gid_cols = set(on)   # de-dup shared key columns
     left_out, right_names = _suffix_overlaps(left, right, keep_right_gid_cols,
                                              suffixes)
+    #: output name of each deduplicated key column -> right source name
+    #: (outer tails coalesce these from the right side)
+    key_coalesce = ({ln: rn for ln, rn in zip(left_on, right_on)}
+                    if on is not None else {})
 
-    left_join = how == "left"
+    left_join = how in ("left", "full")
+    with_tail = how in ("right", "full")
     if left_join and right.num_rows == 0:   # degenerate: all-null right side
         cols = [(n, c) for n, c in left_out.items()]
         for src_name, out_name in right_names:
@@ -147,8 +169,13 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
         return Table(cols)
 
     out_counts = jnp.maximum(counts, 1) if left_join else counts
-    total = int(out_counts.sum())                         # the one host sync
-    if total == 0:
+    if with_tail:
+        total, n_tail = (int(x) for x in jax.device_get(
+            (out_counts.sum(), (~rmatched).sum())))   # the one host sync
+    else:
+        total, n_tail = int(out_counts.sum()), 0      # the one host sync
+
+    if total == 0 and n_tail == 0:
         cols = [(n, Column(data=jnp.zeros(0, c.dtype.jnp_dtype), dtype=c.dtype)
                  if c.offsets is None else c.gather(jnp.zeros(0, jnp.int32)))
                 for n, c in left_out.items()]
@@ -156,8 +183,25 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
             c = right[src_name]
             cols.append((out_name, c.gather(jnp.zeros(0, jnp.int32))))
         return Table(cols)
-    bucket = pow2_bucket(total)
 
+    pieces = []
+    if total:
+        pieces.append(_expand_segment(left_out, right, right_names, rorder,
+                                      lo, counts, total, left_join))
+    if n_tail:
+        pieces.append(_unmatched_right_tail(left_out, right, right_names,
+                                            rmatched, n_tail, key_coalesce))
+    if len(pieces) == 1:
+        return pieces[0]
+    from .common import concat_tables
+    return concat_tables(pieces)
+
+
+def _expand_segment(left_out: Table, right: Table, right_names, rorder, lo,
+                    counts, total: int, left_join: bool) -> Table:
+    """The match-expansion rows (plus unmatched-left rows when
+    ``left_join``): the original inner/left join body."""
+    bucket = pow2_bucket(total)
     lfixed = [(n, c) for n, c in left_out.items() if c.offsets is None]
     rfixed = [(s, o) for s, o in right_names
               if right[s].offsets is None]
@@ -202,6 +246,27 @@ def join(left: Table, right: Table, on: Optional[Sequence[str] | str] = None,
             if left_join:
                 g = g.with_validity(g.valid_mask() & matched[:total])
             cols.append((out_name, g))
+    return Table(cols)
+
+
+def _unmatched_right_tail(left_out: Table, right: Table, right_names,
+                          rmatched, n_tail: int,
+                          key_coalesce: dict[str, str]) -> Table:
+    """Full/right outer tail: right rows with no left match, left columns
+    all-null except ``on=``-deduplicated keys (coalesced from the right)."""
+    from .filter import _compact_kernel
+    bucket = min(pow2_bucket(n_tail), int(rmatched.shape[0]))
+    idx, _, _ = _compact_kernel(~rmatched, (), (), bucket=bucket)
+    idx = idx[:n_tail]
+    cols: list[tuple[str, Column]] = []
+    for name, col in left_out.items():
+        rn = key_coalesce.get(name)
+        if rn is not None:
+            cols.append((name, right[rn].gather(idx)))
+        else:
+            cols.append((name, all_null_column(col.dtype, n_tail)))
+    for src_name, out_name in right_names:
+        cols.append((out_name, right[src_name].gather(idx)))
     return Table(cols)
 
 

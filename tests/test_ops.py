@@ -383,6 +383,103 @@ class TestJoin:
         with pytest.raises(ValueError, match="dtype mismatch"):
             ops.join(left, right, on="k")
 
+    def test_full_outer_basic(self):
+        left = Table.from_pydict({"k": [1, 2, 3], "l": [10, 20, 30]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [2, 4], "r": [200, 400]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k", how="full")
+        # Expansion rows first (left order), then unmatched right; the
+        # deduplicated key is coalesced from the right for the tail.
+        assert out.to_pydict() == {"k": [1, 2, 3, 4],
+                                   "l": [10, 20, 30, None],
+                                   "r": [None, 200, None, 400]}
+
+    def test_right_outer_basic(self):
+        left = Table.from_pydict({"k": [1, 2, 3], "l": [10, 20, 30]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [2, 4], "r": [200, 400]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k", how="right")
+        assert out.to_pydict() == {"k": [2, 4], "l": [20, None],
+                                   "r": [200, 400]}
+
+    def test_outer_null_keys_unmatched_both_sides(self):
+        # Null keys never match; full outer surfaces them as unmatched
+        # rows from BOTH sides (the Spark/cuDF contract pandas breaks —
+        # pandas matches NaN keys to each other).
+        left = Table.from_pydict({"k": [1, None], "l": [10, 20]},
+                                 dtypes={"k": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"k": [None, 1], "r": [100, 200]},
+                                  dtypes={"k": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, on="k", how="full")
+        assert out.to_pydict() == {"k": [1, None, None],
+                                   "l": [10, 20, None],
+                                   "r": [200, None, 100]}
+
+    def test_full_outer_distinct_key_names(self):
+        # left_on/right_on: both key columns survive; no coalescing.
+        left = Table.from_pydict({"lk": [1, 2], "l": [10, 20]},
+                                 dtypes={"lk": dt.INT32, "l": dt.INT64})
+        right = Table.from_pydict({"rk": [2, 4], "r": [200, 400]},
+                                  dtypes={"rk": dt.INT32, "r": dt.INT64})
+        out = ops.join(left, right, left_on=["lk"], right_on=["rk"],
+                       how="full")
+        assert out.to_pydict() == {"lk": [1, 2, None],
+                                   "l": [10, 20, None],
+                                   "rk": [None, 2, 4],
+                                   "r": [None, 200, 400]}
+
+    def test_full_outer_string_payloads(self):
+        left = Table.from_pydict({"k": [1, 2], "ls": ["a", None]},
+                                 dtypes={"k": dt.INT64, "ls": dt.STRING})
+        right = Table.from_pydict({"k": [2, 9], "rs": ["bb", "zz"]},
+                                  dtypes={"k": dt.INT64, "rs": dt.STRING})
+        out = ops.join(left, right, on="k", how="full")
+        assert out.to_pydict() == {"k": [1, 2, 9],
+                                   "ls": ["a", None, None],
+                                   "rs": [None, "bb", "zz"]}
+
+    def test_outer_random_sweep_vs_oracle(self, rng):
+        # Dict-based oracle with Spark null semantics (nulls never match).
+        n, m, hi = 400, 350, 50
+        lk = [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(0, hi, n)]
+        rk = [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(0, hi, m)]
+        lv = list(range(n))
+        rv = [x * 10 for x in range(m)]
+        left = Table.from_pydict({"k": lk, "lv": lv},
+                                 dtypes={"k": dt.INT64, "lv": dt.INT64})
+        right = Table.from_pydict({"k": rk, "rv": rv},
+                                  dtypes={"k": dt.INT64, "rv": dt.INT64})
+
+        def oracle(how):
+            rows = []
+            rmatched = [False] * m
+            for i, k in enumerate(lk):
+                matches = [j for j, kr in enumerate(rk)
+                           if k is not None and kr == k]
+                for j in matches:
+                    rmatched[j] = True
+                    rows.append((k, lv[i], rv[j]))
+                if not matches and how in ("left", "full"):
+                    rows.append((k, lv[i], None))
+            if how in ("right", "full"):
+                for j in range(m):
+                    if not rmatched[j]:
+                        rows.append((rk[j], None, rv[j]))
+            return rows
+
+        def rowkey(r):
+            return tuple((x is None, x) for x in r)
+
+        for how in ("inner", "left", "right", "full"):
+            got = ops.join(left, right, on="k", how=how).to_pydict()
+            got_rows = list(zip(got["k"], got["lv"], got["rv"]))
+            assert (sorted(got_rows, key=rowkey)
+                    == sorted(oracle(how), key=rowkey)), how
+
     def test_random_sweep_vs_pandas(self, rng):
         n = 500
         lk = rng.integers(0, 60, n).astype(np.int64)
