@@ -93,6 +93,10 @@ class Column:
         phys = dtype.np_dtype
         if values.dtype == np.bool_ and dtype == BOOL8:
             values = values.astype(np.uint8)
+        if dtype.is_two_word and (values.ndim != 2 or values.shape[1] != 2):
+            raise ValueError(
+                f"{dtype!r} needs an (n, 2) uint64 (lo, hi) word array, "
+                f"got shape {values.shape}")
         if values.dtype != phys:
             raise ValueError(
                 f"physical dtype mismatch: values are {values.dtype}, {dtype!r} needs {phys}")
@@ -111,8 +115,22 @@ class Column:
         if dtype == STRING:
             from .ops.strings import strings_from_pylist  # cycle-free: ops imports nothing back
             return strings_from_pylist(values)
-        phys = dtype.np_dtype
         n = len(values)
+        if dtype.is_two_word:
+            # Unscaled 128-bit ints -> (n, 2) uint64 (lo, hi) words,
+            # two's complement (Arrow/cudf decimal128 byte order).
+            data = np.zeros((n, 2), dtype=np.uint64)
+            mask = np.ones(n, dtype=np.bool_)
+            for i, v in enumerate(values):
+                if v is None:
+                    mask[i] = False
+                    continue
+                u = int(v) & ((1 << 128) - 1)
+                data[i, 0] = u & ((1 << 64) - 1)
+                data[i, 1] = u >> 64
+            validity = None if mask.all() else mask
+            return Column.from_numpy(data, validity, dtype)
+        phys = dtype.np_dtype
         data = np.zeros(n, dtype=phys)
         mask = np.ones(n, dtype=np.bool_)
         for i, v in enumerate(values):
@@ -141,6 +159,11 @@ class Column:
         vals, mask = self.to_numpy()
         if self.dtype == BOOL8:
             out = [bool(v) for v in vals]
+        elif self.dtype.is_two_word:
+            out = []
+            for lo, hi in vals:
+                u = (int(hi) << 64) | int(lo)
+                out.append(u - (1 << 128) if u >= (1 << 127) else u)
         else:
             out = [v.item() for v in vals]
         if mask is not None:
@@ -197,6 +220,9 @@ def all_null_column(dtype: DType, n: int) -> Column:
     if dtype == STRING:
         return Column(data=jnp.zeros(0, jnp.uint8), validity=validity,
                       offsets=jnp.zeros(n + 1, jnp.int32), dtype=dtype)
+    if dtype.is_two_word:
+        return Column(data=jnp.zeros((n, 2), dtype.jnp_dtype),
+                      validity=validity, dtype=dtype)
     return Column(data=jnp.zeros(n, dtype.jnp_dtype), validity=validity,
                   dtype=dtype)
 

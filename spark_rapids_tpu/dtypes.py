@@ -91,6 +91,13 @@ _PHYSICAL: dict[TypeId, np.dtype] = {
 
 _VARIABLE_WIDTH = frozenset({TypeId.STRING, TypeId.LIST, TypeId.STRUCT, TypeId.DICTIONARY32})
 
+#: DECIMAL128 has no 128-bit host/device scalar type; its device
+#: representation is an ``(n, 2) uint64`` array of little-endian
+#: (lo, hi) words in two's complement (Arrow/cudf byte order).  cudf
+#: treats it as a 16-byte fixed-width type (``fixed_point<__int128_t>``);
+#: the word layout here round-trips its bytes exactly.
+_TWO_WORD = frozenset({TypeId.DECIMAL128})
+
 
 @dataclass(frozen=True)
 class DType:
@@ -116,7 +123,12 @@ class DType:
     @property
     def is_fixed_width(self) -> bool:
         """Mirrors ``cudf::is_fixed_width`` for the ids we support on device."""
-        return self.type_id in _PHYSICAL
+        return self.type_id in _PHYSICAL or self.type_id in _TWO_WORD
+
+    @property
+    def is_two_word(self) -> bool:
+        """16-byte types stored as ``(n, 2) uint64`` (lo, hi) words."""
+        return self.type_id in _TWO_WORD
 
     @property
     def is_variable_width(self) -> bool:
@@ -150,6 +162,8 @@ class DType:
     @property
     def itemsize(self) -> int:
         """Element size in bytes (``cudf::size_of``); errors for variable width."""
+        if self.type_id in _TWO_WORD:
+            return 16
         try:
             return _PHYSICAL[self.type_id].itemsize
         except KeyError:
@@ -157,6 +171,8 @@ class DType:
 
     @property
     def np_dtype(self) -> np.dtype:
+        if self.type_id in _TWO_WORD:
+            return np.dtype(np.uint64)        # per-word dtype; data is (n, 2)
         try:
             return _PHYSICAL[self.type_id]
         except KeyError:
@@ -203,6 +219,14 @@ def decimal32(scale: int) -> DType:
 
 def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    """128-bit decimal (Spark's default for precision > 18; the reference
+    bridge reconstructs it from (type-id 27, scale) pairs,
+    RowConversionJni.cpp:56-61).  Device form: (n, 2) uint64 lo/hi words;
+    see :mod:`spark_rapids_tpu.ops.decimal128` for the limb arithmetic."""
+    return DType(TypeId.DECIMAL128, scale)
 
 
 def from_type_ids(type_ids, scales=None) -> list[DType]:

@@ -48,12 +48,14 @@ _PA_TO_TYPEID = {
 def _pa_type_to_dtype(t: pa.DataType) -> DType:
     if pa.types.is_decimal(t):
         # Arrow scale is digits right of the point; cudf scale is the base-10
-        # exponent (negated).  precision <= 9 -> decimal32, <= 18 -> decimal64.
-        if t.precision > 18:
-            raise ValueError(
-                f"decimal precision {t.precision} > 18 needs decimal128, "
-                f"which has no device representation yet")
-        type_id = TypeId.DECIMAL32 if t.precision <= 9 else TypeId.DECIMAL64
+        # exponent (negated).  precision <= 9 -> decimal32, <= 18 ->
+        # decimal64, else decimal128 ((n, 2) u64 word representation).
+        if t.precision <= 9:
+            type_id = TypeId.DECIMAL32
+        elif t.precision <= 18:
+            type_id = TypeId.DECIMAL64
+        else:
+            type_id = TypeId.DECIMAL128
         return DType(type_id, -t.scale)
     try:
         return DType(_PA_TO_TYPEID[t])
@@ -63,7 +65,8 @@ def _pa_type_to_dtype(t: pa.DataType) -> DType:
 
 def _dtype_to_pa_type(dtype: DType) -> pa.DataType:
     if dtype.is_decimal:
-        precision = 9 if dtype.type_id == TypeId.DECIMAL32 else 18
+        precision = {TypeId.DECIMAL32: 9, TypeId.DECIMAL64: 18,
+                     TypeId.DECIMAL128: 38}[dtype.type_id]
         return pa.decimal128(precision, -dtype.scale)
     for pa_t, tid in _PA_TO_TYPEID.items():
         if tid == dtype.type_id and pa_t != pa.large_string():
@@ -102,7 +105,19 @@ def from_arrow_array(arr: pa.Array | pa.ChunkedArray) -> Column:
                       offsets=jnp.asarray((offsets - base).copy()), dtype=dtype)
 
     if pa.types.is_decimal(arr.type):
-        # decimal128 payloads -> unscaled int32/int64 (host loop; decimals
+        if dtype.is_two_word:
+            # Arrow decimal128 values ARE (lo, hi) little-endian u64
+            # pairs — reinterpret the buffer, no per-value conversion.
+            bufs = arr.buffers()
+            validity = _unpack_bitmap(bufs[0], arr.offset, n)
+            words = np.frombuffer(bufs[1], np.uint64,
+                                  count=2 * (n + arr.offset))
+            words = words[2 * arr.offset:].reshape(n, 2).copy()
+            return Column(data=jnp.asarray(words),
+                          validity=None if validity is None or validity.all()
+                          else jnp.asarray(validity),
+                          dtype=dtype)
+        # decimal32/64 payloads -> unscaled int32/int64 (host loop; decimals
         # are schema-rare enough that this stays off the hot path)
         np_dt = dtype.np_dtype
         unscaled = []
@@ -136,6 +151,14 @@ def from_arrow_array(arr: pa.Array | pa.ChunkedArray) -> Column:
                   dtype=dtype)
 
 
+def _validity_buffer(mask: np.ndarray | None):
+    """(packed LSB validity buffer or None, null count) from a NULL mask."""
+    if mask is None:
+        return None, 0
+    return pa.py_buffer(np.packbits(~mask, bitorder="little").tobytes()), \
+        int(mask.sum())
+
+
 def to_arrow_array(col: Column) -> pa.Array:
     """Materialize a device Column as a pyarrow array."""
     dtype = col.dtype
@@ -148,17 +171,21 @@ def to_arrow_array(col: Column) -> pa.Array:
         offsets = np.asarray(col.offsets, np.int32)
         chars = np.asarray(col.data, np.uint8)
         n = len(offsets) - 1
-        validity_buf = None
-        null_count = 0
-        if mask is not None:
-            null_count = int(mask.sum())
-            validity_buf = pa.py_buffer(
-                np.packbits(~mask, bitorder="little").tobytes())
+        validity_buf, null_count = _validity_buffer(mask)
         return pa.StringArray.from_buffers(
             n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(chars.tobytes()),
             validity_buf, null_count)
 
     values = np.asarray(col.data)
+    if dtype.is_two_word:
+        # (n, 2) u64 words are byte-identical to Arrow decimal128 values.
+        pa_t = _dtype_to_pa_type(dtype)
+        n = values.shape[0]
+        validity_buf, null_count = _validity_buffer(mask)
+        return pa.Array.from_buffers(
+            pa_t, n,
+            [validity_buf, pa.py_buffer(np.ascontiguousarray(values).tobytes())],
+            null_count)
     if dtype.is_decimal:
         pa_t = _dtype_to_pa_type(dtype)
         import decimal

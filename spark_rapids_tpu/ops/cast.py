@@ -28,6 +28,13 @@ def cast(col: Column, to: DType) -> Column:
     src, dst = col.dtype, to
     data = col.data
 
+    if dst.is_two_word:
+        from .decimal128 import cast_to_d128
+        return cast_to_d128(col, to)
+    if src.is_two_word:
+        from .decimal128 import cast_from_d128
+        return cast_from_d128(col, to)
+
     if src.is_decimal and dst.is_decimal:
         data = _rescale(data.astype(dst.jnp_dtype), src.scale, dst.scale)
     elif src.is_decimal:

@@ -95,6 +95,22 @@ def grouping_sort_operands(datas, valids) -> list[jax.Array]:
     return ops
 
 
+def grouping_columns_with(cols: list[Column], *flag_lists):
+    """:func:`grouping_columns` plus per-key flag lists (ascending,
+    nulls_first, ...) kept aligned through the expansion: a key that
+    expands into several columns (DECIMAL128's word pair) duplicates its
+    flags onto every expanded column.  Returns
+    ``(expanded_cols, *expanded_flag_lists)``."""
+    out_cols: list[Column] = []
+    out_flags: list[list] = [[] for _ in flag_lists]
+    for i, col in enumerate(cols):
+        expanded = grouping_columns([col])
+        out_cols.extend(expanded)
+        for j, flags in enumerate(flag_lists):
+            out_flags[j].extend([flags[i]] * len(expanded))
+    return (out_cols, *out_flags)
+
+
 #: Rows per chunk for chunked (segmented) prefix scans.  62500 x 64
 #: chunks measured best at 4M rows on v5e; shared by every scan below so
 #: there is exactly one constant to retune.
@@ -259,13 +275,20 @@ def concat_tables(tables: list) -> "Table":
 def grouping_columns(cols: list[Column]) -> list[Column]:
     """Map key columns to group/compare-friendly forms: STRING columns become
     lexicographically-ordered INT32 dictionary codes (validity preserved),
-    everything else passes through."""
+    DECIMAL128 expands into its (hi signed, lo unsigned) word pair — the
+    pair's lexicographic order equals 128-bit signed order, so the
+    multi-key machinery downstream needs no 128-bit compares — and
+    everything else passes through.  May return MORE columns than given;
+    callers use the result only as an ordered key set."""
     out = []
     for col in cols:
         if col.offsets is not None:
             from .strings import dictionary_encode
             codes, _ = dictionary_encode(col)
             out.append(codes)
+        elif col.dtype.is_two_word:
+            from .decimal128 import key_columns
+            out.extend(key_columns(col))
         else:
             out.append(col)
     return out

@@ -1,0 +1,245 @@
+"""128-bit decimal limb arithmetic.
+
+TPU has no 128-bit scalar type, so DECIMAL128 columns are ``(n, 2)``
+uint64 arrays of little-endian (lo, hi) words in two's complement — the
+exact byte layout of Arrow / cudf ``fixed_point<__int128_t>`` values, so
+interop is a view, not a conversion.  The reference's bridge reconstructs
+decimal types from (type-id, scale) wire pairs (RowConversionJni.cpp:56-61);
+Spark's default decimal (38, 18) is this type.
+
+Everything here is vectorized limb arithmetic on u64 (or u32 sub-limb)
+lanes — adds with carry, compares via (hi signed, lo unsigned)
+lexicographic order, and base-10 rescaling:
+
+* scale DOWN (multiply by 10^k): schoolbook 64x64 multiply split into
+  32-bit half-limbs so partial products fit u64;
+* scale UP (divide by 10^k): long division over four 32-bit limbs by a
+  divisor < 2^30, applied in <= 10^9 chunks, truncating toward zero
+  (cudf ``fixed_point::rescaled`` semantics).
+
+Key ordering everywhere (sort / group-by / join) reduces a decimal128 to
+TWO ordinary key operands — hi as signed int64, lo as unsigned — which
+compare identically to the 128-bit signed value; the engine's multi-key
+machinery handles the rest (see ops.common.grouping_columns).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..column import Column
+from ..dtypes import DType, INT64, UINT64
+
+_U64 = jnp.uint64
+_MASK32 = (1 << 32) - 1
+
+
+def split_words(data: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(n, 2) words -> (lo u64, hi u64)."""
+    return data[:, 0], data[:, 1]
+
+
+def join_words(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    return jnp.stack([lo.astype(_U64), hi.astype(_U64)], axis=1)
+
+
+def key_columns(col: Column) -> list[Column]:
+    """Order/equality-preserving expansion into two ordinary key columns:
+    (hi as SIGNED int64, lo as unsigned) — lexicographic comparison on the
+    pair equals signed 128-bit numeric comparison."""
+    lo, hi = split_words(col.data)
+    hi_signed = lax.bitcast_convert_type(hi, jnp.int64)
+    return [
+        Column(data=hi_signed, validity=col.validity, dtype=INT64),
+        Column(data=lo, validity=col.validity, dtype=UINT64),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# add / negate / compare
+# ---------------------------------------------------------------------------
+
+def negate(data: jax.Array) -> jax.Array:
+    """Two's-complement 128-bit negation: ~x + 1.  The +1 carries into
+    the high word exactly when the low word is zero (~lo + 1 wraps)."""
+    lo, hi = split_words(data)
+    nlo = (~lo) + _U64(1)
+    nhi = (~hi) + jnp.where(lo == 0, _U64(1), _U64(0))
+    return join_words(nlo, nhi)
+
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    """128-bit wrapping add."""
+    alo, ahi = split_words(a)
+    blo, bhi = split_words(b)
+    lo = alo + blo
+    carry = (lo < alo).astype(_U64)
+    hi = ahi + bhi + carry
+    return join_words(lo, hi)
+
+
+def is_negative(data: jax.Array) -> jax.Array:
+    _, hi = split_words(data)
+    return lax.bitcast_convert_type(hi, jnp.int64) < 0
+
+
+def compare(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Signed comparison: -1 / 0 / +1 as int32."""
+    alo, ahi = split_words(a)
+    blo, bhi = split_words(b)
+    ahs = lax.bitcast_convert_type(ahi, jnp.int64)
+    bhs = lax.bitcast_convert_type(bhi, jnp.int64)
+    hi_lt, hi_gt = ahs < bhs, ahs > bhs
+    lo_lt, lo_gt = alo < blo, alo > blo
+    lt = hi_lt | (~hi_gt & lo_lt)
+    gt = hi_gt | (~hi_lt & lo_gt)
+    return jnp.where(lt, jnp.int32(-1), jnp.where(gt, jnp.int32(1),
+                                                  jnp.int32(0)))
+
+
+# ---------------------------------------------------------------------------
+# widen / narrow
+# ---------------------------------------------------------------------------
+
+def from_int64(v: jax.Array) -> jax.Array:
+    """Sign-extend int64 unscaled values to 128-bit words."""
+    lo = lax.bitcast_convert_type(v.astype(jnp.int64), _U64)
+    hi = jnp.where(v < 0, _U64(0xFFFFFFFFFFFFFFFF), _U64(0))
+    return join_words(lo, hi)
+
+
+def to_int64(data: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Narrow to int64: (values, in_range mask)."""
+    lo, hi = split_words(data)
+    v = lax.bitcast_convert_type(lo, jnp.int64)
+    # In range iff hi is the sign extension of lo's top bit.
+    expect_hi = jnp.where(v < 0, _U64(0xFFFFFFFFFFFFFFFF), _U64(0))
+    return v, hi == expect_hi
+
+
+def to_float64(data: jax.Array) -> jax.Array:
+    lo, hi = split_words(data)
+    his = lax.bitcast_convert_type(hi, jnp.int64)
+    return his.astype(jnp.float64) * jnp.float64(2.0 ** 64) \
+        + lo.astype(jnp.float64)
+
+
+# ---------------------------------------------------------------------------
+# base-10 rescale
+# ---------------------------------------------------------------------------
+
+def _mul_u64(a: jax.Array, b_const: int):
+    """a (u64) * b (python int < 2^64) -> (lo u64, carry u64) via 32-bit
+    half-limb schoolbook multiply."""
+    a_lo = a & _U64(_MASK32)
+    a_hi = a >> _U64(32)
+    b_lo = b_const & _MASK32
+    b_hi = b_const >> 32
+    p0 = a_lo * _U64(b_lo)                      # <= 2^64 - 2^33 + 1: fits
+    p1a = a_lo * _U64(b_hi)
+    p1b = a_hi * _U64(b_lo)
+    p2 = a_hi * _U64(b_hi)
+    mid = p1a + (p0 >> _U64(32))
+    mid_carry = (mid < p1a).astype(_U64)
+    mid2 = mid + p1b
+    mid_carry = mid_carry + (mid2 < mid).astype(_U64)
+    lo = (p0 & _U64(_MASK32)) | (mid2 << _U64(32))
+    hi = p2 + (mid2 >> _U64(32)) + (mid_carry << _U64(32))
+    return lo, hi
+
+
+def mul_pow10(data: jax.Array, k: int) -> jax.Array:
+    """Multiply by 10^k (k >= 0), wrapping at 128 bits (cudf rescale
+    contract: overflow is the caller's precision responsibility)."""
+    out = data
+    while k > 0:
+        step = min(k, 19)                       # 10^19 < 2^64
+        m = 10 ** step
+        lo, hi = split_words(out)
+        new_lo, carry = _mul_u64(lo, m)
+        hi_lo, _ = _mul_u64(hi, m)
+        out = join_words(new_lo, hi_lo + carry)
+        k -= step
+    return out
+
+
+def _div_small(data: jax.Array, d: int) -> jax.Array:
+    """Unsigned 128-bit // d for 0 < d < 2^30, via four 32-bit limbs."""
+    lo, hi = split_words(data)
+    limbs = [hi >> _U64(32), hi & _U64(_MASK32),
+             lo >> _U64(32), lo & _U64(_MASK32)]      # most significant first
+    dd = jnp.int64(d)
+    r = jnp.zeros_like(lo, jnp.int64)
+    q = []
+    for limb in limbs:
+        cur = (r << jnp.int64(32)) | limb.astype(jnp.int64)
+        q.append((cur // dd).astype(_U64))
+        r = cur % dd
+    out_hi = (q[0] << _U64(32)) | q[1]
+    out_lo = (q[2] << _U64(32)) | q[3]
+    return join_words(out_lo, out_hi)
+
+
+def div_pow10(data: jax.Array, k: int) -> jax.Array:
+    """Signed division by 10^k (k >= 0), truncating toward zero."""
+    if k == 0:
+        return data
+    neg = is_negative(data)
+    mag = jnp.where(neg[:, None], negate(data), data)
+    while k > 0:
+        step = min(k, 9)                        # 10^9 < 2^30
+        mag = _div_small(mag, 10 ** step)
+        k -= step
+    return jnp.where(neg[:, None], negate(mag), mag)
+
+
+def rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
+    """Move between base-10 scales (value = unscaled * 10**scale)."""
+    diff = from_scale - to_scale
+    if diff == 0:
+        return data
+    if diff > 0:
+        return mul_pow10(data, diff)
+    return div_pow10(data, -diff)
+
+
+# ---------------------------------------------------------------------------
+# casts (wired from ops.cast)
+# ---------------------------------------------------------------------------
+
+def cast_to_d128(col: Column, to: DType) -> Column:
+    """numeric/decimal -> decimal128."""
+    src = col.dtype
+    if src.is_two_word:
+        data = rescale(col.data, src.scale, to.scale)
+    elif src.is_floating:
+        scaled = col.data.astype(jnp.float64) * (10.0 ** -to.scale)
+        scaled = jnp.trunc(scaled)
+        # f64 has 53 mantissa bits; route through int64 (documented
+        # precision limit of float->decimal128, same as any f64 source).
+        data = from_int64(scaled.astype(jnp.int64))
+    else:
+        v = col.data.astype(jnp.int64)
+        data = rescale(from_int64(v), src.scale, to.scale)
+    return Column(data=data, validity=col.validity, dtype=to)
+
+
+def cast_from_d128(col: Column, to: DType) -> Column:
+    """decimal128 -> numeric/decimal."""
+    src = col.dtype
+    if to.is_two_word:
+        return cast_to_d128(col, to)
+    if to.is_floating:
+        data = to_float64(col.data) * (10.0 ** src.scale)
+        return Column(data=data.astype(to.jnp_dtype), validity=col.validity,
+                      dtype=to)
+    target_scale = to.scale if to.is_decimal else 0
+    rescaled = rescale(col.data, src.scale, target_scale)
+    v, ok = to_int64(rescaled)
+    validity = col.validity
+    # Out-of-range narrows become nulls (cudf overflow is UB; nulling is
+    # the defined, testable behavior here).
+    validity = ok if validity is None else (validity & ok)
+    return Column(data=v.astype(to.jnp_dtype), validity=validity, dtype=to)

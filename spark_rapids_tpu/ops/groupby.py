@@ -115,8 +115,17 @@ def groupby_agg(table: Table, keys: Sequence[str],
     for value_name, how, _ in aggs:
         col = table[value_name]
         if how in ("nunique", "median"):
+            if col.dtype.is_two_word:
+                raise TypeError(
+                    f"aggregation {how!r} on decimal128 column "
+                    f"{value_name!r} is not supported; cast to "
+                    f"decimal64/float64 first")
             continue                      # dedicated kernels (own sort order)
-        if col.offsets is not None:
+        if col.offsets is not None or col.dtype.is_two_word:
+            # Strings and decimal128 can't ride the 1-D payload sort:
+            # first/last gather from the original column at the end,
+            # count rides a validity surrogate; arithmetic aggregates
+            # need a cast (decimal128 sums exceed any device dtype).
             if how in ("first", "last"):
                 continue
             if how in ("count", "count_all"):
@@ -126,9 +135,10 @@ def groupby_agg(table: Table, keys: Sequence[str],
                                        validity=col.validity,
                                        dtype=DType(TypeId.INT8)))
                 continue
+            kind = ("strings" if col.offsets is not None else "decimal128")
             raise TypeError(
-                f"aggregation {how!r} is not defined for strings "
-                f"(column {value_name!r})")
+                f"aggregation {how!r} is not defined for {kind} "
+                f"(column {value_name!r}); cast first")
         _ensure_payload(value_name, col)
 
     perm, sorted_pay, boundary, count = _groupby_sort(
@@ -146,7 +156,7 @@ def groupby_agg(table: Table, keys: Sequence[str],
         col = table[value_name]
         if how in ("nunique", "median"):
             continue
-        if col.offsets is not None:
+        if col.offsets is not None or col.dtype.is_two_word:
             if how in ("count", "count_all"):
                 spec.append((pay_names.index(f"__validity__:{value_name}"),
                              how, int(TypeId.INT8), 0))
@@ -190,7 +200,8 @@ def groupby_agg(table: Table, keys: Sequence[str],
                                          validity=ok[:num_groups],
                                          dtype=FLOAT64)))
             continue
-        if col.offsets is not None and how in ("first", "last"):
+        if (col.offsets is not None or col.dtype.is_two_word) \
+                and how in ("first", "last"):
             idx = starts if how == "first" else ends
             out.append((out_name, col.gather(jnp.take(perm, idx))))
             continue

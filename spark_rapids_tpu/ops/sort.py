@@ -42,10 +42,13 @@ def _descending_key(x: jax.Array) -> jax.Array:
 
 def sort_operands(columns: Sequence[Column], ascending: Sequence[bool],
                   nulls_first: Sequence[bool]) -> list[jax.Array]:
-    """Build the lax.sort key operands (2 per column: null rank, value)."""
-    from .common import grouping_columns
+    """Build the lax.sort key operands (2 per column: null rank, value;
+    4 for DECIMAL128, whose (hi, lo) word pair carries the order)."""
+    from .common import grouping_columns_with
+    columns, ascending, nulls_first = grouping_columns_with(
+        list(columns), list(ascending), list(nulls_first))
     ops: list[jax.Array] = []
-    for col, asc, nf in zip(grouping_columns(list(columns)), ascending, nulls_first):
+    for col, asc, nf in zip(columns, ascending, nulls_first):
         valid = col.valid_mask()
         # rank 0 sorts first. nulls_first -> nulls rank 0.
         null_rank = jnp.where(valid, jnp.uint8(1 if nf else 0),

@@ -51,10 +51,13 @@ def _window_order(table: Table, partition_by: Sequence[str],
     if not partition_by:
         raise ValueError("partition_by must name at least one column")
     part_cols = grouping_columns([table[name] for name in partition_by])
-    order_cols = grouping_columns([table[name] for name in (order_by or [])])
-    if ascending is not None and len(ascending) != len(order_cols):
+    raw_order = [table[name] for name in (order_by or [])]
+    if ascending is not None and len(ascending) != len(raw_order):
         raise ValueError("ascending must match order_by length")
-    asc = [True] * len(part_cols) + list(ascending or [True] * len(order_cols))
+    from .common import grouping_columns_with
+    order_cols, asc_order = grouping_columns_with(
+        raw_order, list(ascending or [True] * len(raw_order)))
+    asc = [True] * len(part_cols) + asc_order
     perm = sorted_order(part_cols + order_cols, ascending=asc)
     n = perm.shape[0]
     inv = jnp.zeros(n, jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))

@@ -74,7 +74,12 @@ def _build_plan(layout: RowLayout) -> list[_Slot]:
     slots: list[_Slot] = []
     for c, (dtype, start) in enumerate(zip(layout.schema, layout.column_starts)):
         size = dtype.itemsize
-        if size == 8:
+        if size == 16:
+            # DECIMAL128: four u32 slots from the (n, 2) u64 word pair,
+            # little-endian across the 16 bytes (lo word first).
+            for k in range(4):
+                slots.append(_Slot(start // 4 + k, 0, c, f"d128_{k}", 16))
+        elif size == 8:
             slots.append(_Slot(start // 4, 0, c, "lo", 8))
             slots.append(_Slot(start // 4 + 1, 0, c, "hi", 8))
         elif size == 4:
@@ -102,7 +107,13 @@ def _column_streams(layout: RowLayout, datas, masks) -> list[jax.Array]:
             continue
         dtype = layout.schema[slot.col]
         data = datas[slot.col]
-        if slot.size == 8:
+        if slot.size == 16:
+            k = int(slot.part[-1])
+            word = data[:, k // 2]                    # u64 (lo then hi)
+            half = (word >> jnp.uint64(32)) if k % 2 else \
+                (word & jnp.uint64(0xFFFFFFFF))
+            streams.append(half.astype(_U32))
+        elif slot.size == 8:
             if dtype.np_dtype == np.float64 and not backend_has_native_f64_bitcast():
                 bits = f64_to_bits(data).astype(jnp.uint64)
             else:
@@ -152,6 +163,11 @@ def _extract_column(layout: RowLayout, words_of, col: int):
     start = layout.column_starts[col]
     size = dtype.itemsize
     target = dtype.jnp_dtype
+    if size == 16:
+        w = [words_of(start // 4 + k).astype(jnp.uint64) for k in range(4)]
+        lo = w[0] | (w[1] << jnp.uint64(32))
+        hi = w[2] | (w[3] << jnp.uint64(32))
+        return jnp.stack([lo, hi], axis=1)
     if size == 8:
         lo = words_of(start // 4).astype(jnp.uint64)
         hi = words_of(start // 4 + 1).astype(jnp.uint64)
@@ -363,14 +379,19 @@ def use_pallas() -> bool:
     return rows_impl() == "pallas" and jax.default_backend() == "tpu"
 
 
+def _pallas_supports(layout: RowLayout) -> bool:
+    # 16-byte columns (DECIMAL128) are XLA-path only for now.
+    return all(dt.itemsize != 16 for dt in layout.schema)
+
+
 def pack_image(layout: RowLayout, datas, masks) -> jax.Array:
-    if use_pallas():
+    if use_pallas() and _pallas_supports(layout):
         return pack_words_pallas(layout, datas, masks)
     return pack_words(layout, datas, masks)
 
 
 def unpack_image(layout: RowLayout, image: jax.Array):
-    if use_pallas():
+    if use_pallas() and _pallas_supports(layout):
         return unpack_words_pallas(layout, image)
     return unpack_words(layout, image)
 

@@ -78,7 +78,12 @@ def compute_fixed_width_layout(schema: Sequence[DType]) -> RowLayout:
         if not dtype.is_fixed_width:
             raise ValueError("Only fixed width types are currently supported")
         size = dtype.itemsize
-        at = align_offset(at, size)   # natural alignment
+        # Natural alignment, capped at 8: the reference format has no
+        # 16-byte types (its kernel switch handles 1/2/4/8 only,
+        # row_conversion.cu:128-156); DECIMAL128 is this engine's
+        # extension, laid out as two consecutive 64-bit words at 8-byte
+        # alignment (lo, hi little-endian — Arrow/cudf byte order).
+        at = align_offset(at, min(size, 8))
         starts.append(at)
         sizes.append(size)
         at += size

@@ -72,10 +72,16 @@ class TestArrowRoundTrip:
         at = pa.table({"s": pa.array(["aa", "b"], pa.large_string())})
         assert from_arrow(at)["s"].to_pylist() == ["aa", "b"]
 
-    def test_decimal128_wide_precision_rejected(self):
-        at = pa.table({"d": pa.array([None], pa.decimal128(38, 2))})
-        with pytest.raises(ValueError, match="decimal128"):
-            from_arrow(at)
+    def test_decimal128_wide_precision(self):
+        # precision > 18 maps to DECIMAL128 ((n, 2) u64 words).
+        import decimal
+        from spark_rapids_tpu import dtypes as dt
+        at = pa.table({"d": pa.array(
+            [decimal.Decimal("123456789012345678901234567.89"), None],
+            pa.decimal128(38, 2))})
+        t = from_arrow(at)
+        assert t["d"].dtype == dt.decimal128(-2)
+        assert t["d"].to_pylist() == [12345678901234567890123456789, None]
 
 
 class TestParquet:
