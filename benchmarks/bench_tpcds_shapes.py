@@ -95,6 +95,8 @@ def bench_shape(name, p, table, chain_col, leaf_col):
 
 
 def main():
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
     from spark_rapids_tpu.exec import col, plan
 
     rng = np.random.default_rng(42)
@@ -150,6 +152,32 @@ def main():
                         [("item_sk", "nunique", "distinct_items"),
                          ("price", "sum", "total")]))
     bench_shape("tpcds_q95_shape_nunique", q95, fact, "price", "total")
+
+    # q95 big-big: web_sales self-join on order number — two N-row FACT
+    # tables, no broadcastable side (keys repeat ~2x per side), then the
+    # "shipped from a different warehouse" filter and an aggregate.  This
+    # is the shuffled-hash-join shape BASELINE.json names; the probe is
+    # bound once per table pair (cached) and the expansion runs in-program
+    # at a static capacity.
+    n_orders = max(N // 2, 1)
+    ws1 = srt.Table([
+        ("order_sk", Column.from_numpy(
+            rng.integers(0, n_orders, N).astype(np.int64))),
+        ("wh1", Column.from_numpy(rng.integers(0, 15, N).astype(np.int8))),
+        ("profit", Column.from_numpy(rng.normal(20, 40, N))),
+    ])
+    ws2 = srt.Table([
+        ("order_sk2", Column.from_numpy(
+            rng.integers(0, n_orders, N).astype(np.int64))),
+        ("wh2", Column.from_numpy(rng.integers(0, 15, N).astype(np.int8))),
+    ])
+    q95bb = (plan()
+             .join_shuffled(ws2, left_on="order_sk", right_on="order_sk2")
+             .filter(col("wh1").ne(col("wh2")))
+             .groupby_agg(["wh1"], [("profit", "sum", "p"),
+                                    ("profit", "count", "n")])
+             .sort_by(["wh1"]))
+    bench_shape("tpcds_q95_bigbig_join", q95bb, ws1, "profit", "p")
 
     # q67-ish: windowed top-k — rank rows per store by profit, keep top 10
     q67 = (plan()

@@ -52,8 +52,8 @@ from ..dtypes import BOOL8, INT32, INT64, DType, TypeId
 from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate, render
-from .plan import (FilterStep, GroupAggStep, JoinStep, LimitStep, Plan,
-                   ProjectStep, SortStep, WindowStep)
+from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
+                   LimitStep, Plan, ProjectStep, SortStep, WindowStep)
 
 def _dense_max_cells() -> int:
     """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
@@ -164,7 +164,22 @@ class _Bound:
         self.steps: tuple = ()
         self.group_metas: list[_GroupMeta] = []
         self.join_metas: list = []
+        #: the bound input table (shuffled-join bind-time probes read the
+        #: original key columns from it)
+        self._table = table
+        #: True while program row state is still index-aligned with the
+        #: input table (no reorder/expansion yet) — the precondition for
+        #: binding a shuffled join's per-row probe arrays.
+        self._row_aligned = True
+        self._passthrough: set[str] = set()
         self._build(table)
+
+    def shuffle_key_source(self, name: str):
+        """The input-table column behind ``name`` if it is still
+        unmodified and row-aligned, else None."""
+        if not self._row_aligned or name not in self._passthrough:
+            return None
+        return self._table[name] if name in self._table else None
 
     def _build(self, table: Table) -> None:
         plan = self.plan
@@ -182,6 +197,12 @@ class _Bound:
 
         need_rowid = False
         for name, c in table.items():
+            if c.dtype.is_two_word:
+                raise TypeError(
+                    f"decimal128 column {name!r} is not yet supported in "
+                    f"compiled plans (its (n, 2)-word representation cannot "
+                    f"ride the 1-D sort/window payload paths); use the "
+                    f"eager ops layer, or cast to decimal64/float64 first")
             if c.offsets is None:
                 self.exec_cols[name] = c
                 continue
@@ -229,6 +250,7 @@ class _Bound:
                 steps.append(step)
                 passthrough = set(step.keys)
                 self.probe_sources = {}
+                self._row_aligned = False
                 current_names = (list(step.keys)
                                  + [out for _, _, out in step.aggs])
             elif isinstance(step, WindowStep):
@@ -255,9 +277,41 @@ class _Bound:
                 current_names += [out for _, out in meta.pays]
                 current_names += [out for _, out in meta.str_pays]
                 steps.append(step)
+            elif isinstance(step, JoinShuffledStep):
+                if not self._row_aligned:
+                    raise TypeError(
+                        "a shuffled join must come before any group-by, "
+                        "sort, limit, or other shuffled join (its bind-time "
+                        "probe is aligned to input-table rows); join first, "
+                        "then aggregate")
+                from .join import bind_join_shuffled
+                self._passthrough = passthrough
+                meta = bind_join_shuffled(self, step, len(self.join_metas),
+                                          current_names)
+                self.join_metas.append(meta)
+                steps.append(step)
+                if step.how in ("inner", "left"):
+                    # Row state is replaced by the expansion: nothing stays
+                    # index-aligned with the input, but every gathered
+                    # column's value domain is a subset of its source's —
+                    # keep dense group-by viable on post-join keys by
+                    # probing the sources.
+                    for nm in list(passthrough):
+                        if nm in table and nm not in self.probe_sources:
+                            self.probe_sources[nm] = (table[nm], False)
+                    for _, out in meta.pays:
+                        src = step.table[out]
+                        self.probe_sources[out] = (src, step.how == "left")
+                    passthrough = set()
+                    self._row_aligned = False
+                    current_names += [out for _, out in meta.pays]
+                    current_names += [out for _, out in meta.str_pays]
             else:
+                if isinstance(step, (SortStep, LimitStep)):
+                    self._row_aligned = False
                 steps.append(step)
         self.steps = tuple(steps)
+        self._passthrough = passthrough
 
     def _check_string_refs(self, step) -> None:
         """String columns never enter the traced program, so expressions
@@ -388,7 +442,8 @@ class _Bound:
         static JoinMeta, so neither the compile-cache key nor the compiled
         closure may pin the build Table's device buffers (two build tables
         with identical signatures correctly share one program)."""
-        return tuple(_JOIN_MARKER if isinstance(s, JoinStep) else s
+        return tuple(_JOIN_MARKER
+                     if isinstance(s, (JoinStep, JoinShuffledStep)) else s
                      for s in self.steps)
 
     def signature(self):
@@ -812,8 +867,18 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
                         axis_size=axis_size)
                 sharded = False
             elif step is _JOIN_MARKER:
-                cols, sel = trace_join(cols, sel, side, join_metas[ji])
+                from .join import ShuffledJoinMeta, trace_join_shuffled
+                meta = join_metas[ji]
                 ji += 1
+                if isinstance(meta, ShuffledJoinMeta):
+                    if sharded:
+                        raise TypeError(
+                            "shuffled join inside a sharded program — "
+                            "run_plan_dist lowers it through the mesh "
+                            "shuffle before assembly (internal error)")
+                    cols, sel = trace_join_shuffled(cols, sel, side, meta)
+                else:
+                    cols, sel = trace_join(cols, sel, side, meta)
             elif isinstance(step, WindowStep):
                 if sharded:
                     raise TypeError(
@@ -871,7 +936,8 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
                         order.append(nm)
         elif isinstance(step, GroupAggStep):
             order = list(step.keys) + [out for _, _, out in step.aggs]
-        elif isinstance(step, JoinStep) and step.how in ("inner", "left"):
+        elif isinstance(step, (JoinStep, JoinShuffledStep)) \
+                and step.how in ("inner", "left"):
             order += [nm for nm in step.table.names
                       if nm not in step.right_on and nm not in order]
         elif isinstance(step, WindowStep):
@@ -1026,6 +1092,13 @@ def explain_plan(plan: Plan, table: Table) -> str:
             lines.append(
                 f"  BroadcastJoin[{meta.how}, probe={meta.mode}, "
                 f"build={meta.dim_rows} rows] on {keys}")
+        elif isinstance(step, JoinShuffledStep):
+            meta = bound.join_metas[ji]
+            ji += 1
+            lines.append(
+                f"  ShuffledJoin[{meta.how}, right={meta.right_rows} rows, "
+                f"capacity={meta.capacity}; bind-time factorize probe] on "
+                f"{', '.join(step.left_on)}")
         elif isinstance(step, WindowStep):
             lines.append(
                 f"  Window[{step.func} -> {step.out}; partition by "
@@ -1038,7 +1111,8 @@ def explain_plan(plan: Plan, table: Table) -> str:
             lines.append(f"  Limit[{step.k}]")
     lines.append("  Materialize[compact by selection; "
                  + ("1 host sync]" if any(
-                     isinstance(s, (FilterStep, GroupAggStep, JoinStep))
+                     isinstance(s, (FilterStep, GroupAggStep, JoinStep,
+                                    JoinShuffledStep))
                      for s in bound.steps) else "0 host syncs]"))
     return "\n".join(lines)
 
@@ -1068,7 +1142,7 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
                     t = t.with_column(nm, evaluate(e, env))
         elif isinstance(step, GroupAggStep):
             t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
-        elif isinstance(step, JoinStep):
+        elif isinstance(step, (JoinStep, JoinShuffledStep)):
             # Rename build keys to hidden temporaries first so a build-key
             # name equal to a PROBE column can never be suffix-renamed by
             # the eager join (the compiled path always drops build keys).

@@ -40,7 +40,7 @@ from ..dtypes import BOOL8
 from ..parallel.mesh import DistTable
 from ..table import Table
 from .compile import _Bound, _assemble, _final_order, materialize
-from .plan import GroupAggStep, Plan
+from .plan import GroupAggStep, JoinShuffledStep, Plan
 
 _DIST_COMPILED: dict = {}
 
@@ -65,8 +65,65 @@ def _ends_replicated(bound: _Bound) -> bool:
     return any(isinstance(s, GroupAggStep) for s in bound.steps)
 
 
+def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
+    """Execute a plan containing a shuffled join: per-shard prefix, then
+    the mesh shuffle join (both sides ``all_to_all``-repartitioned by key
+    hash and merge-joined per shard, parallel.dist_ops), then the suffix
+    plan on the joined DistTable.
+
+    This is the distributed big-big join of the TPC-DS q95 shape: the
+    single-chip compiled form binds a probe over whole tables; across a
+    mesh the equivalent data movement is the shuffle itself.
+    """
+    from ..parallel.dist_ops import dist_join
+    from ..parallel.mesh import shard_table
+
+    i = next(idx for idx, s in enumerate(plan.steps)
+             if isinstance(s, JoinShuffledStep))
+    step: JoinShuffledStep = plan.steps[i]
+    if any(isinstance(s, GroupAggStep) for s in plan.steps[:i]):
+        raise TypeError(
+            "shuffled join after a group-by is not supported in a "
+            "distributed plan (the left side is already an aggregate); "
+            "join first, then aggregate")
+    if step.how not in ("inner", "left"):
+        raise TypeError(
+            f"distributed shuffled join supports inner/left, not "
+            f"{step.how!r} (semi/anti: aggregate the right side's keys "
+            f"and use join_broadcast, or run single-chip)")
+
+    right = step.table
+    if any(c.offsets is not None for c in right.columns):
+        raise TypeError(
+            "distributed plans operate on fixed-width columns only "
+            "(dictionary-encode the right table's strings first)")
+    # Align key names so both shuffles route by the same columns.
+    if tuple(step.left_on) != tuple(step.right_on):
+        clashes = (set(step.left_on) &
+                   (set(right.names) - set(step.right_on)))
+        if clashes:
+            raise ValueError(
+                f"renaming right keys {step.right_on} -> {step.left_on} "
+                f"collides with right columns {sorted(clashes)}; rename "
+                f"them first")
+        right = right.rename(dict(zip(step.right_on, step.left_on)))
+    pre = (run_plan_dist(Plan(plan.steps[:i]), dist, mesh)
+           if i else dist)
+    overlap = (set(right.names) - set(step.left_on)) & set(pre.table.names)
+    if overlap:
+        raise ValueError(
+            f"join output column(s) {sorted(overlap)} collide with "
+            f"existing columns; rename one side first")
+    rdist = shard_table(right, mesh)
+    joined = dist_join(pre, rdist, mesh, on=list(step.left_on),
+                       how=step.how)
+    return run_plan_dist(Plan(plan.steps[i + 1:]), joined, mesh)
+
+
 def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     """Execute ``plan`` against a row-sharded table on ``mesh``."""
+    if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
+        return _lower_shuffled_join(plan, dist, mesh)
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
     if _live_count_cached(dist.row_mask) == 0:

@@ -276,3 +276,219 @@ def trace_join(cols, sel, side, meta: JoinMeta):
     if meta.how == "inner":
         sel = found if sel is None else (sel & found)
     return new, sel
+
+
+# ---------------------------------------------------------------------------
+# shuffled (big-big) join — many-to-many expansion inside the program
+# ---------------------------------------------------------------------------
+#
+# The broadcast join above requires unique build keys and a small build
+# side.  TPC-DS q95 joins two *fact* tables (web_sales x web_sales on
+# order number): no side broadcasts, keys repeat, and the output size is a
+# data-dependent many-to-many expansion.  The reference envelope serves
+# this with cuDF's shuffled hash join (both sides repartitioned, then a
+# per-partition hash join).  The TPU re-architecture:
+#
+# * the probe — factorize both sides' keys over their union with ONE
+#   multi-key sort, then a vectorized searchsorted (ops.join's fused
+#   kernel) — runs at BIND time and is cached per (left keys, right
+#   table) buffer identity.  Its outputs (per-left-row match count, match
+#   range start, right-row order) depend only on the two key multisets,
+#   never on the plan's filters, so repeated queries over the same tables
+#   skip the sort entirely;
+# * the capacity — a pow2 bucket of the unfiltered match total — is
+#   static; a filter can only shrink the live expansion, so the program
+#   writes into a fixed (capacity,)-shaped output with a selection mask
+#   (padded slots dead), keeping the whole plan one XLA program;
+# * the in-program expansion recovers each output slot's owning left row
+#   with the scatter-indicator + prefix-sum trick (O(capacity), no
+#   searchsorted over the output).
+
+@dataclass(frozen=True)
+class ShuffledJoinMeta:
+    """Static description of one shuffled join (compile-cache key part)."""
+    index: int
+    how: str                             # inner | left | semi | anti
+    capacity: int                        # pow2 output slots (inner/left)
+    n_left: int
+    right_rows: int
+    #: fixed-width right payloads: (side-input name, output name)
+    pays: tuple[tuple[str, str], ...]
+    #: string right payloads: (right column name, output name)
+    str_pays: tuple[tuple[str, str], ...]
+    #: hidden right-row-id column for late string gathering (None if no
+    #: string payloads)
+    rowid_name: Optional[str]
+
+
+# probe cache: (left key cols + right table key cols) buffer ids ->
+# (rorder, lo, counts, total_inner, total_left)
+_SHUFFLE_PROBE_CACHE: dict = {}
+
+
+def _shuffled_probe(left_keys: list[Column], right, right_on):
+    from .stats import _guarded_cache_get, _guarded_cache_put
+    right_keys = [right[rn] for rn in right_on]
+    buffers = tuple(b for c in (left_keys + right_keys)
+                    for b in (c.data, c.offsets, c.validity) if b is not None)
+    cache_key = tuple(id(b) for b in buffers)
+    hit = _guarded_cache_get(_SHUFFLE_PROBE_CACHE, cache_key, buffers)
+    if hit is not None:
+        return hit
+
+    from ..ops.join import _factorize_union
+    from ..table import Table
+    n = left_keys[0].size
+    lt = Table([(f"__k{i}__", c) for i, c in enumerate(left_keys)])
+    rorder, lo, counts, _rmatched = _factorize_union(
+        lt, right, [f"__k{i}__" for i in range(len(left_keys))],
+        list(right_on))
+    counts32 = counts.astype(jnp.int32)
+    totals = jnp.stack([counts.sum(),
+                        jnp.maximum(counts, 1).sum()])
+    import jax
+    t_inner, t_left = (int(x) for x in jax.device_get(totals))  # bind sync
+    result = (rorder, lo.astype(jnp.int32), counts32, t_inner, t_left)
+    _guarded_cache_put(_SHUFFLE_PROBE_CACHE, cache_key, buffers, result)
+    return result
+
+
+def bind_join_shuffled(bound, step, index: int,
+                       current_names: list[str]) -> ShuffledJoinMeta:
+    """Probe at bind time, register side inputs, produce the static meta."""
+    from ..ops.common import pow2_bucket
+    right = step.table
+    left_keys = []
+    for ln, rn in zip(step.left_on, step.right_on):
+        if ln in bound.string_cols or ln in bound.dictionaries:
+            raise TypeError(
+                f"shuffled join probe key {ln!r} is a string column; "
+                f"dictionary-encode both sides or use the eager ops.join")
+        if rn not in right:
+            raise KeyError(f"right-side key {rn!r} not in "
+                           f"{list(right.names)}")
+        src = bound.shuffle_key_source(ln)
+        if src is None:
+            raise TypeError(
+                f"shuffled join key {ln!r} must be an unmodified input "
+                f"column (the bind-time probe reads the input table); "
+                f"join first, derive columns after")
+        if src.dtype != right[rn].dtype:
+            raise TypeError(
+                f"join key dtype mismatch: {ln}={src.dtype!r} vs "
+                f"{rn}={right[rn].dtype!r} (cast first)")
+        left_keys.append(src)
+
+    rorder, lo, counts, t_inner, t_left = _shuffled_probe(
+        left_keys, right, step.right_on)
+    total = t_left if step.how == "left" else t_inner
+    if total >= 1 << 31:
+        raise ValueError(
+            f"shuffled join expansion is {total} rows (>= 2^31); add a "
+            f"pre-join filter or fall back to the eager ops.join in batches")
+    capacity = pow2_bucket(total) if step.how in ("inner", "left") else 0
+
+    prefix = f"__sjoin{index}__"
+    bound.side_inputs[prefix + "counts"] = Column(data=counts, dtype=INT32)
+    pays: list[tuple[str, str]] = []
+    str_pays: list[tuple[str, str]] = []
+    rowid_name = None
+    if step.how in ("inner", "left"):
+        bound.side_inputs[prefix + "lo"] = Column(data=lo, dtype=INT32)
+        bound.side_inputs[prefix + "rorder"] = Column(data=rorder,
+                                                      dtype=INT32)
+        right_key_names = set(step.right_on)
+        for name, c in right.items():
+            if name in right_key_names:
+                continue
+            if name in current_names:
+                raise ValueError(
+                    f"join output column {name!r} collides with an "
+                    f"existing column; rename one side first")
+            if c.offsets is None:
+                side_name = prefix + "pay__" + name
+                bound.side_inputs[side_name] = c
+                pays.append((side_name, name))
+            else:
+                str_pays.append((name, name))
+        if str_pays:
+            rowid_name = prefix + "rowid"
+            bound.join_string_srcs[rowid_name] = [
+                (right[src], out) for src, out in str_pays]
+
+    return ShuffledJoinMeta(index, step.how, capacity,
+                            left_keys[0].size, right.num_rows,
+                            tuple(pays), tuple(str_pays), rowid_name)
+
+
+def trace_join_shuffled(cols, sel, side, meta: ShuffledJoinMeta):
+    """Traced expansion (runs inside the plan program).
+
+    Replaces the whole row state: every live column is gathered at its
+    owning left row; the output length becomes ``meta.capacity`` with a
+    fresh selection marking live slots.  Same slot-ownership trick as
+    ops.join._expand_kernel.
+    """
+    prefix = f"__sjoin{meta.index}__"
+    counts = side[prefix + "counts"].data            # (n,) int32
+
+    if meta.how in ("semi", "anti"):
+        found = counts > 0
+        keep = found if meta.how == "semi" else ~found
+        return cols, keep if sel is None else (sel & keep)
+
+    lo = side[prefix + "lo"].data
+    rorder = side[prefix + "rorder"].data
+    n = meta.n_left
+    C = meta.capacity
+    live = jnp.ones(n, jnp.bool_) if sel is None else sel
+    if meta.how == "left":
+        out_counts = jnp.where(live, jnp.maximum(counts, 1), 0)
+    else:
+        out_counts = jnp.where(live, counts, 0)
+
+    bounds = jnp.cumsum(out_counts)                  # int32: total < 2^31
+    total = bounds[-1] if n else jnp.int32(0)
+    starts = bounds - out_counts
+    pos = jnp.arange(C, dtype=jnp.int32)
+    # Scatter every row's start (zero-output rows stack on the next
+    # start); prefix count - 1 yields the LAST row starting at or before
+    # each slot — the owning row (ops.join._expand_kernel's trick).
+    indicator = jnp.zeros(C, jnp.int32).at[
+        jnp.clip(starts, 0, C - 1)].add(
+            jnp.where(starts < C, 1, 0).astype(jnp.int32))
+    lrow = jnp.clip(jnp.cumsum(indicator) - 1, 0, max(n - 1, 0))
+    k = pos - jnp.take(starts, lrow)
+    matched = jnp.take(counts, lrow) > 0
+    rpos = jnp.take(lo, lrow) + k
+    empty_right = meta.right_rows == 0    # no matches; left join null-pads
+    if empty_right:
+        rrow = jnp.zeros(C, jnp.int32)
+    else:
+        rrow = jnp.take(rorder, jnp.clip(rpos, 0, meta.right_rows - 1))
+    out_sel = pos < total
+
+    new: dict[str, Column] = {}
+    for name, c in cols.items():
+        data = jnp.take(c.data, lrow, axis=0)
+        validity = None if c.validity is None else jnp.take(c.validity, lrow)
+        new[name] = Column(data=data, validity=validity, dtype=c.dtype)
+    for side_name, out_name in meta.pays:
+        pay = side[side_name]
+        if empty_right:
+            data = jnp.zeros((C,) + pay.data.shape[1:], pay.data.dtype)
+            validity = jnp.zeros(C, jnp.bool_)
+        else:
+            data = jnp.take(pay.data, rrow, axis=0)
+            validity = (None if pay.validity is None
+                        else jnp.take(pay.validity, rrow))
+            if meta.how == "left":
+                # Unmatched left rows contribute one all-null right slot.
+                validity = (matched if validity is None
+                            else (validity & matched))
+        new[out_name] = Column(data=data, validity=validity, dtype=pay.dtype)
+    if meta.rowid_name is not None:
+        new[meta.rowid_name] = Column(
+            data=rrow, validity=matched if meta.how == "left" else None,
+            dtype=INT32)
+    return new, out_sel

@@ -81,6 +81,26 @@ class JoinStep:
 
 
 @dataclass(frozen=True)
+class JoinShuffledStep:
+    """Shuffled (big-big) equi-join: both sides are fact-sized, keys need
+    not be unique, and the output is a data-dependent many-to-many
+    expansion.
+
+    The cuDF/spark-rapids counterpart is the shuffled hash join (both
+    sides repartitioned by key over UCX, then a per-partition hash join —
+    the TPC-DS q95 shape where two fact tables join and no broadcast
+    fits).  Here the single-chip compiled form probes at bind time
+    (sort-based factorize over the key union, cached per table buffers)
+    and expands inside the program to a static pow2 capacity; the
+    distributed form hash-shuffles both sides with ``lax.all_to_all``
+    over the mesh axis and merge-joins per shard (parallel.dist_ops)."""
+    table: object                      # Table (identity hash/eq)
+    left_on: tuple[str, ...]
+    right_on: tuple[str, ...]
+    how: str                           # inner | left | semi | anti
+
+
+@dataclass(frozen=True)
 class WindowStep:
     """One window-function column (Spark OVER clause).
 
@@ -109,8 +129,8 @@ class LimitStep:
     k: int
 
 
-Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep, WindowStep,
-             SortStep, LimitStep]
+Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep,
+             JoinShuffledStep, WindowStep, SortStep, LimitStep]
 
 WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
                 "sum", "min", "max", "count")
@@ -126,6 +146,14 @@ class Plan:
     def filter(self, pred: Expr) -> "Plan":
         """Keep rows where ``pred`` is true (null predicate drops the row,
         cudf ``apply_boolean_mask`` semantics)."""
+        if not isinstance(pred, Expr):
+            # The most common way to get here: `col(a) == col(b)` — Expr
+            # keeps structural ==/!= (it is a compile-cache key), so the
+            # comparison evaluated to a Python bool.
+            raise TypeError(
+                f"filter predicate must be an expression, got "
+                f"{type(pred).__name__} {pred!r}; use .eq()/.ne() for "
+                f"column equality comparisons")
         return Plan(self.steps + (FilterStep(pred),))
 
     def with_columns(self, **exprs: Expr) -> "Plan":
@@ -197,6 +225,40 @@ class Plan:
             raise ValueError("left_on/right_on must have the same length")
         return Plan(self.steps + (JoinStep(table, tuple(left_on),
                                            tuple(right_on), how),))
+
+    def join_shuffled(self, table: Table,
+                      on: Optional[Sequence[str] | str] = None,
+                      left_on: Optional[Sequence[str] | str] = None,
+                      right_on: Optional[Sequence[str] | str] = None,
+                      how: str = "inner") -> "Plan":
+        """Join against a fact-sized ``table`` whose keys need NOT be
+        unique (many-to-many expansion) — the shuffled hash join of the
+        TPC-DS q95 shape, where neither side fits a broadcast.
+
+        ``how``: "inner", "left", "semi", or "anti".  The right side's
+        non-key columns are appended to the schema (name collisions are
+        an error — rename first); its key columns are dropped.  Probe
+        keys must be columns of the plan's *input* table, unmodified, and
+        the join must precede any group-by/sort/limit (join first, then
+        aggregate — the physical-plan order Spark produces for these
+        queries anyway).  In ``run_dist`` both sides are hash-shuffled
+        across the mesh (``lax.all_to_all``) and merge-joined per shard;
+        there ``how`` is limited to inner/left.
+        """
+        if how not in ("inner", "left", "semi", "anti"):
+            raise ValueError(f"unsupported join type {how!r}")
+        if on is not None:
+            left_on = right_on = on
+        if not left_on or not right_on:
+            raise ValueError("join keys: pass `on=` or left_on/right_on")
+        if isinstance(left_on, str):
+            left_on = [left_on]
+        if isinstance(right_on, str):
+            right_on = [right_on]
+        if len(left_on) != len(right_on):
+            raise ValueError("left_on/right_on must have the same length")
+        return Plan(self.steps + (JoinShuffledStep(
+            table, tuple(left_on), tuple(right_on), how),))
 
     def window(self, out: str, func: str,
                partition_by: Sequence[str] | str,

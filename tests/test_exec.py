@@ -507,6 +507,126 @@ class TestBroadcastJoin:
         _check(p, f)
 
 
+class TestShuffledJoin:
+    """Big-big (many-to-many) join in compiled plans — the TPC-DS q95
+    shape: neither side broadcastable, keys repeat on both sides."""
+
+    def _facts(self, rng, n=3000, m=2500, hi=400, with_strings=False):
+        left = Table([
+            ("k", Column.from_numpy(rng.integers(0, hi, n).astype(np.int64),
+                                    validity=rng.random(n) > 0.05)),
+            ("lv", Column.from_numpy(
+                rng.integers(-100, 100, n).astype(np.int64))),
+            ("lf", Column.from_numpy(rng.normal(size=n))),
+        ])
+        rcols = [
+            ("rk", Column.from_numpy(rng.integers(0, hi, m).astype(np.int64),
+                                     validity=rng.random(m) > 0.05)),
+            ("rv", Column.from_numpy(rng.integers(0, 50, m).astype(np.int64),
+                                     validity=rng.random(m) > 0.1)),
+        ]
+        if with_strings:
+            rcols.append(("rs", Column.from_pylist(
+                [None if i % 11 == 0 else f"r{i % 17}" for i in range(m)],
+                dt.STRING)))
+        return left, Table(rcols)
+
+    def test_all_hows(self, rng):
+        left, right = self._facts(rng)
+        for how in ("inner", "left", "semi", "anti"):
+            p = plan().join_shuffled(right, left_on="k", right_on="rk",
+                                     how=how)
+            _check(p, left, rtol=1e-12, atol=1e-12)
+
+    def test_filter_join_groupby_sort(self, rng):
+        # The q95 physical shape: filter -> shuffled join -> aggregate.
+        left, right = self._facts(rng)
+        p = (plan()
+             .filter(col("lv") > -50)
+             .join_shuffled(right, left_on="k", right_on="rk")
+             .groupby_agg(["rv"], [("lf", "sum", "s"), ("lv", "count", "c")])
+             .sort_by(["rv"]))
+        _check(p, left, rtol=1e-9, atol=1e-9)
+
+    def test_dense_groupby_on_joined_key(self, rng):
+        # The joined payload's domain comes from the right table via the
+        # probe-source mechanism; the post-join group-by must go dense.
+        from spark_rapids_tpu.exec.compile import _Bound
+        left, right = self._facts(rng)
+        p = (plan().join_shuffled(right, left_on="k", right_on="rk")
+             .groupby_agg(["rv"], [("lv", "sum", "s")]))
+        assert _Bound(p, left).group_metas[0].dense
+        _check(p, left)
+
+    def test_shared_key_name_on(self, rng):
+        left, right = self._facts(rng)
+        right = right.rename({"rk": "k"})
+        p = plan().join_shuffled(right, on="k")
+        _check(p, left, rtol=1e-12, atol=1e-12)
+
+    def test_string_payload_rides_right(self, rng):
+        left, right = self._facts(rng, with_strings=True)
+        for how in ("inner", "left"):
+            p = plan().join_shuffled(right, left_on="k", right_on="rk",
+                                     how=how)
+            _check(p, left, rtol=1e-12, atol=1e-12)
+
+    def test_left_strings_pass_through(self, rng):
+        left, right = self._facts(rng, n=500, m=400)
+        words = ["a", "bb", "", "dddd"]
+        left = left.with_column("ls", Column.from_pylist(
+            [None if i % 9 == 0 else words[i % 4]
+             for i in range(left.num_rows)], dt.STRING))
+        p = plan().join_shuffled(right, left_on="k", right_on="rk")
+        _check(p, left, rtol=1e-12, atol=1e-12)
+
+    def test_empty_right(self, rng):
+        left, _ = self._facts(rng, n=200)
+        right = Table([
+            ("rk", Column.from_numpy(np.zeros(0, np.int64))),
+            ("rv", Column.from_numpy(np.zeros(0, np.int64))),
+        ])
+        for how in ("inner", "left", "semi", "anti"):
+            p = plan().join_shuffled(right, left_on="k", right_on="rk",
+                                     how=how)
+            _check(p, left)
+
+    def test_after_sort_raises(self, rng):
+        left, right = self._facts(rng, n=200, m=100)
+        p = (plan().sort_by(["lv"])
+             .join_shuffled(right, left_on="k", right_on="rk"))
+        with pytest.raises(TypeError, match="shuffled join must come"):
+            p.run(left)
+
+    def test_redefined_key_raises(self, rng):
+        left, right = self._facts(rng, n=200, m=100)
+        p = (plan().with_columns(k=col("k") + 1)
+             .join_shuffled(right, left_on="k", right_on="rk"))
+        with pytest.raises(TypeError, match="unmodified input"):
+            p.run(left)
+
+    def test_collision_raises(self, rng):
+        left, right = self._facts(rng, n=200, m=100)
+        right = right.rename({"rv": "lv"})
+        p = plan().join_shuffled(right, left_on="k", right_on="rk")
+        with pytest.raises(ValueError, match="collides"):
+            p.run(left)
+
+    def test_probe_cache_reused_across_plans(self, rng):
+        import spark_rapids_tpu.exec.join as J
+        left, right = self._facts(rng, n=300, m=200)
+        before = len(J._SHUFFLE_PROBE_CACHE)
+        p1 = plan().join_shuffled(right, left_on="k", right_on="rk")
+        p1.run(left)
+        mid = len(J._SHUFFLE_PROBE_CACHE)
+        # A different plan over the SAME tables reuses the bound probe.
+        p2 = (plan().filter(col("lv") > 0)
+              .join_shuffled(right, left_on="k", right_on="rk"))
+        p2.run(left)
+        assert len(J._SHUFFLE_PROBE_CACHE) == mid
+        assert mid == before + 1
+
+
 class TestSortLimit:
     def test_sort_desc_nulls(self, rng):
         t = _mixed_table(rng)

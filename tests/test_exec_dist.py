@@ -131,3 +131,76 @@ class TestDistPlans:
         p = plan().groupby_agg(["k1"], [("v", "first", "vf")])
         with pytest.raises(TypeError, match="first/last"):
             p.run_dist(shard_table(t, mesh), mesh)
+
+
+def _row_multiset(t):
+    d = t.to_pydict()
+    names = sorted(d)
+    return sorted(zip(*[d[nm] for nm in names]),
+                  key=lambda r: tuple((x is None, x) for x in r))
+
+
+class TestDistShuffledJoin:
+    """Big-big join over the mesh: both sides hash-shuffled with
+    all_to_all, merge-joined per shard (the q95 shape distributed)."""
+
+    def _facts(self, rng, n=4003, m=3001, hi=300):
+        left = Table([
+            ("k", Column.from_numpy(rng.integers(0, hi, n).astype(np.int64),
+                                    validity=rng.random(n) > 0.05)),
+            ("lv", Column.from_numpy(
+                rng.integers(-100, 100, n).astype(np.int64))),
+        ])
+        right = Table([
+            ("rk", Column.from_numpy(rng.integers(0, hi, m).astype(np.int64),
+                                     validity=rng.random(m) > 0.05)),
+            ("rv", Column.from_numpy(rng.integers(0, 40, m).astype(np.int64),
+                                     validity=rng.random(m) > 0.1)),
+        ])
+        return left, right
+
+    def test_join_groupby_matches_local(self, rng, mesh):
+        left, right = self._facts(rng)
+        p = (plan()
+             .filter(col("lv") > -50)
+             .join_shuffled(right, left_on="k", right_on="rk")
+             .groupby_agg(["rv"], [("lv", "sum", "s"), ("lv", "count", "c")])
+             .sort_by(["rv"]))
+        got = p.run_dist(shard_table(left, mesh), mesh)
+        want = p.run(left)
+        assert_tables_equal(want, got, rtol=1e-9, atol=1e-9)
+
+    def test_join_only_multiset(self, rng, mesh):
+        from spark_rapids_tpu.parallel import collect
+        left, right = self._facts(rng)
+        for how in ("inner", "left"):
+            p = plan().join_shuffled(right, left_on="k", right_on="rk",
+                                     how=how)
+            got = collect(p.run_dist(shard_table(left, mesh), mesh))
+            want = p.run(left)
+            assert _row_multiset(got) == _row_multiset(want), how
+
+    def test_shared_key_name(self, rng, mesh):
+        left, right = self._facts(rng, n=1200, m=900)
+        right = right.rename({"rk": "k"})
+        p = (plan().join_shuffled(right, on="k")
+             .groupby_agg(["rv"], [("lv", "sum", "s")])
+             .sort_by(["rv"]))
+        got = p.run_dist(shard_table(left, mesh), mesh)
+        want = p.run(left)
+        assert_tables_equal(want, got)
+
+    def test_semi_raises_dist(self, rng, mesh):
+        left, right = self._facts(rng, n=400, m=300)
+        p = plan().join_shuffled(right, left_on="k", right_on="rk",
+                                 how="semi")
+        with pytest.raises(TypeError, match="inner/left"):
+            p.run_dist(shard_table(left, mesh), mesh)
+
+    def test_join_after_groupby_raises_dist(self, rng, mesh):
+        left, right = self._facts(rng, n=400, m=300)
+        p = (plan().groupby_agg(["k"], [("lv", "sum", "s")],
+                                domains={"k": (0, 299)})
+             .join_shuffled(right, left_on="k", right_on="rk"))
+        with pytest.raises(TypeError, match="join first"):
+            p.run_dist(shard_table(left, mesh), mesh)
