@@ -1,0 +1,65 @@
+#!/bin/bash
+# Host-interop check: prove non-Python hosts can drive the srt_* C ABI.
+#
+# The reference's entire purpose is serving a foreign host runtime — the
+# JVM — through a hand-written JNI bridge (RowConversionJni.cpp).  This
+# engine's host boundary is a plain C ABI, so the proof has two tiers:
+#
+#  1. C host (always runs): hosts/c/host_check.c is compiled and driven
+#     by tests/test_host_interop.py; a process with no Python in it packs
+#     a table through srt_convert_to_rows and the bytes must equal the
+#     Python/device path's, byte for byte.
+#  2. JVM host (when a JDK 22+ with java.lang.foreign is on PATH):
+#     hosts/java/RowConversionFfm.java — the same protocol via Panama FFM
+#     downcalls, no JNI glue — is compiled and run against the same spec
+#     file; absent a JDK the tier is skipped the way the reference skips
+#     CuFileTest on runners without GDS (ci/premerge-build.sh:28).
+set -ex
+
+cd "$(dirname "$0")/.."
+
+# Tier 1: C host byte-equality suite (compiles hosts/c/host_check.c).
+python -m pytest tests/test_host_interop.py -q
+
+# Tier 2: JVM host via Panama FFM.
+if command -v javac >/dev/null 2>&1 && command -v java >/dev/null 2>&1; then
+    JAVA_MAJOR=$(javac -version 2>&1 | sed -E 's/javac ([0-9]+).*/\1/')
+    if [[ "${JAVA_MAJOR}" -ge 22 ]]; then
+        WORK=$(mktemp -d)
+        trap 'rm -rf "${WORK}"' EXIT
+        javac -d "${WORK}" hosts/java/RowConversionFfm.java
+
+        # Spec + expected bytes from the Python path.
+        python - "$WORK" <<'EOF'
+import sys
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.ffi.hostspec import expected_row_bytes, write_spec
+
+work = sys.argv[1]
+rng = np.random.default_rng(7)
+n = 1000
+t = Table([
+    ("i64", Column.from_numpy(rng.integers(-1 << 40, 1 << 40, n).astype(np.int64),
+                              validity=rng.random(n) > 0.1)),
+    ("f64", Column.from_numpy(rng.normal(size=n), validity=rng.random(n) > 0.1)),
+    ("i32", Column.from_numpy(rng.integers(-1 << 20, 1 << 20, n).astype(np.int32))),
+    ("d64", Column.from_numpy(rng.integers(-1 << 40, 1 << 40, n).astype(np.int64),
+                              dtype=dt.decimal64(-8),
+                              validity=rng.random(n) > 0.1)),
+])
+write_spec(t, f"{work}/table.spec")
+open(f"{work}/expected.bin", "wb").write(expected_row_bytes(t))
+EOF
+        java --enable-native-access=ALL-UNNAMED -cp "${WORK}" RowConversionFfm \
+            spark_rapids_tpu/ffi/libspark_rapids_tpu_host.so \
+            "${WORK}/table.spec" "${WORK}/rows.bin"
+        cmp "${WORK}/rows.bin" "${WORK}/expected.bin"
+        echo "JVM FFM host byte-equality: OK"
+    else
+        echo "JDK ${JAVA_MAJOR} < 22 (no java.lang.foreign): JVM tier skipped"
+    fi
+else
+    echo "no JDK on PATH: JVM tier skipped (C-host tier covered the ABI)"
+fi

@@ -1,0 +1,127 @@
+/* Non-Python host proof for the srt_* C ABI.
+ *
+ * The reference exists to serve a JVM host (RowConversion.java:101-121
+ * calls into RowConversionJni.cpp:24-66); this engine's host boundary is
+ * a plain C ABI instead of JNI, so ANY host runtime with a C FFI — JVM
+ * Panama, JNA, .NET P/Invoke, C itself — can drive it.  This program is
+ * the executable proof: it dlopens the library (no Python anywhere in the
+ * process), builds a table from raw bytes read from a spec file, calls
+ * srt_convert_to_rows, and writes the resulting row-blob bytes out.  The
+ * test harness (tests/test_host_interop.py) asserts those bytes equal the
+ * Python path's, byte for byte; hosts/java/RowConversionFfm.java is the
+ * same protocol in Java FFM for JVM environments.
+ *
+ * Spec file layout (little-endian):
+ *   int32 ncols, int64 num_rows
+ *   per column: int32 type_id, int32 scale, int32 elem_size,
+ *               int32 has_valid, then num_rows*elem_size data bytes,
+ *               then (has_valid ? num_rows : 0) validity bytes (0/1).
+ *
+ * Usage: host_check <libspark_rapids_tpu_host.so> <spec> <out>
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef int64_t (*convert_fn)(int32_t, const int32_t*, const int32_t*, int64_t,
+                              const void* const*, const uint8_t* const*,
+                              int64_t, int32_t, int32_t*, int32_t*);
+typedef int32_t (*blobs_count_fn)(int64_t);
+typedef int64_t (*blob_rows_fn)(int64_t, int32_t);
+typedef int32_t (*blob_rowsize_fn)(int64_t, int32_t);
+typedef const uint8_t* (*blob_data_fn)(int64_t, int32_t);
+typedef void (*blobs_free_fn)(int64_t);
+typedef const char* (*last_error_fn)(void);
+
+static void die(const char* msg) {
+  fprintf(stderr, "host_check: %s\n", msg);
+  exit(1);
+}
+
+static void* must_sym(void* lib, const char* name) {
+  void* p = dlsym(lib, name);
+  if (!p) die(dlerror());
+  return p;
+}
+
+static void read_exact(FILE* f, void* buf, size_t n) {
+  if (fread(buf, 1, n, f) != n) die("short read in spec file");
+}
+
+int main(int argc, char** argv) {
+  if (argc != 4) die("usage: host_check <lib.so> <spec> <out>");
+
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!lib) die(dlerror());
+  convert_fn convert = (convert_fn)must_sym(lib, "srt_convert_to_rows");
+  blobs_count_fn blobs_count = (blobs_count_fn)must_sym(lib, "srt_blobs_count");
+  blob_rows_fn blob_rows = (blob_rows_fn)must_sym(lib, "srt_blob_num_rows");
+  blob_rowsize_fn blob_rowsize =
+      (blob_rowsize_fn)must_sym(lib, "srt_blob_row_size");
+  blob_data_fn blob_data = (blob_data_fn)must_sym(lib, "srt_blob_data");
+  blobs_free_fn blobs_free = (blobs_free_fn)must_sym(lib, "srt_blobs_free");
+  last_error_fn last_error = (last_error_fn)must_sym(lib, "srt_last_error");
+
+  FILE* spec = fopen(argv[2], "rb");
+  if (!spec) die("cannot open spec file");
+  int32_t ncols = 0;
+  int64_t num_rows = 0;
+  read_exact(spec, &ncols, sizeof ncols);
+  read_exact(spec, &num_rows, sizeof num_rows);
+  if (ncols <= 0 || ncols > 1024 || num_rows < 0) die("bad spec header");
+
+  int32_t* type_ids = calloc((size_t)ncols, sizeof(int32_t));
+  int32_t* scales = calloc((size_t)ncols, sizeof(int32_t));
+  void** data = calloc((size_t)ncols, sizeof(void*));
+  uint8_t** valid = calloc((size_t)ncols, sizeof(uint8_t*));
+  if (!type_ids || !scales || !data || !valid) die("oom");
+
+  for (int32_t c = 0; c < ncols; ++c) {
+    int32_t elem_size = 0, has_valid = 0;
+    read_exact(spec, &type_ids[c], sizeof(int32_t));
+    read_exact(spec, &scales[c], sizeof(int32_t));
+    read_exact(spec, &elem_size, sizeof(int32_t));
+    read_exact(spec, &has_valid, sizeof(int32_t));
+    if (elem_size <= 0 || elem_size > 16) die("bad element size");
+    size_t nbytes = (size_t)num_rows * (size_t)elem_size;
+    data[c] = malloc(nbytes ? nbytes : 1);
+    if (!data[c]) die("oom");
+    read_exact(spec, data[c], nbytes);
+    if (has_valid) {
+      valid[c] = malloc((size_t)num_rows ? (size_t)num_rows : 1);
+      if (!valid[c]) die("oom");
+      read_exact(spec, valid[c], (size_t)num_rows);
+    }
+  }
+  fclose(spec);
+
+  int32_t num_blobs = 0, status = 0;
+  int64_t handle =
+      convert(ncols, type_ids, scales, num_rows, (const void* const*)data,
+              (const uint8_t* const*)valid, 0, 1, &num_blobs, &status);
+  if (handle == 0) {
+    fprintf(stderr, "srt_convert_to_rows failed (%d): %s\n", status,
+            last_error());
+    return 2;
+  }
+  if (blobs_count(handle) != num_blobs) die("blob count mismatch");
+
+  FILE* out = fopen(argv[3], "wb");
+  if (!out) die("cannot open output file");
+  for (int32_t i = 0; i < num_blobs; ++i) {
+    int64_t rows = blob_rows(handle, i);
+    int32_t row_size = blob_rowsize(handle, i);
+    const uint8_t* bytes = blob_data(handle, i);
+    if (rows < 0 || row_size <= 0 || !bytes) die("bad blob accessor result");
+    if (fwrite(bytes, 1, (size_t)(rows * row_size), out) !=
+        (size_t)(rows * row_size))
+      die("short write");
+  }
+  fclose(out);
+  blobs_free(handle);
+  printf("host_check ok: %d blob(s), %lld rows\n", num_blobs,
+         (long long)num_rows);
+  return 0;
+}

@@ -1,0 +1,162 @@
+// JVM host sample for the srt_* C ABI via Panama FFM (JDK 22+).
+//
+// The reference serves the JVM through JNI (RowConversion.java:101-121 ->
+// RowConversionJni.cpp:24-66, with a hand-written native bridge per entry
+// point).  This engine exposes a plain C ABI instead, so a modern JVM
+// needs NO native glue at all: java.lang.foreign binds the symbols
+// directly.  This program is the JVM twin of hosts/c/host_check.c — same
+// spec-file protocol, same output bytes — so the byte-equality oracle in
+// tests/test_host_interop.py applies to either host.
+//
+// Build/run (needs a JDK with java.lang.foreign, 22+):
+//   javac RowConversionFfm.java
+//   java --enable-native-access=ALL-UNNAMED RowConversionFfm \
+//        <libspark_rapids_tpu_host.so> <spec> <out>
+// ci/host-interop-check.sh invokes this automatically when a suitable JDK
+// is on PATH and skips (like the reference's hardware-gated CuFileTest
+// exclusion) when not.
+
+import java.io.IOException;
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.Paths;
+
+public final class RowConversionFfm {
+
+  public static void main(String[] args) throws Throwable {
+    if (args.length != 3) {
+      System.err.println("usage: RowConversionFfm <lib.so> <spec> <out>");
+      System.exit(1);
+    }
+    Linker linker = Linker.nativeLinker();
+    try (Arena arena = Arena.ofConfined()) {
+      SymbolLookup lib = SymbolLookup.libraryLookup(Paths.get(args[0]), arena);
+
+      MethodHandle convert = linker.downcallHandle(
+          lib.find("srt_convert_to_rows").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.JAVA_LONG,   // blob-set handle
+              ValueLayout.JAVA_INT,                       // ncols
+              ValueLayout.ADDRESS,                        // type_ids
+              ValueLayout.ADDRESS,                        // scales
+              ValueLayout.JAVA_LONG,                      // num_rows
+              ValueLayout.ADDRESS,                        // col_data**
+              ValueLayout.ADDRESS,                        // col_valid**
+              ValueLayout.JAVA_LONG,                      // max_batch_bytes
+              ValueLayout.JAVA_INT,                       // check_row_width
+              ValueLayout.ADDRESS,                        // out_num_blobs
+              ValueLayout.ADDRESS));                      // out_status
+      MethodHandle blobsCount = linker.downcallHandle(
+          lib.find("srt_blobs_count").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG));
+      MethodHandle blobRows = linker.downcallHandle(
+          lib.find("srt_blob_num_rows").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.JAVA_LONG, ValueLayout.JAVA_LONG,
+              ValueLayout.JAVA_INT));
+      MethodHandle blobRowSize = linker.downcallHandle(
+          lib.find("srt_blob_row_size").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+              ValueLayout.JAVA_INT));
+      MethodHandle blobData = linker.downcallHandle(
+          lib.find("srt_blob_data").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+              ValueLayout.JAVA_INT));
+      MethodHandle blobsFree = linker.downcallHandle(
+          lib.find("srt_blobs_free").orElseThrow(),
+          FunctionDescriptor.ofVoid(ValueLayout.JAVA_LONG));
+      MethodHandle lastError = linker.downcallHandle(
+          lib.find("srt_last_error").orElseThrow(),
+          FunctionDescriptor.of(ValueLayout.ADDRESS));
+
+      Spec spec = Spec.read(Paths.get(args[1]));
+
+      MemorySegment typeIds = arena.allocateFrom(ValueLayout.JAVA_INT,
+          spec.typeIds);
+      MemorySegment scales = arena.allocateFrom(ValueLayout.JAVA_INT,
+          spec.scales);
+      MemorySegment dataPtrs = arena.allocate(ValueLayout.ADDRESS,
+          spec.ncols);
+      MemorySegment validPtrs = arena.allocate(ValueLayout.ADDRESS,
+          spec.ncols);
+      for (int c = 0; c < spec.ncols; c++) {
+        MemorySegment d = arena.allocate(Math.max(spec.data[c].length, 1));
+        MemorySegment.copy(spec.data[c], 0, d, ValueLayout.JAVA_BYTE, 0,
+            spec.data[c].length);
+        dataPtrs.setAtIndex(ValueLayout.ADDRESS, c, d);
+        if (spec.valid[c] != null) {
+          MemorySegment v = arena.allocate(Math.max(spec.valid[c].length, 1));
+          MemorySegment.copy(spec.valid[c], 0, v, ValueLayout.JAVA_BYTE, 0,
+              spec.valid[c].length);
+          validPtrs.setAtIndex(ValueLayout.ADDRESS, c, v);
+        } else {
+          validPtrs.setAtIndex(ValueLayout.ADDRESS, c, MemorySegment.NULL);
+        }
+      }
+
+      MemorySegment numBlobs = arena.allocate(ValueLayout.JAVA_INT);
+      MemorySegment status = arena.allocate(ValueLayout.JAVA_INT);
+      long handle = (long) convert.invoke(spec.ncols, typeIds, scales,
+          spec.numRows, dataPtrs, validPtrs, 0L, 1, numBlobs, status);
+      if (handle == 0) {
+        MemorySegment err = (MemorySegment) lastError.invoke();
+        throw new RuntimeException("srt_convert_to_rows failed ("
+            + status.get(ValueLayout.JAVA_INT, 0) + "): "
+            + err.reinterpret(4096).getString(0));
+      }
+      int n = (int) blobsCount.invoke(handle);
+      if (n != numBlobs.get(ValueLayout.JAVA_INT, 0)) {
+        throw new RuntimeException("blob count mismatch");
+      }
+      try (var out = Files.newOutputStream(Paths.get(args[2]))) {
+        for (int i = 0; i < n; i++) {
+          long rows = (long) blobRows.invoke(handle, i);
+          int rowSize = (int) blobRowSize.invoke(handle, i);
+          MemorySegment bytes = (MemorySegment) blobData.invoke(handle, i);
+          byte[] buf = bytes.reinterpret(rows * rowSize)
+              .toArray(ValueLayout.JAVA_BYTE);
+          out.write(buf);
+        }
+      }
+      blobsFree.invoke(handle);
+      System.out.println("RowConversionFfm ok: " + n + " blob(s), "
+          + spec.numRows + " rows");
+    }
+  }
+
+  /** Parsed spec file (see hosts/c/host_check.c for the layout). */
+  private record Spec(int ncols, long numRows, int[] typeIds, int[] scales,
+                      byte[][] data, byte[][] valid) {
+
+    static Spec read(Path path) throws IOException {
+      ByteBuffer b = ByteBuffer.wrap(Files.readAllBytes(path))
+          .order(ByteOrder.LITTLE_ENDIAN);
+      int ncols = b.getInt();
+      long numRows = b.getLong();
+      int[] typeIds = new int[ncols];
+      int[] scales = new int[ncols];
+      byte[][] data = new byte[ncols][];
+      byte[][] valid = new byte[ncols][];
+      for (int c = 0; c < ncols; c++) {
+        typeIds[c] = b.getInt();
+        scales[c] = b.getInt();
+        int elemSize = b.getInt();
+        int hasValid = b.getInt();
+        data[c] = new byte[(int) (numRows * elemSize)];
+        b.get(data[c]);
+        if (hasValid != 0) {
+          valid[c] = new byte[(int) numRows];
+          b.get(valid[c]);
+        }
+      }
+      return new Spec(ncols, numRows, typeIds, scales, data, valid);
+    }
+  }
+}

@@ -31,10 +31,12 @@ int32_t itemsize(TypeId id) {
     case TypeId::DURATION_NANOSECONDS:
     case TypeId::DECIMAL64:
       return 8;
+    case TypeId::DECIMAL128:
+      // Two little-endian 64-bit words (lo, hi) at 8-byte alignment — the
+      // engine's extension to the reference format (dtypes.py _TWO_WORD,
+      // rows/layout.py), byte-compatible with Arrow/cudf decimal128.
+      return 16;
     default:
-      // DECIMAL128 included: the Python/JAX side has no 16-byte physical
-      // dtype (dtypes.py _PHYSICAL), and the cross-host byte contract must
-      // not let one side pack what the other cannot unpack.
       throw std::invalid_argument("Only fixed width types are currently supported");
   }
 }
@@ -46,7 +48,6 @@ bool is_fixed_width(TypeId id) {
     case TypeId::STRING:
     case TypeId::LIST:
     case TypeId::STRUCT:
-    case TypeId::DECIMAL128:  // no physical dtype on the Python/JAX side
       return false;
     default:
       return true;
@@ -67,7 +68,9 @@ RowLayout compute_fixed_width_layout(const std::vector<DType>& schema) {
     if (!is_fixed_width(dt.type_id))
       throw std::invalid_argument("Only fixed width types are currently supported");
     int32_t size = itemsize(dt.type_id);
-    at = align_offset(at, size);  // natural alignment
+    // Natural alignment capped at 8: DECIMAL128 (16 bytes) sits at 8-byte
+    // alignment as two consecutive 64-bit words (rows/layout.py contract).
+    at = align_offset(at, size < 8 ? size : 8);
     layout.column_starts.push_back(at);
     layout.column_sizes.push_back(size);
     at += size;

@@ -1,0 +1,48 @@
+"""Host-interop spec files: the byte protocol shared with non-Python hosts.
+
+The host samples (hosts/c/host_check.c, hosts/java/RowConversionFfm.java)
+prove that a process with no Python in it can drive the srt_* C ABI — the
+role the reference's JNI layer plays for the JVM (RowConversionJni.cpp).
+This module writes their input: a little-endian spec file describing a
+fixed-width table as raw column buffers.
+
+Layout: int32 ncols, int64 num_rows, then per column
+int32 type_id, int32 scale, int32 elem_size, int32 has_valid,
+``num_rows * elem_size`` data bytes, ``num_rows`` validity bytes (0/1)
+when has_valid.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from ..table import Table
+
+
+def write_spec(table: Table, path: str | Path) -> None:
+    """Serialize a fixed-width table's host buffers to a spec file."""
+    with open(path, "wb") as f:
+        f.write(struct.pack("<iq", table.num_columns, table.num_rows))
+        for _, col in table.items():
+            if col.offsets is not None:
+                raise TypeError("spec files carry fixed-width columns only")
+            data = np.ascontiguousarray(np.asarray(col.data))
+            f.write(struct.pack("<iiii", int(col.dtype.type_id),
+                                col.dtype.scale, col.dtype.itemsize,
+                                1 if col.validity is not None else 0))
+            f.write(data.tobytes())
+            if col.validity is not None:
+                f.write(np.asarray(col.validity).astype(np.uint8).tobytes())
+
+
+def expected_row_bytes(table: Table) -> bytes:
+    """The Python/device path's row-format bytes for the same table —
+    the byte-equality oracle the host programs are checked against."""
+    from ..rows import convert as rc
+    from ..rows.image import words_to_host_bytes
+    blobs = rc.to_rows(table)
+    return b"".join(bytes(words_to_host_bytes(b.words, b.row_size))
+                    for b in blobs)
