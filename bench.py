@@ -32,7 +32,7 @@ import time
 import numpy as np
 
 N_ROWS = 4_000_000
-REPS = 8
+REPS = 48
 
 
 def _make_inputs(rng):
@@ -60,38 +60,48 @@ def _make_inputs(rng):
 
 
 def bench_device(schema, datas, masks):
-    """Chained pack->unpack round trips (separate jitted programs)."""
+    """Chained pack->unpack round trips (separate jitted programs).
+
+    Two dispatches per iteration: the data-dependent perturbation (+0/+1
+    derived from the previous words) is FUSED into the pack program — a
+    separate perturb jit measured ~2.2 ms of pure dispatch latency per
+    iteration through the tunneled device.  REPS is sized to amortize the
+    fixed end-of-chain host-read fence, measured ~95-120 ms through the
+    tunnel (BASELINE.md "transpose roofline analysis"): at 8 reps the
+    fence alone halves the reported throughput; at 48 it costs ~10%.
+    """
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_tpu.rows.convert import _packer, _unpacker
+    from spark_rapids_tpu.rows.layout import compute_fixed_width_layout
+    from spark_rapids_tpu.rows.image import pack_image, unpack_image
 
-    _, pack = _packer(schema)
-    _, unpack = _unpacker(schema)
+    layout = compute_fixed_width_layout(schema)
 
     @jax.jit
-    def perturb(d0, words):
-        # Data-dependent +0/+1 so each iteration's inputs differ and depend
-        # on the previous output; cost is one elementwise pass over d0.
-        bump = (words[0, -1] & jnp.uint32(1)).astype(d0.dtype)
-        return d0 + bump
+    def pack_chained(d, v, prev_words):
+        bump = (prev_words[0, -1] & jnp.uint32(1)).astype(d[0].dtype)
+        return pack_image(layout, (d[0] + bump,) + tuple(d[1:]), v)
 
-    words = pack(datas, masks)
-    d, v = unpack(words)
-    # Warm the EXACT loop composition: the in-loop pack call sees the
-    # unpack outputs' buffer layouts, which can trigger a re-specialized
-    # compile distinct from the warmup above — it must happen outside the
-    # timed region.
-    d0 = perturb(d[0], words)
-    words = pack((d0,) + tuple(d[1:]), v)
-    d, v = unpack(words)
+    @jax.jit
+    def unpack_step(words):
+        return unpack_image(layout, words)
+
+    W = layout.row_size // 4
+    words = jnp.zeros((W, N_ROWS), jnp.uint32)
+    d, v = datas, masks
+    # Warm the EXACT loop composition (in-loop calls see the unpack
+    # outputs' buffer layouts; a re-specialized compile must happen
+    # outside the timed region).
+    for _ in range(2):
+        words = pack_chained(d, v, words)
+        d, v = unpack_step(words)
     _ = np.asarray(d[0][-1:])                             # force completion
 
     t0 = time.perf_counter()
     for _ in range(REPS):
-        d0 = perturb(d[0], words)
-        words = pack((d0,) + tuple(d[1:]), v)
-        d, v = unpack(words)
+        words = pack_chained(d, v, words)
+        d, v = unpack_step(words)
     _ = np.asarray(d[0][-1:])                             # host read = fence
     dt = (time.perf_counter() - t0) / REPS
     return N_ROWS / dt

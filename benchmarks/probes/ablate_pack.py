@@ -1,0 +1,94 @@
+"""Roofline ablation: pack cost by column class (amortized fit protocol).
+
+Findings feed BASELINE.md's transpose roofline analysis.  Protocol: the
+(W, n) words output is both the jit output and the chain carrier (DCE-
+proof), iterations chain through a data-dependent bump, one host fence
+per REPS bucket, linear fit separates the fixed fence+dispatch cost from
+the true per-iteration kernel cost.
+"""
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import jax
+import jax.numpy as jnp
+import spark_rapids_tpu  # noqa: F401  (x64 on)
+from spark_rapids_tpu.rows.layout import compute_fixed_width_layout
+from spark_rapids_tpu.rows.image import pack_words
+from spark_rapids_tpu.dtypes import (BOOL8, FLOAT32, FLOAT64, INT8, INT32,
+                                     INT64)
+
+_U32 = jnp.uint32
+N = 16_000_000
+rng = np.random.default_rng(0)
+
+
+def fit_words_chain(stepf, W):
+    w = jnp.zeros((W, N), _U32)
+    for _ in range(3):
+        w = stepf(w)
+    jax.block_until_ready(w)
+    np.asarray(w[0, -1:])
+    res = {}
+    for REPS in (2, 8, 16):
+        t0 = time.perf_counter()
+        x = w
+        for _ in range(REPS):
+            x = stepf(x)
+        np.asarray(x[0, -1:])
+        res[REPS] = time.perf_counter() - t0
+    xs = np.array(list(res))
+    ys = np.array([res[k] for k in xs])
+    b, a = np.polyfit(xs, ys, 1)
+    return b
+
+
+def bench(name, schema):
+    layout = compute_fixed_width_layout(schema)
+    W = layout.row_size // 4
+    mk = {INT64: lambda: rng.integers(-1 << 40, 1 << 40, N).astype(np.int64),
+          FLOAT64: lambda: rng.normal(size=N),
+          INT32: lambda: rng.integers(-1 << 20, 1 << 20, N).astype(np.int32),
+          BOOL8: lambda: rng.integers(0, 2, N).astype(np.uint8),
+          FLOAT32: lambda: rng.normal(size=N).astype(np.float32),
+          INT8: lambda: rng.integers(-128, 128, N).astype(np.int8)}
+    ds = tuple(jnp.asarray(mk[d]()) for d in schema)
+    ms = tuple(jnp.asarray(rng.integers(0, 4, N) > 0) for _ in schema)
+
+    @jax.jit
+    def step(w):
+        bump = (w[0, -1] != 0).astype(ds[0].dtype)
+        ds2 = (ds[0] + bump,) + ds[1:]
+        return pack_words(layout, ds2, ms)
+
+    b = fit_words_chain(step, W)
+    data_b = sum(d.itemsize for d in schema) + len(schema) + layout.row_size
+    print(f"{name:28s}: {b*1e3:6.1f} ms -> {N/b/1e6:5.0f} Mrows/s, "
+          f"{data_b*N/b/1e9:4.0f} GB/s logical, W={W}", flush=True)
+
+
+if __name__ == "__main__":
+    bench("4x INT32", (INT32,) * 4)
+    bench("8x INT32", (INT32,) * 8)
+    bench("4x INT64", (INT64,) * 4)
+    bench("4x FLOAT64", (FLOAT64,) * 4)
+    bench("4x INT8", (INT8,) * 4)
+    bench("4x BOOL8", (BOOL8,) * 4)
+    bench("full 8-col mixed", (INT64, FLOAT64, INT32, BOOL8, FLOAT32,
+                               INT8, INT32, INT64))
+    streams = [jnp.asarray(rng.integers(0, 1 << 32, N, dtype=np.uint64)
+                           .astype(np.uint32)) for _ in range(12)]
+
+    @jax.jit
+    def stk(w):
+        bump = (w[0, -1] != 0).astype(_U32)
+        ss = [streams[0] + bump] + streams[1:]
+        return jnp.stack(ss, 0)
+
+    b = fit_words_chain(stk, 12)
+    print(f"{'stack 12 ready streams':28s}: {b*1e3:6.1f} ms -> "
+          f"{N/b/1e6:5.0f} Mrows/s, {12*4*2*N/b/1e9:4.0f} GB/s", flush=True)

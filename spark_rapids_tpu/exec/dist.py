@@ -78,6 +78,9 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
     from ..parallel.dist_ops import dist_join
     from ..parallel.mesh import shard_table
 
+    from ..parallel.mesh import collect
+    from .compile import run_plan_eager
+
     i = next(idx for idx, s in enumerate(plan.steps)
              if isinstance(s, JoinShuffledStep))
     step: JoinShuffledStep = plan.steps[i]
@@ -114,6 +117,11 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
         raise ValueError(
             f"join output column(s) {sorted(overlap)} collide with "
             f"existing columns; rename one side first")
+    # Degenerate shapes (0-row right side, prefix that filtered every row)
+    # break shuffle/join trace-time assumptions — mirror run_plan_dist's
+    # empty-input policy and finish eagerly on the collected rows.
+    if right.num_rows == 0 or _live_count_cached(pre.row_mask) == 0:
+        return run_plan_eager(Plan(plan.steps[i:]), collect(pre))
     rdist = shard_table(right, mesh)
     joined = dist_join(pre, rdist, mesh, on=list(step.left_on),
                        how=step.how)
@@ -122,16 +130,18 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
 
 def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     """Execute ``plan`` against a row-sharded table on ``mesh``."""
+    if _live_count_cached(dist.row_mask) == 0:
+        # Degenerate shapes break trace-time assumptions (and the probe
+        # under an all-False mask); mirror run_plan's eager fallback.
+        # Checked before the shuffled-join dispatch so every lowering
+        # path sees live rows.
+        from ..parallel.mesh import collect
+        from .compile import run_plan_eager
+        return run_plan_eager(plan, collect(dist))
     if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
         return _lower_shuffled_join(plan, dist, mesh)
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
-    if _live_count_cached(dist.row_mask) == 0:
-        # Degenerate shapes break trace-time assumptions (and the probe
-        # under an all-False mask); mirror run_plan's eager fallback.
-        from ..parallel.mesh import collect
-        from .compile import run_plan_eager
-        return run_plan_eager(plan, collect(dist))
     table = dist.table
     bound = _Bound(plan, table, probe_mask=dist.row_mask)
     if bound.string_cols or bound.dictionaries:

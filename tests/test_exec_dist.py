@@ -204,3 +204,30 @@ class TestDistShuffledJoin:
              .join_shuffled(right, left_on="k", right_on="rk"))
         with pytest.raises(TypeError, match="join first"):
             p.run_dist(shard_table(left, mesh), mesh)
+
+    def test_empty_left_falls_back_eager(self, rng, mesh):
+        left, right = self._facts(rng, n=16, m=8)
+        empty = left.gather(np.zeros(0, np.int32))
+        d0 = shard_table(empty, mesh, capacity=2)
+        p = (plan().join_shuffled(right, left_on="k", right_on="rk")
+             .groupby_agg(["rv"], [("lv", "sum", "s")]))
+        out = p.run_dist(d0, mesh)
+        assert out.num_rows == 0
+
+    def test_empty_right_falls_back_eager(self, rng, mesh):
+        left, right = self._facts(rng, n=400, m=8)
+        right0 = right.gather(np.zeros(0, np.int32))
+        for how in ("inner", "left"):
+            p = plan().join_shuffled(right0, left_on="k", right_on="rk",
+                                     how=how)
+            got = p.run_dist(shard_table(left, mesh), mesh)
+            want = p.run(left)
+            assert _row_multiset(got) == _row_multiset(want), how
+
+    def test_prefix_filters_all_rows(self, rng, mesh):
+        left, right = self._facts(rng, n=400, m=300)
+        p = (plan().filter(col("lv") > 10_000)      # drops every row
+             .join_shuffled(right, left_on="k", right_on="rk"))
+        got = p.run_dist(shard_table(left, mesh), mesh)
+        want = p.run(left)
+        assert _row_multiset(got) == _row_multiset(want)
