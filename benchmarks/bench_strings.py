@@ -111,6 +111,47 @@ def main():
 
     _bench("q28_like_cast_groupby_2M", q28ish, np.int64(0))
 
+    # -- same pipeline through the LazyTable facade: the eager LIKE mask
+    # fuses with filter -> cast -> grouped sum as ONE compiled program
+    # (exec/lazy.py); no plan() in the pipeline code, one host sync.
+    from spark_rapids_tpu.exec import col as C, lazy
+
+    def q28_lazy(state):
+        t = srt.Table(list(table.items())).with_column(
+            "price", Column(data=table["price"].data + state,
+                            dtype=dt.decimal64(-2)))
+        pred = strings.like(t["name"], "%promo%")
+        agg = (lazy(t)
+               .filter(pred)
+               .with_columns(pricef=C("price").cast(dt.FLOAT64))
+               .groupby_agg(["g"], [("pricef", "sum", "rev"),
+                                    ("pricef", "count", "n")])
+               .collect())
+        nxt = (agg["n"].data[0] & 1).astype(np.int64)
+        return agg["rev"], nxt
+
+    _bench("q28_lazy_fused_2M", q28_lazy, np.int64(0))
+
+    # -- device-chained form: collect_padded() keeps the whole iteration
+    # sync-free (the materializing count is the ONE remaining sync of the
+    # lazy path; this isolates the program cost the way the other
+    # whole-plan numbers in BASELINE.md are recorded).
+    def q28_lazy_chained(state):
+        t = srt.Table(list(table.items())).with_column(
+            "price", Column(data=table["price"].data + state,
+                            dtype=dt.decimal64(-2)))
+        pred = strings.like(t["name"], "%promo%")
+        agg, sel = (lazy(t)
+                    .filter(pred)
+                    .with_columns(pricef=C("price").cast(dt.FLOAT64))
+                    .groupby_agg(["g"], [("pricef", "sum", "rev"),
+                                         ("pricef", "count", "n")])
+                    .collect_padded())
+        nxt = (agg["n"].data[0] & 1).astype(np.int64)
+        return agg["rev"], nxt
+
+    _bench("q28_lazy_chained_2M", q28_lazy_chained, np.int64(0))
+
 
 if __name__ == "__main__":
     main()

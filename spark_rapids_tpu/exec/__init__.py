@@ -20,6 +20,8 @@ no host round trip happens until the caller materializes the result.  See
 """
 
 from .expr import Col, Expr, Lit, col, lit
+from .lazy import LazyTable, lazy
 from .plan import Plan, plan
 
-__all__ = ["Col", "Expr", "Lit", "Plan", "col", "lit", "plan"]
+__all__ = ["Col", "Expr", "LazyTable", "Lit", "Plan", "col", "lazy", "lit",
+           "plan"]

@@ -1136,7 +1136,15 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
         elif isinstance(step, ProjectStep):
             env = dict(t.items())
             if step.narrow:
-                t = Table([(nm, evaluate(e, env)) for nm, e in step.cols])
+                # Hidden engine columns survive narrowing, mirroring the
+                # compiled path (_trace_project): rowid indirection,
+                # string-agg surrogates, and lazy-facade attachments all
+                # carry state the user-visible schema doesn't show.
+                cols = [(nm, t[nm]) for nm in t.names
+                        if nm.startswith("__")
+                        and nm not in {n for n, _ in step.cols}]
+                cols += [(nm, evaluate(e, env)) for nm, e in step.cols]
+                t = Table(cols)
             else:
                 for nm, e in step.cols:
                     t = t.with_column(nm, evaluate(e, env))

@@ -118,10 +118,16 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
             f"join output column(s) {sorted(overlap)} collide with "
             f"existing columns; rename one side first")
     # Degenerate shapes (0-row right side, prefix that filtered every row)
-    # break shuffle/join trace-time assumptions — mirror run_plan_dist's
-    # empty-input policy and finish eagerly on the collected rows.
+    # break shuffle/join trace-time assumptions — finish eagerly on the
+    # collected rows, then restore the documented return contract: a plan
+    # that ends row-sharded must hand back a DistTable regardless of the
+    # data shape that routed it here (right-side emptiness is build-side
+    # data the caller does not control).
     if right.num_rows == 0 or _live_count_cached(pre.row_mask) == 0:
-        return run_plan_eager(Plan(plan.steps[i:]), collect(pre))
+        result = run_plan_eager(Plan(plan.steps[i:]), collect(pre))
+        if any(isinstance(s, GroupAggStep) for s in plan.steps[i:]):
+            return result                     # replicated-ending: a Table
+        return shard_table(result, mesh)
     rdist = shard_table(right, mesh)
     joined = dist_join(pre, rdist, mesh, on=list(step.left_on),
                        how=step.how)

@@ -107,6 +107,11 @@ class Expr:
     def fill_null(self, value: Scalar) -> "Expr":
         return FillNull(self, value)
 
+    def cast(self, to) -> "Expr":
+        """Cast to another fixed-width dtype (ops.cast semantics,
+        including decimal scale arithmetic) inside the plan program."""
+        return Cast(self, to)
+
 
 @dataclass(frozen=True)
 class Col(Expr):
@@ -137,6 +142,12 @@ class UnOp(Expr):
 class FillNull(Expr):
     operand: Expr
     value: Scalar
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    to: object                  # DType (hashable; part of the plan key)
 
 
 def col(name: str) -> Col:
@@ -170,6 +181,8 @@ def render(expr: Expr) -> str:
         return repr(expr.value)
     if isinstance(expr, FillNull):
         return f"coalesce({render(expr.operand)}, {expr.value!r})"
+    if isinstance(expr, Cast):
+        return f"cast({render(expr.operand)} as {expr.to!r})"
     if isinstance(expr, UnOp):
         if expr.op == "is_null":
             return f"({render(expr.operand)} IS NULL)"
@@ -191,6 +204,8 @@ def references(expr: Expr) -> set[str]:
     if isinstance(expr, Lit):
         return set()
     if isinstance(expr, FillNull):
+        return references(expr.operand)
+    if isinstance(expr, Cast):
         return references(expr.operand)
     if isinstance(expr, UnOp):
         return references(expr.operand)
@@ -217,6 +232,12 @@ def evaluate(expr: Expr, env: dict[str, Column]) -> Column:
         return expr.value            # binary_op accepts scalars directly
     if isinstance(expr, FillNull):
         return fill_null(evaluate(expr.operand, env), expr.value)
+    if isinstance(expr, Cast):
+        from ..ops.cast import cast as cast_op
+        operand = evaluate(expr.operand, env)
+        if not isinstance(operand, Column):
+            raise TypeError("cast needs a column operand")
+        return cast_op(operand, expr.to)
     if isinstance(expr, UnOp):
         operand = evaluate(expr.operand, env)
         if not isinstance(operand, Column):

@@ -1,0 +1,164 @@
+"""Lazy table facade: eager-looking pipelines, one compiled program.
+
+The eager ops layer pays a synchronous host round trip at every
+data-dependent output size (filter count, group count, join total) —
+measured ~400 ms each through a tunneled device (BASELINE.md).  The plan
+compiler removes that cost but asks the caller to think in plans.  This
+facade closes the gap: a :class:`LazyTable` RECORDS the same operations
+the eager layer exposes and flushes them through the whole-plan compiler
+at :meth:`collect` — one XLA program, at most one host sync, no
+``plan()`` in user code:
+
+    out = (lazy(t)
+           .filter(strings.like(t["name"], "%promo%"))   # device mask
+           .with_columns(pricef=col("price").cast(FLOAT64))
+           .groupby_agg(["g"], [("pricef", "sum", "rev")])
+           .collect())
+
+Two kinds of arguments compose:
+
+* **expressions** (``col``/``lit`` trees incl. ``.cast()``) — evaluated
+  inside the compiled program;
+* **concrete device Columns** aligned with the SOURCE table's rows (the
+  result of an eager string/regex op, a precomputed mask...) — attached
+  as hidden input columns, so eager kernels that cannot live inside a
+  plan expression (LIKE, regex, ...) still fuse into the pipeline with
+  zero extra syncs.  After a row-multiplicity-changing step (group-by,
+  shuffled join, sort, limit) source alignment is gone and attaching a
+  concrete Column raises.
+
+The reference-world analog is Spark's own lazy DataFrame -> codegen'd
+stage pipeline; the eager ops layer remains the semantics oracle
+(every LazyTable pipeline is also runnable step-by-step through it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from ..column import Column
+from ..table import Table
+from .expr import Col, Expr, col
+from .plan import (GroupAggStep, JoinShuffledStep, LimitStep, Plan,
+                   SortStep)
+
+_HIDDEN = "__lazy{}__"
+
+
+class LazyTable:
+    """A recorded pipeline over a source table (immutable; methods return
+    new LazyTables)."""
+
+    def __init__(self, table: Table, plan: Optional[Plan] = None,
+                 attached: frozenset = frozenset()):
+        self._table = table
+        self._plan = plan if plan is not None else Plan()
+        #: exactly the hidden column names THIS facade attached — dropping
+        #: by these (never by prefix) cannot touch a user column
+        self._attached = attached
+
+    # -- internals ---------------------------------------------------------
+    def _aligned(self) -> bool:
+        """Concrete source-aligned Columns may only attach before any
+        row-multiplicity/order-changing step."""
+        return not any(isinstance(s, (GroupAggStep, SortStep, LimitStep,
+                                      JoinShuffledStep))
+                       for s in self._plan.steps)
+
+    def _attach(self, column: Column, what: str) -> tuple["LazyTable", str]:
+        if not self._aligned():
+            raise TypeError(
+                f"cannot attach a precomputed {what} after a group-by/"
+                f"sort/limit/shuffled join (row alignment with the source "
+                f"table is gone); compute it as an expression instead, or "
+                f"collect() first")
+        if column.size != self._table.num_rows:
+            raise ValueError(
+                f"precomputed {what} has {column.size} rows; the source "
+                f"table has {self._table.num_rows}")
+        # Never clobber an existing column (a user table may legitimately
+        # contain a "__lazy..."-named column).
+        i = len(self._attached)
+        while _HIDDEN.format(i) in self._table:
+            i += 1
+        name = _HIDDEN.format(i)
+        return LazyTable(self._table.with_column(name, column), self._plan,
+                         self._attached | {name}), name
+
+    def _step(self, plan: Plan) -> "LazyTable":
+        return LazyTable(self._table, plan, self._attached)
+
+    # -- pipeline builders -------------------------------------------------
+    def filter(self, pred: Union[Expr, Column]) -> "LazyTable":
+        """Keep rows where ``pred`` holds: an expression, or a precomputed
+        device bool Column (e.g. an eager LIKE/regex mask)."""
+        if isinstance(pred, Column):
+            lt, name = self._attach(pred, "filter mask")
+            return lt._step(lt._plan.filter(col(name)))
+        return self._step(self._plan.filter(pred))
+
+    def with_columns(self, **exprs) -> "LazyTable":
+        """Add/replace columns: expressions or source-aligned Columns."""
+        lt = self
+        expr_items: dict[str, Expr] = {}
+        for name, e in exprs.items():
+            if isinstance(e, Column):
+                lt, hidden = lt._attach(e, f"column {name!r}")
+                expr_items[name] = Col(hidden)
+            else:
+                expr_items[name] = e
+        return lt._step(lt._plan.with_columns(**expr_items))
+
+    def select(self, *items) -> "LazyTable":
+        return self._step(self._plan.select(*items))
+
+    def groupby_agg(self, keys: Sequence[str],
+                    aggs: Sequence[tuple[str, str, str]],
+                    domains=None) -> "LazyTable":
+        return self._step(self._plan.groupby_agg(keys, aggs,
+                                                 domains=domains))
+
+    def distinct(self, *keys: str, domains=None) -> "LazyTable":
+        return self._step(self._plan.distinct(*keys, domains=domains))
+
+    def join_broadcast(self, table: Table, **kw) -> "LazyTable":
+        return self._step(self._plan.join_broadcast(table, **kw))
+
+    def join_shuffled(self, table: Table, **kw) -> "LazyTable":
+        return self._step(self._plan.join_shuffled(table, **kw))
+
+    def window(self, out: str, func: str, partition_by, **kw) -> "LazyTable":
+        return self._step(self._plan.window(out, func, partition_by, **kw))
+
+    def sort_by(self, by, ascending=None, nulls_first=None) -> "LazyTable":
+        return self._step(self._plan.sort_by(by, ascending, nulls_first))
+
+    def limit(self, k: int) -> "LazyTable":
+        return self._step(self._plan.limit(k))
+
+    # -- execution ---------------------------------------------------------
+    def collect(self) -> Table:
+        """Run the recorded pipeline as ONE compiled program (at most one
+        host sync, for the output row count)."""
+        out = self._plan.run(self._table)
+        drop = [nm for nm in out.names if nm in self._attached]
+        return out.drop(drop) if drop else out
+
+    def collect_padded(self):
+        """Sync-free form: (padded Table, live-row selection Column)."""
+        out, sel = self._plan.run_padded(self._table)
+        drop = [nm for nm in out.names if nm in self._attached]
+        return (out.drop(drop) if drop else out), sel
+
+    def explain(self) -> str:
+        return self._plan.explain(self._table)
+
+    def __repr__(self) -> str:
+        return (f"LazyTable({self._table.num_rows} rows x "
+                f"{self._table.num_columns} cols, "
+                f"{len(self._plan.steps)} recorded steps)")
+
+
+def lazy(table: Table) -> LazyTable:
+    """Start a lazy pipeline over ``table``."""
+    return LazyTable(table)

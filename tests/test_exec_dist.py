@@ -134,6 +134,10 @@ class TestDistPlans:
 
 
 def _row_multiset(t):
+    from spark_rapids_tpu.parallel import collect
+    from spark_rapids_tpu.parallel.mesh import DistTable
+    if isinstance(t, DistTable):
+        t = collect(t)
     d = t.to_pydict()
     names = sorted(d)
     return sorted(zip(*[d[nm] for nm in names]),
