@@ -41,6 +41,7 @@ plan must avoid, which is why this file exists.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Optional
 
@@ -63,6 +64,18 @@ def _dense_max_cells() -> int:
     return dense_groupby_max_cells()
 
 _ROWID = "__rowid__"
+
+#: Engine-owned hidden plan-state columns (rowid indirection, string-agg
+#: surrogates, join rowids, lazy-facade attachments).  Narrow selects
+#: preserve exactly these — a USER column that merely starts with "__"
+#: is ordinary data and narrows away like any other.
+_ENGINE_HIDDEN = re.compile(
+    r"^(?:__rowid__$|__valid__:|__codes__:|__strref__:"
+    r"|__join\d+__|__sjoin\d+__|__lazy\d+__$)")
+
+
+def _is_engine_hidden(name: str) -> bool:
+    return bool(_ENGINE_HIDDEN.match(name))
 
 
 class _JoinMarkerT:
@@ -473,10 +486,10 @@ def _trace_project(cols, sel, step: ProjectStep):
     new = dict(cols) if not step.narrow else {}
     if step.narrow:
         # Hidden engine columns (rowid indirection, string-agg surrogates,
-        # join rowids) always survive narrowing — they carry state the
-        # user-visible schema doesn't show.
+        # join rowids, lazy attachments) always survive narrowing — they
+        # carry state the user-visible schema doesn't show.
         for nm in cols:
-            if nm.startswith("__"):
+            if _is_engine_hidden(nm):
                 new[nm] = cols[nm]
     for name, e in step.cols:
         if isinstance(e, Col) and e.name == name and name not in cols:
@@ -1141,7 +1154,7 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
                 # string-agg surrogates, and lazy-facade attachments all
                 # carry state the user-visible schema doesn't show.
                 cols = [(nm, t[nm]) for nm in t.names
-                        if nm.startswith("__")
+                        if _is_engine_hidden(nm)
                         and nm not in {n for n, _ in step.cols}]
                 cols += [(nm, evaluate(e, env)) for nm, e in step.cols]
                 t = Table(cols)

@@ -140,10 +140,14 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
         # Degenerate shapes break trace-time assumptions (and the probe
         # under an all-False mask); mirror run_plan's eager fallback.
         # Checked before the shuffled-join dispatch so every lowering
-        # path sees live rows.
-        from ..parallel.mesh import collect
+        # path sees live rows.  The return CONTRACT is preserved: a plan
+        # that ends row-sharded hands back a DistTable here too.
+        from ..parallel.mesh import collect, shard_table
         from .compile import run_plan_eager
-        return run_plan_eager(plan, collect(dist))
+        result = run_plan_eager(plan, collect(dist))
+        if any(isinstance(s, GroupAggStep) for s in plan.steps):
+            return result
+        return shard_table(result, mesh)
     if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
         return _lower_shuffled_join(plan, dist, mesh)
     axis = mesh.axis_names[0]

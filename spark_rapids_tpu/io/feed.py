@@ -94,7 +94,8 @@ def _row_group_reader(path, columns):
     that row group — matching ``read_parquet(engine="auto")`` semantics
     without re-yielding rows already produced.
     """
-    from .parquet_native import read_metadata, _decode_chunk
+    from .parquet_native import (read_metadata, _decode_chunk,
+                                 _materialize_piece)
 
     try:
         cols, row_groups = read_metadata(path)
@@ -116,7 +117,11 @@ def _row_group_reader(path, columns):
                     if chunk.column.name in want:
                         f.seek(chunk.start_offset)
                         raw = f.read(chunk.total_compressed)
-                        by_name[chunk.column.name] = _decode_chunk(raw, chunk)
+                        # Row-group streaming materializes per chunk (the
+                        # whole-column dictionary fusion needs all chunks;
+                        # a stream hands each group on as it decodes).
+                        by_name[chunk.column.name] = _materialize_piece(
+                            _decode_chunk(raw, chunk))
                 table = Table([(n, by_name[n]) for n in want])
             except NotImplementedError:
                 table = _arrow_row_group(path, i, columns)

@@ -32,6 +32,13 @@ def read_parquet(path, columns: Optional[Sequence[str]] = None,
     ``engine="arrow"`` uses pyarrow's host reader; ``engine="auto"``
     (default) picks native when the file is inside its envelope (flat
     schema, no filters) and falls back to Arrow otherwise.
+
+    Routing rationale (measured, BASELINE.md): on a quiet host the two
+    engines are within ~15% of each other (interleaved medians); on a
+    loaded host — the shared-Spark-executor case this reader exists
+    for — the native path is unaffected while Arrow's multithreaded host
+    decode loses ~30%, so native is the safer default wherever it can
+    read the file.
     """
     if engine not in ("auto", "native", "arrow"):
         raise ValueError(f"engine must be auto|native|arrow, got {engine!r}")

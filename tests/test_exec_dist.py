@@ -235,3 +235,13 @@ class TestDistShuffledJoin:
         got = p.run_dist(shard_table(left, mesh), mesh)
         want = p.run(left)
         assert _row_multiset(got) == _row_multiset(want)
+
+    def test_empty_input_keeps_disttable_contract(self, rng, mesh):
+        from spark_rapids_tpu.parallel.mesh import DistTable
+        left, _ = self._facts(rng, n=16, m=8)
+        empty = left.gather(np.zeros(0, np.int32))
+        d0 = shard_table(empty, mesh, capacity=2)
+        # Row-sharded-ending plan over an empty input: still a DistTable.
+        out = plan().filter(col("lv") > 0).run_dist(d0, mesh)
+        assert isinstance(out, DistTable)
+        assert out.num_rows() == 0

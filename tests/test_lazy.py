@@ -121,3 +121,17 @@ class TestLazyHygiene:
         mask = Column.from_numpy(np.zeros(0, np.bool_))
         out = lazy(t).select("g").filter(mask).collect()
         assert out.num_rows == 0 and out.names == ("g",)
+
+    def test_user_dunder_column_narrows_away(self, rng):
+        # A user "__"-named column is ordinary data: an explicit narrow
+        # select drops it (only ENGINE hidden names survive narrowing).
+        n = 50
+        t = Table([
+            ("__priority", Column.from_numpy(np.arange(n, dtype=np.int64))),
+            ("g", Column.from_numpy(np.zeros(n, np.int32))),
+        ])
+        out = lazy(t).select("g").collect()
+        assert out.names == ("g",)
+        out2 = (lazy(t).select("g")
+                .filter(Column.from_numpy(np.ones(n, np.bool_))).collect())
+        assert out2.names == ("g",)
