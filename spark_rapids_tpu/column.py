@@ -42,20 +42,25 @@ from .dtypes import BOOL8, DType, STRING, from_numpy_dtype
 @jax.tree_util.register_pytree_node_class
 @dataclass(frozen=True)
 class Column:
-    data: jax.Array
+    data: jax.Array = None
     validity: Optional[jax.Array] = None   # bool_ (n,), True = valid
     offsets: Optional[jax.Array] = None    # int32 (n+1,) for variable width
     dtype: DType = None                    # static
+    #: nested children (Arrow/cudf layout): LIST -> (element column,)
+    #: with ``offsets`` set and ``data`` None; STRUCT -> one column per
+    #: field with ``data`` None.  Fixed-width/string columns have none.
+    children: tuple = ()
 
     # -- pytree protocol -----------------------------------------------------
     def tree_flatten(self):
-        children = (self.data, self.validity, self.offsets)
-        return children, self.dtype
+        leaves = (self.data, self.validity, self.offsets, self.children)
+        return leaves, self.dtype
 
     @classmethod
-    def tree_unflatten(cls, aux, children):
-        data, validity, offsets = children
-        return cls(data=data, validity=validity, offsets=offsets, dtype=aux)
+    def tree_unflatten(cls, aux, leaves):
+        data, validity, offsets, children = leaves
+        return cls(data=data, validity=validity, offsets=offsets,
+                   dtype=aux, children=tuple(children))
 
     # -- basic properties ----------------------------------------------------
     def __len__(self) -> int:
@@ -65,7 +70,28 @@ class Column:
     def size(self) -> int:
         if self.offsets is not None:
             return int(self.offsets.shape[0]) - 1
+        if self.data is None:                 # STRUCT: length of any field
+            return self.children[0].size
         return int(self.data.shape[0])
+
+    def field(self, name: str) -> "Column":
+        """A STRUCT field as a standalone column; the struct's own nulls
+        mask the field (a null struct has null fields, Arrow semantics)."""
+        if not self.dtype.is_struct:
+            raise TypeError(f"field() needs a STRUCT column, got {self.dtype!r}")
+        child = self.children[self.dtype.field_index(name)]
+        if self.validity is None:
+            return child
+        v = self.validity if child.validity is None \
+            else (child.validity & self.validity)
+        return replace(child, validity=v)
+
+    @property
+    def element(self) -> "Column":
+        """A LIST column's flattened element column."""
+        if not self.dtype.is_list:
+            raise TypeError(f"element needs a LIST column, got {self.dtype!r}")
+        return self.children[0]
 
     @property
     def nullable(self) -> bool:
@@ -116,6 +142,39 @@ class Column:
             from .ops.strings import strings_from_pylist  # cycle-free: ops imports nothing back
             return strings_from_pylist(values)
         n = len(values)
+        if dtype.is_list:
+            # Arrow/cudf list layout: (n+1) offsets into a flattened
+            # element column (recursively any supported type).
+            offsets = np.zeros(n + 1, np.int32)
+            mask = np.ones(n, np.bool_)
+            flat: list = []
+            for i, v in enumerate(values):
+                if v is None:
+                    mask[i] = False
+                    offsets[i + 1] = offsets[i]
+                else:
+                    flat.extend(v)
+                    offsets[i + 1] = offsets[i] + len(v)
+            child = Column.from_pylist(flat, dtype.element)
+            return Column(offsets=jnp.asarray(offsets),
+                          validity=None if mask.all() else jnp.asarray(mask),
+                          dtype=dtype, children=(child,))
+        if dtype.is_struct:
+            mask = np.ones(n, np.bool_)
+            per_field: list[list] = [[] for _ in dtype.fields]
+            for i, v in enumerate(values):
+                if v is None:
+                    mask[i] = False
+                    for lst in per_field:
+                        lst.append(None)
+                else:
+                    for j, (nm, _) in enumerate(dtype.fields):
+                        per_field[j].append(v.get(nm))
+            children = tuple(Column.from_pylist(vals, fdt)
+                             for vals, (_, fdt) in zip(per_field,
+                                                       dtype.fields))
+            return Column(validity=None if mask.all() else jnp.asarray(mask),
+                          dtype=dtype, children=children)
         if dtype.is_two_word:
             # Unscaled 128-bit ints -> (n, 2) uint64 (lo, hi) words,
             # two's complement (Arrow/cudf decimal128 byte order).
@@ -156,6 +215,25 @@ class Column:
         if self.dtype == STRING:
             from .ops.strings import strings_to_pylist
             return strings_to_pylist(self)
+        if self.dtype is not None and self.dtype.is_list:
+            offs = np.asarray(self.offsets)
+            elems = self.children[0].to_pylist()
+            mask = (None if self.validity is None
+                    else np.asarray(self.validity))
+            out = [elems[offs[i]:offs[i + 1]] for i in range(self.size)]
+            if mask is not None:
+                out = [v if m else None for v, m in zip(out, mask)]
+            return out
+        if self.dtype is not None and self.dtype.is_struct:
+            cols = [c.to_pylist() for c in self.children]
+            names = [nm for nm, _ in self.dtype.fields]
+            mask = (None if self.validity is None
+                    else np.asarray(self.validity))
+            out = [dict(zip(names, row)) for row in zip(*cols)] \
+                if cols else [{} for _ in range(self.size)]
+            if mask is not None:
+                out = [v if m else None for v, m in zip(out, mask)]
+            return out
         vals, mask = self.to_numpy()
         if self.dtype == BOOL8:
             out = [bool(v) for v in vals]
@@ -191,12 +269,17 @@ class Column:
         if fill_invalid:
             in_range = (indices >= 0) & (indices < self.size)
             clipped = jnp.clip(indices, 0, self.size - 1)
-            if self.offsets is not None:
-                from .ops.strings import strings_gather
-                out = strings_gather(self, clipped)
-            else:
-                out = self._fixed_gather(clipped)
+            out = self.gather(clipped)
             return out.with_validity(out.valid_mask() & in_range)
+        if self.dtype is not None and self.dtype.is_struct:
+            children = tuple(c.gather(indices) for c in self.children)
+            validity = None
+            if self.validity is not None:
+                validity = jnp.take(self.validity, indices, mode="clip")
+            return Column(validity=validity, dtype=self.dtype,
+                          children=children)
+        if self.dtype is not None and self.dtype.is_list:
+            return _list_gather(self, indices)
         if self.offsets is not None:
             from .ops.strings import strings_gather
             return strings_gather(self, indices)
@@ -214,12 +297,56 @@ class Column:
                 f"nullable={self.nullable})")
 
 
+def _list_gather(col: Column, indices: jax.Array) -> Column:
+    """Row gather of a LIST column: rebuild offsets, then gather the child
+    at per-element source positions (recursive — the child may itself be a
+    string, list, or struct column).  One host sync for the output element
+    total (the same data-dependent boundary the string engine pays)."""
+    offs = col.offsets
+    idx = indices.astype(jnp.int32)
+    if int(idx.shape[0]) == 0:
+        child = col.children[0].gather(jnp.zeros(0, jnp.int32))
+        return Column(offsets=jnp.zeros(1, jnp.int32),
+                      validity=None if col.validity is None
+                      else jnp.zeros(0, jnp.bool_),
+                      dtype=col.dtype, children=(child,))
+    lens = jnp.take(offs, idx + 1, mode="clip") - jnp.take(offs, idx,
+                                                           mode="clip")
+    if col.validity is not None:
+        lens = jnp.where(jnp.take(col.validity, idx, mode="clip"), lens, 0)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    total = int(new_offsets[-1])                  # host sync
+    pos = jnp.arange(max(total, 1), dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos,
+                                    side="right").astype(jnp.int32) - 1,
+                   0, max(int(idx.shape[0]) - 1, 0))
+    src = jnp.take(offs, jnp.take(idx, row), mode="clip") \
+        + (pos - jnp.take(new_offsets, row))
+    child = col.children[0].gather(src[:total]) if total else \
+        col.children[0].gather(jnp.zeros(0, jnp.int32))
+    validity = None
+    if col.validity is not None:
+        validity = jnp.take(col.validity, idx, mode="clip")
+    return Column(offsets=new_offsets, validity=validity, dtype=col.dtype,
+                  children=(child,))
+
+
 def all_null_column(dtype: DType, n: int) -> Column:
     """A column of ``n`` null rows (zero payloads) of the given dtype."""
     validity = jnp.zeros(n, jnp.bool_)
     if dtype == STRING:
         return Column(data=jnp.zeros(0, jnp.uint8), validity=validity,
                       offsets=jnp.zeros(n + 1, jnp.int32), dtype=dtype)
+    if dtype.is_list:
+        return Column(offsets=jnp.zeros(n + 1, jnp.int32),
+                      validity=validity, dtype=dtype,
+                      children=(all_null_column(dtype.element, 0)
+                                .with_validity(None),))
+    if dtype.is_struct:
+        return Column(validity=validity, dtype=dtype,
+                      children=tuple(all_null_column(fdt, n)
+                                     for _, fdt in dtype.fields))
     if dtype.is_two_word:
         return Column(data=jnp.zeros((n, 2), dtype.jnp_dtype),
                       validity=validity, dtype=dtype)

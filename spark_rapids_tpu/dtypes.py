@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -105,15 +106,32 @@ class DType:
 
     Hashable and comparable; used as static metadata in pytrees (so two tables
     with the same schema share jit caches).
+
+    Nested types carry their shape statically: LIST has ``element`` (the
+    child type), STRUCT has ``fields`` ((name, DType) pairs) — mirroring
+    cudf's ``data_type`` + children and Arrow's nested type objects, so
+    schemas stay hashable compile-cache keys all the way down.
     """
 
     type_id: TypeId
     scale: int = 0
+    #: LIST element type (None otherwise).
+    element: "Optional[DType]" = None
+    #: STRUCT fields as ((name, DType), ...) (empty otherwise).
+    fields: tuple = ()
 
     def __post_init__(self):
         object.__setattr__(self, "type_id", TypeId(self.type_id))
         if self.scale != 0 and not self.is_decimal:
             raise ValueError(f"scale is only valid for decimal types, got {self.type_id!r}")
+        if self.element is not None and self.type_id != TypeId.LIST:
+            raise ValueError("element is only valid for LIST")
+        if self.fields and self.type_id != TypeId.STRUCT:
+            raise ValueError("fields are only valid for STRUCT")
+        if self.type_id == TypeId.LIST and self.element is None:
+            raise ValueError("LIST needs an element type (use list_())")
+        if self.type_id == TypeId.STRUCT and not self.fields:
+            raise ValueError("STRUCT needs fields (use struct())")
 
     # -- classification ------------------------------------------------------
     @property
@@ -158,6 +176,25 @@ class DType:
     def is_string(self) -> bool:
         return self.type_id == TypeId.STRING
 
+    @property
+    def is_list(self) -> bool:
+        return self.type_id == TypeId.LIST
+
+    @property
+    def is_struct(self) -> bool:
+        return self.type_id == TypeId.STRUCT
+
+    @property
+    def is_nested(self) -> bool:
+        return self.type_id in (TypeId.LIST, TypeId.STRUCT)
+
+    def field_index(self, name: str) -> int:
+        for i, (nm, _) in enumerate(self.fields):
+            if nm == name:
+                return i
+        raise KeyError(f"struct has no field {name!r} "
+                       f"(have {[nm for nm, _ in self.fields]})")
+
     # -- physical layout -----------------------------------------------------
     @property
     def itemsize(self) -> int:
@@ -185,6 +222,11 @@ class DType:
     def __repr__(self) -> str:
         if self.is_decimal:
             return f"DType({self.type_id.name}, scale={self.scale})"
+        if self.is_list:
+            return f"DType(LIST<{self.element!r}>)"
+        if self.is_struct:
+            inner = ", ".join(f"{nm}: {dt!r}" for nm, dt in self.fields)
+            return f"DType(STRUCT<{inner}>)"
         return f"DType({self.type_id.name})"
 
 
@@ -219,6 +261,20 @@ def decimal32(scale: int) -> DType:
 
 def decimal64(scale: int) -> DType:
     return DType(TypeId.DECIMAL64, scale)
+
+
+def list_(element: DType) -> DType:
+    """LIST<element>: offsets-based list column (Arrow/cudf list layout)."""
+    return DType(TypeId.LIST, element=element)
+
+
+def struct(fields) -> DType:
+    """STRUCT<name: type, ...> from a dict or (name, DType) pairs."""
+    if isinstance(fields, dict):
+        fields = tuple(fields.items())
+    else:
+        fields = tuple((nm, dt) for nm, dt in fields)
+    return DType(TypeId.STRUCT, fields=fields)
 
 
 def decimal128(scale: int) -> DType:

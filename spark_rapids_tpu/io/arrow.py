@@ -46,6 +46,13 @@ _PA_TO_TYPEID = {
 
 
 def _pa_type_to_dtype(t: pa.DataType) -> DType:
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        from ..dtypes import list_
+        return list_(_pa_type_to_dtype(t.value_type))
+    if pa.types.is_struct(t):
+        from ..dtypes import struct
+        return struct([(t.field(i).name, _pa_type_to_dtype(t.field(i).type))
+                       for i in range(t.num_fields)])
     if pa.types.is_decimal(t):
         # Arrow scale is digits right of the point; cudf scale is the base-10
         # exponent (negated).  precision <= 9 -> decimal32, <= 18 ->
@@ -64,6 +71,11 @@ def _pa_type_to_dtype(t: pa.DataType) -> DType:
 
 
 def _dtype_to_pa_type(dtype: DType) -> pa.DataType:
+    if dtype.is_list:
+        return pa.list_(_dtype_to_pa_type(dtype.element))
+    if dtype.is_struct:
+        return pa.struct([(nm, _dtype_to_pa_type(fdt))
+                          for nm, fdt in dtype.fields])
     if dtype.is_decimal:
         precision = {TypeId.DECIMAL32: 9, TypeId.DECIMAL64: 18,
                      TypeId.DECIMAL128: 38}[dtype.type_id]
@@ -88,6 +100,30 @@ def from_arrow_array(arr: pa.Array | pa.ChunkedArray) -> Column:
         arr = arr.combine_chunks()
     dtype = _pa_type_to_dtype(arr.type)
     n = len(arr)
+
+    if dtype.is_list:
+        if pa.types.is_large_list(arr.type):
+            arr = arr.cast(pa.list_(arr.type.value_type))
+        bufs = arr.buffers()
+        validity = _unpack_bitmap(bufs[0], arr.offset, n)
+        offsets = np.frombuffer(bufs[1], np.int32,
+                                count=n + 1 + arr.offset)[arr.offset:]
+        base = offsets[0]
+        # arr.values covers the parent's whole child buffer; slice to this
+        # array's extent so recursion sees exactly our elements.
+        child = from_arrow_array(arr.values[base:offsets[-1]])
+        return Column(offsets=jnp.asarray((offsets - base).copy()),
+                      validity=None if validity is None or validity.all()
+                      else jnp.asarray(validity),
+                      dtype=dtype, children=(child,))
+    if dtype.is_struct:
+        bufs = arr.buffers()
+        validity = _unpack_bitmap(bufs[0], arr.offset, n)
+        children = tuple(from_arrow_array(arr.field(i))
+                         for i in range(arr.type.num_fields))
+        return Column(validity=None if validity is None or validity.all()
+                      else jnp.asarray(validity),
+                      dtype=dtype, children=children)
 
     if dtype.type_id == TypeId.STRING:
         if pa.types.is_large_string(arr.type):
@@ -165,6 +201,21 @@ def to_arrow_array(col: Column) -> pa.Array:
     mask = None
     if col.validity is not None:
         mask = ~np.asarray(col.validity)
+
+    if dtype.is_list:
+        validity_buf, null_count = _validity_buffer(mask)
+        offsets = np.asarray(col.offsets, np.int32)
+        values = to_arrow_array(col.children[0])
+        return pa.ListArray.from_buffers(
+            _dtype_to_pa_type(dtype), len(offsets) - 1,
+            [validity_buf, pa.py_buffer(offsets.tobytes())],
+            null_count, children=[values])
+    if dtype.is_struct:
+        validity_buf, null_count = _validity_buffer(mask)
+        children = [to_arrow_array(c) for c in col.children]
+        return pa.StructArray.from_buffers(
+            _dtype_to_pa_type(dtype), col.size, [validity_buf],
+            null_count, children=children)
 
     if dtype.type_id == TypeId.STRING:
         # zero-copy from the Arrow-layout offsets+chars the column already holds

@@ -103,12 +103,19 @@ _UNSUPPORTED_ENCODINGS = {5, 6, 7, 9}   # DELTA_* family, BYTE_STREAM_SPLIT
 
 @dataclass(frozen=True)
 class ColumnInfo:
-    """Flat-schema leaf column: physical + logical type and level widths."""
+    """Schema leaf column: physical + logical type and level widths.
+
+    ``max_rep > 0`` marks a LIST column (one repetition level — the
+    standard 3-level list encoding); ``max_def`` then distinguishes null
+    list / empty list / null element / present element."""
     name: str
     physical: int
     dtype: DType
-    optional: bool          # max definition level is 1 iff optional
+    optional: bool          # max definition level is 1 iff optional (flat)
     type_length: int = 0    # FIXED_LEN_BYTE_ARRAY width (bytes)
+    max_rep: int = 0        # 1 for LIST columns
+    max_def: int = 0        # full definition-level depth (lists)
+    element_optional: bool = False
 
 
 @dataclass(frozen=True)
@@ -215,9 +222,40 @@ def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]]]:
     for _ in range(n_children):
         elem = schema_elems[idx]
         idx += 1
-        if elem.get(5):     # group node: nested schema
-            raise NotImplementedError(
-                "nested schemas need the Arrow reader (flat columns only)")
+        if elem.get(5):     # group node
+            # Standard 3-level LIST: optional group X (LIST=3) {
+            #   repeated group list { <element> } }.  Anything else
+            # (MAP, structs, multi-level nesting) -> Arrow reader.
+            name = elem[4].decode()
+            if elem.get(6) != 3 or elem.get(5) != 1:
+                raise NotImplementedError(
+                    f"nested group {name!r} is not a standard LIST; "
+                    f"MAP/STRUCT schemas need the Arrow reader")
+            mid = schema_elems[idx]
+            idx += 1
+            if mid.get(3) != 2 or mid.get(5, 0) != 1:
+                raise NotImplementedError(
+                    f"column {name!r}: non-standard (2-level) list "
+                    f"encoding needs the Arrow reader")
+            leaf = schema_elems[idx]
+            idx += 1
+            if leaf.get(5):
+                raise NotImplementedError(
+                    f"column {name!r}: nested list elements need the "
+                    f"Arrow reader")
+            from ..dtypes import list_
+            phys = leaf[1]
+            list_optional = elem.get(3, 0) == 1
+            element_optional = leaf.get(3, 0) == 1
+            elem_dtype = _logical_dtype(phys, leaf, name)
+            columns.append(ColumnInfo(
+                name=name, physical=phys, dtype=list_(elem_dtype),
+                optional=list_optional, type_length=leaf.get(2, 0),
+                max_rep=1,
+                max_def=(1 if list_optional else 0) + 1
+                + (1 if element_optional else 0),
+                element_optional=element_optional))
+            continue
         name = elem[4].decode()
         phys = elem[1]
         repetition = elem.get(3, 0)   # 0 required, 1 optional, 2 repeated
@@ -365,6 +403,41 @@ def _parse_runs_and_ones(buf: bytes, bit_width: int, num_values: int
     runs = parse_rle_runs(buf, bit_width, num_values)
     ones = count_rle_ones(buf, runs, num_values) if bit_width == 1 else None
     return runs, ones
+
+
+def _expand_levels_host(buf: Optional[bytes], bit_width: int,
+                        num_values: int) -> np.ndarray:
+    """Expand an RLE/bit-packed LEVEL stream to int8 values on the host.
+
+    Levels are metadata-scale (<= 2 bits for lists) and drive offset/
+    validity construction, which is host work anyway; element VALUES stay
+    on the device path.  O(#runs) + O(num_values) numpy."""
+    if bit_width == 0 or buf is None:
+        return np.zeros(num_values, np.int8)
+    runs = parse_rle_runs(buf, bit_width, num_values)
+    total = num_values
+    if runs["out_start"].size:
+        total = max(total,
+                    int((runs["out_start"] + runs["count"]).max()))
+    out = np.zeros(total, np.int8)
+    allbits = None
+    for start, count, value, base, is_rle in zip(
+            runs["out_start"], runs["count"], runs["rle_value"],
+            runs["bp_bit_base"], runs["is_rle"]):
+        if is_rle:
+            out[start:start + count] = value
+        else:
+            if allbits is None:
+                allbits = np.unpackbits(np.frombuffer(buf, np.uint8),
+                                        bitorder="little")
+            nbits = int(count) * bit_width
+            seg = allbits[base:base + nbits]
+            if seg.size < nbits:
+                seg = np.pad(seg, (0, nbits - seg.size))
+            vals = seg.reshape(int(count), bit_width) @ \
+                (1 << np.arange(bit_width, dtype=np.int16))
+            out[start:start + count] = vals.astype(np.int8)
+    return out[:num_values]
 
 
 def count_rle_ones(buf: bytes, runs: Dict[str, np.ndarray],
@@ -660,6 +733,8 @@ class _PageSlice:
     encoding: int
     values: bytes
     def_runs: Optional[Dict[str, np.ndarray]] = None   # parsed def levels
+    rep_levels: Optional[np.ndarray] = None   # LIST: expanded rep levels
+    def_levels: Optional[np.ndarray] = None   # LIST: expanded def levels
 
 
 def _page_kind(p: _PageSlice) -> str:
@@ -703,6 +778,7 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
             continue
         if ptype == P_INDEX:
             continue
+        rep_buf = None
         if ptype == P_DATA:
             dph = header[5]
             num_values = dph[1]
@@ -711,7 +787,12 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
             body = _decompress(chunk.codec, payload, header[2])
             bpos = 0
             def_buf = None
-            if info.optional:
+            if info.max_rep:
+                (rep_len,) = _struct.unpack_from("<I", body, bpos)
+                bpos += 4
+                rep_buf = body[bpos:bpos + rep_len]
+                bpos += rep_len
+            if info.optional or info.max_rep:
                 if def_enc != E_RLE:
                     raise NotImplementedError(
                         f"definition-level encoding {def_enc} "
@@ -727,18 +808,29 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
             encoding = dph[4]
             def_len = dph[5]
             rep_len = dph[6]
-            if rep_len:
+            if rep_len and not info.max_rep:
                 raise NotImplementedError("repetition levels (nested data)")
-            def_buf = payload[:def_len] if info.optional else None
-            rest = payload[def_len:]
+            rep_buf = payload[:rep_len] if rep_len else None
+            def_buf = payload[rep_len:rep_len + def_len] \
+                if (info.optional or info.max_rep) else None
+            rest = payload[rep_len + def_len:]
             is_compressed = dph.get(7, True)
-            values = _decompress(chunk.codec, rest, header[2] - def_len) \
+            values = _decompress(chunk.codec, rest,
+                                 header[2] - def_len - rep_len) \
                 if is_compressed else rest
         else:
             raise NotImplementedError(f"page type {ptype}")
 
         def_runs = None
-        if info.optional:
+        rep_levels = def_levels = None
+        if info.max_rep:
+            # LIST column: expand both level streams on the host (levels
+            # are <= 2-bit metadata; offsets/validity are host-built).
+            rep_levels = _expand_levels_host(rep_buf, 1, num_values)
+            def_bits = max(int(info.max_def).bit_length(), 1)
+            def_levels = _expand_levels_host(def_buf, def_bits, num_values)
+            n_defined = int((def_levels == info.max_def).sum())
+        elif info.optional:
             if ptype == P_DATA_V2:
                 n_defined = num_values - dph[2]     # num_nulls is exact in v2
             else:
@@ -749,11 +841,26 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
         pages.append(_PageSlice(row_base=row_base, num_values=num_values,
                                 def_base=def_base, n_defined=n_defined,
                                 def_buf=def_buf, encoding=encoding,
-                                values=values, def_runs=def_runs))
+                                values=values, def_runs=def_runs,
+                                rep_levels=rep_levels,
+                                def_levels=def_levels))
         row_base += num_values
         def_base += n_defined
         remaining -= num_values
     return dictionary, pages, row_base
+
+
+def _expand_dict_codes(pages: List[_PageSlice]) -> jax.Array:
+    """Fuse a run of dictionary pages' RLE/bit-packed code streams into one
+    device expansion (shared by the flat dict path and the deferred
+    string-chunk path)."""
+    base0 = pages[0].def_base
+    n_dense = sum(p.n_defined for p in pages)
+    m = RunMerger()
+    for p in pages:
+        m.add_stream(p.values[1:], p.values[0], p.n_defined,
+                     p.def_base - base0)
+    return m.expand(pages[0].values[0], n_dense)
 
 
 def _chunk_validity(pages: List[_PageSlice], total_rows: int) -> jax.Array:
@@ -777,11 +884,7 @@ def _dense_group(pages: List[_PageSlice], kind: str, info: ColumnInfo,
     if kind == "dict":
         if dictionary is None:
             raise ValueError("dictionary-encoded page with no dictionary page")
-        m = RunMerger()
-        for p in pages:
-            m.add_stream(p.values[1:], p.values[0], p.n_defined,
-                         p.def_base - base0)
-        indices = m.expand(pages[0].values[0], n_dense)
+        indices = _expand_dict_codes(pages)
         if dictionary.column is not None:
             return dictionary.column.gather(indices)
         return Column(data=dictionary.values[indices], dtype=info.dtype)
@@ -840,16 +943,14 @@ def _decode_chunk(blob: bytes, chunk: ChunkInfo):
     if not pages:
         return _empty_column(info.dtype)
 
+    if info.max_rep:
+        return _decode_list_chunk(info, dictionary, pages)
+
     if (info.dtype == STRING and dictionary is not None
             and all(_page_kind(p) == "dict" for p in pages)):
-        base0 = pages[0].def_base
         n_dense = sum(p.n_defined for p in pages)
-        m = RunMerger()
-        for p in pages:
-            m.add_stream(p.values[1:], p.values[0], p.n_defined,
-                         p.def_base - base0)
-        indices = m.expand(pages[0].values[0], n_dense)
-        codes = Column(data=indices.astype(jnp.int32), dtype=INT32)
+        codes = Column(data=_expand_dict_codes(pages).astype(jnp.int32),
+                       dtype=INT32)
         if info.optional and n_dense != total_rows:
             valid = _chunk_validity(pages, total_rows)
             codes = Column(data=_scatter_defined(codes.data, valid,
@@ -907,6 +1008,71 @@ def _decode_chunk(blob: bytes, chunk: ChunkInfo):
                       dtype=STRING)
     data = _scatter_defined(dense_col.data, valid, n=total_rows)
     return Column(data=data, validity=valid, dtype=info.dtype)
+
+
+def _decode_list_chunk(info: ColumnInfo, dictionary: Optional[_Dict],
+                       pages: List[_PageSlice]) -> Column:
+    """LIST column chunk: element values decode through the same fused
+    device machinery as flat columns; offsets and validity come from the
+    host-expanded repetition/definition levels (rep == 0 starts a row;
+    def distinguishes null list / empty list / null element / value)."""
+    from dataclasses import replace as _dc_replace
+    elem_dt = info.dtype.element
+    einfo = _dc_replace(info, dtype=elem_dt, optional=info.element_optional,
+                        max_rep=0, max_def=0)
+
+    groups: List[Tuple[str, List[_PageSlice]]] = []
+    for pg in pages:
+        kind = _page_kind(pg)
+        if groups and groups[-1][0] == kind:
+            groups[-1][1].append(pg)
+        else:
+            groups.append((kind, [pg]))
+    parts = [_dense_group(ps, kind, einfo, dictionary)
+             for kind, ps in groups]
+    dense = parts[0] if len(parts) == 1 else _concat_columns(parts)
+    if dense.offsets is None:
+        target = elem_dt.jnp_dtype
+        if dense.data.dtype != target:
+            dense = Column(data=dense.data.astype(target), dtype=elem_dt)
+        elif dense.dtype != elem_dt:
+            dense = Column(data=dense.data, dtype=elem_dt)
+
+    rep = np.concatenate([pg.rep_levels for pg in pages])
+    deff = np.concatenate([pg.def_levels for pg in pages])
+    base = 1 if info.optional else 0
+    is_row = rep == 0
+    n_rows = int(is_row.sum())
+    row_ids = np.cumsum(is_row) - 1
+    elem_slot = deff >= base + 1
+    lens = np.bincount(row_ids[elem_slot],
+                       minlength=max(n_rows, 1))[:max(n_rows, 1)]
+    if n_rows == 0:
+        lens = lens[:0]
+    offsets = np.concatenate([np.zeros(1, np.int64),
+                              np.cumsum(lens)]).astype(np.int32)
+
+    validity = None
+    if info.optional:
+        row_def = deff[is_row]
+        vr = row_def >= base
+        if not vr.all():
+            validity = jnp.asarray(vr)
+
+    if info.element_optional:
+        edef = deff[elem_slot]
+        if (edef != info.max_def).any():
+            if dense.offsets is not None:
+                raise NotImplementedError(
+                    "lists of strings with null elements need the "
+                    "Arrow reader")
+            evalid = jnp.asarray(edef == info.max_def)
+            n_slots = int(elem_slot.sum())
+            data = _scatter_defined(dense.data, evalid, n=n_slots)
+            dense = Column(data=data, validity=evalid, dtype=elem_dt)
+
+    return Column(offsets=jnp.asarray(offsets), validity=validity,
+                  dtype=info.dtype, children=(dense,))
 
 
 def _empty_column(dtype: DType) -> Column:

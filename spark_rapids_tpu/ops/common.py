@@ -248,6 +248,26 @@ def concat_columns(pieces: list[Column]) -> Column:
     dtype = pieces[0].dtype
     if any(p.dtype != dtype for p in pieces[1:]):
         raise TypeError(f"dtype mismatch: {[p.dtype for p in pieces]}")
+    if dtype is not None and dtype.is_struct:
+        validity = None
+        if any(p.validity is not None for p in pieces):
+            validity = jnp.concatenate([p.valid_mask() for p in pieces])
+        children = tuple(
+            concat_columns([p.children[i] for p in pieces])
+            for i in range(len(dtype.fields)))
+        return Column(validity=validity, dtype=dtype, children=children)
+    if dtype is not None and dtype.is_list:
+        validity = None
+        if any(p.validity is not None for p in pieces):
+            validity = jnp.concatenate([p.valid_mask() for p in pieces])
+        child = concat_columns([p.children[0] for p in pieces])
+        parts = [pieces[0].offsets]
+        base = pieces[0].offsets[-1]
+        for p in pieces[1:]:
+            parts.append(p.offsets[1:] + base)
+            base = base + p.offsets[-1]
+        return Column(offsets=jnp.concatenate(parts), validity=validity,
+                      dtype=dtype, children=(child,))
     if pieces[0].offsets is not None:
         from .strings import concat_columns as strings_concat
         return strings_concat(pieces)
@@ -282,6 +302,11 @@ def grouping_columns(cols: list[Column]) -> list[Column]:
     callers use the result only as an ordered key set."""
     out = []
     for col in cols:
+        if col.dtype is not None and col.dtype.is_nested:
+            raise TypeError(
+                f"{col.dtype!r} cannot be a grouping/sort/join key; key on "
+                f"a struct field (col.field(name)) or a derived scalar "
+                f"instead")
         if col.offsets is not None:
             from .strings import dictionary_encode
             codes, _ = dictionary_encode(col)

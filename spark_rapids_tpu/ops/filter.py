@@ -35,7 +35,16 @@ def _compact_table(table: Table, keep: jax.Array) -> Table:
     (+ eager string gathers, which are host-sized anyway)."""
     count = int(jnp.sum(keep))
     bucket = min(pow2_bucket(count), table.num_rows)
-    fixed = [(name, col) for name, col in table.items() if col.offsets is None]
+
+    def needs_gather(col):
+        # Strings and nested columns go through Column.gather (which
+        # recurses into offsets/children); flat buffers ride the fused
+        # compaction kernel.
+        return col.offsets is not None or (col.dtype is not None
+                                           and col.dtype.is_nested)
+
+    fixed = [(name, col) for name, col in table.items()
+             if not needs_gather(col)]
     idx, datas, valids = _compact_kernel(
         keep, tuple(c.data for _, c in fixed),
         tuple(c.validity for _, c in fixed), bucket=bucket)
@@ -46,7 +55,7 @@ def _compact_table(table: Table, keep: jax.Array) -> Table:
                            dtype=col.dtype)
     sliced_idx = None
     for name, col in table.items():
-        if col.offsets is not None:
+        if needs_gather(col):
             if sliced_idx is None:
                 sliced_idx = idx[:count]
             out[name] = col.gather(sliced_idx)

@@ -131,7 +131,7 @@ def to_rows(table: Table, *, max_batch_bytes: int = MAX_BATCH_BYTES,
     from ..config import ensure_compile_cache
     ensure_compile_cache()
     schema = tuple(table.schema())
-    if any(dt.is_string for dt in schema):
+    if any(dt.is_string or dt.is_nested for dt in schema):
         from .varwidth import compute_var_layout, to_var_rows
         if check_row_width:
             fixed_size = compute_var_layout(schema).fixed.row_size
@@ -189,7 +189,7 @@ def from_rows(blobs: Union[Sequence[RowBlob], RowBlob], schema: Sequence[DType],
         names = [f"c{i}" for i in range(len(schema))]
     elif len(names) != len(schema):
         raise ValueError(f"{len(names)} names for {len(schema)} schema columns")
-    if any(dt.is_string for dt in schema):
+    if any(dt.is_string or dt.is_nested for dt in schema):
         from ..ops.common import concat_tables
         from .varwidth import empty_var_table
         if not blobs:

@@ -65,16 +65,75 @@ class VarLayout:
     var_cols: tuple[int, ...]        # schema indices of string columns
 
 
+def _is_var(dt: DType) -> bool:
+    return dt.is_string or dt.is_list
+
+
 @functools.lru_cache(maxsize=None)
 def compute_var_layout(schema: tuple[DType, ...]) -> VarLayout:
-    fixed_schema = tuple(INT64 if dt.is_string else dt for dt in schema)
-    var_cols = tuple(i for i, dt in enumerate(schema) if dt.is_string)
+    for dt in schema:
+        if dt.is_list and not dt.element.is_fixed_width:
+            raise NotImplementedError(
+                f"row format supports LIST of fixed-width elements only "
+                f"(got {dt!r}); move nested payloads via Arrow interop")
+        if dt.is_struct:
+            raise NotImplementedError(
+                "STRUCT columns have no row-format encoding (the reference "
+                "punts nested types too, RowConversion.java:111); flatten "
+                "fields into top-level columns or use Arrow interop")
+    fixed_schema = tuple(INT64 if _is_var(dt) else dt for dt in schema)
+    var_cols = tuple(i for i, dt in enumerate(schema) if _is_var(dt))
     if not var_cols:
         raise ValueError("schema has no variable-width columns; use the "
                          "fixed-width engine")
     return VarLayout(schema=tuple(schema),
                      fixed=compute_fixed_width_layout(fixed_schema),
                      var_cols=var_cols)
+
+
+def _list_byte_view(c: Column) -> Column:
+    """A LIST<fixed-width> column as a synthetic STRING column over its
+    raw element bytes: byte offsets = element offsets * itemsize, payload
+    = the flattened elements' little-endian bytes.  The var-section
+    machinery then needs no list-specific kernels — the (len<<32|offset)
+    slot design extends to lists for free.  (One host round trip for the
+    byte view; this is the host-interop boundary anyway.)"""
+    elem = c.dtype.element
+    child = c.children[0]
+    if child.validity is not None:
+        raise NotImplementedError(
+            "LIST elements with nulls have no row-format encoding yet; "
+            "fill or drop element nulls first, or use Arrow interop")
+    k = elem.itemsize
+    host = np.ascontiguousarray(np.asarray(child.data))
+    return Column(data=jnp.asarray(host.view(np.uint8).ravel()),
+                  offsets=(c.offsets * k).astype(jnp.int32),
+                  validity=c.validity, dtype=STRING)
+
+
+def _list_from_bytes(col: Column, dtype: DType) -> Column:
+    """Inverse of :func:`_list_byte_view` at unpack time."""
+    elem = dtype.element
+    k = elem.itemsize
+    host = np.ascontiguousarray(np.asarray(col.data))
+    if elem.is_two_word:
+        data = jnp.asarray(host.view(np.uint64).reshape(-1, 2))
+    else:
+        data = jnp.asarray(host.view(elem.np_dtype))
+    child = Column(data=data, dtype=elem)
+    return Column(offsets=(col.offsets // k).astype(jnp.int32),
+                  validity=col.validity, dtype=dtype, children=(child,))
+
+
+def _byte_view_table(table: Table) -> Table:
+    """Replace LIST columns with their byte-view STRING forms (no-op for
+    tables without lists)."""
+    if not any(c.dtype is not None and c.dtype.is_list
+               for c in table.columns):
+        return table
+    return Table([(nm, _list_byte_view(c)
+                   if c.dtype is not None and c.dtype.is_list else c)
+                  for nm, c in table.items()])
 
 
 @jax.tree_util.register_pytree_node_class
@@ -227,6 +286,8 @@ def pack_var_rows(table: Table) -> VarRowBlob:
     (``to_var_rows``).
     """
     from .layout import MAX_BATCH_BYTES
+    compute_var_layout(tuple(table.schema()))     # validate BEFORE adapting
+    table = _byte_view_table(table)
     schema = tuple(table.schema())
     layout = compute_var_layout(schema)
     if table.num_rows == 0:
@@ -340,7 +401,13 @@ def empty_var_table(schema: Sequence[DType],
     """A zero-row table for a (string-bearing) schema."""
     cols = []
     for name, dt in zip(names, schema):
-        if dt.is_string:
+        if dt.is_list:
+            cols.append((name, Column(
+                offsets=jnp.zeros(1, jnp.int32), dtype=dt,
+                children=(Column(data=jnp.zeros(
+                    (0, 2) if dt.element.is_two_word else 0,
+                    dt.element.jnp_dtype), dtype=dt.element),))))
+        elif dt.is_string:
             cols.append((name, Column(data=jnp.zeros(0, jnp.uint8),
                                       offsets=jnp.zeros(1, jnp.int32),
                                       dtype=STRING)))
@@ -354,10 +421,12 @@ def to_var_rows(table: Table, *, max_batch_bytes: int) -> list[VarRowBlob]:
     """Batched serialization: split so no blob exceeds ``max_batch_bytes``
     (reference contract RowConversion.java:32-48), in 32-row multiples
     where possible."""
+    compute_var_layout(tuple(table.schema()))     # validate BEFORE adapting
+    table = _byte_view_table(table)
     schema = tuple(table.schema())
     layout = compute_var_layout(schema)
     _, _, row_sizes, row_offsets = _row_var_geometry(layout, table)
-    off = np.asarray(row_offsets)                    # the host sync
+    off = np.asarray(row_offsets)                    # the one host sync
     n = table.num_rows
     if n == 0 or off[-1] <= max_batch_bytes:
         return [pack_var_rows(table)]
@@ -427,14 +496,15 @@ def unpack_var_rows(blob: VarRowBlob, schema: Sequence[DType],
     columns = []
     si = 0
     for i, (name, dt) in enumerate(zip(names, schema)):
-        if dt.is_string:
+        if _is_var(dt):
             out_offsets, chars = str_outs[si]
             chars = chars[:char_counts[si]]
             si += 1
             validity = valids[i][:n]
-            columns.append((name, Column(data=chars,
-                                         offsets=out_offsets[:n + 1],
-                                         validity=validity, dtype=STRING)))
+            scol = Column(data=chars, offsets=out_offsets[:n + 1],
+                          validity=validity, dtype=STRING)
+            columns.append((name, _list_from_bytes(scol, dt)
+                            if dt.is_list else scol))
         else:
             columns.append((name, Column(data=datas[i][:n],
                                          validity=valids[i][:n],

@@ -1,0 +1,232 @@
+"""LIST/STRUCT columns: representation, gather/filter, Arrow interop,
+row-format var-section encoding, and native Parquet repetition levels.
+
+The reference punts nested types in its one kernel (nested TODO at
+RowConversion.java:111; fixed-width gate row_conversion.cu:514-516) but
+the cudf envelope has them (SURVEY.md §2.3.1); the oracle here is
+pyarrow plus Python-list reconstruction.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import Column, Table, ops
+from spark_rapids_tpu import dtypes as dt
+
+
+class TestRepresentation:
+    def test_list_round_trip(self):
+        vals = [[1, 2, 3], [], None, [7]]
+        c = Column.from_pylist(vals, dt.list_(dt.INT64))
+        assert c.size == 4
+        assert c.to_pylist() == vals
+
+    def test_list_of_strings(self):
+        vals = [["a", "bb"], None, [], ["x", None, "zzz"]]
+        c = Column.from_pylist(vals, dt.list_(dt.STRING))
+        assert c.to_pylist() == vals
+
+    def test_list_of_lists(self):
+        vals = [[[1], [2, 3]], None, [[], [4]]]
+        c = Column.from_pylist(vals, dt.list_(dt.list_(dt.INT32)))
+        assert c.to_pylist() == vals
+
+    def test_struct_round_trip_and_field(self):
+        S = dt.struct({"a": dt.INT64, "s": dt.STRING})
+        vals = [{"a": 1, "s": "x"}, None, {"a": None, "s": "y"}]
+        c = Column.from_pylist(vals, S)
+        assert c.to_pylist() == vals
+        # a null struct nulls its fields (Arrow semantics)
+        assert c.field("a").to_pylist() == [1, None, None]
+        assert c.field("s").to_pylist() == ["x", None, "y"]
+        with pytest.raises(KeyError, match="no field"):
+            c.field("zz")
+
+    def test_struct_of_list(self):
+        S = dt.struct({"xs": dt.list_(dt.INT64), "n": dt.INT32})
+        vals = [{"xs": [1, 2], "n": 10}, {"xs": None, "n": None}, None]
+        c = Column.from_pylist(vals, S)
+        assert c.to_pylist() == vals
+
+    def test_dtype_validation(self):
+        with pytest.raises(ValueError, match="element"):
+            dt.DType(dt.TypeId.LIST)
+        with pytest.raises(ValueError, match="fields"):
+            dt.DType(dt.TypeId.STRUCT)
+
+
+class TestOps:
+    def test_gather_list(self):
+        c = Column.from_pylist([[1, 2], None, [], [9, 8, 7]],
+                               dt.list_(dt.INT64))
+        g = c.gather(np.array([3, 1, 0], np.int32))
+        assert g.to_pylist() == [[9, 8, 7], None, [1, 2]]
+
+    def test_filter_table_with_nested(self, rng):
+        n = 100
+        t = Table([
+            ("v", Column.from_pylist(list(range(n)), dt.INT64)),
+            ("xs", Column.from_pylist(
+                [None if i % 7 == 0 else [i, i + 1] for i in range(n)],
+                dt.list_(dt.INT32))),
+            ("rec", Column.from_pylist(
+                [{"a": i, "b": float(i)} for i in range(n)],
+                dt.struct({"a": dt.INT64, "b": dt.FLOAT64}))),
+        ])
+        mask = Column.from_numpy(
+            (np.arange(n) % 3 == 0).astype(np.bool_))
+        out = ops.apply_boolean_mask(t, mask)
+        keep = [i for i in range(n) if i % 3 == 0]
+        assert out["v"].to_pylist() == keep
+        assert out["xs"].to_pylist() == \
+            [None if i % 7 == 0 else [i, i + 1] for i in keep]
+        assert out["rec"].to_pylist() == \
+            [{"a": i, "b": float(i)} for i in keep]
+
+    def test_groupby_on_struct_field(self):
+        n = 12
+        S = dt.struct({"g": dt.INT64, "v": dt.INT64})
+        t = Table([("rec", Column.from_pylist(
+            [{"g": i % 3, "v": i} for i in range(n)], S))])
+        t2 = (t.with_column("gk", t["rec"].field("g"))
+               .with_column("vv", t["rec"].field("v")))
+        g = ops.groupby_agg(t2, ["gk"], [("vv", "sum", "s")])
+        got = dict(zip(g["gk"].to_pylist(), g["s"].to_pylist()))
+        assert got == {0: 18, 1: 22, 2: 26}
+
+    def test_nested_key_raises(self):
+        t = Table([("xs", Column.from_pylist([[1]], dt.list_(dt.INT64))),
+                   ("v", Column.from_pylist([1], dt.INT64))])
+        with pytest.raises(TypeError, match="key"):
+            ops.sort_by(t, "xs")
+
+    def test_concat_nested(self):
+        L = dt.list_(dt.INT64)
+        a = Column.from_pylist([[1], None], L)
+        b = Column.from_pylist([[2, 3]], L)
+        out = ops.concat_columns([a, b])
+        assert out.to_pylist() == [[1], None, [2, 3]]
+
+
+class TestArrow:
+    def test_round_trip(self):
+        at = pa.table({
+            "xs": pa.array([[1, 2], None, [], [3]], pa.list_(pa.int64())),
+            "rec": pa.array(
+                [{"a": 1, "s": "x"}, {"a": None, "s": None}, None,
+                 {"a": 4, "s": "w"}],
+                pa.struct([("a", pa.int64()), ("s", pa.string())])),
+            "deep": pa.array([[["p", None]], None, [[], ["q"]], [["r"]]],
+                             pa.list_(pa.list_(pa.string()))),
+        })
+        from spark_rapids_tpu.io.arrow import from_arrow, to_arrow
+        t = from_arrow(at)
+        assert to_arrow(t).equals(at)
+
+    def test_sliced_array(self):
+        from spark_rapids_tpu.io.arrow import from_arrow_array
+        arr = pa.array([[1], [2, 3], None, [4]], pa.list_(pa.int64()))
+        c = from_arrow_array(arr.slice(1, 3))
+        assert c.to_pylist() == [[2, 3], None, [4]]
+
+
+class TestRowFormat:
+    def test_list_round_trip(self, rng):
+        t = Table([
+            ("a", Column.from_pylist([1, None, 3, 4], dt.INT64)),
+            ("xs", Column.from_pylist([[1, 2, 3], None, [], [9]],
+                                      dt.list_(dt.INT32))),
+            ("s", Column.from_pylist(["ab", None, "", "xyz"], dt.STRING)),
+            ("fs", Column.from_pylist([[1.5], [2.5, 3.5], None, []],
+                                      dt.list_(dt.FLOAT64))),
+        ])
+        from spark_rapids_tpu.rows import convert as rc
+        blobs = rc.to_rows(t)
+        back = rc.from_rows(blobs, t.schema(), t.names)
+        assert back.to_pydict() == t.to_pydict()
+
+    def test_list_batched(self):
+        from spark_rapids_tpu.rows import convert as rc
+        t = Table([("xs", Column.from_pylist(
+            [[i, i + 1] for i in range(3000)], dt.list_(dt.INT64)))])
+        blobs = rc.to_rows(t, max_batch_bytes=40_000)
+        assert len(blobs) > 1
+        back = rc.from_rows(blobs, t.schema(), t.names)
+        assert back.to_pydict() == t.to_pydict()
+
+    def test_struct_raises_with_guidance(self):
+        from spark_rapids_tpu.rows import convert as rc
+        t = Table([("r", Column.from_pylist(
+            [{"a": 1}], dt.struct({"a": dt.INT64})))])
+        with pytest.raises(NotImplementedError, match="STRUCT"):
+            rc.to_rows(t)
+
+    def test_element_nulls_raise(self):
+        from spark_rapids_tpu.rows import convert as rc
+        t = Table([("xs", Column.from_pylist([[1, None]],
+                                             dt.list_(dt.INT64)))])
+        with pytest.raises(NotImplementedError, match="nulls"):
+            rc.to_rows(t)
+
+
+class TestParquetLists:
+    def _table(self, rng, n=3000):
+        return pa.table({
+            "k": pa.array(np.arange(n, dtype=np.int64)),
+            "xs": pa.array([None if i % 11 == 0 else
+                            [int(x) for x in rng.integers(0, 100, i % 5)]
+                            for i in range(n)], pa.list_(pa.int64())),
+            "ys": pa.array([[None, float(i)] if i % 4 == 0 else [float(i)]
+                            for i in range(n)], pa.list_(pa.float64())),
+            "ss": pa.array([["a", "bb"] if i % 2 else []
+                            for i in range(n)], pa.list_(pa.string())),
+        })
+
+    def test_v1_pages_multi_row_group(self, rng, tmp_path):
+        from spark_rapids_tpu.io.parquet_native import read_parquet_native
+        at = self._table(rng)
+        p = tmp_path / "lists.parquet"
+        pq.write_table(at, p, row_group_size=1000)
+        t = read_parquet_native(p)
+        for name in at.column_names:
+            assert t[name].to_pylist() == at[name].to_pylist(), name
+
+    def test_v2_pages_zstd(self, rng, tmp_path):
+        from spark_rapids_tpu.io.parquet_native import read_parquet_native
+        at = self._table(rng)
+        p = tmp_path / "lists2.parquet"
+        pq.write_table(at, p, row_group_size=700,
+                       data_page_version="2.0", compression="zstd")
+        t = read_parquet_native(p)
+        for name in at.column_names:
+            assert t[name].to_pylist() == at[name].to_pylist(), name
+
+    def test_map_still_raises(self, tmp_path):
+        from spark_rapids_tpu.io.parquet_native import read_parquet_native
+        at = pa.table({"m": pa.array([[("k", 1)]],
+                                     pa.map_(pa.string(), pa.int64()))})
+        p = tmp_path / "map.parquet"
+        pq.write_table(at, p)
+        with pytest.raises(NotImplementedError):
+            read_parquet_native(p)
+
+
+class TestEmptyGathers:
+    def test_zero_row_filter_with_list(self):
+        t = Table([
+            ("v", Column.from_pylist([1, 2, 3, 4], dt.INT64)),
+            ("xs", Column.from_pylist([[1], [2, 3], None, []],
+                                      dt.list_(dt.INT64))),
+        ])
+        out = ops.apply_boolean_mask(
+            t, Column.from_numpy(np.zeros(4, np.bool_)))
+        assert out.num_rows == 0
+        assert out["xs"].to_pylist() == []
+
+    def test_empty_gather_struct_of_list(self):
+        S = dt.struct({"xs": dt.list_(dt.INT64)})
+        c = Column.from_pylist([{"xs": [1]}, {"xs": []}], S)
+        g = c.gather(np.zeros(0, np.int32))
+        assert g.size == 0 and g.to_pylist() == []

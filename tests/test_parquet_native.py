@@ -182,11 +182,18 @@ class TestEngineDispatch:
         assert_tables_equal(read_parquet(path, engine="auto"),
                             read_parquet(path, engine="arrow"))
 
-    def test_native_rejects_nested(self, tmp_path):
+    def test_native_reads_lists_rejects_structs(self, tmp_path):
+        # LIST schemas are in-envelope now (repetition levels,
+        # tests/test_nested.py); STRUCT groups still fall back to Arrow.
         path = tmp_path / "t.parquet"
         pq.write_table(pa.table({"l": pa.array([[1, 2], [3]])}), path)
+        assert read_parquet(path, engine="native")["l"].to_pylist() == \
+            [[1, 2], [3]]
+        spath = tmp_path / "s.parquet"
+        pq.write_table(pa.table({"r": pa.array(
+            [{"a": 1}], pa.struct([("a", pa.int64())]))}), spath)
         with pytest.raises(NotImplementedError):
-            read_parquet(path, engine="native")
+            read_parquet(spath, engine="native")
 
     def test_auto_falls_back_on_delta_encoding(self, tmp_path):
         path = tmp_path / "t.parquet"
