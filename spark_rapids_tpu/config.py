@@ -82,9 +82,11 @@ def ensure_compile_cache(resolve_backend: bool = True) -> None:
     lazily from the engine's compile entry points otherwise — by the time
     the engine compiles anything, a multi-host user has already run
     ``jax.distributed.initialize``, so resolving the backend here is safe
-    (at import it would not be).  CPU stays uncached: its AOT artifacts
-    bake in exact host machine features and risk SIGILL from a shared
-    cache directory.
+    (at import it would not be).  CPU stays uncached by default: its AOT
+    artifacts bake in exact host machine features and risk SIGILL from a
+    shared cache directory.  Set ``SRT_CPU_COMPILE_CACHE=1`` to cache on
+    CPU too — safe when the cache directory is private to one machine
+    (CI runners use this: the test suite is compile-dominated).
     """
     global _CACHE_DECIDED
     if _CACHE_DECIDED:
@@ -94,14 +96,15 @@ def ensure_compile_cache(resolve_backend: bool = True) -> None:
     if path is None or jax.config.jax_compilation_cache_dir:
         _CACHE_DECIDED = True
         return
+    cpu_ok = _flag("SRT_CPU_COMPILE_CACHE")
     platforms = jax.config.jax_platforms or ""
     if platforms:
-        if platforms.split(",")[0].strip() == "cpu":
+        if platforms.split(",")[0].strip() == "cpu" and not cpu_ok:
             _CACHE_DECIDED = True
             return
     elif resolve_backend:
         try:
-            if jax.default_backend() == "cpu":
+            if jax.default_backend() == "cpu" and not cpu_ok:
                 _CACHE_DECIDED = True
                 return
         except Exception:
@@ -166,5 +169,6 @@ def knob_table() -> dict[str, str]:
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_LEAK_DEBUG",
              "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL",
-             "SRT_DENSE_MAX_CELLS", "SRT_COMPILE_CACHE")
+             "SRT_DENSE_MAX_CELLS", "SRT_COMPILE_CACHE",
+             "SRT_CPU_COMPILE_CACHE")
     return {n: os.environ.get(n, "<default>") for n in names}
