@@ -153,12 +153,23 @@ def _flat_hits(col: Column, pat: np.ndarray):
     data = col.data
     total = data.shape[0]
     m = len(pat)
-    ext = jnp.pad(data, (0, m))
+    # Widen ONCE to i32 before the shifted compares: u8 slices force lane
+    # relayouts on TPU (measured 143 ms vs 13.7 ms for 5 compares over a
+    # 28M-char buffer).
+    ext = jnp.pad(data.astype(jnp.int32), (0, m))
     match = jnp.ones(total, jnp.bool_)
     for k in range(m):
-        match = match & (ext[k:k + total] == pat[k])
+        match = match & (ext[k:k + total] == int(pat[k]))
     row = _row_ids(col.offsets, total)
-    ends = jnp.take(col.offsets, row + 1)
+    # Per-char row END without the 28M-wide gather (jnp.take(offsets,
+    # row+1) measured 311 ms): scatter each row's end at its start
+    # position, then a running max carries it across the row.  Rows
+    # starting at the same position (empties) resolve to the real row's
+    # end — the only chars at or past that position are the real row's.
+    ends_seed = jnp.zeros(total, jnp.int32).at[
+        jnp.clip(col.offsets[:-1], 0, total - 1)].max(
+            jnp.where(col.offsets[:-1] < total, col.offsets[1:], 0))
+    ends = jax.lax.cummax(ends_seed)
     pos = jnp.arange(total, dtype=jnp.int32)
     return match & (pos + m <= ends), row, pos
 
