@@ -216,6 +216,14 @@ class _Bound:
                     f"compiled plans (its (n, 2)-word representation cannot "
                     f"ride the 1-D sort/window payload paths); use the "
                     f"eager ops layer, or cast to decimal64/float64 first")
+            if c.dtype.is_nested:
+                raise TypeError(
+                    f"nested column {name!r} ({c.dtype.type_id.name}) is not "
+                    f"supported in compiled plans; use the eager ops layer, "
+                    f"select struct fields with .field(), or drop the column "
+                    f"from the input table first (table.select/.drop — a "
+                    f"plan-level select cannot help; this check covers the "
+                    f"whole bound input)")
             if c.offsets is None:
                 self.exec_cols[name] = c
                 continue

@@ -165,7 +165,8 @@ def bind_join(bound, step: JoinStep, index: int,
             raise KeyError(f"build-side key {rn!r} not in "
                            f"{list(dim.names)}")
         c = dim[rn]
-        if c.offsets is not None or c.dtype.is_floating:
+        if (c.offsets is not None or c.dtype.is_floating
+                or c.dtype.is_nested):
             raise TypeError(
                 f"broadcast join keys must be integer-typed "
                 f"({rn!r} is {c.dtype.type_id.name}); "
@@ -194,6 +195,12 @@ def bind_join(bound, step: JoinStep, index: int,
                 raise ValueError(
                     f"join output column {name!r} collides with an existing "
                     f"column; rename one side first")
+            if c.dtype is not None and c.dtype.is_nested:
+                raise TypeError(
+                    f"nested build-side payload {name!r} "
+                    f"({c.dtype.type_id.name}) is not supported in compiled "
+                    f"plans; drop it from the build table or use the eager "
+                    f"ops.join")
             if c.offsets is None:
                 side_name = prefix + "pay__" + name
                 bound.side_inputs[side_name] = c
@@ -405,6 +412,12 @@ def bind_join_shuffled(bound, step, index: int,
                 raise ValueError(
                     f"join output column {name!r} collides with an "
                     f"existing column; rename one side first")
+            if c.dtype is not None and c.dtype.is_nested:
+                raise TypeError(
+                    f"nested right-side payload {name!r} "
+                    f"({c.dtype.type_id.name}) is not supported in compiled "
+                    f"plans; drop it from the right table or use the eager "
+                    f"ops.join")
             if c.offsets is None:
                 side_name = prefix + "pay__" + name
                 bound.side_inputs[side_name] = c

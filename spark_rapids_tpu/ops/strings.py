@@ -596,13 +596,22 @@ def strings_gather(col: Column, indices) -> Column:
     (position->source map built from searchsorted over the new offsets).
     """
     indices = jnp.asarray(indices)
+    if col.size == 0 and int(indices.shape[0]) > 0:
+        # No source rows (e.g. the join late-gather path with an empty
+        # build side): every output row is null.  Without this guard the
+        # offsets takes below are out of bounds and JAX's default fill
+        # (INT32_MIN) poisons the size sync.
+        n_out = int(indices.shape[0])
+        return Column(data=jnp.zeros(0, jnp.uint8),
+                      offsets=jnp.zeros(n_out + 1, jnp.int32),
+                      validity=jnp.zeros(n_out, jnp.bool_), dtype=STRING)
     offsets = col.offsets
-    starts = jnp.take(offsets, indices)
-    lens = jnp.take(offsets, indices + 1) - starts
+    starts = jnp.take(offsets, indices, mode="clip")
+    lens = jnp.take(offsets, indices + 1, mode="clip") - starts
     new_offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                    jnp.cumsum(lens, dtype=jnp.int32)])
     chars = _segment_gather(col.data, starts, new_offsets)
     validity = None
     if col.validity is not None:
-        validity = jnp.take(col.validity, indices)
+        validity = jnp.take(col.validity, indices, mode="clip")
     return Column(data=chars, validity=validity, offsets=new_offsets, dtype=STRING)

@@ -106,8 +106,17 @@ def _list_byte_view(c: Column) -> Column:
             "fill or drop element nulls first, or use Arrow interop")
     k = elem.itemsize
     host = np.ascontiguousarray(np.asarray(child.data))
+    # Byte offsets in int64: an int32 multiply wraps silently once
+    # element_offset * itemsize reaches 2^31 (>134M int128 elements).
+    byte_offsets = np.asarray(c.offsets, dtype=np.int64) * k
+    if byte_offsets.size and int(byte_offsets[-1]) >= 1 << 31:
+        raise ValueError(
+            f"LIST column's flattened element bytes "
+            f"({int(byte_offsets[-1])}) exceed the 2 GB var-section "
+            f"limit; split the batch (convert.py's batching does this "
+            f"for the row path)")
     return Column(data=jnp.asarray(host.view(np.uint8).ravel()),
-                  offsets=(c.offsets * k).astype(jnp.int32),
+                  offsets=jnp.asarray(byte_offsets.astype(np.int32)),
                   validity=c.validity, dtype=STRING)
 
 

@@ -591,6 +591,29 @@ class TestShuffledJoin:
                                      how=how)
             _check(p, left)
 
+    def test_empty_right_with_string_payload(self, rng):
+        # ADVICE r2 (medium): the late string gather used to run against
+        # the 0-row right string column and crash in broadcast_in_dim
+        # (JAX's OOB take fill is INT32_MIN).  The post-join filter is
+        # load-bearing: it exercises the compact-then-gather path.
+        left, _ = self._facts(rng, n=64)
+        right = Table([
+            ("rk", Column.from_numpy(np.zeros(0, np.int64))),
+            ("rs", Column.from_pylist([], dt.STRING)),
+            ("rv", Column.from_numpy(np.zeros(0, np.int64))),
+        ])
+        for how in ("inner", "left"):
+            p = (plan().join_shuffled(right, left_on="k", right_on="rk",
+                                      how=how)
+                 .filter(col("lv") > -50))
+            out = p.run(left)
+            if how == "left":
+                assert out.num_rows > 0
+                assert not np.asarray(out["rs"].valid_mask()).any()
+            else:
+                assert out.num_rows == 0
+            _check(p, left)
+
     def test_after_sort_raises(self, rng):
         left, right = self._facts(rng, n=200, m=100)
         p = (plan().sort_by(["lv"])

@@ -170,6 +170,52 @@ class TestRowFormat:
         with pytest.raises(NotImplementedError, match="nulls"):
             rc.to_rows(t)
 
+    def test_list_byte_view_overflow_raises(self):
+        # ADVICE r2 (low): byte offsets used to wrap in int32 before the
+        # cast; element_offset * itemsize >= 2^31 must error, not corrupt.
+        import jax.numpy as jnp
+        from spark_rapids_tpu.rows.varwidth import _list_byte_view
+        child = Column.from_numpy(np.arange(4, dtype=np.int64))
+        c = Column(offsets=jnp.asarray([0, 300_000_000], jnp.int32),
+                   dtype=dt.list_(dt.INT64), children=(child,))
+        with pytest.raises(ValueError, match="2 GB"):
+            _list_byte_view(c)
+
+
+class TestCompiledPlanRejection:
+    def test_nested_rejected_at_bind_time(self, rng):
+        # ADVICE r2 (low): a STRUCT column used to die with an opaque
+        # trace-time error and a LIST column was silently treated as a
+        # string column; both must raise a clean bind-time TypeError.
+        from spark_rapids_tpu.exec import col, plan
+        n = 8
+        base = [("x", Column.from_numpy(np.arange(n, dtype=np.int64)))]
+        st = Column.from_pylist([{"a": i} for i in range(n)],
+                                dt.struct({"a": dt.INT64}))
+        ls = Column.from_pylist([[i] for i in range(n)], dt.list_(dt.INT64))
+        for nested in (st, ls):
+            t = Table(base + [("nested", nested)])
+            with pytest.raises(TypeError, match="nested column"):
+                plan().filter(col("x") > 1).run(t)
+
+    def test_nested_join_payload_rejected(self, rng):
+        # Nested columns must not sneak in through a join's build/right
+        # table either (a LIST payload was classified as a string payload
+        # and materialized as a children-less Column).
+        from spark_rapids_tpu.exec import col, plan
+        n = 8
+        left = Table([("k", Column.from_numpy(np.arange(n, dtype=np.int64)))])
+        right_cols = [
+            ("rk", Column.from_numpy(np.arange(4, dtype=np.int64))),
+            ("rl", Column.from_pylist([[i] for i in range(4)],
+                                      dt.list_(dt.INT64))),
+        ]
+        right = Table(right_cols)
+        with pytest.raises(TypeError, match="nested"):
+            plan().join_broadcast(right, left_on="k", right_on="rk").run(left)
+        with pytest.raises(TypeError, match="nested"):
+            plan().join_shuffled(right, left_on="k", right_on="rk").run(left)
+
 
 class TestParquetLists:
     def _table(self, rng, n=3000):
