@@ -19,9 +19,9 @@ no host round trip happens until the caller materializes the result.  See
     out = q1.run(lineitem)          # ONE device program + one final sync
 """
 
-from .expr import Col, Expr, Lit, col, lit
+from .expr import CaseWhen, Col, Expr, Lit, col, lit, when
 from .lazy import LazyTable, lazy
 from .plan import Plan, plan
 
-__all__ = ["Col", "Expr", "LazyTable", "Lit", "Plan", "col", "lazy", "lit",
-           "plan"]
+__all__ = ["CaseWhen", "Col", "Expr", "LazyTable", "Lit", "Plan", "col",
+           "lazy", "lit", "plan", "when"]

@@ -123,25 +123,11 @@ class _ColInfo:
     string: bool
 
 
-# dictionary-encode cache keyed on the (chars, offsets, validity) buffer
-# identities — all three define string content+nulls (see stats._CACHE for
-# why sharing any one buffer must not alias cache entries).
-_DICT_CACHE: dict = {}
-
-
 def _dict_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
-    from .stats import _guarded_cache_get, _guarded_cache_put
-    buffers = tuple(b for b in (col.data, col.offsets, col.validity)
-                    if b is not None)
-    key = tuple(id(b) for b in buffers)
-    hit = _guarded_cache_get(_DICT_CACHE, key, buffers)
-    if hit is not None:
-        return hit
-    from ..ops.strings import dictionary_encode
-    codes, uniq = dictionary_encode(col)
-    result = (codes, tuple(uniq))
-    _guarded_cache_put(_DICT_CACHE, key, buffers, result)
-    return result
+    """Buffer-identity-memoized dictionary encode, shared with the eager
+    string predicates (ops.strings.dictionary_encode_cached)."""
+    from ..ops.strings import dictionary_encode_cached
+    return dictionary_encode_cached(col)
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +151,17 @@ class _Bound:
         self.side_inputs: dict[str, Column] = {}
         self.string_cols: dict[str, Column] = {} # gathered at materialize
         self.dictionaries: dict[str, tuple[str, ...]] = {}
+        #: input string columns not yet shadowed by a project — the set
+        #: string-literal predicates may be rewritten against.
+        self._live_strcols: set[str] = set()
+        #: dictionary-encoded key columns still holding their codes (a
+        #: project redefining the name drops it — the vocabulary no
+        #: longer describes the values).
+        self._live_dictkeys: set[str] = set()
+        #: string-valued names produced inside the plan (join string
+        #: payloads, first/last string aggregates) — carried by rowid
+        #: indirection, so expressions cannot touch them.
+        self._deferred_strs: set[str] = set()
         #: hidden join-rowid column -> [(build string Column, out name)]
         self.join_string_srcs: dict[str, list] = {}
         #: state column -> (source Column, forced_nullable) for group-key
@@ -237,6 +234,8 @@ class _Bound:
         if need_rowid:
             self.exec_cols[_ROWID] = Column(
                 data=jnp.arange(self.n, dtype=jnp.int32), dtype=INT32)
+        self._live_strcols = set(self.string_cols)
+        self._live_dictkeys = set(self.dictionaries)
 
         # Rewrite string aggregations and track which state columns still
         # hold unchanged input values (so group-key domains may be probed
@@ -245,16 +244,23 @@ class _Bound:
         current_names = list(self.exec_cols) + list(self.string_cols)
         steps: list = []
         for step in plan.steps:
+            step = self._rewrite_string_predicates(step)
             self._check_string_refs(step)
             if isinstance(step, ProjectStep):
                 redefined = {nm for nm, e in step.cols
                              if not (isinstance(e, Col) and e.name == nm)}
                 passthrough -= redefined
+                self._live_strcols -= redefined
+                self._live_dictkeys -= redefined
+                self._deferred_strs -= redefined
                 for nm in redefined:
                     self.probe_sources.pop(nm, None)
                 if step.narrow:
                     passthrough &= ({nm for nm, _ in step.cols} | {_ROWID})
                     kept = {nm for nm, _ in step.cols}
+                    self._live_strcols &= kept
+                    self._live_dictkeys &= kept
+                    self._deferred_strs &= kept
                     self.probe_sources = {
                         k: v for k, v in self.probe_sources.items()
                         if k in kept}
@@ -272,6 +278,30 @@ class _Bound:
                 passthrough = set(step.keys)
                 self.probe_sources = {}
                 self._row_aligned = False
+                self._live_strcols = set()
+                # An aggregate over a dict-encoded string column yields
+                # codes from the same vocabulary when the agg is order/
+                # value-preserving — carry the vocabulary to the output
+                # name so materialization decodes it.  Arithmetic aggs
+                # over codes would be meaningless numbers; reject them.
+                agg_dicts: dict[str, tuple[str, ...]] = {}
+                for val, how, out in step.aggs:
+                    if val in self._live_dictkeys:
+                        if how in ("min", "max", "first", "last"):
+                            agg_dicts[out] = self.dictionaries[val]
+                        elif how not in ("count", "count_all", "nunique"):
+                            raise TypeError(
+                                f"aggregation {how!r} is not defined for "
+                                f"string column {val!r}")
+                self._live_dictkeys &= set(step.keys)
+                self.dictionaries.update(agg_dicts)
+                self._live_dictkeys |= set(agg_dicts)
+                # first/last string aggregates surface as user-visible
+                # string outputs backed by __strref__ surrogates (the
+                # rewritten agg's out name is "__strref__:<src>:<user>").
+                self._deferred_strs = {
+                    out.split(":", 2)[2] for _, _, out in step.aggs
+                    if out.startswith("__strref__:")}
                 current_names = (list(step.keys)
                                  + [out for _, _, out in step.aggs])
             elif isinstance(step, WindowStep):
@@ -297,6 +327,7 @@ class _Bound:
                         self.side_inputs[side_name], step.how == "left")
                 current_names += [out for _, out in meta.pays]
                 current_names += [out for _, out in meta.str_pays]
+                self._deferred_strs |= {out for _, out in meta.str_pays}
                 steps.append(step)
             elif isinstance(step, JoinShuffledStep):
                 if not self._row_aligned:
@@ -327,12 +358,120 @@ class _Bound:
                     self._row_aligned = False
                     current_names += [out for _, out in meta.pays]
                     current_names += [out for _, out in meta.str_pays]
+                    self._deferred_strs |= {out for _, out in meta.str_pays}
             else:
                 if isinstance(step, (SortStep, LimitStep)):
                     self._row_aligned = False
                 steps.append(step)
         self.steps = tuple(steps)
         self._passthrough = passthrough
+        # Materialization decodes by name (_rebuild); a vocabulary whose
+        # key name was redefined mid-plan must not survive to decode the
+        # redefined values as if they were codes.
+        self.dictionaries = {k: v for k, v in self.dictionaries.items()
+                             if k in self._live_dictkeys}
+
+    def _ensure_pred_codes(self, name: str) -> tuple[str, tuple[str, ...]]:
+        """Dictionary-encode string column ``name`` for predicate use and
+        return (codes exec-column name, sorted vocabulary).
+
+        A string *group/sort key* already lives in exec state as codes
+        under its own name (with its vocabulary in ``self.dictionaries``);
+        other string columns get a hidden ``__codes__:`` surrogate."""
+        if name in self.dictionaries:
+            return name, self.dictionaries[name]
+        surrogate = f"__codes__:{name}"
+        codes, uniq = _dict_encode_cached(self.string_cols[name])
+        if surrogate not in self.exec_cols:
+            self.exec_cols[surrogate] = codes
+        return surrogate, uniq
+
+    def _rewrite_string_predicates(self, step):
+        """Rewrite string-literal predicates onto dictionary codes.
+
+        ``col("ch").eq("web")``, ``.isin(...)``, ordered compares, and
+        null tests against *input* string columns become INT32 code
+        predicates at bind time: the vocabulary from the cached
+        dictionary encode is sorted, so ``code OP bisect(lit)`` preserves
+        lexicographic semantics, and the codes column carries the source
+        validity so null propagation is unchanged.  Strings themselves
+        still never enter the traced program."""
+        import bisect
+
+        from .expr import (BinOp, CaseWhen, Cast, Col, Expr, FillNull, IsIn,
+                           Lit, UnOp)
+
+        # Rewritable names: live (not yet redefined) input string columns,
+        # plus string group/sort keys still riding as codes under their
+        # own name (a project redefining the name drops it from both).
+        strcols = self._live_strcols | self._live_dictkeys
+
+        def always_false(codes_name: str) -> Expr:
+            # ne(c, c): False where valid, null where null.
+            return BinOp("ne", Col(codes_name), Col(codes_name))
+
+        def always_true(codes_name: str) -> Expr:
+            return BinOp("eq", Col(codes_name), Col(codes_name))
+
+        def cmp(name: str, op: str, value: str) -> Expr:
+            from ..ops.strings import scalar_cut
+            codes_name, uniq = self._ensure_pred_codes(name)
+            kind, k = scalar_cut(op, value, uniq)
+            if kind == "const":
+                return (always_true(codes_name) if k
+                        else always_false(codes_name))
+            return BinOp(kind, Col(codes_name), Lit(k))
+
+        from .expr import FLIP_CMP as _FLIP
+
+        def rw(e: Expr) -> Expr:
+            if isinstance(e, BinOp):
+                l, r = e.left, e.right
+                if (isinstance(l, Col) and l.name in strcols
+                        and isinstance(r, Lit) and isinstance(r.value, str)):
+                    return cmp(l.name, e.op, r.value)
+                if (isinstance(r, Col) and r.name in strcols
+                        and isinstance(l, Lit) and isinstance(l.value, str)):
+                    return cmp(r.name, _FLIP.get(e.op, e.op), l.value)
+                return BinOp(e.op, rw(l), rw(r))
+            if isinstance(e, IsIn):
+                if (isinstance(e.operand, Col) and e.operand.name in strcols
+                        and all(isinstance(v, str) for v in e.values)):
+                    codes_name, uniq = self._ensure_pred_codes(e.operand.name)
+                    idxs = []
+                    for v in e.values:
+                        i = bisect.bisect_left(uniq, v)
+                        if i < len(uniq) and uniq[i] == v:
+                            idxs.append(i)
+                    if not idxs:
+                        return always_false(codes_name)
+                    return IsIn(Col(codes_name), tuple(sorted(idxs)))
+                return IsIn(rw(e.operand), e.values)
+            if isinstance(e, UnOp):
+                if (e.op in ("is_null", "is_valid")
+                        and isinstance(e.operand, Col)
+                        and e.operand.name in strcols):
+                    codes_name, _ = self._ensure_pred_codes(e.operand.name)
+                    return UnOp(e.op, Col(codes_name))
+                return UnOp(e.op, rw(e.operand))
+            if isinstance(e, FillNull):
+                return FillNull(rw(e.operand), e.value)
+            if isinstance(e, Cast):
+                return Cast(rw(e.operand), e.to)
+            if isinstance(e, CaseWhen):
+                branches = tuple((rw(c), rw(v)) for c, v in e.branches)
+                default = None if e.default is None else rw(e.default)
+                return CaseWhen(branches, default)
+            return e
+
+        if isinstance(step, FilterStep):
+            return FilterStep(rw(step.pred))
+        if isinstance(step, ProjectStep):
+            cols = tuple((nm, e if (isinstance(e, Col) and e.name == nm)
+                          else rw(e))
+                         for nm, e in step.cols)
+            return ProjectStep(cols, step.narrow)
+        return step
 
     def _check_string_refs(self, step) -> None:
         """String columns never enter the traced program, so expressions
@@ -346,13 +485,17 @@ class _Bound:
             exprs = [e for nm, e in step.cols
                      if not (isinstance(e, Col) and e.name == nm)]
         for e in exprs:
-            bad = references(e) & set(self.string_cols)
+            # Live sets, not all input string names: a project may have
+            # legitimately redefined a string name to a numeric column.
+            bad = references(e) & (self._live_strcols | self._deferred_strs)
             if bad:
                 raise TypeError(
                     f"string column(s) {sorted(bad)} cannot be used in plan "
                     f"expressions (strings pass through plans by indirection; "
-                    f"compute string predicates eagerly with ops.strings and "
-                    f"feed the result in as a column)")
+                    f"only literal predicates on input string columns rewrite "
+                    f"onto dictionary codes — compute other string "
+                    f"expressions eagerly with ops.strings, or filter the "
+                    f"build table before the join)")
 
     def _rewrite_string_aggs(self, step: GroupAggStep) -> GroupAggStep:
         """String value columns can't flow through the program; rewrite
@@ -403,7 +546,10 @@ class _Bound:
                         for _, how, _ in step.aggs)
         sizes: list[int] = []
         for name, hint in zip(step.keys, step.domains):
-            dictionary = self.dictionaries.get(name)
+            # A vocabulary only describes the key while the name still
+            # holds its codes (a project may have redefined it).
+            dictionary = (self.dictionaries.get(name)
+                          if name in self._live_dictkeys else None)
             # Metadata may only come from a bind-time-known source: an
             # unchanged input column, or a join payload's (small)
             # build-side column.  A redefined key's nullability/dtype are
@@ -447,9 +593,7 @@ class _Bound:
                 dense = False
             size = (hi - lo + 1) + (1 if nullable else 0)
             sizes.append(size)
-            keys.append(_KeyMeta(name, lo, hi, nullable,
-                                 dictionary if name in self.dictionaries else None,
-                                 dtype))
+            keys.append(_KeyMeta(name, lo, hi, nullable, dictionary, dtype))
         cells = 1
         for s in sizes:
             cells *= s
@@ -1017,7 +1161,8 @@ def _rebuild(bound: _Bound, out_cols: dict[str, Column]) -> Table:
     rowid = out_cols.get(_ROWID)
     result: dict[str, Column] = {}
     for name, c in out_cols.items():
-        if name == _ROWID or name.startswith("__valid__:"):
+        if (name == _ROWID or name.startswith("__valid__:")
+                or name.startswith("__codes__:")):
             continue
         if name in bound.join_string_srcs:
             # Hidden join rowid: gather each build-side string payload at

@@ -112,6 +112,27 @@ class Expr:
         including decimal scale arithmetic) inside the plan program."""
         return Cast(self, to)
 
+    # membership / ranges --------------------------------------------------
+    def isin(self, values) -> "Expr":
+        """SQL ``IN (v1, v2, ...)`` against a static literal list.
+
+        Evaluated as one vectorized membership test (no per-value OR
+        chain); null operand rows stay null, mirroring Spark's semantics
+        when the IN list itself has no nulls."""
+        if isinstance(values, (str, bytes)):
+            raise TypeError(
+                "isin() takes a list of values, not a bare string — "
+                f"isin({values!r}) would test per-character membership; "
+                f"write isin([{values!r}])")
+        vals = tuple(values)
+        if not vals:
+            raise ValueError("isin() needs at least one value")
+        return IsIn(self, vals)
+
+    def between(self, lo, hi) -> "Expr":
+        """SQL ``BETWEEN lo AND hi`` (inclusive both ends)."""
+        return (self >= lo) & (self <= hi)
+
 
 @dataclass(frozen=True)
 class Col(Expr):
@@ -150,6 +171,39 @@ class Cast(Expr):
     to: object                  # DType (hashable; part of the plan key)
 
 
+@dataclass(frozen=True)
+class IsIn(Expr):
+    operand: Expr
+    values: tuple               # static literal list (hashable plan-key part)
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expr):
+    """SQL ``CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] [ELSE d] END``.
+
+    Built with :func:`when`; a missing ``otherwise`` yields null rows
+    where no branch matches (Spark semantics).  Branches are evaluated
+    as nested ``if_else`` selects — first matching branch wins."""
+    #: ((condition, value), ...) in priority order
+    branches: tuple
+    #: the ELSE expression, or None for null
+    default: object
+
+    def when(self, cond, value) -> "CaseWhen":
+        return CaseWhen(self.branches + ((_wrap(cond), _wrap(value)),),
+                        self.default)
+
+    def otherwise(self, value) -> "CaseWhen":
+        if self.default is not None:
+            raise ValueError("otherwise() already set")
+        return CaseWhen(self.branches, _wrap(value))
+
+
+def when(cond, value) -> CaseWhen:
+    """Start a CASE WHEN chain: ``when(c, v).when(c2, v2).otherwise(d)``."""
+    return CaseWhen(((_wrap(cond), _wrap(value)),), None)
+
+
 def col(name: str) -> Col:
     return Col(name)
 
@@ -161,11 +215,19 @@ def lit(value: Scalar) -> Lit:
 def _wrap(x) -> Expr:
     if isinstance(x, Expr):
         return x
-    if isinstance(x, (bool, int, float)):
+    if isinstance(x, (bool, int, float, str)):
+        # str literals are only meaningful against string columns; the plan
+        # binder rewrites such predicates onto dictionary codes at bind
+        # time (compile._rewrite_string_predicates).
         return Lit(x)
     raise TypeError(f"cannot use {type(x).__name__} in a plan expression "
                     f"(wrap columns with col(), scalars are auto-wrapped)")
 
+
+#: comparison-operator mirror for flipped operand order (shared with the
+#: plan binder's string-predicate rewrite, compile._rewrite_string_predicates)
+FLIP_CMP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+            "eq": "eq", "ne": "ne"}
 
 _OP_SYMBOLS = {"add": "+", "sub": "-", "mul": "*", "truediv": "/",
                "floordiv": "//", "mod": "%", "pow": "**",
@@ -183,6 +245,14 @@ def render(expr: Expr) -> str:
         return f"coalesce({render(expr.operand)}, {expr.value!r})"
     if isinstance(expr, Cast):
         return f"cast({render(expr.operand)} as {expr.to!r})"
+    if isinstance(expr, IsIn):
+        vals = ", ".join(repr(v) for v in expr.values)
+        return f"({render(expr.operand)} IN ({vals}))"
+    if isinstance(expr, CaseWhen):
+        parts = " ".join(f"WHEN {render(c)} THEN {render(v)}"
+                         for c, v in expr.branches)
+        tail = f" ELSE {render(expr.default)}" if expr.default is not None else ""
+        return f"(CASE {parts}{tail} END)"
     if isinstance(expr, UnOp):
         if expr.op == "is_null":
             return f"({render(expr.operand)} IS NULL)"
@@ -211,6 +281,15 @@ def references(expr: Expr) -> set[str]:
         return references(expr.operand)
     if isinstance(expr, BinOp):
         return references(expr.left) | references(expr.right)
+    if isinstance(expr, IsIn):
+        return references(expr.operand)
+    if isinstance(expr, CaseWhen):
+        out = set()
+        for c, v in expr.branches:
+            out |= references(c) | references(v)
+        if expr.default is not None:
+            out |= references(expr.default)
+        return out
     raise TypeError(f"not an expression: {expr!r}")
 
 
@@ -248,6 +327,126 @@ def evaluate(expr: Expr, env: dict[str, Column]) -> Column:
             return is_valid(operand)
         return unary_op(operand, expr.op)
     if isinstance(expr, BinOp):
-        return binary_op(evaluate(expr.left, env),
-                         evaluate(expr.right, env), expr.op)
+        lv = evaluate(expr.left, env)
+        rv = evaluate(expr.right, env)
+        from ..dtypes import STRING
+        if (isinstance(lv, Column) and lv.dtype == STRING
+                and isinstance(rv, str)):
+            from ..ops.strings import compare_scalar
+            return compare_scalar(lv, rv, expr.op)
+        if (isinstance(rv, Column) and rv.dtype == STRING
+                and isinstance(lv, str)):
+            from ..ops.strings import compare_scalar
+            return compare_scalar(rv, lv, FLIP_CMP[expr.op])
+        return binary_op(lv, rv, expr.op)
+    if isinstance(expr, IsIn):
+        return _eval_isin(expr, env)
+    if isinstance(expr, CaseWhen):
+        return _eval_case(expr, env)
     raise TypeError(f"not an expression: {expr!r}")
+
+
+def _eval_isin(expr: IsIn, env: dict[str, Column]) -> Column:
+    from ..dtypes import STRING
+    from ..ops.binary import binary_op
+
+    operand = evaluate(expr.operand, env)
+    if not isinstance(operand, Column):
+        raise TypeError("isin needs a column operand")
+    if operand.dtype == STRING:
+        from ..ops.strings import isin_scalar_list
+        return isin_scalar_list(operand, expr.values)
+    # One eq per distinct value, OR-reduced through binary_op — the list
+    # is static and small (an IN list), so this stays a handful of fused
+    # VPU compares, and each compare gets binary_op's type promotion and
+    # null semantics (a 1.5 literal against an INT64 column matches
+    # nothing instead of silently truncating to 1).
+    hit = None
+    for v in sorted(set(expr.values)):
+        h = binary_op(operand, v, "eq")
+        hit = h if hit is None else binary_op(hit, h, "or")
+    return hit
+
+
+def _eval_case(expr: CaseWhen, env: dict[str, Column]) -> Column:
+    from ..column import Column as Col_, all_null_column
+    from ..ops.binary import if_else
+
+    conds = [evaluate(c, env) for c, _ in expr.branches]
+    vals = [evaluate(v, env) for _, v in expr.branches]
+    for c in conds:
+        if not isinstance(c, Col_):
+            raise TypeError("CASE WHEN condition must involve a column")
+    def _scalar_dtype(*scalars):
+        from ..dtypes import BOOL8, FLOAT64, INT64
+        if any(isinstance(s, float) for s in scalars):
+            return FLOAT64
+        if all(isinstance(s, bool) for s in scalars):
+            return BOOL8
+        return INT64
+
+    if expr.default is not None:
+        acc = evaluate(expr.default, env)
+    else:
+        # No ELSE: rows with no matching branch are null.  Infer the null
+        # column's dtype from the first column-valued branch, else from
+        # the python scalar types of the branch values.
+        proto = next((v for v in vals if isinstance(v, Col_)), None)
+        if proto is not None:
+            acc = all_null_column(proto.dtype, len(proto))
+        else:
+            acc = all_null_column(_scalar_dtype(*vals), len(conds[0]))
+
+    # Branch-result promotion (Spark CASE coerces all branches to one
+    # type): without it, if_else's "dtype of the first column operand"
+    # rule silently truncates a float branch against an int column, or a
+    # wide-int branch against a narrow-int column.  Decimal branches are
+    # left alone (scale semantics live in ops.cast; mixed decimal CASEs
+    # should cast explicitly).
+    import numpy as np
+
+    from ..dtypes import FLOAT64
+    from ..ops.cast import cast as cast_op
+    everything = vals + [acc]
+    col_vals = [v for v in everything if isinstance(v, Col_)]
+    scal_vals = [v for v in everything if not isinstance(v, Col_)]
+    if any(isinstance(s, str) for s in scal_vals):
+        raise TypeError(
+            "string-valued CASE branches are not supported in plan "
+            "expressions (strings pass through plans by indirection); "
+            "build the string column eagerly with ops.strings, or CASE "
+            "over small-int tags and decode after materialization")
+    any_decimal = any(v.dtype.is_decimal for v in col_vals)
+    any_float = (any(isinstance(s, float) for s in scal_vals)
+                 or any(v.dtype.is_floating for v in col_vals))
+    if not any_decimal and col_vals:
+        if any_float and any(not v.dtype.is_floating for v in col_vals):
+            vals = [cast_op(v, FLOAT64)
+                    if isinstance(v, Col_) and v.dtype != FLOAT64 else v
+                    for v in vals]
+            if isinstance(acc, Col_) and acc.dtype != FLOAT64:
+                acc = cast_op(acc, FLOAT64)
+        elif not any_float:
+            # All-integer/bool branches: widen every column to the widest
+            # integer dtype present so no branch wraps.
+            int_dts = [v.dtype for v in col_vals if v.dtype.is_integer]
+            if int_dts:
+                widest = max(int_dts,
+                             key=lambda d: np.dtype(d.jnp_dtype).itemsize)
+                vals = [cast_op(v, widest)
+                        if isinstance(v, Col_) and v.dtype.is_integer
+                        and v.dtype != widest else v
+                        for v in vals]
+                if (isinstance(acc, Col_) and acc.dtype.is_integer
+                        and acc.dtype != widest):
+                    acc = cast_op(acc, widest)
+
+    for c, v in zip(reversed(conds), reversed(vals)):
+        if not isinstance(v, Col_) and not isinstance(acc, Col_):
+            # Both branch value and accumulator are scalars: materialize
+            # the accumulator so if_else has a column to shape against.
+            import jax.numpy as jnp
+            dt = _scalar_dtype(v, acc)
+            acc = Col_(data=jnp.full(len(c), acc, dt.jnp_dtype), dtype=dt)
+        acc = if_else(c, v, acc)
+    return acc

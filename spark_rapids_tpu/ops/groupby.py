@@ -135,6 +135,14 @@ def groupby_agg(table: Table, keys: Sequence[str],
                                        validity=col.validity,
                                        dtype=DType(TypeId.INT8)))
                 continue
+            if how in ("min", "max") and col.offsets is not None:
+                # min/max of strings = min/max of dictionary codes (the
+                # vocabulary is sorted lexicographically); decoded after
+                # aggregation.
+                from .strings import dictionary_encode_cached
+                codes, _uniq = dictionary_encode_cached(col)
+                _ensure_payload(f"__codes__:{value_name}", codes)
+                continue
             kind = ("strings" if col.offsets is not None else "decimal128")
             raise TypeError(
                 f"aggregation {how!r} is not defined for {kind} "
@@ -160,6 +168,9 @@ def groupby_agg(table: Table, keys: Sequence[str],
             if how in ("count", "count_all"):
                 spec.append((pay_names.index(f"__validity__:{value_name}"),
                              how, int(TypeId.INT8), 0))
+            elif how in ("min", "max") and col.offsets is not None:
+                spec.append((pay_names.index(f"__codes__:{value_name}"),
+                             how, int(TypeId.INT32), 0))
             continue
         spec.append((pay_names.index(value_name), how,
                      int(col.dtype.type_id), col.dtype.scale))
@@ -204,6 +215,26 @@ def groupby_agg(table: Table, keys: Sequence[str],
                 and how in ("first", "last"):
             idx = starts if how == "first" else ends
             out.append((out_name, col.gather(jnp.take(perm, idx))))
+            continue
+        if col.offsets is not None and how in ("min", "max"):
+            from .strings import dictionary_encode_cached, strings_from_pylist
+            _codes, uniq = dictionary_encode_cached(col)
+            data, validity = results[ri]
+            ri += 1
+            if not uniq:
+                from ..column import all_null_column
+                out.append((out_name, all_null_column(col.dtype, num_groups)))
+                continue
+            dict_col = strings_from_pylist(list(uniq))
+            idx = jnp.clip(data[:num_groups].astype(jnp.int32), 0,
+                           len(uniq) - 1)
+            s = dict_col.gather(idx)
+            if validity is not None:
+                v = (validity[:num_groups] if s.validity is None
+                     else s.validity & validity[:num_groups])
+                s = Column(data=s.data, offsets=s.offsets, validity=v,
+                           dtype=s.dtype)
+            out.append((out_name, s))
             continue
         data, validity = results[ri]
         ri += 1

@@ -572,6 +572,96 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
     return codes_col, uniques
 
 
+# dictionary-encode memo keyed on (chars, offsets, validity) buffer
+# identities — all three define string content+nulls.  Shared by the plan
+# binder (exec.compile) and the eager scalar predicates below, so a CASE
+# WHEN with several conditions on one column factorizes it exactly once.
+_ENCODE_CACHE: dict = {}
+
+
+def dictionary_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
+    from ..exec.stats import _guarded_cache_get, _guarded_cache_put
+    buffers = tuple(b for b in (col.data, col.offsets, col.validity)
+                    if b is not None)
+    key = tuple(id(b) for b in buffers)
+    hit = _guarded_cache_get(_ENCODE_CACHE, key, buffers)
+    if hit is None:
+        codes, uniq = dictionary_encode(col)
+        hit = (codes, tuple(uniq))
+        _guarded_cache_put(_ENCODE_CACHE, key, buffers, hit)
+    return hit
+
+
+def scalar_cut(op: str, value: str, uniq) -> tuple:
+    """Map (comparison op, literal, sorted vocabulary) to a code-space
+    predicate — THE single definition shared by the eager path
+    (:func:`compare_scalar`) and the plan binder's bind-time rewrite
+    (exec.compile._rewrite_string_predicates), so the two cannot
+    desynchronize.
+
+    Returns ``("const", bool)`` when the predicate is constant over all
+    valid rows, else ``(code_op, k)`` with ``code_op`` in eq/ne/lt/ge to
+    apply against the INT32 codes."""
+    import bisect
+
+    if op in ("eq", "ne"):
+        i = bisect.bisect_left(uniq, value)
+        present = i < len(uniq) and uniq[i] == value
+        if not present:
+            return ("const", op == "ne")
+        return (op, i)
+    if op in ("lt", "ge"):
+        k = bisect.bisect_left(uniq, value)
+    elif op in ("le", "gt"):
+        k = bisect.bisect_right(uniq, value)
+    else:
+        raise ValueError(f"string comparison op {op!r} not supported")
+    if op in ("lt", "le"):
+        return ("const", False) if k == 0 else ("lt", k)
+    return ("const", True) if k == 0 else ("ge", k)
+
+
+def compare_scalar(col: Column, value: str, op: str) -> Column:
+    """Row-wise comparison of a string column against one literal.
+
+    ``op`` is eq/ne/lt/le/gt/ge with byte-wise lexicographic order (the
+    same order ``dictionary_encode`` sorts by; the cutpoint logic is
+    shared with the plan binder via :func:`scalar_cut`).  Null rows stay
+    null."""
+    from ..dtypes import BOOL8
+
+    codes, uniq = dictionary_encode_cached(col)
+    data = codes.data
+    kind, k = scalar_cut(op, value, uniq)
+    if kind == "const":
+        mask = jnp.full(data.shape, bool(k), jnp.bool_)
+    elif kind == "eq":
+        mask = data == k
+    elif kind == "ne":
+        mask = data != k
+    elif kind == "lt":
+        mask = data < k
+    else:
+        mask = data >= k
+    return Column(data=mask, validity=codes.validity, dtype=BOOL8)
+
+
+def isin_scalar_list(col: Column, values) -> Column:
+    """Membership of each row in a static list of string literals."""
+    import bisect
+
+    from ..dtypes import BOOL8
+
+    codes, uniq = dictionary_encode_cached(col)
+    data = codes.data
+    hit = jnp.zeros(data.shape, jnp.bool_)
+    for v in values:
+        i = bisect.bisect_left(uniq, v)
+        if i < len(uniq) and uniq[i] == v:
+            hit = hit | (data == i)
+    return Column(data=hit, validity=codes.validity, dtype=BOOL8)
+
+
 def fill_null_strings(col: Column, value: str) -> Column:
     """Replace null rows with ``value`` (cudf ``replace_nulls`` for strings).
 

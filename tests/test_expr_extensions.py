@@ -1,0 +1,247 @@
+"""Expression-IR extensions: isin / between / CASE WHEN / string predicates.
+
+Oracle strategy mirrors test_exec.py: the compiled plan must equal the
+same pipeline run step-by-step through the eager ops layer — string
+predicates in particular take two different routes (bind-time dictionary
+rewrite vs eager ``ops.strings.compare_scalar``) and must agree.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.exec import col, lit, plan, when
+from spark_rapids_tpu.exec.compile import run_plan_eager
+from spark_rapids_tpu.exec.expr import render
+
+
+def _table(rng, n=500):
+    words = ["web", "store", "catalog", "outlet", ""]
+    svals = [None if rng.random() < 0.15 else words[rng.integers(0, 5)]
+             for _ in range(n)]
+    return Table([
+        ("k", Column.from_numpy(rng.integers(0, 6, n).astype(np.int32),
+                                validity=rng.random(n) > 0.1)),
+        ("v", Column.from_numpy(rng.integers(-100, 100, n).astype(np.int64),
+                                validity=rng.random(n) > 0.1)),
+        ("f", Column.from_numpy(rng.normal(size=n))),
+        ("ch", Column.from_pylist(svals, dt.STRING)),
+    ])
+
+
+def _check(p, t, **kw):
+    got = p.run(t)
+    want = run_plan_eager(p, t)
+    assert_tables_equal(want, got, **kw)
+
+
+class TestIsInBetween:
+    def test_isin_ints(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("k").isin([1, 3, 5])), t)
+
+    def test_isin_null_rows_drop(self, rng):
+        t = _table(rng)
+        out = plan().filter(col("k").isin([0, 1, 2, 3, 4, 5])).run(t)
+        # nulls in k are neither in nor out -> dropped by the filter
+        assert out.num_rows == int(np.asarray(t["k"].valid_mask()).sum())
+
+    def test_isin_empty_list_raises(self):
+        with pytest.raises(ValueError):
+            col("k").isin([])
+
+    def test_between(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("v").between(-10, 40)), t)
+
+    def test_isin_project(self, rng):
+        t = _table(rng)
+        _check(plan().with_columns(hit=col("k").isin([2, 4])), t)
+
+
+class TestCaseWhen:
+    def test_case_scalar_branches(self, rng):
+        t = _table(rng)
+        e = (when(col("v") > 50, 2).when(col("v") > 0, 1).otherwise(0))
+        _check(plan().with_columns(bucket=e), t)
+
+    def test_case_no_otherwise_is_null(self, rng):
+        t = _table(rng)
+        p = plan().with_columns(b=when(col("v") > 0, 1))
+        out = p.run(t)
+        vm = np.asarray(t["v"].valid_mask())
+        vd = np.asarray(t["v"].data.astype(np.int64))
+        hit = vm & (vd > 0)
+        got_valid = np.asarray(out["b"].valid_mask())
+        np.testing.assert_array_equal(got_valid, hit)
+        _check(p, t)
+
+    def test_case_column_branches(self, rng):
+        t = _table(rng)
+        e = when(col("f") > 0.0, col("v")).otherwise(-col("v"))
+        _check(plan().with_columns(w=e), t)
+
+    def test_case_in_aggregation(self, rng):
+        t = _table(rng)
+        p = (plan()
+             .with_columns(web_v=when(col("ch").eq("web"), col("v"))
+                           .otherwise(0))
+             .groupby_agg(["k"], [("web_v", "sum", "wsum")])
+             .sort_by(["k"]))
+        _check(p, t)
+
+    def test_double_otherwise_raises(self):
+        e = when(col("v") > 0, 1).otherwise(0)
+        with pytest.raises(ValueError):
+            e.otherwise(2)
+
+    def test_render(self):
+        e = when(col("v") > 0, 1).otherwise(0)
+        s = render(e)
+        assert "CASE" in s and "ELSE" in s
+        assert "IN" in render(col("k").isin([1, 2]))
+
+
+class TestStringPredicates:
+    def test_eq_literal(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("ch").eq("web")), t)
+
+    def test_ne_literal(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("ch").ne("store")), t)
+
+    def test_eq_absent_literal(self, rng):
+        t = _table(rng)
+        out = plan().filter(col("ch").eq("nosuch")).run(t)
+        assert out.num_rows == 0
+
+    def test_ne_absent_literal_keeps_valid(self, rng):
+        t = _table(rng)
+        out = plan().filter(col("ch").ne("nosuch")).run(t)
+        assert out.num_rows == int(np.asarray(t["ch"].valid_mask()).sum())
+
+    def test_ordered_literal(self, rng):
+        t = _table(rng)
+        for op in ("__lt__", "__le__", "__gt__", "__ge__"):
+            _check(plan().filter(getattr(col("ch"), op)("outlet")), t)
+
+    def test_reversed_operands(self, rng):
+        t = _table(rng)
+        _check(plan().filter(lit("outlet") > col("ch")), t)
+
+    def test_isin_strings(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("ch").isin(["web", "catalog", "nosuch"])), t)
+
+    def test_is_null_string(self, rng):
+        t = _table(rng)
+        _check(plan().filter(col("ch").is_null()), t)
+        _check(plan().filter(col("ch").is_valid()), t)
+
+    def test_string_filter_then_groupby(self, rng):
+        t = _table(rng)
+        p = (plan()
+             .filter(col("ch").isin(["web", "store"]))
+             .groupby_agg(["k"], [("v", "sum", "vs"),
+                                  ("v", "count", "nv")])
+             .sort_by(["k"]))
+        _check(p, t)
+
+    def test_string_key_postagg_filter(self, rng):
+        t = _table(rng)
+        p = (plan()
+             .groupby_agg(["ch"], [("v", "sum", "vs")])
+             .filter(col("ch").eq("web")))
+        _check(p, t)
+
+    def test_case_when_string_cond(self, rng):
+        t = _table(rng)
+        e = (when(col("ch").eq("web"), col("v"))
+             .when(col("ch").eq("store"), -col("v"))
+             .otherwise(0))
+        _check(plan().with_columns(signed=e), t)
+
+
+class TestReviewRegressions:
+    """Silent-wrong-result cases found by code review of this feature."""
+
+    def test_isin_float_literal_on_int_column(self, rng):
+        # 1.5 must not truncate to 1: no int row can equal it.
+        t = _table(rng)
+        out = plan().filter(col("v").isin([1.5])).run(t)
+        assert out.num_rows == 0
+        _check(plan().filter(col("v").isin([1.0, 3.5, 7.0])), t)
+
+    def test_redefined_dict_key_is_not_a_string(self, rng):
+        # Sorting by a string key dictionary-encodes it; a later project
+        # redefining the name to a numeric column must make string
+        # literal predicates stop rewriting against the stale vocabulary.
+        t = _table(rng)
+        p = (plan().sort_by(["ch"])
+             .with_columns(ch=col("v"))
+             .filter(col("ch") > 0))
+        _check(p, t)
+
+    def test_case_float_scalar_promotes_int_column(self, rng):
+        t = _table(rng)
+        p = plan().with_columns(x=when(col("v") > 0, 1.5).otherwise(col("v")))
+        out = p.run(t)
+        assert out["x"].dtype.is_floating
+        vd = np.asarray(t["v"].data)
+        vm = np.asarray(t["v"].valid_mask())
+        i = int(np.nonzero(vm & (vd > 0))[0][0])
+        assert out["x"].to_pylist()[i] == 1.5
+        _check(p, t)
+
+    def test_string_min_max_agg_decodes(self, rng):
+        # A dict-encoded sort key aggregated with min/max must decode
+        # back to strings at materialize, even under a different name.
+        t = _table(rng)
+        p = (plan().sort_by(["ch"])
+             .groupby_agg(["k"], [("ch", "min", "ch_min"),
+                                  ("ch", "max", "ch")])
+             .sort_by(["k"]))
+        out = p.run(t)
+        assert out["ch_min"].dtype == dt.STRING
+        assert out["ch"].dtype == dt.STRING
+        _check(p, t)
+
+    def test_string_sum_agg_raises(self, rng):
+        t = _table(rng)
+        p = plan().sort_by(["ch"]).groupby_agg(["k"], [("ch", "sum", "s")])
+        with pytest.raises(TypeError, match="not defined for string"):
+            p.run(t)
+
+    def test_case_mixed_int_widths_widen(self, rng):
+        t = _table(rng)
+        p = plan().with_columns(
+            x=when(col("f") > 0.0, col("k")).otherwise(col("v")))
+        out = p.run(t)
+        assert out["x"].dtype == dt.INT64
+        _check(p, t)
+
+    def test_isin_bare_string_raises(self):
+        with pytest.raises(TypeError, match="bare string"):
+            col("ch").isin("web")
+
+    def test_case_string_branch_raises_cleanly(self, rng):
+        t = _table(rng)
+        p = plan().with_columns(
+            tier=when(col("v") > 0, "gold").otherwise("base"))
+        with pytest.raises(TypeError, match="string-valued CASE"):
+            p.run(t)
+
+    def test_join_string_payload_predicate_raises_cleanly(self, rng):
+        t = _table(rng)
+        dims = Table([
+            ("dk", Column.from_numpy(np.arange(6, dtype=np.int32))),
+            ("dname", Column.from_pylist(
+                ["a", "b", "c", "d", "e", "f"], dt.STRING)),
+        ])
+        p = (plan()
+             .join_broadcast(dims, left_on="k", right_on="dk")
+             .filter(col("dname").eq("b")))
+        with pytest.raises(TypeError, match="cannot be used in plan"):
+            p.run(t)
