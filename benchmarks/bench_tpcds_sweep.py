@@ -1,0 +1,69 @@
+"""TPC-DS query-bank sweep: queries/hr on one chip.
+
+BASELINE.json's north-star metric is "TPC-DS SF1000 queries/hr"; this
+bench runs the implemented bank (spark_rapids_tpu/models/tpcds_queries)
+end to end — generation excluded, compile included only in the warm-up
+pass — and reports steady-state queries/hr, the compile-once execution
+model a Spark plan cache gives the reference system.
+
+Protocol per the repo's tunneled-TPU measurement rules (BASELINE.md):
+each query materializes its result (host sync) every iteration, so the
+timed loop is fence-accurate by construction; the warm-up pass absorbs
+per-program tunnel load cost (~30s/program first time, ~0 after).
+
+Usage: python benchmarks/bench_tpcds_sweep.py [sf_rows] [passes]
+Prints one JSON line {"metric", "value", "unit", "per_query"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    sf_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    passes = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.models.tpcds_queries import QUERIES
+
+    t0 = time.time()
+    d = tpcds.generate(sf_rows)
+    print(f"# generated sf_rows={sf_rows} in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    # Warm-up: compile + load every program once.
+    t0 = time.time()
+    for nm, fn in QUERIES.items():
+        t1 = time.time()
+        fn(d)
+        print(f"# warm {nm}: {time.time() - t1:.2f}s", file=sys.stderr)
+    print(f"# warm pass total {time.time() - t0:.1f}s", file=sys.stderr)
+
+    per_query: dict[str, float] = {}
+    t_all = time.time()
+    n_runs = 0
+    for _ in range(passes):
+        for nm, fn in QUERIES.items():
+            t1 = time.time()
+            fn(d)
+            per_query[nm] = per_query.get(nm, 0.0) + (time.time() - t1)
+            n_runs += 1
+    wall = time.time() - t_all
+    qph = n_runs / wall * 3600.0
+
+    print(json.dumps({
+        "metric": "tpcds_bank_queries_per_hour",
+        "value": round(qph, 1),
+        "unit": "queries/hr",
+        "sf_rows": sf_rows,
+        "queries": len(QUERIES),
+        "per_query_s": {k: round(v / passes, 3)
+                        for k, v in sorted(per_query.items())},
+    }))
+
+
+if __name__ == "__main__":
+    main()

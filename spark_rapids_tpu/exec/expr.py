@@ -87,12 +87,13 @@ class Expr:
     def ne(self, other) -> "Expr":
         return BinOp("ne", self, _wrap(other))
 
-    # boolean -------------------------------------------------------------
+    # boolean (SQL three-valued logic: true|null=true, false&null=false —
+    # Spark's WHERE-clause semantics, cudf NULL_LOGICAL_AND/OR) ----------
     def __and__(self, other):
-        return BinOp("and", self, _wrap(other))
+        return BinOp("and_kleene", self, _wrap(other))
 
     def __or__(self, other):
-        return BinOp("or", self, _wrap(other))
+        return BinOp("or_kleene", self, _wrap(other))
 
     def __invert__(self):
         return UnOp("not", self)
@@ -232,7 +233,8 @@ FLIP_CMP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
 _OP_SYMBOLS = {"add": "+", "sub": "-", "mul": "*", "truediv": "/",
                "floordiv": "//", "mod": "%", "pow": "**",
                "eq": "=", "ne": "!=", "lt": "<", "le": "<=",
-               "gt": ">", "ge": ">=", "and": "&", "or": "|"}
+               "gt": ">", "ge": ">=", "and": "&", "or": "|",
+               "and_kleene": "&", "or_kleene": "|"}
 
 
 def render(expr: Expr) -> str:

@@ -271,6 +271,13 @@ def trace_join(cols, sel, side, meta: JoinMeta):
     new = dict(cols)
     for side_name, out_name in meta.pays:
         pay = side[side_name]
+        if meta.dim_rows == 0:
+            # Empty build side (a dimension filter matched nothing): no
+            # probe row is `found`, so payload values never surface —
+            # but the gather itself must not read an empty axis.
+            from ..column import all_null_column
+            new[out_name] = all_null_column(pay.dtype, n)
+            continue
         data = jnp.take(pay.data, dimrow, axis=0)
         validity = (None if pay.validity is None
                     else jnp.take(pay.validity, dimrow))

@@ -1,0 +1,425 @@
+"""TPC-DS-shaped schema and synthetic data generator.
+
+The reference system's north-star workload is TPC-DS on Spark
+(/root/repo/BASELINE.json: "distributed shuffle: full TPC-DS SF1000
+99-query sweep"); the reference repo itself ships no query engine — the
+queries arrive as Spark physical plans and the native library executes
+their columnar fragments (SURVEY.md §0).  This module provides the data
+half of that workload for the TPU engine: a scale-parameterized star
+schema with TPC-DS's table shapes (three sales channels + returns facts,
+conformed dimensions), realistic key skew, null fractions, and the
+string/date/demographic attributes the query bank
+(:mod:`.tpcds_queries`) filters on.
+
+It is a *shape-faithful synthetic*, not dsdgen: per-table row counts
+follow the spec's relative scaling but values are drawn from compact
+vocabularies so that correctness oracles (pandas re-implementations in
+tests/test_tpcds.py) stay tractable.  Column subsets cover what the
+query bank touches; extending a query usually means adding a column
+here first.
+
+Scale parameter: ``sf_rows`` = store_sales row count.  The other tables
+scale relative to it the way TPC-DS scales relative to SF.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+import numpy as np
+
+from ..column import Column
+from ..dtypes import STRING
+from ..table import Table
+
+# -- vocabularies (compact stand-ins for dsdgen's) --------------------------
+
+CATEGORIES = ("Books", "Electronics", "Home", "Jewelry", "Music",
+              "Shoes", "Sports", "Women")
+CLASSES = tuple(f"class{i:02d}" for i in range(16))
+BRANDS = tuple(f"brand#{i:03d}" for i in range(50))
+STATES = ("CA", "GA", "IL", "NY", "TX", "TN", "OH", "WA")
+COUNTIES = tuple(f"{s} County {i}" for s in ("Fair", "Rich", "Walker",
+                                             "Ziebach") for i in range(2))
+CITIES = ("Midway", "Fairview", "Oak Grove", "Glendale", "Centerville",
+          "Springdale", "Shiloh", "Pleasant Hill")
+GENDERS = ("M", "F")
+MARITAL = ("M", "S", "D", "W", "U")
+EDUCATION = ("Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown")
+BUY_POTENTIAL = (">10000", "5001-10000", "1001-5000", "501-1000",
+                 "0-500", "Unknown")
+DAY_NAMES = ("Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday")
+FIRST_NAMES = tuple(f"First{i:03d}" for i in range(64))
+LAST_NAMES = tuple(f"Last{i:03d}" for i in range(64))
+COMPANIES = ("pri", "able", "ought", "eing", "bar", "cally")
+
+
+@dataclass
+class TpcdsData:
+    """The generated star schema (every member is a :class:`Table`)."""
+
+    store_sales: Table
+    web_sales: Table
+    catalog_sales: Table
+    store_returns: Table
+    web_returns: Table
+    date_dim: Table
+    time_dim: Table
+    item: Table
+    store: Table
+    customer: Table
+    customer_address: Table
+    customer_demographics: Table
+    household_demographics: Table
+    promotion: Table
+    web_site: Table
+    warehouse: Table
+
+    def names(self):
+        return [f.name for f in fields(self)]
+
+
+def _col_i64(rng, lo, hi, n, null_frac=0.0):
+    data = rng.integers(lo, hi, n).astype(np.int64)
+    validity = None if null_frac == 0 else rng.random(n) >= null_frac
+    return Column.from_numpy(data, validity=validity)
+
+
+def _col_f64(rng, lo, hi, n, null_frac=0.0):
+    data = np.round(rng.uniform(lo, hi, n), 2)
+    validity = None if null_frac == 0 else rng.random(n) >= null_frac
+    return Column.from_numpy(data, validity=validity)
+
+
+def _col_vocab(rng, vocab, n, null_frac=0.0, weights=None):
+    idx = rng.choice(len(vocab), size=n, p=weights)
+    vals = [vocab[i] for i in idx]
+    if null_frac:
+        nulls = rng.random(n) < null_frac
+        vals = [None if dead else v for v, dead in zip(vals, nulls)]
+    return Column.from_pylist(vals, STRING)
+
+
+def _skewed_fk(rng, n_keys, n, null_frac=0.02):
+    """Foreign keys with zipf-ish skew (hot dimension members), 1-based;
+    a few percent null like dsdgen's nullable FK columns."""
+    raw = rng.zipf(1.3, size=n)
+    keys = ((raw - 1) % n_keys + 1).astype(np.int64)
+    # blend with uniform so every key appears
+    uni = rng.integers(1, n_keys + 1, n)
+    take_uni = rng.random(n) < 0.5
+    keys = np.where(take_uni, uni, keys)
+    validity = None if null_frac == 0 else rng.random(n) >= null_frac
+    return Column.from_numpy(keys, validity=validity)
+
+
+#: first date_sk; date_sk walks day-by-day over two years (1998-1999),
+#: mirroring the spec's Julian-style surrogate keys.
+DATE_SK0 = 2450815
+N_DAYS = 730
+
+
+def _date_dim() -> Table:
+    sk = np.arange(DATE_SK0, DATE_SK0 + N_DAYS, dtype=np.int64)
+    day_index = np.arange(N_DAYS)
+    year = np.where(day_index < 365, 1998, 1999).astype(np.int64)
+    doy = day_index % 365
+    # 12 months of 30 days + a 5-day remainder folded into December:
+    # synthetic calendar, consistent across year/moy/dom/week/quarter.
+    moy = np.minimum(doy // 30, 11).astype(np.int64) + 1
+    dom = (doy - (moy - 1) * 30 + 1).astype(np.int64)
+    dow = (day_index % 7).astype(np.int64)
+    week_seq = (day_index // 7 + 1).astype(np.int64)
+    qoy = ((moy - 1) // 3 + 1).astype(np.int64)
+    month_seq = ((year - 1998) * 12 + moy - 1).astype(np.int64)
+    return Table([
+        ("d_date_sk", Column.from_numpy(sk)),
+        ("d_year", Column.from_numpy(year)),
+        ("d_moy", Column.from_numpy(moy)),
+        ("d_dom", Column.from_numpy(dom)),
+        ("d_dow", Column.from_numpy(dow)),
+        ("d_qoy", Column.from_numpy(qoy)),
+        ("d_week_seq", Column.from_numpy(week_seq)),
+        ("d_month_seq", Column.from_numpy(month_seq)),
+        ("d_day_name", Column.from_pylist(
+            [DAY_NAMES[int(d)] for d in dow], STRING)),
+    ])
+
+
+def _time_dim() -> Table:
+    # minute granularity: 1440 rows
+    sk = np.arange(1440, dtype=np.int64)
+    return Table([
+        ("t_time_sk", Column.from_numpy(sk)),
+        ("t_hour", Column.from_numpy((sk // 60).astype(np.int64))),
+        ("t_minute", Column.from_numpy((sk % 60).astype(np.int64))),
+    ])
+
+
+def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
+    """Generate the full schema at ``sf_rows`` store_sales rows.
+
+    Table scaling mirrors TPC-DS's relative proportions: web/catalog
+    sales at ~half the store channel, returns at ~10%, dimensions at
+    spec-like cardinalities bounded below so small test scales still
+    exercise every code path (all vocab members appear, every channel
+    has rows)."""
+    rng = np.random.default_rng(seed)
+
+    n_ss = int(sf_rows)
+    n_ws = max(n_ss // 2, 64)
+    n_cs = max(n_ss // 2, 64)
+    n_sr = max(n_ss // 10, 32)
+    n_wr = max(n_ws // 10, 16)
+    n_item = max(min(n_ss // 200, 18_000), 60)
+    n_store = 12
+    n_cust = max(min(n_ss // 20, 100_000), 200)
+    n_addr = max(n_cust // 2, 100)
+    n_cd = 7 * len(GENDERS) * len(MARITAL)       # full demographic cross
+    n_hd = 7200
+    n_promo = 30
+    n_web = 6
+    n_wh = 5
+
+    # -- dimensions --------------------------------------------------------
+    date_dim = _date_dim()
+    time_dim = _time_dim()
+
+    isk = np.arange(1, n_item + 1, dtype=np.int64)
+    cat_idx = rng.integers(0, len(CATEGORIES), n_item)
+    brand_idx = rng.integers(0, len(BRANDS), n_item)
+    class_idx = rng.integers(0, len(CLASSES), n_item)
+    # id/name pairs are functionally dependent (as in dsdgen), so query
+    # results can group by the compact id and attach the name after
+    # aggregation with a small unique-key broadcast join.
+    item = Table([
+        ("i_item_sk", Column.from_numpy(isk)),
+        ("i_item_id", Column.from_pylist(
+            [f"ITEM{k:08d}" for k in isk], STRING)),
+        ("i_brand_id", Column.from_numpy(brand_idx.astype(np.int64) + 1)),
+        ("i_brand", Column.from_pylist(
+            [BRANDS[i] for i in brand_idx], STRING)),
+        ("i_category_id", Column.from_numpy(cat_idx.astype(np.int64) + 1)),
+        ("i_category", Column.from_pylist(
+            [CATEGORIES[i] for i in cat_idx], STRING)),
+        ("i_class_id", Column.from_numpy(class_idx.astype(np.int64) + 1)),
+        ("i_class", Column.from_pylist(
+            [CLASSES[i] for i in class_idx], STRING)),
+        # cyclic, not uniform-random: every manufacturer/manager id in
+        # 1..99 exists at every scale, so fixed query parameters always
+        # select a non-empty item subset
+        ("i_manufact_id", Column.from_numpy((isk % 99 + 1).astype(np.int64))),
+        ("i_manager_id", Column.from_numpy(
+            ((isk * 7) % 99 + 1).astype(np.int64))),
+        ("i_current_price", _col_f64(rng, 0.5, 100.0, n_item)),
+    ])
+
+    ssk = np.arange(1, n_store + 1, dtype=np.int64)
+    store = Table([
+        ("s_store_sk", Column.from_numpy(ssk)),
+        ("s_store_id", Column.from_pylist(
+            [f"STORE{k:04d}" for k in ssk], STRING)),
+        ("s_store_name", Column.from_pylist(
+            [f"store{k % 7}" for k in ssk], STRING)),
+        ("s_state", _col_vocab(rng, STATES, n_store)),
+        ("s_county", _col_vocab(rng, COUNTIES, n_store)),
+        ("s_city_id", Column.from_numpy(
+            (ssk % len(CITIES) + 1).astype(np.int64))),
+        ("s_city", Column.from_pylist(
+            [CITIES[int(k) % len(CITIES)] for k in ssk], STRING)),
+        ("s_zip5", _col_i64(rng, 10_000, 99_999, n_store)),
+        ("s_number_employees", _col_i64(rng, 200, 300, n_store)),
+        ("s_gmt_offset", Column.from_numpy(
+            rng.choice([-5.0, -6.0, -7.0, -8.0], n_store))),
+    ])
+
+    ask = np.arange(1, n_addr + 1, dtype=np.int64)
+    ca_state_idx = rng.integers(0, len(STATES), n_addr)
+    ca_city_idx = rng.integers(0, len(CITIES), n_addr)
+    # state/city carry an id column functionally dependent on the name
+    # (queries group/compare on the compact id and decode afterwards)
+    customer_address = Table([
+        ("ca_address_sk", Column.from_numpy(ask)),
+        ("ca_state_id", Column.from_numpy(
+            ca_state_idx.astype(np.int64) + 1)),
+        ("ca_state", Column.from_pylist(
+            [STATES[i] for i in ca_state_idx], STRING)),
+        ("ca_county", _col_vocab(rng, COUNTIES, n_addr)),
+        ("ca_city_id", Column.from_numpy(ca_city_idx.astype(np.int64) + 1)),
+        ("ca_city", Column.from_pylist(
+            [CITIES[i] for i in ca_city_idx], STRING)),
+        ("ca_zip5", _col_i64(rng, 10_000, 99_999, n_addr)),
+        ("ca_country", Column.from_pylist(
+            ["United States"] * n_addr, STRING)),
+        ("ca_gmt_offset", Column.from_numpy(
+            rng.choice([-5.0, -6.0, -7.0, -8.0], n_addr))),
+    ])
+
+    csk = np.arange(1, n_cust + 1, dtype=np.int64)
+    customer = Table([
+        ("c_customer_sk", Column.from_numpy(csk)),
+        ("c_customer_id", Column.from_pylist(
+            [f"CUST{k:010d}" for k in csk], STRING)),
+        ("c_current_addr_sk", _col_i64(rng, 1, n_addr + 1, n_cust)),
+        ("c_current_cdemo_sk", _col_i64(rng, 1, n_cd + 1, n_cust,
+                                        null_frac=0.02)),
+        ("c_current_hdemo_sk", _col_i64(rng, 1, n_hd + 1, n_cust,
+                                        null_frac=0.02)),
+        ("c_first_name", _col_vocab(rng, FIRST_NAMES, n_cust,
+                                    null_frac=0.02)),
+        ("c_last_name", _col_vocab(rng, LAST_NAMES, n_cust,
+                                   null_frac=0.02)),
+    ])
+
+    # full cross of education x gender x marital (spec: cd is a cross
+    # join of demographic attributes)
+    cd_rows = [(e, g, m) for e in EDUCATION for g in GENDERS
+               for m in MARITAL]
+    customer_demographics = Table([
+        ("cd_demo_sk", Column.from_numpy(
+            np.arange(1, len(cd_rows) + 1, dtype=np.int64))),
+        ("cd_education_status", Column.from_pylist(
+            [r[0] for r in cd_rows], STRING)),
+        ("cd_gender", Column.from_pylist([r[1] for r in cd_rows], STRING)),
+        ("cd_marital_status", Column.from_pylist(
+            [r[2] for r in cd_rows], STRING)),
+        ("cd_purchase_estimate", Column.from_numpy(
+            (np.arange(len(cd_rows)) % 10 * 1000 + 500).astype(np.int64))),
+    ])
+
+    hsk = np.arange(1, n_hd + 1, dtype=np.int64)
+    household_demographics = Table([
+        ("hd_demo_sk", Column.from_numpy(hsk)),
+        ("hd_dep_count", Column.from_numpy((hsk % 10).astype(np.int64))),
+        ("hd_vehicle_count", Column.from_numpy(
+            (hsk % 6 - 1).astype(np.int64))),
+        ("hd_buy_potential", Column.from_pylist(
+            [BUY_POTENTIAL[int(k) % len(BUY_POTENTIAL)] for k in hsk],
+            STRING)),
+    ])
+
+    psk = np.arange(1, n_promo + 1, dtype=np.int64)
+    promotion = Table([
+        ("p_promo_sk", Column.from_numpy(psk)),
+        ("p_channel_email", Column.from_pylist(
+            ["N" if k % 5 else "Y" for k in psk], STRING)),
+        ("p_channel_event", Column.from_pylist(
+            ["N" if k % 3 else "Y" for k in psk], STRING)),
+        ("p_channel_dmail", Column.from_pylist(
+            ["N" if k % 2 else "Y" for k in psk], STRING)),
+    ])
+
+    wsk = np.arange(1, n_web + 1, dtype=np.int64)
+    web_site = Table([
+        ("web_site_sk", Column.from_numpy(wsk)),
+        ("web_company_name", Column.from_pylist(
+            [COMPANIES[int(k) % len(COMPANIES)] for k in wsk], STRING)),
+    ])
+
+    whk = np.arange(1, n_wh + 1, dtype=np.int64)
+    warehouse = Table([
+        ("w_warehouse_sk", Column.from_numpy(whk)),
+        ("w_state", _col_vocab(rng, STATES, n_wh)),
+        ("w_warehouse_name", Column.from_pylist(
+            [f"Warehouse {k}" for k in whk], STRING)),
+    ])
+
+    # -- facts -------------------------------------------------------------
+    def sales_dates(n):
+        return Column.from_numpy(
+            rng.integers(DATE_SK0, DATE_SK0 + N_DAYS, n).astype(np.int64),
+            validity=rng.random(n) >= 0.01)
+
+    qty = lambda n: _col_i64(rng, 1, 100, n, null_frac=0.04)
+    price = lambda n: _col_f64(rng, 1.0, 300.0, n, null_frac=0.04)
+
+    store_sales = Table([
+        ("ss_sold_date_sk", sales_dates(n_ss)),
+        ("ss_sold_time_sk", _col_i64(rng, 0, 1440, n_ss, null_frac=0.01)),
+        ("ss_item_sk", _skewed_fk(rng, n_item, n_ss, null_frac=0.0)),
+        ("ss_customer_sk", _skewed_fk(rng, n_cust, n_ss)),
+        ("ss_cdemo_sk", _skewed_fk(rng, n_cd, n_ss)),
+        ("ss_hdemo_sk", _skewed_fk(rng, n_hd, n_ss)),
+        ("ss_addr_sk", _skewed_fk(rng, n_addr, n_ss)),
+        ("ss_store_sk", _skewed_fk(rng, n_store, n_ss)),
+        ("ss_promo_sk", _skewed_fk(rng, n_promo, n_ss)),
+        ("ss_ticket_number", _col_i64(rng, 1, max(n_ss // 3, 2), n_ss)),
+        ("ss_quantity", qty(n_ss)),
+        ("ss_sales_price", price(n_ss)),
+        ("ss_list_price", price(n_ss)),
+        ("ss_ext_sales_price", price(n_ss)),
+        ("ss_ext_discount_amt", _col_f64(rng, 0.0, 80.0, n_ss,
+                                         null_frac=0.04)),
+        ("ss_ext_wholesale_cost", price(n_ss)),
+        ("ss_ext_list_price", price(n_ss)),
+        ("ss_ext_tax", _col_f64(rng, 0.0, 25.0, n_ss, null_frac=0.04)),
+        ("ss_coupon_amt", _col_f64(rng, 0.0, 50.0, n_ss, null_frac=0.04)),
+        ("ss_net_profit", _col_f64(rng, -100.0, 200.0, n_ss,
+                                   null_frac=0.04)),
+        ("ss_net_paid", price(n_ss)),
+    ])
+
+    web_sales = Table([
+        ("ws_sold_date_sk", sales_dates(n_ws)),
+        ("ws_ship_date_sk", sales_dates(n_ws)),
+        ("ws_item_sk", _skewed_fk(rng, n_item, n_ws, null_frac=0.0)),
+        ("ws_bill_customer_sk", _skewed_fk(rng, n_cust, n_ws)),
+        ("ws_bill_addr_sk", _skewed_fk(rng, n_addr, n_ws)),
+        ("ws_web_site_sk", _skewed_fk(rng, n_web, n_ws, null_frac=0.0)),
+        ("ws_warehouse_sk", _skewed_fk(rng, n_wh, n_ws, null_frac=0.0)),
+        ("ws_order_number", _col_i64(rng, 1, max(n_ws // 4, 2), n_ws)),
+        ("ws_quantity", qty(n_ws)),
+        ("ws_ext_sales_price", price(n_ws)),
+        ("ws_ext_discount_amt", _col_f64(rng, 0.0, 80.0, n_ws,
+                                         null_frac=0.04)),
+        ("ws_ext_ship_cost", _col_f64(rng, 0.0, 60.0, n_ws,
+                                      null_frac=0.04)),
+        ("ws_net_profit", _col_f64(rng, -100.0, 200.0, n_ws,
+                                   null_frac=0.04)),
+        ("ws_net_paid", price(n_ws)),
+    ])
+
+    catalog_sales = Table([
+        ("cs_sold_date_sk", sales_dates(n_cs)),
+        ("cs_item_sk", _skewed_fk(rng, n_item, n_cs, null_frac=0.0)),
+        ("cs_bill_customer_sk", _skewed_fk(rng, n_cust, n_cs)),
+        ("cs_bill_cdemo_sk", _skewed_fk(rng, n_cd, n_cs)),
+        ("cs_promo_sk", _skewed_fk(rng, n_promo, n_cs)),
+        ("cs_quantity", qty(n_cs)),
+        ("cs_list_price", price(n_cs)),
+        ("cs_sales_price", price(n_cs)),
+        ("cs_coupon_amt", _col_f64(rng, 0.0, 50.0, n_cs, null_frac=0.04)),
+        ("cs_ext_sales_price", price(n_cs)),
+        ("cs_net_profit", _col_f64(rng, -100.0, 200.0, n_cs,
+                                   null_frac=0.04)),
+    ])
+
+    store_returns = Table([
+        ("sr_returned_date_sk", sales_dates(n_sr)),
+        ("sr_customer_sk", _skewed_fk(rng, n_cust, n_sr)),
+        ("sr_store_sk", _skewed_fk(rng, n_store, n_sr)),
+        ("sr_item_sk", _skewed_fk(rng, n_item, n_sr, null_frac=0.0)),
+        ("sr_ticket_number", _col_i64(rng, 1, max(n_ss // 3, 2), n_sr)),
+        ("sr_return_amt", _col_f64(rng, 0.5, 200.0, n_sr,
+                                   null_frac=0.02)),
+        ("sr_return_quantity", qty(n_sr)),
+    ])
+
+    web_returns = Table([
+        ("wr_order_number", _col_i64(rng, 1, max(n_ws // 4, 2), n_wr)),
+        ("wr_returned_date_sk", sales_dates(n_wr)),
+        ("wr_return_amt", _col_f64(rng, 0.5, 200.0, n_wr,
+                                   null_frac=0.02)),
+    ])
+
+    return TpcdsData(
+        store_sales=store_sales, web_sales=web_sales,
+        catalog_sales=catalog_sales, store_returns=store_returns,
+        web_returns=web_returns, date_dim=date_dim, time_dim=time_dim,
+        item=item, store=store, customer=customer,
+        customer_address=customer_address,
+        customer_demographics=customer_demographics,
+        household_demographics=household_demographics,
+        promotion=promotion, web_site=web_site, warehouse=warehouse)

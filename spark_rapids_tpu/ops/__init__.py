@@ -6,10 +6,14 @@ sort-based groupby and join (no hash tables), lax.sort multi-key sorting,
 searchsorted merge probes, prefix-sum expansions.
 """
 
-from . import datetime, reductions, window
+from . import datetime, reductions, regex, strings, window
 from .binary import binary_op, fill_null, if_else, is_null, is_valid, unary_op
 from .cast import cast
 from .common import concat_columns, concat_tables
+
+#: SQL UNION ALL over same-schema tables (an alias: the engine's
+#: row-concatenation is exactly the union-all physical op).
+union_all = concat_tables
 from .filter import apply_boolean_mask, distinct, drop_nulls
 from .groupby import groupby, groupby_agg
 from .join import join
@@ -35,9 +39,12 @@ __all__ = [
     "join",
     "lower_bound",
     "reductions",
+    "regex",
     "sort_by",
     "sorted_order",
+    "strings",
     "unary_op",
+    "union_all",
     "upper_bound",
     "window",
 ]

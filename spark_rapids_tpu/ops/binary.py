@@ -82,6 +82,7 @@ _OPS = {
 
 #: scalar-op-column forms: how to express `scalar OP col` as `col OP' ...`
 _REFLECT = {"add": "add", "mul": "mul", "and": "and", "or": "or",
+            "and_kleene": "and_kleene", "or_kleene": "or_kleene",
             "eq": "eq", "ne": "ne",
             "lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
 
@@ -104,6 +105,8 @@ def binary_op(a: Operand, b: Operand, op: str) -> Column:
                 FLOAT64 if isinstance(a, float) else INT64)
             return binary_op(lit, b, op)
         raise ValueError(f"unsupported binary op {op!r} with scalar left operand")
+    if op in ("or_kleene", "and_kleene"):
+        return _kleene(a, b, op)
     if op not in _OPS:
         raise ValueError(f"unsupported binary op {op!r}")
     _check_decimal_operands(a, b, op)
@@ -130,6 +133,41 @@ def binary_op(a: Operand, b: Operand, op: str) -> Column:
     return Column(data=res,
                   validity=_combine_validity(a, b if isinstance(b, Column) else None),
                   dtype=out_dtype)
+
+
+def _kleene(a: Column, b: Operand, op: str) -> Column:
+    """SQL three-valued AND/OR (Spark semantics; cudf's NULL_LOGICAL_AND/
+    NULL_LOGICAL_OR): ``true OR null = true``, ``false AND null = false``,
+    unlike the plain ``and``/``or`` ops which propagate nulls
+    unconditionally.  Plan expressions (exec.expr ``&``/``|``) lower to
+    these so compiled queries match Spark's WHERE-clause logic."""
+    xa = _payload(a) != 0
+    yb = _payload(b)
+    if isinstance(yb, jax.Array):
+        xb = yb != 0
+        vb = b.validity if isinstance(b, Column) else None
+    else:
+        xb = jnp.full(xa.shape, bool(yb))
+        vb = None
+    va = a.validity
+    ones = None
+    ma = va if va is not None else (ones := jnp.ones(xa.shape, jnp.bool_))
+    mb = vb if vb is not None else (ones if ones is not None
+                                    else jnp.ones(xa.shape, jnp.bool_))
+    at = ma & xa                     # definitely true
+    bt = mb & xb
+    af = ma & ~xa                    # definitely false
+    bf = mb & ~xb
+    if op == "or_kleene":
+        data = at | bt
+        validity = at | bt | (af & bf)
+    else:
+        data = ~(af | bf) & (at & bt)
+        validity = af | bf | (at & bt)
+    if va is None and vb is None:
+        validity = None
+    return Column(data=data.astype(jnp.uint8), validity=validity,
+                  dtype=BOOL8)
 
 
 # -- unary --------------------------------------------------------------------

@@ -19,9 +19,16 @@ from ..dtypes import BOOL8, DType, TypeId
 
 
 def cast(col: Column, to: DType) -> Column:
-    """Cast a fixed-width column to another fixed-width dtype."""
+    """Cast a column to another dtype (fixed-width both ways, plus the
+    Spark string casts: string -> int/float parse with null-on-malformed,
+    number -> decimal string format)."""
     if col.dtype == to:
         return col
+    from ..dtypes import STRING
+    if col.dtype == STRING:
+        return _cast_from_string(col, to)
+    if to == STRING:
+        return _cast_to_string(col)
     if not col.dtype.is_fixed_width or not to.is_fixed_width:
         raise ValueError(f"cast {col.dtype!r} -> {to!r}: both must be fixed width")
 
@@ -59,6 +66,183 @@ def cast(col: Column, to: DType) -> Column:
         data = data.astype(dst.jnp_dtype)
 
     return Column(data=data, validity=col.validity, dtype=to)
+
+
+#: widest decimal run a string parse examines (int64 max has 19 digits;
+#: longer runs are malformed -> null, the Spark non-ANSI contract)
+_PARSE_WINDOW = 24
+
+
+def _cast_from_string(col: Column, to: DType) -> Column:
+    """Parse strings to numbers, null on malformed (Spark CAST with
+    ansi=off; cudf ``to_integers``/``to_floats``).
+
+    Vectorized over a (rows, 24) window gather of the leading bytes —
+    sign, integer digits, optional '.' + fraction for floats.  Exponent
+    forms and strings longer than the window parse to null."""
+    import numpy as np
+
+    from ..dtypes import STRING
+    from .strings import _gather_window, strip
+
+    if to == STRING:
+        return col
+    s = strip(col)
+    offsets = s.offsets
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    w = _PARSE_WINDOW
+    win = _gather_window(s, offsets[:-1], w).astype(jnp.int32)
+    pos_in = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_row = pos_in < lens[:, None]
+    ch = jnp.where(in_row, win, 0)
+
+    sign_byte = ch[:, 0]
+    has_sign = (sign_byte == ord("-")) | (sign_byte == ord("+"))
+    neg = sign_byte == ord("-")
+    digit = (ch >= ord("0")) & (ch <= ord("9")) & in_row
+    dval = jnp.clip(ch - ord("0"), 0, 9).astype(jnp.int64)
+    is_dot = (ch == ord(".")) & in_row
+    body = in_row & (pos_in >= has_sign[:, None].astype(jnp.int32))
+
+    # first dot position (or row length if none)
+    big = jnp.full((), w + 1, jnp.int32)
+    dot_pos = jnp.min(jnp.where(is_dot, pos_in, big), axis=1)
+    n_dots = jnp.sum(is_dot.astype(jnp.int32), axis=1)
+
+    int_part = body & digit & (pos_in < dot_pos[:, None])
+    frac_part = body & digit & (pos_in > dot_pos[:, None])
+    n_int = jnp.sum(int_part.astype(jnp.int32), axis=1)
+    n_frac = jnp.sum(frac_part.astype(jnp.int32), axis=1)
+
+    # every body byte must be a digit or the single dot
+    body_ok = jnp.all(~body | digit | is_dot, axis=1)
+    fits = lens <= w
+
+    if to.is_floating or to.is_decimal:
+        ok = (body_ok & fits & (n_dots <= 1) & (lens > has_sign)
+              & ((n_int + n_frac) > 0))
+        # place value: the r-th integer digit (1-based from the left, of
+        # n_int total) scales by 10^(n_int - r); the r-th fraction digit
+        # by 10^-r
+        int_rank = jnp.cumsum(int_part.astype(jnp.int32), axis=1)
+        frac_rank = jnp.cumsum(frac_part.astype(jnp.int32), axis=1)
+        fint = jnp.sum(jnp.where(
+            int_part,
+            dval.astype(jnp.float64)
+            * 10.0 ** (n_int[:, None] - int_rank).astype(jnp.float64),
+            0.0), axis=1)
+        ffrac = jnp.sum(jnp.where(
+            frac_part,
+            dval.astype(jnp.float64)
+            * 10.0 ** (-frac_rank).astype(jnp.float64),
+            0.0), axis=1)
+        val = jnp.where(neg, -(fint + ffrac), fint + ffrac)
+        validity = ok if s.validity is None else (s.validity & ok)
+        if to.is_decimal:
+            scaled = jnp.trunc(val * (10.0 ** -to.scale))
+            return Column(data=scaled.astype(to.jnp_dtype),
+                          validity=validity, dtype=to)
+        return Column(data=val.astype(to.jnp_dtype), validity=validity,
+                      dtype=to)
+
+    if to == BOOL8:
+        # Spark accepts true/false/t/f/y/n/yes/no/0/1 — cover the common
+        # true/false/0/1 forms via a round trip through lowercase compare
+        raise ValueError("cast string -> bool is not supported; compare "
+                         "against literals instead")
+
+    # integer targets: digits only, no dot
+    ok = (body_ok & fits & (n_dots == 0) & (n_int > 0)
+          & (n_int <= 19) & (lens > has_sign))
+    int_rank = jnp.cumsum(int_part.astype(jnp.int32), axis=1)
+    pow10 = jnp.asarray(
+        np.concatenate([[0], 10 ** np.arange(19, dtype=np.int64)]),
+        jnp.int64)
+    place = jnp.take(pow10, jnp.clip(n_int[:, None] - int_rank + 1, 0, 19))
+    val = jnp.sum(jnp.where(int_part, dval * place, 0), axis=1)
+    val = jnp.where(neg, -val, val)
+    validity = ok if s.validity is None else (s.validity & ok)
+    return Column(data=val.astype(to.jnp_dtype), validity=validity,
+                  dtype=to)
+
+
+def _cast_to_string(col: Column) -> Column:
+    """Format numbers as decimal strings, device-side.
+
+    Integers (and bools, and decimals via their unscaled value + scale
+    point insertion) format with a digit matrix + pack; floats take a
+    host-assisted round trip (shortest round-trip float repr is a
+    sequential algorithm — a documented deviation, matching how the
+    engine host-assists dictionary encodes)."""
+    import numpy as np
+
+    from ..dtypes import STRING
+    from .strings import _offsets_from_lens, strings_from_pylist
+
+    if col.dtype.is_floating:
+        data, validity = col.to_numpy()
+        vals = [repr(float(v)) for v in data]
+        out = strings_from_pylist(vals)
+        return out.with_validity(
+            None if validity is None else jnp.asarray(validity))
+    if col.dtype == BOOL8:
+        data, validity = col.to_numpy()
+        out = strings_from_pylist(
+            ["true" if v else "false" for v in data])
+        return out.with_validity(
+            None if validity is None else jnp.asarray(validity))
+    if col.dtype.is_two_word:
+        raise ValueError("cast decimal128 -> string: cast to decimal64 "
+                         "first")
+
+    scale = col.dtype.scale if col.dtype.is_decimal else 0
+    if scale > 0:
+        # positive scale multiplies the unscaled value; format the logical
+        # integer directly
+        v = col.data.astype(jnp.int64) * (10 ** scale)
+        scale = 0
+    else:
+        v = col.data.astype(jnp.int64)
+    frac_digits = -scale
+    neg = v < 0
+    mag = jnp.abs(v)
+
+    # digit count of the magnitude (>= 1)
+    pow10 = jnp.asarray(10 ** np.arange(19, dtype=np.int64), jnp.int64)
+    ndig = jnp.sum((mag[:, None] >= pow10[None, :]).astype(jnp.int32),
+                   axis=1)
+    ndig = jnp.maximum(ndig, 1)
+    # ensure enough digits to cover the fraction + a leading zero
+    ndig = jnp.maximum(ndig, frac_digits + 1)
+    out_lens = ndig + neg.astype(jnp.int32) + (1 if frac_digits else 0)
+    new_offsets = _offsets_from_lens(out_lens)
+    total = int(new_offsets[-1])
+    if total == 0:
+        return Column(data=jnp.zeros(0, jnp.uint8), validity=col.validity,
+                      offsets=new_offsets, dtype=STRING)
+    from .strings import _row_ids
+    pos = jnp.arange(total, dtype=jnp.int32)
+    row = _row_ids(new_offsets, total)
+    rel = pos - jnp.take(new_offsets, row)
+    rneg = jnp.take(neg, row)
+    rnd = jnp.take(ndig, row)
+    rmag = jnp.take(mag, row)
+    # layout: [-] d ... d [. d ... d]; digit index from the left among
+    # ndig digits, skipping the sign and the point
+    di = rel - rneg.astype(jnp.int32)
+    if frac_digits:
+        point_at = rnd - frac_digits + rneg.astype(jnp.int32)
+        is_point = rel == point_at
+        di = jnp.where(rel > point_at, di - 1, di)
+    else:
+        is_point = jnp.zeros(total, jnp.bool_)
+    # value of digit i (from left): mag // 10^(ndig-1-i) % 10
+    exp = jnp.clip(rnd - 1 - di, 0, 18)
+    digit = (rmag // jnp.take(pow10, exp)) % 10
+    chars = jnp.where(is_point, ord("."), ord("0") + digit)
+    chars = jnp.where(rneg & (rel == 0), ord("-"), chars)
+    return Column(data=chars.astype(jnp.uint8), validity=col.validity,
+                  offsets=new_offsets, dtype=STRING)
 
 
 def _rescale(unscaled, from_scale: int, to_scale: int):

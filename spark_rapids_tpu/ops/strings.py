@@ -489,6 +489,226 @@ def like(col: Column, pattern: str, escape: str = "\\") -> Column:
     return matches_re(col, "".join(out))
 
 
+def _strip_counts(col: Column, chars: str, leading: bool, trailing: bool):
+    """Per-row (new_start_delta, new_length) after stripping the byte set
+    ``chars`` from the requested ends, computed on the flat buffer."""
+    data = col.data
+    total = data.shape[0]
+    offsets = col.offsets
+    n = col.size
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    if total == 0:
+        z = jnp.zeros(n, jnp.int32)
+        return z, lens
+    pats = np.frombuffer(chars.encode("utf-8"), np.uint8)
+    wide = data.astype(jnp.int32)
+    strippable = jnp.zeros(total, jnp.bool_)
+    for b in np.unique(pats):
+        strippable = strippable | (wide == int(b))
+    keep = ~strippable
+    row = _row_ids(offsets, total)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    idx_in_row = pos - jnp.take(offsets, row)
+    big = jnp.iinfo(jnp.int32).max
+    first_keep = jnp.full(n, big, jnp.int32).at[row].min(
+        jnp.where(keep, idx_in_row, big))
+    last_keep = jnp.full(n, -1, jnp.int32).at[row].max(
+        jnp.where(keep, idx_in_row, -1))
+    all_strip = last_keep < 0
+    # All-strippable rows strip to "": start collapses to the row end
+    # (leading) or end to the row start (trailing); max(end-start, 0)
+    # covers the both-sides case.
+    start = (jnp.where(all_strip, lens, first_keep) if leading
+             else jnp.zeros(n, jnp.int32))
+    end = (jnp.where(all_strip, 0, last_keep + 1) if trailing else lens)
+    return start, jnp.maximum(end - start, 0)
+
+
+def _restrip(col: Column, chars: str, leading: bool,
+             trailing: bool) -> Column:
+    start, new_len = _strip_counts(col, chars, leading, trailing)
+    new_offsets = _offsets_from_lens(new_len)
+    chars_out = _segment_gather(col.data, col.offsets[:-1] + start,
+                                new_offsets)
+    return Column(data=chars_out, validity=col.validity,
+                  offsets=new_offsets, dtype=STRING)
+
+
+def strip(col: Column, chars: str = " \t\n\r") -> Column:
+    """cudf ``strip`` / Spark ``trim``: remove leading+trailing bytes."""
+    return _restrip(col, chars, True, True)
+
+
+def lstrip(col: Column, chars: str = " \t\n\r") -> Column:
+    return _restrip(col, chars, True, False)
+
+
+def rstrip(col: Column, chars: str = " \t\n\r") -> Column:
+    return _restrip(col, chars, False, True)
+
+
+def _padded(col: Column, width: int, fill: str, left: bool) -> Column:
+    """Shared lpad/rpad: rows shorter than ``width`` gain fill bytes."""
+    if len(fill) != 1:
+        raise ValueError("pad fill must be a single byte")
+    fb = int(fill.encode("utf-8")[0])
+    offsets = col.offsets
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    out_lens = jnp.maximum(lens, width)
+    new_offsets = _offsets_from_lens(out_lens)
+    total = int(new_offsets[-1])
+    if total == 0:
+        return Column(data=jnp.zeros(0, jnp.uint8), validity=col.validity,
+                      offsets=new_offsets, dtype=STRING)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    row = _row_ids(new_offsets, total)
+    rel = pos - jnp.take(new_offsets, row)
+    rlen = jnp.take(lens, row)
+    pad = jnp.take(out_lens, row) - rlen
+    src_rel = rel - pad if left else rel
+    from_src = (src_rel >= 0) & (src_rel < rlen)
+    src = jnp.take(offsets, row) + jnp.clip(src_rel, 0, None)
+    safe = jnp.clip(src, 0, max(col.data.shape[0] - 1, 0))
+    chars = jnp.where(from_src,
+                      jnp.take(col.data, safe).astype(jnp.int32),
+                      fb).astype(jnp.uint8)
+    return Column(data=chars, validity=col.validity, offsets=new_offsets,
+                  dtype=STRING)
+
+
+def lpad(col: Column, width: int, fill: str = " ") -> Column:
+    return _padded(col, width, fill, True)
+
+
+def rpad(col: Column, width: int, fill: str = " ") -> Column:
+    return _padded(col, width, fill, False)
+
+
+def zfill(col: Column, width: int) -> Column:
+    return _padded(col, width, "0", True)
+
+
+def repeat_strings(col: Column, times: int) -> Column:
+    """cudf ``repeat_strings``: each row repeated ``times`` times."""
+    if times < 0:
+        raise ValueError("times must be >= 0")
+    offsets = col.offsets
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    out_lens = lens * times
+    new_offsets = _offsets_from_lens(out_lens)
+    total = int(new_offsets[-1])
+    if total == 0:
+        return Column(data=jnp.zeros(0, jnp.uint8), validity=col.validity,
+                      offsets=new_offsets, dtype=STRING)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    row = _row_ids(new_offsets, total)
+    rel = pos - jnp.take(new_offsets, row)
+    rlen = jnp.maximum(jnp.take(lens, row), 1)
+    src = jnp.take(offsets, row) + rel % rlen
+    return Column(data=jnp.take(col.data, src), validity=col.validity,
+                  offsets=new_offsets, dtype=STRING)
+
+
+def reverse_strings(col: Column) -> Column:
+    """Byte-wise row reversal (equals cudf ``reverse`` for ASCII)."""
+    offsets = col.offsets
+    total = int(offsets[-1])
+    if total == 0:
+        return col
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    row = _row_ids(offsets, total)
+    rel = pos - jnp.take(offsets, row)
+    src = jnp.take(offsets, row) + jnp.take(lens, row) - 1 - rel
+    return Column(data=jnp.take(col.data, src), validity=col.validity,
+                  offsets=offsets, dtype=STRING)
+
+
+def _active_matches(col: Column, pat: np.ndarray) -> jax.Array:
+    """Left-to-right non-overlapping match starts (SQL replace scan).
+
+    When the pattern cannot overlap itself (no proper KMP border), raw
+    matches are provably non-overlapping and the vectorized hit mask is
+    exact.  Self-overlapping patterns ("aa", "abab") resolve greedily
+    with a chunked countdown scan over the flat buffer."""
+    hits, _row, _pos = _flat_hits(col, pat)
+    k = len(pat)
+    if k <= 1:
+        return hits
+    # KMP border check on host: does any proper prefix equal a suffix?
+    self_overlaps = any(
+        np.array_equal(pat[:i], pat[len(pat) - i:]) for i in range(1, k))
+    if not self_overlaps:
+        return hits
+    total = hits.shape[0]
+
+    def body(countdown, h):
+        active = h & (countdown == 0)
+        countdown = jnp.where(active, k - 1,
+                              jnp.maximum(countdown - 1, 0))
+        return countdown, active
+
+    _, active = jax.lax.scan(body, jnp.zeros((), jnp.int32), hits)
+    return active
+
+
+def replace_strings(col: Column, old: str, new: str) -> Column:
+    """Literal find-and-replace (cudf ``replace`` / Spark ``replace``):
+    left-to-right non-overlapping occurrences of ``old`` become ``new``.
+
+    Expansion-based: per input byte an emission width (0 inside a match,
+    len(new) at a match start, 1 elsewhere), then one scatter-indicator
+    prefix-sum pass maps output bytes back to sources — the same
+    O(total-bytes) formulation as every other var-width rebuild here."""
+    pat = np.frombuffer(old.encode("utf-8"), np.uint8)
+    rep = np.frombuffer(new.encode("utf-8"), np.uint8)
+    k, m = len(pat), len(rep)
+    if k == 0:
+        raise ValueError("replace pattern must be non-empty")
+    data = col.data
+    total = data.shape[0]
+    if total == 0:
+        return col
+    active = _active_matches(col, pat)
+    # coverage: byte b is inside a match iff an active start lies in
+    # (b-k, b] — diff-array trick, cumsum > 0.
+    diff = jnp.zeros(total + k, jnp.int32)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    diff = diff.at[pos].add(active.astype(jnp.int32))
+    diff = diff.at[pos + k].add(-active.astype(jnp.int32))
+    covered = jnp.cumsum(diff[:total]) > 0
+    width = jnp.where(active, m, jnp.where(covered, 0, 1))
+    out_start = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(width, dtype=jnp.int32)])   # (total+1,)
+    out_total = int(out_start[-1])
+
+    # per-row output offsets: prefix sums of width at row boundaries
+    new_offsets = jnp.take(out_start, col.offsets)
+
+    if out_total == 0:
+        return Column(data=jnp.zeros(0, jnp.uint8), validity=col.validity,
+                      offsets=new_offsets, dtype=STRING)
+    # map each output byte to its emitting input byte: scatter-max each
+    # emitter's index at its output start (emitters have distinct
+    # starts), then a running max carries it across the emission
+    seed = jnp.zeros(out_total, jnp.int32).at[
+        jnp.clip(out_start[:-1], 0, out_total - 1)].max(
+            jnp.where((width > 0) & (out_start[:-1] < out_total),
+                      pos + 1, 0))
+    src_b = jax.lax.cummax(seed) - 1
+    opos = jnp.arange(out_total, dtype=jnp.int32)
+    rel = opos - jnp.take(out_start[:-1], src_b)
+    is_rep = jnp.take(active, src_b)
+    rep_arr = (jnp.asarray(rep, jnp.int32) if m
+               else jnp.zeros(1, jnp.int32))
+    rep_char = jnp.take(rep_arr, jnp.clip(rel, 0, max(m - 1, 0)))
+    lit_char = jnp.take(data, jnp.take(pos, src_b)).astype(jnp.int32)
+    chars = jnp.where(is_rep, rep_char, lit_char).astype(jnp.uint8)
+    return Column(data=chars, validity=col.validity, offsets=new_offsets,
+                  dtype=STRING)
+
+
 def concat_columns(cols: list[Column]) -> Column:
     """Concatenate string columns row-wise (axis 0)."""
     offsets_parts = [np.asarray(cols[0].offsets)]

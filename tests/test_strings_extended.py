@@ -1,0 +1,159 @@
+"""String-envelope extensions: strip/pad/replace/repeat/reverse and
+string <-> number casts.  Oracle: plain Python string/number semantics
+row by row (Spark/cuDF behavior where they differ is noted per test)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, dtypes as dt
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.ops.cast import cast as _cast
+
+
+def _col(vals):
+    return S.strings_from_pylist(vals)
+
+
+def _out(col):
+    return S.strings_to_pylist(col)
+
+
+CASES = ["  hello  ", "world", "", "  ", "xxhixx", None, "a b c",
+         "\tmix \n", "x"]
+
+
+class TestStripPad:
+    def test_strip(self):
+        c = _col(CASES)
+        assert _out(S.strip(c)) == [None if v is None else v.strip()
+                                    for v in CASES]
+
+    def test_lstrip_rstrip(self):
+        c = _col(CASES)
+        assert _out(S.lstrip(c)) == [None if v is None else v.lstrip()
+                                     for v in CASES]
+        assert _out(S.rstrip(c)) == [None if v is None else v.rstrip()
+                                     for v in CASES]
+
+    def test_strip_custom_chars(self):
+        c = _col(["xxabcxx", "xbx", "xxx", None, "abc"])
+        assert _out(S.strip(c, "x")) == ["abc", "b", "", None, "abc"]
+
+    def test_pad(self):
+        vals = ["ab", "abcdef", "", None, "x"]
+        c = _col(vals)
+        assert _out(S.lpad(c, 4)) == [None if v is None else v.rjust(4)
+                                      for v in vals]
+        assert _out(S.rpad(c, 4)) == [None if v is None else v.ljust(4)
+                                      for v in vals]
+        assert _out(S.zfill(c, 3)) == [None if v is None else v.rjust(3, "0")
+                                       for v in vals]
+
+
+class TestReplaceRepeatReverse:
+    def test_replace_simple(self):
+        vals = ["banana", "ana", "", None, "nanana", "xyz"]
+        c = _col(vals)
+        assert _out(S.replace_strings(c, "na", "X")) == \
+            [None if v is None else v.replace("na", "X") for v in vals]
+
+    def test_replace_grow(self):
+        vals = ["a-b-c", "-", "abc", None]
+        c = _col(vals)
+        assert _out(S.replace_strings(c, "-", "<->")) == \
+            [None if v is None else v.replace("-", "<->") for v in vals]
+
+    def test_replace_shrink_to_empty(self):
+        vals = ["a--b--c", "--", "abc", None]
+        c = _col(vals)
+        assert _out(S.replace_strings(c, "--", "")) == \
+            [None if v is None else v.replace("--", "") for v in vals]
+
+    def test_replace_self_overlapping(self):
+        # "aaa".replace("aa") must consume greedily left-to-right
+        vals = ["aaa", "aaaa", "aa", "a", None, "baaab"]
+        c = _col(vals)
+        assert _out(S.replace_strings(c, "aa", "z")) == \
+            [None if v is None else v.replace("aa", "z") for v in vals]
+
+    def test_repeat(self):
+        vals = ["ab", "", None, "xyz"]
+        c = _col(vals)
+        assert _out(S.repeat_strings(c, 3)) == \
+            [None if v is None else v * 3 for v in vals]
+        assert _out(S.repeat_strings(c, 0)) == \
+            [None if v is None else "" for v in vals]
+
+    def test_reverse(self):
+        vals = ["abc", "", None, "ab"]
+        c = _col(vals)
+        assert _out(S.reverse_strings(c)) == \
+            [None if v is None else v[::-1] for v in vals]
+
+
+class TestStringToNumber:
+    def test_to_int64(self):
+        vals = ["123", "-45", "+7", "0", "  42  ", "12.5", "abc", "",
+                None, "9223372036854775807", "99999999999999999999999999"]
+        c = _col(vals)
+        out = _cast(c, dt.INT64)
+        want = [123, -45, 7, 0, 42, None, None, None, None,
+                9223372036854775807, None]
+        assert out.to_pylist() == want
+
+    def test_to_int32(self):
+        c = _col(["11", "-3", "x"])
+        out = _cast(c, dt.INT32)
+        assert out.to_pylist() == [11, -3, None]
+        assert out.dtype == dt.INT32
+
+    def test_to_float64(self):
+        vals = ["1.5", "-2.25", "3", ".5", "5.", "1.2.3", "e5", None,
+                "  -0.75 "]
+        c = _col(vals)
+        out = _cast(c, dt.FLOAT64)
+        want = [1.5, -2.25, 3.0, 0.5, 5.0, None, None, None, -0.75]
+        got = out.to_pylist()
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            if w is None:
+                assert g is None
+            else:
+                assert g == pytest.approx(w)
+
+    def test_to_decimal(self):
+        c = _col(["12.345", "-1.5", "bad"])
+        out = _cast(c, dt.decimal64(-2))
+        # decimal64 scale -2: unscaled = trunc(value * 100)
+        assert out.data.tolist()[:2] == [1234, -150]
+        assert out.to_pylist()[2] is None
+
+
+class TestNumberToString:
+    def test_int64_to_string(self):
+        vals = [0, 7, -13, 123456, -9223372036854775808 + 1, None]
+        c = Column.from_pylist(vals, dt.INT64)
+        out = _cast(c, dt.STRING)
+        assert S.strings_to_pylist(out) == \
+            [None if v is None else str(v) for v in vals]
+
+    def test_decimal_to_string(self):
+        c = Column.from_numpy(np.asarray([1234, -150, 5], np.int64),
+                              dtype=dt.decimal64(-2))
+        out = _cast(c, dt.STRING)
+        assert S.strings_to_pylist(out) == ["12.34", "-1.50", "0.05"]
+
+    def test_bool_float_to_string(self):
+        b = Column.from_pylist([True, False, None], dt.BOOL8)
+        assert S.strings_to_pylist(_cast(b, dt.STRING)) == \
+            ["true", "false", None]
+        f = Column.from_pylist([1.5, None], dt.FLOAT64)
+        assert S.strings_to_pylist(_cast(f, dt.STRING)) == \
+            ["1.5", None]
+
+    def test_roundtrip(self):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-10**12, 10**12, 500).tolist() + [None, 0]
+        c = Column.from_pylist(vals, dt.INT64)
+        back = _cast(_cast(c, dt.STRING), dt.INT64)
+        assert back.to_pylist() == vals

@@ -1,0 +1,605 @@
+"""TPC-DS query-bank oracle tests.
+
+Every bank query runs at a small scale and is checked against an
+independent pandas re-implementation of the same semantics (the bank
+must not be its own oracle; mirrors the reference strategy of full-table
+equality against a known-good engine, SURVEY.md §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpcds
+from spark_rapids_tpu.models.tpcds_queries import QUERIES
+
+SF_ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpcds.generate(SF_ROWS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def pdf(data):
+    """The same tables as pandas DataFrames (None -> NaN/NA)."""
+    out = {}
+    for nm in data.names():
+        t = getattr(data, nm)
+        out[nm] = pd.DataFrame(
+            {c: pd.array(t[c].to_pylist()) for c in t.names})
+    return out
+
+
+def _assert_frame(got, want, float_cols=(), sort_check_cols=None):
+    """Compare a result Table against a pandas frame column-by-column.
+
+    ``sort_check_cols``: when the query's ORDER BY includes a float key,
+    ties (and float rounding) can legally reorder rows; pass the subset
+    of columns that define a total order to re-sort both sides before
+    comparison."""
+    got_df = pd.DataFrame({c: pd.array(got[c].to_pylist())
+                           for c in got.names})
+    assert set(got_df.columns) == set(want.columns), \
+        f"columns: {sorted(got_df.columns)} vs {sorted(want.columns)}"
+    want = want[list(got_df.columns)]     # engine column order wins
+    assert len(got_df) == len(want), f"rows: {len(got_df)} vs {len(want)}"
+    if sort_check_cols:
+        got_df = got_df.sort_values(sort_check_cols).reset_index(drop=True)
+        want = want.sort_values(sort_check_cols).reset_index(drop=True)
+    else:
+        want = want.reset_index(drop=True)
+    for c in want.columns:
+        g, w = got_df[c], want[c]
+        if c in float_cols:
+            gn = g.isna().to_numpy(dtype=bool)
+            wn = w.isna().to_numpy(dtype=bool)
+            np.testing.assert_array_equal(gn, wn, err_msg=f"nulls in {c}")
+            np.testing.assert_allclose(
+                g.to_numpy(dtype=float)[~gn], w.to_numpy(dtype=float)[~wn],
+                rtol=1e-9, atol=1e-9, err_msg=c)
+        else:
+            assert g.tolist() == w.tolist(), f"column {c}"
+
+
+class TestBatchA:
+    def test_q3(self, data, pdf):
+        got = QUERIES["q3"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        j = (ss.merge(dd[dd.d_moy == 11][["d_date_sk", "d_year"]],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[it.i_manufact_id == 28][["i_item_sk", "i_brand_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_brand_id"], dropna=False)
+             ["ss_ext_sales_price"].sum(min_count=1).reset_index()
+             .rename(columns={"ss_ext_sales_price": "sum_agg"}))
+        g["i_brand"] = [tpcds.BRANDS[i - 1] for i in g.i_brand_id]
+        g = (g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                           ascending=[True, False, True]).head(100)
+             [["d_year", "i_brand_id", "sum_agg", "i_brand"]])
+        _assert_frame(got, g, float_cols=("sum_agg",),
+                      sort_check_cols=["d_year", "i_brand_id"])
+
+    def test_q7(self, data, pdf):
+        got = QUERIES["q7"](data)
+        ss, cd, dd, pr = (pdf["store_sales"], pdf["customer_demographics"],
+                          pdf["date_dim"], pdf["promotion"])
+        it = pdf["item"]
+        cds = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+                 & (cd.cd_education_status == "College")].cd_demo_sk
+        dds = dd[dd.d_year == 1998].d_date_sk
+        prs = pr[(pr.p_channel_email == "N")
+                 | (pr.p_channel_event == "N")].p_promo_sk
+        j = ss[ss.ss_cdemo_sk.isin(cds) & ss.ss_sold_date_sk.isin(dds)
+               & ss.ss_promo_sk.isin(prs)]
+        g = (j.groupby("ss_item_sk", dropna=False)
+             .agg(agg1=("ss_quantity", "mean"),
+                  agg2=("ss_list_price", "mean"),
+                  agg3=("ss_coupon_amt", "mean"),
+                  agg4=("ss_sales_price", "mean")).reset_index())
+        g = g.merge(it[["i_item_sk", "i_item_id"]], left_on="ss_item_sk",
+                    right_on="i_item_sk")[
+            ["ss_item_sk", "agg1", "agg2", "agg3", "agg4", "i_item_id"]]
+        g = g.sort_values("ss_item_sk").head(100)
+        _assert_frame(got, g, float_cols=("agg1", "agg2", "agg3", "agg4"))
+
+    def test_q26(self, data, pdf):
+        got = QUERIES["q26"](data)
+        cs, cd, dd, pr = (pdf["catalog_sales"], pdf["customer_demographics"],
+                          pdf["date_dim"], pdf["promotion"])
+        it = pdf["item"]
+        cds = cd[(cd.cd_gender == "F") & (cd.cd_marital_status == "M")
+                 & (cd.cd_education_status == "College")].cd_demo_sk
+        dds = dd[dd.d_year == 1999].d_date_sk
+        prs = pr[(pr.p_channel_email == "N")
+                 | (pr.p_channel_event == "N")].p_promo_sk
+        j = cs[cs.cs_bill_cdemo_sk.isin(cds) & cs.cs_sold_date_sk.isin(dds)
+               & cs.cs_promo_sk.isin(prs)]
+        g = (j.groupby("cs_item_sk", dropna=False)
+             .agg(agg1=("cs_quantity", "mean"),
+                  agg2=("cs_list_price", "mean"),
+                  agg3=("cs_coupon_amt", "mean"),
+                  agg4=("cs_sales_price", "mean")).reset_index())
+        g = g.merge(it[["i_item_sk", "i_item_id"]], left_on="cs_item_sk",
+                    right_on="i_item_sk")[
+            ["cs_item_sk", "agg1", "agg2", "agg3", "agg4", "i_item_id"]]
+        g = g.sort_values("cs_item_sk").head(100)
+        _assert_frame(got, g, float_cols=("agg1", "agg2", "agg3", "agg4"))
+
+    def test_q42(self, data, pdf):
+        got = QUERIES["q42"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        j = (ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1998)]
+                      [["d_date_sk", "d_year"]],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[it.i_manager_id == 1][["i_item_sk", "i_category_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_category_id"], dropna=False)
+             ["ss_ext_sales_price"].sum(min_count=1).reset_index()
+             .rename(columns={"ss_ext_sales_price": "sum_agg"}))
+        g["i_category"] = [tpcds.CATEGORIES[i - 1] for i in g.i_category_id]
+        g = (g.sort_values(["sum_agg", "d_year", "i_category_id"],
+                           ascending=[False, True, True]).head(100)
+             [["d_year", "i_category_id", "sum_agg", "i_category"]])
+        _assert_frame(got, g, float_cols=("sum_agg",),
+                      sort_check_cols=["d_year", "i_category_id"])
+
+    def test_q43(self, data, pdf):
+        got = QUERIES["q43"](data)
+        ss, dd, st = pdf["store_sales"], pdf["date_dim"], pdf["store"]
+        j = ss.merge(dd[dd.d_year == 1998][["d_date_sk", "d_dow"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        names = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+        for i, nm in enumerate(names):
+            j[f"{nm}_sales"] = j.ss_sales_price.where(j.d_dow == i)
+        g = (j.groupby("ss_store_sk", dropna=False)
+             .agg(**{f"{nm}_sales": (f"{nm}_sales",
+                                     lambda s: s.sum(min_count=1))
+                     for nm in names}).reset_index())
+        g = g.merge(st[["s_store_sk", "s_store_id"]], left_on="ss_store_sk",
+                    right_on="s_store_sk")[
+            ["ss_store_sk"] + [f"{nm}_sales" for nm in names]
+            + ["s_store_id"]]
+        g = g.sort_values("ss_store_sk").head(100)
+        _assert_frame(got, g,
+                      float_cols=tuple(f"{nm}_sales" for nm in names))
+
+    def test_q52(self, data, pdf):
+        got = QUERIES["q52"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        j = (ss.merge(dd[(dd.d_moy == 12) & (dd.d_year == 1998)]
+                      [["d_date_sk", "d_year"]],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[["i_item_sk", "i_brand_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_brand_id"], dropna=False)
+             ["ss_ext_sales_price"].sum(min_count=1).reset_index()
+             .rename(columns={"ss_ext_sales_price": "ext_price"}))
+        g["i_brand"] = [tpcds.BRANDS[i - 1] for i in g.i_brand_id]
+        g = (g.sort_values(["d_year", "ext_price", "i_brand_id"],
+                           ascending=[True, False, True]).head(100)
+             [["d_year", "i_brand_id", "ext_price", "i_brand"]])
+        _assert_frame(got, g, float_cols=("ext_price",),
+                      sort_check_cols=["d_year", "i_brand_id"])
+
+    def test_q55(self, data, pdf):
+        got = QUERIES["q55"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        dds = dd[(dd.d_moy == 11) & (dd.d_year == 1999)].d_date_sk
+        j = (ss[ss.ss_sold_date_sk.isin(dds)]
+             .merge(it[it.i_manager_id == 36][["i_item_sk", "i_brand_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby("i_brand_id", dropna=False)["ss_ext_sales_price"]
+             .sum(min_count=1).reset_index()
+             .rename(columns={"ss_ext_sales_price": "ext_price"}))
+        g["i_brand"] = [tpcds.BRANDS[i - 1] for i in g.i_brand_id]
+        g = (g.sort_values(["ext_price", "i_brand_id"],
+                           ascending=[False, True]).head(100)
+             [["i_brand_id", "ext_price", "i_brand"]])
+        _assert_frame(got, g, float_cols=("ext_price",),
+                      sort_check_cols=["i_brand_id"])
+
+    def test_q88(self, data, pdf):
+        got = QUERIES["q88"](data)
+        ss, hd, st, td = (pdf["store_sales"],
+                          pdf["household_demographics"], pdf["store"],
+                          pdf["time_dim"])
+        hds = hd[((hd.hd_dep_count == 3) & hd.hd_vehicle_count.between(0, 2))
+                 | ((hd.hd_dep_count == 0)
+                    & hd.hd_vehicle_count.between(1, 3))].hd_demo_sk
+        sts = st[st.s_store_name == "store3"].s_store_sk
+        j = (ss[ss.ss_hdemo_sk.isin(hds) & ss.ss_store_sk.isin(sts)]
+             .merge(td, left_on="ss_sold_time_sk", right_on="t_time_sk"))
+        j["half_id"] = ((j.t_hour - 8) * 2
+                        + (j.t_minute >= 30).astype(int) - 1)
+        j = j[j.half_id.between(0, 7)]
+        g = (j.groupby("half_id")["t_hour"].count().reset_index()
+             .rename(columns={"t_hour": "cnt"})
+             .sort_values("half_id").reset_index(drop=True))
+        g["half_id"] = g["half_id"].astype("int64")
+        g["cnt"] = g["cnt"].astype("int64")
+        _assert_frame(got, g)
+
+    def test_q96(self, data, pdf):
+        got = QUERIES["q96"](data)
+        ss, hd, st, td = (pdf["store_sales"],
+                          pdf["household_demographics"], pdf["store"],
+                          pdf["time_dim"])
+        hds = hd[hd.hd_dep_count == 7].hd_demo_sk
+        tds = td[(td.t_hour == 20) & (td.t_minute >= 30)].t_time_sk
+        sts = st[st.s_store_name == "store1"].s_store_sk
+        n = len(ss[ss.ss_hdemo_sk.isin(hds) & ss.ss_sold_time_sk.isin(tds)
+                   & ss.ss_store_sk.isin(sts)])
+        assert got["cnt"].to_pylist() == [n]
+
+
+class TestBatchB:
+    def test_q15(self, data, pdf):
+        got = QUERIES["q15"](data)
+        cs, cu, ca, dd = (pdf["catalog_sales"], pdf["customer"],
+                          pdf["customer_address"], pdf["date_dim"])
+        zips = [85669, 86197, 88274, 83405, 86475, 85392, 85460, 80348,
+                81792]
+        ca = ca.copy()
+        ca["ca_flag"] = (ca.ca_zip5.isin(zips)
+                         | ca.ca_state.isin(["CA", "WA", "GA"])).astype(int)
+        dds = dd[(dd.d_qoy == 2) & (dd.d_year == 1999)].d_date_sk
+        j = (cs.merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                      left_on="cs_bill_customer_sk",
+                      right_on="c_customer_sk")
+             .merge(ca[["ca_address_sk", "ca_zip5", "ca_flag"]],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk"))
+        j = j[j.cs_sold_date_sk.isin(dds)]
+        j = j[(j.ca_flag == 1) | (j.cs_sales_price > 500.0)]
+        g = (j.groupby("ca_zip5", dropna=False)["cs_sales_price"]
+             .sum(min_count=1).reset_index()
+             .rename(columns={"cs_sales_price": "total_price"}))
+        g = g.sort_values("ca_zip5").head(100)
+        _assert_frame(got, g, float_cols=("total_price",))
+
+    def test_q19(self, data, pdf):
+        got = QUERIES["q19"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        cu, ca, st = pdf["customer"], pdf["customer_address"], pdf["store"]
+        dds = dd[(dd.d_moy == 11) & (dd.d_year == 1998)].d_date_sk
+        j = (ss[ss.ss_sold_date_sk.isin(dds)]
+             .merge(it[it.i_manager_id == 7][["i_item_sk", "i_brand_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk")
+             .merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                    left_on="ss_customer_sk", right_on="c_customer_sk")
+             .merge(ca[["ca_address_sk", "ca_zip5"]],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk")
+             .merge(st[["s_store_sk", "s_zip5"]],
+                    left_on="ss_store_sk", right_on="s_store_sk"))
+        j = j[j.ca_zip5 != j.s_zip5]
+        g = (j.groupby("i_brand_id", dropna=False)["ss_ext_sales_price"]
+             .sum(min_count=1).reset_index()
+             .rename(columns={"ss_ext_sales_price": "ext_price"}))
+        g["i_brand"] = [tpcds.BRANDS[i - 1] for i in g.i_brand_id]
+        g = (g.sort_values(["ext_price", "i_brand_id"],
+                           ascending=[False, True]).head(100))
+        _assert_frame(got, g, float_cols=("ext_price",),
+                      sort_check_cols=["i_brand_id"])
+
+    def test_q28(self, data, pdf):
+        got = QUERIES["q28"](data)
+        ss = pdf["store_sales"].copy()
+        buckets = [(0, 5, 8.0, 4.0, 7.0), (6, 10, 9.0, 9.0, 3.0),
+                   (11, 15, 7.0, 2.0, 8.0), (16, 20, 6.0, 6.0, 6.0),
+                   (21, 25, 8.5, 1.0, 4.0), (26, 30, 9.5, 8.0, 5.0)]
+        qn = ss.ss_quantity.to_numpy(dtype=float)
+        lp = ss.ss_list_price.to_numpy(dtype=float)
+        cp = ss.ss_coupon_amt.to_numpy(dtype=float)
+        wc = ss.ss_ext_wholesale_cost.to_numpy(dtype=float)
+        bucket = np.full(len(ss), -1)
+        for i, (qlo, qhi, lpl, cpl, wcl) in enumerate(buckets):
+            cond = ((qn >= qlo) & (qn <= qhi)
+                    & (((lp >= lpl) & (lp <= lpl + 60))
+                       | ((cp >= cpl) & (cp <= cpl + 20))
+                       | ((wc >= wcl) & (wc <= wcl + 40))))
+            bucket = np.where((bucket < 0) & cond, i, bucket)
+        ss["bucket"] = bucket
+        j = ss[ss.bucket >= 0]
+        g = (j.groupby("bucket")
+             .agg(avg_lp=("ss_list_price", "mean"),
+                  cnt_lp=("ss_list_price", "count"),
+                  uniq_lp=("ss_list_price", "nunique")).reset_index()
+             .sort_values("bucket").reset_index(drop=True))
+        g["bucket"] = g.bucket.astype("int64")
+        g["cnt_lp"] = g.cnt_lp.astype("int64")
+        g["uniq_lp"] = g.uniq_lp.astype("int64")
+        _assert_frame(got, g, float_cols=("avg_lp",))
+
+    def test_q48(self, data, pdf):
+        got = QUERIES["q48"](data)
+        ss, cd, ca, dd = (pdf["store_sales"],
+                          pdf["customer_demographics"],
+                          pdf["customer_address"], pdf["date_dim"])
+        cd = cd.copy()
+        cd["cd_tag"] = np.select(
+            [(cd.cd_marital_status == "M")
+             & (cd.cd_education_status == "4 yr Degree"),
+             (cd.cd_marital_status == "D")
+             & (cd.cd_education_status == "2 yr Degree"),
+             (cd.cd_marital_status == "S")
+             & (cd.cd_education_status == "College")], [1, 2, 3], 0)
+        ca = ca.copy()
+        ca["ca_tag"] = np.select(
+            [ca.ca_state.isin(["CA", "OH", "TX"]),
+             ca.ca_state.isin(["OR", "NY", "WA"]),
+             ca.ca_state.isin(["GA", "TN", "IL"])], [1, 2, 3], 0)
+        dds = dd[dd.d_year == 1999].d_date_sk
+        j = (ss[ss.ss_sold_date_sk.isin(dds)]
+             .merge(cd[["cd_demo_sk", "cd_tag"]], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(ca[["ca_address_sk", "ca_tag"]], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        sp = j.ss_sales_price.to_numpy(dtype=float)
+        npf = j.ss_net_profit.to_numpy(dtype=float)
+        c1 = (((j.cd_tag == 1) & (sp >= 100) & (sp <= 150))
+              | ((j.cd_tag == 2) & (sp >= 50) & (sp <= 100))
+              | ((j.cd_tag == 3) & (sp >= 150) & (sp <= 200)))
+        c2 = (((j.ca_tag == 1) & (npf >= 0) & (npf <= 2000))
+              | ((j.ca_tag == 2) & (npf >= 150) & (npf <= 3000))
+              | ((j.ca_tag == 3) & (npf >= 50) & (npf <= 25000)))
+        want = j[c1 & c2].ss_quantity.sum()
+        assert got["qty_sum"].to_pylist() == [int(want)]
+
+    def test_q61(self, data, pdf):
+        got = QUERIES["q61"](data)
+        ss, dd, it, st = (pdf["store_sales"], pdf["date_dim"],
+                          pdf["item"], pdf["store"])
+        pr, cu, ca = (pdf["promotion"], pdf["customer"],
+                      pdf["customer_address"])
+        dds = dd[(dd.d_year == 1998) & (dd.d_moy == 11)].d_date_sk
+        its = it[it.i_category == "Jewelry"].i_item_sk
+        sts = st[st.s_gmt_offset == -5.0].s_store_sk
+        cas = ca[ca.ca_gmt_offset == -5.0].ca_address_sk
+        prs = pr[(pr.p_channel_dmail == "Y") | (pr.p_channel_email == "Y")
+                 | (pr.p_channel_event == "Y")].p_promo_sk
+        base = (ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_item_sk.isin(its)
+                   & ss.ss_store_sk.isin(sts)]
+                .merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                       left_on="ss_customer_sk", right_on="c_customer_sk"))
+        base = base[base.c_current_addr_sk.isin(cas)]
+        total = base.ss_ext_sales_price.sum()
+        promo = base[base.ss_promo_sk.isin(prs)].ss_ext_sales_price.sum()
+        g = got.to_pydict()
+        np.testing.assert_allclose(g["promotions"][0], promo, rtol=1e-9)
+        np.testing.assert_allclose(g["total"][0], total, rtol=1e-9)
+
+    def test_q65(self, data, pdf):
+        got = QUERIES["q65"](data)
+        ss, dd, st, it = (pdf["store_sales"], pdf["date_dim"],
+                          pdf["store"], pdf["item"])
+        dds = dd[dd.d_month_seq.between(3, 14)].d_date_sk
+        sc = (ss[ss.ss_sold_date_sk.isin(dds)]
+              .groupby(["ss_store_sk", "ss_item_sk"], dropna=False)
+              ["ss_sales_price"].sum(min_count=1).reset_index()
+              .rename(columns={"ss_sales_price": "revenue"}))
+        sb = (sc.groupby("ss_store_sk", dropna=False)["revenue"].mean()
+              .reset_index().rename(columns={"revenue": "ave"}))
+        j = sc.merge(sb, on="ss_store_sk")
+        j = j[j.revenue <= 0.1 * j.ave]
+        j = (j.merge(st[["s_store_sk", "s_store_name"]],
+                     left_on="ss_store_sk", right_on="s_store_sk")
+             .merge(it[["i_item_sk", "i_current_price"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        j = (j.sort_values(["ss_store_sk", "ss_item_sk"]).head(100)
+             [["ss_store_sk", "ss_item_sk", "revenue", "ave",
+               "s_store_name", "i_current_price"]])
+        _assert_frame(got, j, float_cols=("revenue", "ave",
+                                          "i_current_price"))
+
+    def test_q68(self, data, pdf):
+        got = QUERIES["q68"](data)
+        ss, dd, st, hd = (pdf["store_sales"], pdf["date_dim"],
+                          pdf["store"], pdf["household_demographics"])
+        cu, ca = pdf["customer"], pdf["customer_address"]
+        dds = dd[dd.d_year.isin([1998, 1999])
+                 & dd.d_dom.between(1, 2)].d_date_sk
+        sts = st[st.s_city.isin(["Midway", "Fairview"])].s_store_sk
+        hds = hd[(hd.hd_dep_count == 4)
+                 | (hd.hd_vehicle_count == 3)].hd_demo_sk
+        j = (ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_store_sk.isin(sts)
+                & ss.ss_hdemo_sk.isin(hds)]
+             .merge(ca[["ca_address_sk", "ca_city_id"]],
+                    left_on="ss_addr_sk", right_on="ca_address_sk"))
+        g = (j.groupby(["ss_ticket_number", "ss_customer_sk",
+                        "ca_city_id"], dropna=False)
+             .agg(extended_price=("ss_ext_sales_price",
+                                  lambda s: s.sum(min_count=1)),
+                  list_price=("ss_ext_list_price",
+                              lambda s: s.sum(min_count=1)),
+                  extended_tax=("ss_ext_tax",
+                                lambda s: s.sum(min_count=1)))
+             .reset_index())
+        g = (g.merge(cu[["c_customer_sk", "c_current_addr_sk",
+                         "c_first_name", "c_last_name"]],
+                     left_on="ss_customer_sk", right_on="c_customer_sk")
+             .merge(ca[["ca_address_sk", "ca_city_id"]]
+                    .rename(columns={"ca_address_sk": "__cur_addr",
+                                     "ca_city_id": "cur_city_id"}),
+                    left_on="c_current_addr_sk", right_on="__cur_addr")
+             .drop(columns=["c_customer_sk", "__cur_addr"]))
+        g = g[g.cur_city_id != g.ca_city_id]
+        g["city"] = [tpcds.CITIES[i - 1] for i in g.ca_city_id]
+        g = (g.sort_values(["ss_customer_sk", "ss_ticket_number",
+                            "ca_city_id"]).head(100))
+        _assert_frame(got, g, float_cols=("extended_price", "list_price",
+                                          "extended_tax"))
+
+    def test_q79(self, data, pdf):
+        got = QUERIES["q79"](data)
+        ss, dd, st, hd = (pdf["store_sales"], pdf["date_dim"],
+                          pdf["store"], pdf["household_demographics"])
+        cu = pdf["customer"]
+        dds = dd[(dd.d_dow == 1)
+                 & dd.d_year.isin([1998, 1999])].d_date_sk
+        hds = hd[(hd.hd_dep_count == 6)
+                 | (hd.hd_vehicle_count > 2)].hd_demo_sk
+        stf = st[st.s_number_employees.between(200, 295)]
+        j = (ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_hdemo_sk.isin(hds)]
+             .merge(stf[["s_store_sk", "s_city_id"]],
+                    left_on="ss_store_sk", right_on="s_store_sk"))
+        g = (j.groupby(["ss_ticket_number", "ss_customer_sk", "s_city_id"],
+                       dropna=False)
+             .agg(amt=("ss_coupon_amt", lambda s: s.sum(min_count=1)),
+                  profit=("ss_net_profit", lambda s: s.sum(min_count=1)))
+             .reset_index())
+        g = (g.merge(cu[["c_customer_sk", "c_first_name", "c_last_name"]],
+                     left_on="ss_customer_sk", right_on="c_customer_sk")
+             .drop(columns=["c_customer_sk"]))
+        g["city"] = [tpcds.CITIES[i - 1] for i in g.s_city_id]
+        g = (g.sort_values(["ss_customer_sk", "ss_ticket_number",
+                            "s_city_id"]).head(100))
+        _assert_frame(got, g, float_cols=("amt", "profit"))
+
+
+class TestBatchC:
+    def test_q1(self, data, pdf):
+        got = QUERIES["q1"](data)
+        sr, dd, st, cu = (pdf["store_returns"], pdf["date_dim"],
+                          pdf["store"], pdf["customer"])
+        dds = dd[dd.d_year == 1998].d_date_sk
+        ctr = (sr[sr.sr_returned_date_sk.isin(dds)]
+               .groupby(["sr_customer_sk", "sr_store_sk"], dropna=False)
+               ["sr_return_amt"].sum(min_count=1).reset_index()
+               .rename(columns={"sr_return_amt": "ctr_total_return"}))
+        avg = (ctr.groupby("sr_store_sk", dropna=False)
+               ["ctr_total_return"].mean().reset_index()
+               .rename(columns={"ctr_total_return": "avg_return"}))
+        j = ctr.merge(avg, on="sr_store_sk")
+        j = j[j.ctr_total_return > 1.2 * j.avg_return]
+        sts = st[st.s_state == "TN"].s_store_sk
+        j = j[j.sr_store_sk.isin(sts)]
+        j = (j.merge(cu[["c_customer_sk", "c_customer_id"]],
+                     left_on="sr_customer_sk", right_on="c_customer_sk")
+             .drop(columns=["c_customer_sk"]))
+        j = j.sort_values("sr_customer_sk").head(100)
+        _assert_frame(got, j, float_cols=("ctr_total_return",
+                                          "avg_return"))
+
+    def test_q6(self, data, pdf):
+        got = QUERIES["q6"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        cu, ca = pdf["customer"], pdf["customer_address"]
+        cat_avg = (it.groupby("i_category_id")["i_current_price"]
+                   .mean().rename("cat_avg"))
+        it2 = it.merge(cat_avg, on="i_category_id")
+        its = it2[it2.i_current_price > 1.2 * it2.cat_avg].i_item_sk
+        dds = dd[(dd.d_year == 1998) & (dd.d_moy == 1)].d_date_sk
+        j = (ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_item_sk.isin(its)]
+             .merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                    left_on="ss_customer_sk", right_on="c_customer_sk")
+             .merge(ca[["ca_address_sk", "ca_state_id"]],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk"))
+        g = (j.groupby("ca_state_id").size().reset_index(name="cnt"))
+        g = g[g.cnt >= 10]
+        g["state"] = [tpcds.STATES[i - 1] for i in g.ca_state_id]
+        g["cnt"] = g.cnt.astype("int64")
+        g = g.sort_values(["cnt", "ca_state_id"]).head(100)
+        _assert_frame(got, g)
+
+    def _ratio_oracle(self, fact, it, date_lo, date_hi, cats, pfx):
+        j = fact[(fact[f"{pfx}_sold_date_sk"] >= date_lo)
+                 & (fact[f"{pfx}_sold_date_sk"] <= date_hi)]
+        its = it[it.i_category_id.isin(cats)][["i_item_sk", "i_class_id"]]
+        j = j.merge(its, left_on=f"{pfx}_item_sk", right_on="i_item_sk")
+        g = (j.groupby(["i_class_id", f"{pfx}_item_sk"], dropna=False)
+             [f"{pfx}_ext_sales_price"].sum(min_count=1).reset_index()
+             .rename(columns={f"{pfx}_ext_sales_price": "itemrevenue"}))
+        g["classrevenue"] = g.groupby("i_class_id")["itemrevenue"] \
+            .transform(lambda s: s.sum(min_count=1))
+        g["revenueratio"] = g.itemrevenue * 100.0 / g.classrevenue
+        g["i_class"] = [tpcds.CLASSES[i - 1] for i in g.i_class_id]
+        return (g.sort_values(["i_class_id", f"{pfx}_item_sk"])
+                .head(100))
+
+    def test_q12(self, data, pdf):
+        got = QUERIES["q12"](data)
+        want = self._ratio_oracle(pdf["web_sales"], pdf["item"],
+                                  tpcds.DATE_SK0 + 280,
+                                  tpcds.DATE_SK0 + 310, [1, 2, 3], "ws")
+        _assert_frame(got, want, float_cols=("itemrevenue",
+                                             "classrevenue",
+                                             "revenueratio"))
+
+    def test_q98(self, data, pdf):
+        got = QUERIES["q98"](data)
+        want = self._ratio_oracle(pdf["store_sales"], pdf["item"],
+                                  tpcds.DATE_SK0 + 100,
+                                  tpcds.DATE_SK0 + 130, [4, 5, 6], "ss")
+        _assert_frame(got, want, float_cols=("itemrevenue",
+                                             "classrevenue",
+                                             "revenueratio"))
+
+    def test_q67(self, data, pdf):
+        got = QUERIES["q67"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        dts = dd[dd.d_year == 1999][["d_date_sk", "d_moy"]]
+        j = (ss.merge(dts, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[["i_item_sk", "i_category_id"]],
+                    left_on="ss_item_sk", right_on="i_item_sk"))
+        j["sales"] = j.ss_sales_price * j.ss_quantity
+        g = (j.groupby(["i_category_id", "ss_store_sk", "d_moy"],
+                       dropna=False)["sales"].sum(min_count=1)
+             .reset_index().rename(columns={"sales": "sumsales"}))
+        g["rk"] = (g.groupby("i_category_id", dropna=False)["sumsales"]
+                   .rank(method="min", ascending=False, na_option="bottom")
+                   .astype("int64"))
+        g = g[g.rk <= 10]
+        g["i_category"] = [tpcds.CATEGORIES[i - 1] for i in g.i_category_id]
+        g = (g.sort_values(["i_category_id", "rk", "ss_store_sk",
+                            "d_moy"]).head(100))
+        _assert_frame(got, g, float_cols=("sumsales",))
+
+    def test_q89(self, data, pdf):
+        got = QUERIES["q89"](data)
+        ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+        dts = dd[dd.d_year == 1999][["d_date_sk", "d_moy"]]
+        its = it[it.i_category_id.isin([1, 4, 7])][
+            ["i_item_sk", "i_category_id", "i_class_id"]]
+        j = (ss.merge(dts, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(its, left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["i_category_id", "i_class_id", "ss_store_sk",
+                        "d_moy"], dropna=False)["ss_sales_price"]
+             .sum(min_count=1).reset_index()
+             .rename(columns={"ss_sales_price": "sum_sales"}))
+        part = ["i_category_id", "i_class_id", "ss_store_sk"]
+        g["__part_sum"] = g.groupby(part, dropna=False)["sum_sales"] \
+            .transform(lambda s: s.sum(min_count=1))
+        g["__part_cnt"] = g.groupby(part, dropna=False)["sum_sales"] \
+            .transform("count").astype("int64")
+        g["avg_monthly_sales"] = g["__part_sum"] / g["__part_cnt"]
+        g = g[(g.sum_sales - g.avg_monthly_sales).abs()
+              > g.avg_monthly_sales * 0.1]
+        g = g.copy()
+        g["dev"] = g.sum_sales - g.avg_monthly_sales
+        g = (g.sort_values(["dev", "ss_store_sk", "i_category_id",
+                            "i_class_id", "d_moy"]).head(100))
+        _assert_frame(got, g, float_cols=("sum_sales", "__part_sum",
+                                          "avg_monthly_sales", "dev"))
+
+    def test_q95(self, data, pdf):
+        got = QUERIES["q95"](data)
+        ws, wr, ca, web = (pdf["web_sales"], pdf["web_returns"],
+                           pdf["customer_address"], pdf["web_site"])
+        multi = (ws.groupby("ws_order_number")["ws_warehouse_sk"]
+                 .nunique())
+        multi = set(multi[multi > 1].index)
+        cas = ca[ca.ca_state == "CA"].ca_address_sk
+        webs = web[web.web_company_name == "pri"].web_site_sk
+        lo, hi = tpcds.DATE_SK0 + 31, tpcds.DATE_SK0 + 91
+        j = ws[(ws.ws_ship_date_sk >= lo) & (ws.ws_ship_date_sk <= hi)
+               & ws.ws_bill_addr_sk.isin(cas)
+               & ws.ws_web_site_sk.isin(webs)
+               & ws.ws_order_number.isin(set(wr.wr_order_number))
+               & ws.ws_order_number.isin(multi)]
+        g = got.to_pydict()
+        assert g["order_count"][0] == j.ws_order_number.nunique()
+        np.testing.assert_allclose(g["ship_cost"][0],
+                                   j.ws_ext_ship_cost.sum(), rtol=1e-9)
+        np.testing.assert_allclose(g["net_profit"][0],
+                                   j.ws_net_profit.sum(), rtol=1e-9)
