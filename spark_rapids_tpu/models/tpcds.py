@@ -54,6 +54,15 @@ DAY_NAMES = ("Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
 FIRST_NAMES = tuple(f"First{i:03d}" for i in range(64))
 LAST_NAMES = tuple(f"Last{i:03d}" for i in range(64))
 COMPANIES = ("pri", "able", "ought", "eing", "bar", "cally")
+SHIP_MODE_TYPES = ("EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY")
+CARRIERS = ("UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+            "MSC", "LATVIAN", "DIAMOND")
+COLORS = ("red", "green", "blue", "white", "black", "navy", "peach",
+          "saddle", "ghost", "light", "powder", "dim", "smoke", "burlywood")
+SIZES = ("small", "medium", "large", "extra large", "petite", "N/A")
+UNITS = ("Each", "Dozen", "Case", "Pound", "Ounce", "Ton", "Gram", "Box")
+CONTAINERS = ("Unknown", "Small Box", "Large Box", "Carton")
+REASONS = tuple(f"reason {i}" for i in range(35))
 
 
 @dataclass
@@ -65,6 +74,8 @@ class TpcdsData:
     catalog_sales: Table
     store_returns: Table
     web_returns: Table
+    catalog_returns: Table
+    inventory: Table
     date_dim: Table
     time_dim: Table
     item: Table
@@ -76,6 +87,12 @@ class TpcdsData:
     promotion: Table
     web_site: Table
     warehouse: Table
+    ship_mode: Table
+    call_center: Table
+    income_band: Table
+    reason: Table
+    web_page: Table
+    catalog_page: Table
 
     def names(self):
         return [f.name for f in fields(self)]
@@ -173,6 +190,7 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
     n_cs = max(n_ss // 2, 64)
     n_sr = max(n_ss // 10, 32)
     n_wr = max(n_ws // 10, 16)
+    n_cr = max(n_cs // 10, 16)
     n_item = max(min(n_ss // 200, 18_000), 60)
     n_store = 12
     n_cust = max(min(n_ss // 20, 100_000), 200)
@@ -182,6 +200,15 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
     n_promo = 30
     n_web = 6
     n_wh = 5
+    n_sm = 20
+    n_cc = 6
+    n_ib = 20
+    n_wp = 60
+    n_cp = 60
+    # inventory snapshots at monthly granularity (24 months x items x
+    # warehouses); the spec's weekly cross is shape-equivalent but 4x
+    # the rows for no extra query coverage
+    n_inv_months = 24
 
     # -- dimensions --------------------------------------------------------
     date_dim = _date_dim()
@@ -214,6 +241,21 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("i_manager_id", Column.from_numpy(
             ((isk * 7) % 99 + 1).astype(np.int64))),
         ("i_current_price", _col_f64(rng, 0.5, 100.0, n_item)),
+        ("i_manufact", Column.from_pylist(
+            [f"manufact#{int(k) % 99 + 1:03d}" for k in isk], STRING)),
+        # attribute ids functionally dependent on the name columns (same
+        # group-by-id-decode-after contract as brand/category/class)
+        ("i_color_id", Column.from_numpy(
+            ((isk * 3) % len(COLORS) + 1).astype(np.int64))),
+        ("i_color", Column.from_pylist(
+            [COLORS[(int(k) * 3) % len(COLORS)] for k in isk], STRING)),
+        ("i_size", Column.from_pylist(
+            [SIZES[int(k) % len(SIZES)] for k in isk], STRING)),
+        ("i_units", Column.from_pylist(
+            [UNITS[(int(k) * 5) % len(UNITS)] for k in isk], STRING)),
+        ("i_container", Column.from_pylist(
+            [CONTAINERS[int(k) % len(CONTAINERS)] for k in isk], STRING)),
+        ("i_wholesale_cost", _col_f64(rng, 0.5, 80.0, n_item)),
     ])
 
     ssk = np.arange(1, n_store + 1, dtype=np.int64)
@@ -271,6 +313,15 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
                                     null_frac=0.02)),
         ("c_last_name", _col_vocab(rng, LAST_NAMES, n_cust,
                                    null_frac=0.02)),
+        ("c_preferred_cust_flag", Column.from_pylist(
+            ["Y" if k % 3 else "N" for k in csk], STRING)),
+        ("c_birth_month", Column.from_numpy(
+            (csk % 12 + 1).astype(np.int64))),
+        ("c_birth_year", Column.from_numpy(
+            (1930 + csk % 60).astype(np.int64))),
+        ("c_salutation", Column.from_pylist(
+            [("Mr.", "Mrs.", "Ms.", "Dr.", "Sir")[int(k) % 5]
+             for k in csk], STRING)),
     ])
 
     # full cross of education x gender x marital (spec: cd is a cross
@@ -298,6 +349,8 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("hd_buy_potential", Column.from_pylist(
             [BUY_POTENTIAL[int(k) % len(BUY_POTENTIAL)] for k in hsk],
             STRING)),
+        ("hd_income_band_sk", Column.from_numpy(
+            (hsk % 20 + 1).astype(np.int64))),
     ])
 
     psk = np.arange(1, n_promo + 1, dtype=np.int64)
@@ -324,6 +377,57 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("w_state", _col_vocab(rng, STATES, n_wh)),
         ("w_warehouse_name", Column.from_pylist(
             [f"Warehouse {k}" for k in whk], STRING)),
+        ("w_warehouse_sq_ft", _col_i64(rng, 50_000, 1_000_000, n_wh)),
+        ("w_county", _col_vocab(rng, COUNTIES, n_wh)),
+    ])
+
+    smk = np.arange(1, n_sm + 1, dtype=np.int64)
+    ship_mode = Table([
+        ("sm_ship_mode_sk", Column.from_numpy(smk)),
+        ("sm_type", Column.from_pylist(
+            [SHIP_MODE_TYPES[int(k) % len(SHIP_MODE_TYPES)] for k in smk],
+            STRING)),
+        ("sm_carrier", Column.from_pylist(
+            [CARRIERS[int(k) % len(CARRIERS)] for k in smk], STRING)),
+    ])
+
+    cck = np.arange(1, n_cc + 1, dtype=np.int64)
+    call_center = Table([
+        ("cc_call_center_sk", Column.from_numpy(cck)),
+        ("cc_name", Column.from_pylist(
+            [f"call center {k}" for k in cck], STRING)),
+        ("cc_county", Column.from_pylist(
+            [COUNTIES[int(k) % len(COUNTIES)] for k in cck], STRING)),
+        ("cc_manager", _col_vocab(rng, LAST_NAMES, n_cc)),
+    ])
+
+    ibk = np.arange(1, n_ib + 1, dtype=np.int64)
+    income_band = Table([
+        ("ib_income_band_sk", Column.from_numpy(ibk)),
+        ("ib_lower_bound", Column.from_numpy(
+            ((ibk - 1) * 10_000).astype(np.int64))),
+        ("ib_upper_bound", Column.from_numpy(
+            (ibk * 10_000).astype(np.int64))),
+    ])
+
+    rk = np.arange(1, len(REASONS) + 1, dtype=np.int64)
+    reason = Table([
+        ("r_reason_sk", Column.from_numpy(rk)),
+        ("r_reason_desc", Column.from_pylist(list(REASONS), STRING)),
+    ])
+
+    wpk = np.arange(1, n_wp + 1, dtype=np.int64)
+    web_page = Table([
+        ("wp_web_page_sk", Column.from_numpy(wpk)),
+        ("wp_char_count", Column.from_numpy(
+            (3000 + (wpk * 97) % 3000).astype(np.int64))),
+    ])
+
+    cpk = np.arange(1, n_cp + 1, dtype=np.int64)
+    catalog_page = Table([
+        ("cp_catalog_page_sk", Column.from_numpy(cpk)),
+        ("cp_catalog_page_id", Column.from_pylist(
+            [f"CPAGE{k:06d}" for k in cpk], STRING)),
     ])
 
     # -- facts -------------------------------------------------------------
@@ -379,6 +483,16 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("ws_net_profit", _col_f64(rng, -100.0, 200.0, n_ws,
                                    null_frac=0.04)),
         ("ws_net_paid", price(n_ws)),
+        ("ws_sold_time_sk", _col_i64(rng, 0, 1440, n_ws, null_frac=0.01)),
+        ("ws_ship_mode_sk", _skewed_fk(rng, n_sm, n_ws, null_frac=0.0)),
+        ("ws_web_page_sk", _skewed_fk(rng, n_wp, n_ws, null_frac=0.0)),
+        ("ws_promo_sk", _skewed_fk(rng, n_promo, n_ws)),
+        ("ws_ship_customer_sk", _skewed_fk(rng, n_cust, n_ws,
+                                           null_frac=0.05)),
+        ("ws_ext_list_price", price(n_ws)),
+        ("ws_ext_wholesale_cost", price(n_ws)),
+        ("ws_sales_price", price(n_ws)),
+        ("ws_list_price", price(n_ws)),
     ])
 
     catalog_sales = Table([
@@ -394,6 +508,24 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("cs_ext_sales_price", price(n_cs)),
         ("cs_net_profit", _col_f64(rng, -100.0, 200.0, n_cs,
                                    null_frac=0.04)),
+        ("cs_order_number", _col_i64(rng, 1, max(n_cs // 4, 2), n_cs)),
+        ("cs_warehouse_sk", _skewed_fk(rng, n_wh, n_cs, null_frac=0.03)),
+        ("cs_ship_date_sk", sales_dates(n_cs)),
+        ("cs_ship_mode_sk", _skewed_fk(rng, n_sm, n_cs, null_frac=0.0)),
+        ("cs_call_center_sk", _skewed_fk(rng, n_cc, n_cs, null_frac=0.0)),
+        ("cs_ship_addr_sk", _skewed_fk(rng, n_addr, n_cs)),
+        ("cs_bill_addr_sk", _skewed_fk(rng, n_addr, n_cs)),
+        ("cs_ship_customer_sk", _skewed_fk(rng, n_cust, n_cs,
+                                           null_frac=0.05)),
+        ("cs_ext_discount_amt", _col_f64(rng, 0.0, 80.0, n_cs,
+                                         null_frac=0.04)),
+        ("cs_ext_ship_cost", _col_f64(rng, 0.0, 60.0, n_cs,
+                                      null_frac=0.04)),
+        ("cs_ext_list_price", price(n_cs)),
+        ("cs_ext_wholesale_cost", price(n_cs)),
+        ("cs_sold_time_sk", _col_i64(rng, 0, 1440, n_cs, null_frac=0.01)),
+        ("cs_catalog_page_sk", _skewed_fk(rng, n_cp, n_cs, null_frac=0.0)),
+        ("cs_net_paid", price(n_cs)),
     ])
 
     store_returns = Table([
@@ -405,6 +537,12 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("sr_return_amt", _col_f64(rng, 0.5, 200.0, n_sr,
                                    null_frac=0.02)),
         ("sr_return_quantity", qty(n_sr)),
+        ("sr_reason_sk", _skewed_fk(rng, len(REASONS), n_sr,
+                                    null_frac=0.02)),
+        ("sr_net_loss", _col_f64(rng, 0.5, 150.0, n_sr, null_frac=0.02)),
+        ("sr_cdemo_sk", _skewed_fk(rng, n_cd, n_sr)),
+        ("sr_return_time_sk", _col_i64(rng, 0, 1440, n_sr,
+                                       null_frac=0.01)),
     ])
 
     web_returns = Table([
@@ -412,14 +550,58 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("wr_returned_date_sk", sales_dates(n_wr)),
         ("wr_return_amt", _col_f64(rng, 0.5, 200.0, n_wr,
                                    null_frac=0.02)),
+        ("wr_item_sk", _skewed_fk(rng, n_item, n_wr, null_frac=0.0)),
+        ("wr_returning_customer_sk", _skewed_fk(rng, n_cust, n_wr)),
+        ("wr_returning_addr_sk", _skewed_fk(rng, n_addr, n_wr)),
+        ("wr_refunded_cdemo_sk", _skewed_fk(rng, n_cd, n_wr)),
+        ("wr_refunded_addr_sk", _skewed_fk(rng, n_addr, n_wr)),
+        ("wr_reason_sk", _skewed_fk(rng, len(REASONS), n_wr,
+                                    null_frac=0.02)),
+        ("wr_net_loss", _col_f64(rng, 0.5, 150.0, n_wr, null_frac=0.02)),
+        ("wr_return_quantity", qty(n_wr)),
+    ])
+
+    catalog_returns = Table([
+        ("cr_order_number", _col_i64(rng, 1, max(n_cs // 4, 2), n_cr)),
+        ("cr_item_sk", _skewed_fk(rng, n_item, n_cr, null_frac=0.0)),
+        ("cr_returned_date_sk", sales_dates(n_cr)),
+        ("cr_return_amount", _col_f64(rng, 0.5, 200.0, n_cr,
+                                      null_frac=0.02)),
+        ("cr_return_quantity", qty(n_cr)),
+        ("cr_net_loss", _col_f64(rng, 0.5, 150.0, n_cr, null_frac=0.02)),
+        ("cr_returning_customer_sk", _skewed_fk(rng, n_cust, n_cr)),
+        ("cr_returning_addr_sk", _skewed_fk(rng, n_addr, n_cr)),
+        ("cr_call_center_sk", _skewed_fk(rng, n_cc, n_cr, null_frac=0.02)),
+        ("cr_catalog_page_sk", _skewed_fk(rng, n_cp, n_cr, null_frac=0.0)),
+        ("cr_reason_sk", _skewed_fk(rng, len(REASONS), n_cr,
+                                    null_frac=0.02)),
+    ])
+
+    # inventory: full (month x item x warehouse) cross, snapshot on the
+    # first day of each synthetic 30-day month
+    inv_date = DATE_SK0 + 30 * np.arange(n_inv_months, dtype=np.int64)
+    inv_d, inv_i, inv_w = np.meshgrid(
+        inv_date, np.arange(1, n_item + 1, dtype=np.int64),
+        np.arange(1, n_wh + 1, dtype=np.int64), indexing="ij")
+    n_inv = inv_d.size
+    inventory = Table([
+        ("inv_date_sk", Column.from_numpy(inv_d.ravel())),
+        ("inv_item_sk", Column.from_numpy(inv_i.ravel())),
+        ("inv_warehouse_sk", Column.from_numpy(inv_w.ravel())),
+        ("inv_quantity_on_hand", _col_i64(rng, 0, 1000, n_inv,
+                                          null_frac=0.02)),
     ])
 
     return TpcdsData(
         store_sales=store_sales, web_sales=web_sales,
         catalog_sales=catalog_sales, store_returns=store_returns,
-        web_returns=web_returns, date_dim=date_dim, time_dim=time_dim,
+        web_returns=web_returns, catalog_returns=catalog_returns,
+        inventory=inventory, date_dim=date_dim, time_dim=time_dim,
         item=item, store=store, customer=customer,
         customer_address=customer_address,
         customer_demographics=customer_demographics,
         household_demographics=household_demographics,
-        promotion=promotion, web_site=web_site, warehouse=warehouse)
+        promotion=promotion, web_site=web_site, warehouse=warehouse,
+        ship_mode=ship_mode, call_center=call_center,
+        income_band=income_band, reason=reason, web_page=web_page,
+        catalog_page=catalog_page)

@@ -1,0 +1,360 @@
+"""TPC-DS bank, reporting family: single-channel filter/join/agg shapes.
+
+Same conventions as :mod:`.tpcds_queries` (dimension pre-filtering,
+group-by-id/decode-after, FLOAT64 money); every query here reuses the
+plan-compiler pipeline and is oracle-checked in tests/test_tpcds_report.py.
+This module is imported by :mod:`.tpcds_queries` for the registry merge,
+so it must only import helpers defined at the top of that module.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..column import Column
+from ..table import Table
+from ..exec import col, plan, when
+from .tpcds import TpcdsData
+from .tpcds_queries import _city_map, _class_map, _dim, _scalar_table
+
+
+def q9(d: TpcdsData) -> Table:
+    """TPC-DS q9: per quantity-bucket, report avg(ss_ext_discount_amt)
+    when the bucket is populous else avg(ss_net_paid) — five scalar
+    subqueries folded into one dense group-by plus a host-side CASE."""
+    buckets = [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)]
+    thresholds = [3000, 3000, 3000, 3000, 3000]
+    e = None
+    for i, (lo, hi) in enumerate(buckets):
+        cond = col("ss_quantity").between(lo, hi)
+        e = when(cond, i) if e is None else e.when(cond, i)
+    p = (plan()
+         .with_columns(bucket=e)
+         .filter(col("bucket").between(0, 4))
+         .groupby_agg(["bucket"],
+                      [("ss_quantity", "count", "cnt"),
+                       ("ss_ext_discount_amt", "mean", "avg_disc"),
+                       ("ss_net_paid", "mean", "avg_paid")],
+                      domains={"bucket": (0, 4)})
+         .sort_by(["bucket"]))
+    out = p.run(d.store_sales).to_pydict()
+    by_bucket = {b: (c, ad, ap) for b, c, ad, ap in
+                 zip(out["bucket"], out["cnt"], out["avg_disc"],
+                     out["avg_paid"])}
+    chosen = []
+    for i in range(5):
+        cnt, ad, ap = by_bucket.get(i, (0, None, None))
+        chosen.append(ad if cnt > thresholds[i] else ap)
+    return Table([
+        ("bucket", Column.from_numpy(np.arange(5, dtype=np.int64))),
+        ("chosen_avg", Column.from_numpy(
+            np.asarray([np.nan if v is None else v for v in chosen]),
+            validity=np.asarray([v is not None for v in chosen]))),
+    ])
+
+
+def q13(d: TpcdsData) -> Table:
+    """TPC-DS q13: average sales stats under OR'd (demographic, price,
+    household) and (state, profit) condition triples — the q48 shape plus
+    a household-demographics leg."""
+    cd = (plan()
+          .with_columns(cd_tag=when(
+              col("cd_marital_status").eq("M")
+              & col("cd_education_status").eq("Advanced Degree"), 1)
+              .when(col("cd_marital_status").eq("S")
+                    & col("cd_education_status").eq("College"), 2)
+              .when(col("cd_marital_status").eq("W")
+                    & col("cd_education_status").eq("2 yr Degree"), 3)
+              .otherwise(0))
+          .select("cd_demo_sk", "cd_tag")
+          .run(d.customer_demographics))
+    addr = (plan()
+            .with_columns(ca_tag=when(
+                col("ca_state").isin(["TX", "OH"]), 1)
+                .when(col("ca_state").isin(["OR", "NY", "WA"]), 2)
+                .when(col("ca_state").isin(["GA", "TN", "IL"]), 3)
+                .otherwise(0))
+            .select("ca_address_sk", "ca_tag")
+            .run(d.customer_address))
+    hd = d.household_demographics.select(["hd_demo_sk", "hd_dep_count"])
+    dates = _dim(d.date_dim, col("d_year").eq(1998), ["d_date_sk"])
+    p = (plan()
+         .join_broadcast(dates, left_on="ss_sold_date_sk",
+                         right_on="d_date_sk", how="semi")
+         .join_broadcast(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .join_broadcast(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .join_broadcast(addr, left_on="ss_addr_sk",
+                         right_on="ca_address_sk")
+         .filter(((col("cd_tag").eq(1)
+                   & col("ss_sales_price").between(100.0, 150.0)
+                   & col("hd_dep_count").eq(3))
+                  | (col("cd_tag").eq(2)
+                     & col("ss_sales_price").between(50.0, 100.0)
+                     & col("hd_dep_count").eq(1))
+                  | (col("cd_tag").eq(3)
+                     & col("ss_sales_price").between(150.0, 200.0)
+                     & col("hd_dep_count").eq(1)))
+                 & ((col("ca_tag").eq(1)
+                     & col("ss_net_profit").between(100.0, 200.0))
+                    | (col("ca_tag").eq(2)
+                       & col("ss_net_profit").between(150.0, 300.0))
+                    | (col("ca_tag").eq(3)
+                       & col("ss_net_profit").between(50.0, 250.0))))
+         .with_columns(one=when(col("ss_quantity").is_null(), 1)
+                       .otherwise(1))
+         .groupby_agg(["one"],
+                      [("ss_quantity", "mean", "avg_qty"),
+                       ("ss_ext_sales_price", "mean", "avg_esp"),
+                       ("ss_ext_wholesale_cost", "mean", "avg_ewc"),
+                       ("ss_ext_wholesale_cost", "sum", "sum_ewc")],
+                      domains={"one": (1, 1)}))
+    out = p.run(d.store_sales).to_pydict()
+
+    def pick(name, default=None):
+        vals = out[name]
+        return vals[0] if vals else default
+    return Table([
+        ("avg_qty", Column.from_numpy(
+            np.asarray([float(pick("avg_qty") or 0.0)]))),
+        ("avg_esp", Column.from_numpy(
+            np.asarray([float(pick("avg_esp") or 0.0)]))),
+        ("avg_ewc", Column.from_numpy(
+            np.asarray([float(pick("avg_ewc") or 0.0)]))),
+        ("sum_ewc", Column.from_numpy(
+            np.asarray([float(pick("sum_ewc") or 0.0)]))),
+    ])
+
+
+def q20(d: TpcdsData) -> Table:
+    """TPC-DS q20: q12's class-revenue-share shape over the catalog
+    channel."""
+    from .tpcds import DATE_SK0
+    items = _dim(d.item, col("i_category_id").isin([2, 5, 8]),
+                 ["i_item_sk", "i_class_id"])
+    p = (plan()
+         .filter(col("cs_sold_date_sk").between(DATE_SK0 + 200,
+                                                DATE_SK0 + 230))
+         .join_broadcast(items, left_on="cs_item_sk",
+                         right_on="i_item_sk")
+         .groupby_agg(["i_class_id", "cs_item_sk"],
+                      [("cs_ext_sales_price", "sum", "itemrevenue")])
+         .window("classrevenue", "sum", partition_by=["i_class_id"],
+                 value="itemrevenue", frame="partition")
+         .with_columns(revenueratio=col("itemrevenue") * 100.0
+                       / col("classrevenue"))
+         .join_broadcast(_class_map(), left_on="i_class_id",
+                         right_on="__class_id")
+         .sort_by(["i_class_id", "cs_item_sk"])
+         .limit(100))
+    return p.run(d.catalog_sales)
+
+
+def _deviation_query(d: TpcdsData, group_key: str, time_key: str,
+                     item_pred) -> Table:
+    """Shared q53/q63 shape: sum(ss_sales_price) per (group_key,
+    time_key), partition average over the group, keep rows deviating
+    more than 10%."""
+    dates = _dim(d.date_dim, col("d_year").eq(1999),
+                 ["d_date_sk", time_key])
+    items = _dim(d.item, item_pred, ["i_item_sk", group_key])
+    p = (plan()
+         .join_broadcast(dates, left_on="ss_sold_date_sk",
+                         right_on="d_date_sk")
+         .join_broadcast(items, left_on="ss_item_sk",
+                         right_on="i_item_sk")
+         .groupby_agg([group_key, time_key],
+                      [("ss_sales_price", "sum", "sum_sales")])
+         .window("__psum", "sum", partition_by=[group_key],
+                 value="sum_sales", frame="partition")
+         .window("__pcnt", "count", partition_by=[group_key],
+                 value="sum_sales", frame="partition")
+         .with_columns(avg_quarterly_sales=col("__psum") / col("__pcnt"))
+         .filter(when(col("avg_quarterly_sales") > 0.0,
+                      abs(col("sum_sales") - col("avg_quarterly_sales"))
+                      / col("avg_quarterly_sales")).otherwise(0.0) > 0.1)
+         .select(group_key, "sum_sales", "avg_quarterly_sales", time_key)
+         .sort_by(["avg_quarterly_sales", "sum_sales", group_key,
+                   time_key])
+         .limit(100))
+    return p.run(d.store_sales)
+
+
+def q53(d: TpcdsData) -> Table:
+    """TPC-DS q53: manufacturers whose quarterly sales deviate >10% from
+    their yearly average."""
+    return _deviation_query(d, "i_manufact_id", "d_qoy",
+                            col("i_manufact_id").between(1, 40))
+
+
+def q63(d: TpcdsData) -> Table:
+    """TPC-DS q63: q53's deviation shape per manager by month."""
+    return _deviation_query(d, "i_manager_id", "d_moy",
+                            col("i_manager_id").between(1, 40))
+
+
+def q45(d: TpcdsData) -> Table:
+    """TPC-DS q45: web revenue by customer zip/city where the zip is in
+    a list OR the item is in a chosen item-id set (the OR of a column
+    predicate and a subquery membership)."""
+    zips = [85669, 86197, 88274, 83405, 86475]
+    item_sks = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+    dates = _dim(d.date_dim, col("d_qoy").eq(2) & col("d_year").eq(1999),
+                 ["d_date_sk"])
+    cust = d.customer.select(["c_customer_sk", "c_current_addr_sk"])
+    addr = d.customer_address.select(["ca_address_sk", "ca_zip5",
+                                      "ca_city_id"])
+    p = (plan()
+         .join_broadcast(dates, left_on="ws_sold_date_sk",
+                         right_on="d_date_sk", how="semi")
+         .join_broadcast(cust, left_on="ws_bill_customer_sk",
+                         right_on="c_customer_sk")
+         .join_broadcast(addr, left_on="c_current_addr_sk",
+                         right_on="ca_address_sk")
+         .filter(col("ca_zip5").isin(zips)
+                 | col("ws_item_sk").isin(item_sks))
+         .groupby_agg(["ca_zip5", "ca_city_id"],
+                      [("ws_sales_price", "sum", "total_price")])
+         .join_broadcast(_city_map(), left_on="ca_city_id",
+                         right_on="__city_id")
+         .sort_by(["ca_zip5", "ca_city_id"])
+         .limit(100))
+    return p.run(d.web_sales)
+
+
+def q90(d: TpcdsData) -> Table:
+    """TPC-DS q90: ratio of morning to evening web sales for one page
+    char-count band and dependent count — one dense two-cell group-by
+    instead of two scalar subqueries."""
+    demos = _dim(d.household_demographics, col("hd_dep_count").eq(6),
+                 ["hd_demo_sk"])
+    pages = _dim(d.web_page, col("wp_char_count").between(4000, 5200),
+                 ["wp_web_page_sk"])
+    times = (plan()
+             .with_columns(slot=when(col("t_hour").between(8, 9), 0)
+                           .when(col("t_hour").between(19, 20), 1)
+                           .otherwise(-1))
+             .filter(col("slot").between(0, 1))
+             .select("t_time_sk", "slot")
+             .run(d.time_dim))
+    # web_sales has no hdemo column in the synthetic schema; the
+    # demographic leg rides the bill customer's household instead
+    cust = d.customer.select(["c_customer_sk", "c_current_hdemo_sk"])
+    p = (plan()
+         .join_broadcast(pages, left_on="ws_web_page_sk",
+                         right_on="wp_web_page_sk", how="semi")
+         .join_broadcast(cust, left_on="ws_bill_customer_sk",
+                         right_on="c_customer_sk")
+         .join_broadcast(demos, left_on="c_current_hdemo_sk",
+                         right_on="hd_demo_sk", how="semi")
+         .join_broadcast(times, left_on="ws_sold_time_sk",
+                         right_on="t_time_sk")
+         .groupby_agg(["slot"], [("slot", "count", "cnt")],
+                      domains={"slot": (0, 1)})
+         .sort_by(["slot"]))
+    out = p.run(d.web_sales).to_pydict()
+    counts = dict(zip(out["slot"], out["cnt"]))
+    am, pm = counts.get(0, 0), counts.get(1, 0)
+    ratio = (am / pm) if pm else 0.0
+    return _scalar_table(am_count=int(am), pm_count=int(pm),
+                         am_pm_ratio=float(ratio))
+
+
+def _per_ticket_count_query(d: TpcdsData, dom_pred, hd_pred,
+                            county_list, lo: int, hi: int) -> Table:
+    """Shared q34/q73 shape: tickets with between ``lo`` and ``hi``
+    items, decorated with the buyer's name."""
+    dates = _dim(d.date_dim,
+                 dom_pred & col("d_year").isin([1998, 1999]),
+                 ["d_date_sk"])
+    stores = _dim(d.store, col("s_county").isin(county_list),
+                  ["s_store_sk"])
+    demos = _dim(d.household_demographics, hd_pred, ["hd_demo_sk"])
+    cust = d.customer.select(["c_customer_sk", "c_salutation",
+                              "c_first_name", "c_last_name",
+                              "c_preferred_cust_flag"])
+    p = (plan()
+         .join_broadcast(dates, left_on="ss_sold_date_sk",
+                         right_on="d_date_sk", how="semi")
+         .join_broadcast(stores, left_on="ss_store_sk",
+                         right_on="s_store_sk", how="semi")
+         .join_broadcast(demos, left_on="ss_hdemo_sk",
+                         right_on="hd_demo_sk", how="semi")
+         .groupby_agg(["ss_ticket_number", "ss_customer_sk"],
+                      [("ss_ticket_number", "count", "cnt")])
+         .filter(col("cnt").between(lo, hi))
+         .join_broadcast(cust, left_on="ss_customer_sk",
+                         right_on="c_customer_sk")
+         .sort_by(["ss_customer_sk", "cnt", "ss_ticket_number"],
+                  ascending=[True, False, True])
+         .limit(100))
+    return p.run(d.store_sales)
+
+
+def q34(d: TpcdsData) -> Table:
+    """TPC-DS q34: customers buying 15-20 items on one ticket around the
+    month turn, for big households in chosen counties."""
+    return _per_ticket_count_query(
+        d, col("d_dom").between(1, 3) | col("d_dom").between(25, 28),
+        col("hd_vehicle_count") > 0,
+        ["Fair County 0", "Rich County 1", "Walker County 0",
+         "Ziebach County 1"], 15, 20)
+
+
+def q73(d: TpcdsData) -> Table:
+    """TPC-DS q73: q34's shape for 1-5 item tickets early in the
+    month."""
+    return _per_ticket_count_query(
+        d, col("d_dom").between(1, 2),
+        (col("hd_dep_count") > 0) | (col("hd_vehicle_count") > 1),
+        ["Fair County 1", "Rich County 0", "Ziebach County 0"], 1, 5)
+
+
+def q46(d: TpcdsData) -> Table:
+    """TPC-DS q46: weekend shoppers' per-ticket coupon/profit when they
+    bought in a city other than their home city (q68's shape with the
+    weekend date cut)."""
+    dates = _dim(d.date_dim,
+                 col("d_dow").isin([0, 6])
+                 & col("d_year").isin([1998, 1999]),
+                 ["d_date_sk"])
+    stores = _dim(d.store,
+                  col("s_city").isin(["Midway", "Fairview"]),
+                  ["s_store_sk"])
+    demos = _dim(d.household_demographics,
+                 col("hd_dep_count").eq(5) | col("hd_vehicle_count").eq(2),
+                 ["hd_demo_sk"])
+    addr = d.customer_address.select(["ca_address_sk", "ca_city_id"])
+    cur_addr = (d.customer_address.select(["ca_address_sk", "ca_city_id"])
+                .rename({"ca_address_sk": "__cur_addr",
+                         "ca_city_id": "cur_city_id"}))
+    cust = d.customer.select(["c_customer_sk", "c_current_addr_sk",
+                              "c_first_name", "c_last_name"])
+    p = (plan()
+         .join_broadcast(dates, left_on="ss_sold_date_sk",
+                         right_on="d_date_sk", how="semi")
+         .join_broadcast(stores, left_on="ss_store_sk",
+                         right_on="s_store_sk", how="semi")
+         .join_broadcast(demos, left_on="ss_hdemo_sk",
+                         right_on="hd_demo_sk", how="semi")
+         .join_broadcast(addr, left_on="ss_addr_sk",
+                         right_on="ca_address_sk")
+         .groupby_agg(["ss_ticket_number", "ss_customer_sk",
+                       "ca_city_id"],
+                      [("ss_coupon_amt", "sum", "amt"),
+                       ("ss_net_profit", "sum", "profit")])
+         .join_broadcast(cust, left_on="ss_customer_sk",
+                         right_on="c_customer_sk")
+         .join_broadcast(cur_addr, left_on="c_current_addr_sk",
+                         right_on="__cur_addr")
+         .filter(col("cur_city_id").ne(col("ca_city_id")))
+         .join_broadcast(_city_map(), left_on="ca_city_id",
+                         right_on="__city_id")
+         .sort_by(["ss_customer_sk", "ss_ticket_number", "ca_city_id"])
+         .limit(100))
+    return p.run(d.store_sales)
+
+
+QUERIES = {
+    "q9": q9, "q13": q13, "q20": q20, "q34": q34, "q45": q45,
+    "q46": q46, "q53": q53, "q63": q63, "q73": q73, "q90": q90,
+}
