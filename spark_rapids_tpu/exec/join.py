@@ -85,13 +85,15 @@ class JoinMeta:
 _PROBE_CACHE: dict = {}
 
 
-def _build_probe(key_cols: list[Column]):
+def _build_probe(key_cols: list[Column], dedupe: bool = False):
     """(per-key (lo, hi, shift), mode, packed_hi, side arrays); cached per
-    build key buffer identities."""
+    build key buffer identities.  ``dedupe`` drops duplicate build keys
+    (keeping an arbitrary row per key) — sound only for membership joins
+    (semi/anti), where no payload rides the match."""
     from .stats import _guarded_cache_get, _guarded_cache_put
     buffers = tuple(b for c in key_cols
                     for b in (c.data, c.validity) if b is not None)
-    cache_key = tuple(id(b) for b in buffers)
+    cache_key = (dedupe,) + tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_PROBE_CACHE, cache_key, buffers)
     if hit is not None:
         return hit
@@ -129,10 +131,14 @@ def _build_probe(key_cols: list[Column]):
     packed = np.zeros(rows.size, np.int64)
     for k, lo, sh in zip(np_keys, los, shifts):
         packed |= (k.astype(np.int64) - lo) << sh
-    if np.unique(packed).size != packed.size:
+    if dedupe:
+        packed, first = np.unique(packed, return_index=True)
+        rows = rows[first]
+    elif np.unique(packed).size != packed.size:
         raise ValueError(
             "broadcast join requires unique build-side keys "
-            "(use the eager ops.join for many-to-many joins)")
+            "(use the eager ops.join for many-to-many joins, or a "
+            "semi/anti join for membership tests)")
     packed_hi = int(packed.max())
 
     if packed_hi + 1 <= DIRECT_PROBE_MAX:
@@ -173,7 +179,8 @@ def bind_join(bound, step: JoinStep, index: int,
                 f"dictionary-encode strings or use the eager ops.join")
         key_cols.append(c)
 
-    spans, mode, packed_hi, valid_keys, arrays = _build_probe(key_cols)
+    spans, mode, packed_hi, valid_keys, arrays = _build_probe(
+        key_cols, dedupe=step.how in ("semi", "anti"))
     prefix = f"__join{index}__"
     for nm, arr in arrays.items():
         bound.side_inputs[prefix + nm] = Column(

@@ -210,6 +210,9 @@ class Plan:
         "anti" (probe rows without one).  The build side's non-key columns
         are appended to the schema (name collisions are an error — rename
         first); its key columns are dropped (they equal the probe keys).
+        Semi/anti joins accept duplicate build-side keys (the build side
+        is deduped at bind time — membership only); inner/left require
+        unique keys.
         """
         if how not in ("inner", "left", "semi", "anti"):
             raise ValueError(f"unsupported join type {how!r}")

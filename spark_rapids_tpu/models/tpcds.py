@@ -350,7 +350,7 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
             [BUY_POTENTIAL[int(k) % len(BUY_POTENTIAL)] for k in hsk],
             STRING)),
         ("hd_income_band_sk", Column.from_numpy(
-            (hsk % 20 + 1).astype(np.int64))),
+            (hsk % n_ib + 1).astype(np.int64))),
     ])
 
     psk = np.arange(1, n_promo + 1, dtype=np.int64)
@@ -369,6 +369,8 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("web_site_sk", Column.from_numpy(wsk)),
         ("web_company_name", Column.from_pylist(
             [COMPANIES[int(k) % len(COMPANIES)] for k in wsk], STRING)),
+        ("web_name", Column.from_pylist(
+            [f"site_{int(k)}" for k in wsk], STRING)),
     ])
 
     whk = np.arange(1, n_wh + 1, dtype=np.int64)
@@ -384,6 +386,9 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
     smk = np.arange(1, n_sm + 1, dtype=np.int64)
     ship_mode = Table([
         ("sm_ship_mode_sk", Column.from_numpy(smk)),
+        # sm_type_id functionally determines sm_type (group-by-id contract)
+        ("sm_type_id", Column.from_numpy(
+            (smk % len(SHIP_MODE_TYPES) + 1).astype(np.int64))),
         ("sm_type", Column.from_pylist(
             [SHIP_MODE_TYPES[int(k) % len(SHIP_MODE_TYPES)] for k in smk],
             STRING)),

@@ -1,0 +1,90 @@
+"""Shared helpers for the TPC-DS query-bank family modules.
+
+Lives below :mod:`.tpcds_queries` and the per-family modules so the
+registry merge at the bottom of ``tpcds_queries`` stays acyclic whichever
+module is imported first.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..column import Column
+from ..dtypes import STRING
+from ..table import Table
+from ..exec import plan
+from .tpcds import BRANDS, CATEGORIES, CITIES, CLASSES, STATES
+
+
+def _dim(table: Table, pred=None, select=None) -> Table:
+    """Pre-filter + narrow a dimension table (predicate pushdown below
+    the join, as Spark's optimizer does)."""
+    p = plan()
+    if pred is not None:
+        p = p.filter(pred)
+    if select is not None:
+        p = p.select(*select)
+    if not p.steps:
+        return table
+    return p.run(table)
+
+
+_MAPS: dict = {}
+
+
+def _vocab_map(id_name: str, name_name: str, vocab) -> Table:
+    """A unique-key (id, name) decode table for a vocabulary, memoized by
+    (names, vocab) so repeated queries rebind the same Table object (the
+    plan compile cache is keyed on build-table identity)."""
+    key = (id_name, name_name, tuple(vocab))
+    hit = _MAPS.get(key)
+    if hit is None:
+        hit = Table([
+            (id_name, Column.from_numpy(
+                np.arange(1, len(vocab) + 1, dtype=np.int64))),
+            (name_name, Column.from_pylist(list(vocab), STRING)),
+        ])
+        _MAPS[key] = hit
+    return hit
+
+
+def _brand_map() -> Table:
+    return _vocab_map("__brand_id", "i_brand", BRANDS)
+
+
+def _category_map() -> Table:
+    return _vocab_map("__category_id", "i_category", CATEGORIES)
+
+
+def _class_map() -> Table:
+    return _vocab_map("__class_id", "i_class", CLASSES)
+
+
+def _city_map() -> Table:
+    return _vocab_map("__city_id", "city", CITIES)
+
+
+def _state_map() -> Table:
+    return _vocab_map("__state_id", "state", STATES)
+
+
+def _lag_buckets(p, lag):
+    """Annotate a plan with the five 30-day lag indicator columns of the
+    q62/q99/q50 report shapes (0/1 ints that a group-by sums)."""
+    from ..exec import when
+    return p.with_columns(
+        d30=when(lag <= 30, 1).otherwise(0),
+        d60=when((lag > 30) & (lag <= 60), 1).otherwise(0),
+        d90=when((lag > 60) & (lag <= 90), 1).otherwise(0),
+        d120=when((lag > 90) & (lag <= 120), 1).otherwise(0),
+        dmore=when(lag > 120, 1).otherwise(0))
+
+
+def _scalar_table(**vals) -> Table:
+    cols = []
+    for k, v in vals.items():
+        arr = np.asarray([v])
+        if arr.dtype.kind == "i":
+            arr = arr.astype(np.int64)
+        cols.append((k, Column.from_numpy(arr)))
+    return Table(cols)

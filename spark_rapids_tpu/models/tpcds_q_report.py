@@ -3,8 +3,8 @@
 Same conventions as :mod:`.tpcds_queries` (dimension pre-filtering,
 group-by-id/decode-after, FLOAT64 money); every query here reuses the
 plan-compiler pipeline and is oracle-checked in tests/test_tpcds_report.py.
-This module is imported by :mod:`.tpcds_queries` for the registry merge,
-so it must only import helpers defined at the top of that module.
+This module is imported by :mod:`.tpcds_queries` for the registry merge;
+shared helpers live in :mod:`.tpcds_lib` to keep that merge acyclic.
 """
 
 from __future__ import annotations
@@ -15,7 +15,7 @@ from ..column import Column
 from ..table import Table
 from ..exec import col, plan, when
 from .tpcds import TpcdsData
-from .tpcds_queries import _city_map, _class_map, _dim, _scalar_table
+from .tpcds_lib import _city_map, _class_map, _dim, _scalar_table
 
 
 def q9(d: TpcdsData) -> Table:

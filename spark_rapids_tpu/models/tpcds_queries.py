@@ -42,61 +42,12 @@ from .tpcds import (BRANDS, CATEGORIES, CITIES, CLASSES, DAY_NAMES, STATES,
 
 
 # ---------------------------------------------------------------------------
-# helpers
+# helpers (shared with the per-family modules via tpcds_lib)
 # ---------------------------------------------------------------------------
 
-def _dim(table: Table, pred=None, select=None) -> Table:
-    """Pre-filter + narrow a dimension table (predicate pushdown below
-    the join, as Spark's optimizer does)."""
-    p = plan()
-    if pred is not None:
-        p = p.filter(pred)
-    if select is not None:
-        p = p.select(*select)
-    if not p.steps:
-        return table
-    return p.run(table)
-
-
-_MAPS: dict = {}
-
-
-def _vocab_map(id_name: str, name_name: str, vocab) -> Table:
-    """A unique-key (id, name) decode table for a vocabulary, memoized by
-    (names, vocab) so repeated queries rebind the same Table object (the
-    plan compile cache is keyed on build-table identity)."""
-    key = (id_name, name_name, tuple(vocab))
-    hit = _MAPS.get(key)
-    if hit is None:
-        hit = Table([
-            (id_name, Column.from_numpy(
-                np.arange(1, len(vocab) + 1, dtype=np.int64))),
-            (name_name, Column.from_pylist(list(vocab), STRING)),
-        ])
-        _MAPS[key] = hit
-    return hit
-
-
-def _brand_map() -> Table:
-    return _vocab_map("__brand_id", "i_brand", BRANDS)
-
-
-def _category_map() -> Table:
-    return _vocab_map("__category_id", "i_category", CATEGORIES)
-
-
-def _class_map() -> Table:
-    return _vocab_map("__class_id", "i_class", CLASSES)
-
-
-def _scalar_table(**vals) -> Table:
-    cols = []
-    for k, v in vals.items():
-        arr = np.asarray([v])
-        if arr.dtype.kind == "i":
-            arr = arr.astype(np.int64)
-        cols.append((k, Column.from_numpy(arr)))
-    return Table(cols)
+from .tpcds_lib import (_brand_map, _category_map, _city_map,  # noqa: E402,F401
+                        _class_map, _dim, _scalar_table, _state_map,
+                        _vocab_map)
 
 
 # ---------------------------------------------------------------------------
@@ -327,14 +278,6 @@ def q96(d: TpcdsData) -> Table:
          .select("ss_ticket_number"))
     out = p.run(d.store_sales)
     return _scalar_table(cnt=out.num_rows)
-
-
-def _city_map() -> Table:
-    return _vocab_map("__city_id", "city", CITIES)
-
-
-def _state_map() -> Table:
-    return _vocab_map("__state_id", "state", STATES)
 
 
 def q15(d: TpcdsData) -> Table:
@@ -878,3 +821,12 @@ QUERIES = {
     "q67": q67, "q68": q68, "q79": q79, "q88": q88, "q89": q89,
     "q95": q95, "q96": q96, "q98": q98,
 }
+
+# Registry merge.  The per-family modules and this one share helpers via
+# tpcds_lib, so these imports are acyclic whichever module loads first.
+from . import tpcds_q_report as _report        # noqa: E402
+from . import tpcds_q_logistics as _logistics  # noqa: E402
+
+QUERIES.update(sorted(
+    list(_report.QUERIES.items()) + list(_logistics.QUERIES.items()),
+    key=lambda kv: int(kv[0][1:])))

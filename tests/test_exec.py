@@ -320,6 +320,20 @@ class TestBroadcastJoin:
             p = plan().join_broadcast(d, left_on="fk", right_on="dk", how=how)
             _check(p, f)
 
+    def test_semi_anti_duplicate_build_keys(self, rng):
+        # Membership joins accept a non-unique build side (deduped at
+        # bind time); inner/left still require unique keys.
+        f = self._fact(rng)
+        dup = Table([("dk", Column.from_numpy(
+            rng.integers(0, 40, 500).astype(np.int64),
+            validity=rng.random(500) > 0.1))])
+        for how in ("semi", "anti"):
+            p = plan().join_broadcast(dup, left_on="fk", right_on="dk",
+                                      how=how)
+            _check(p, f)
+        with pytest.raises(ValueError, match="unique build-side keys"):
+            plan().join_broadcast(dup, left_on="fk", right_on="dk").run(f)
+
     def test_search_mode(self, rng):
         from spark_rapids_tpu.exec.compile import _Bound
         f = self._fact(rng, hi=50_000)

@@ -1,0 +1,181 @@
+"""Oracle tests for the TPC-DS logistics family (tpcds_q_logistics.py).
+
+Same contract as tests/test_tpcds.py: every query is checked against an
+independent pandas re-implementation of the same semantics at a small
+scale (the bank must not be its own oracle, SURVEY.md §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpcds
+from spark_rapids_tpu.models.tpcds_queries import QUERIES
+
+from test_tpcds import _assert_frame
+
+SF_ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpcds.generate(SF_ROWS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def pdf(data):
+    out = {}
+    for nm in data.names():
+        t = getattr(data, nm)
+        out[nm] = pd.DataFrame(
+            {c: pd.array(t[c].to_pylist()) for c in t.names})
+    return out
+
+
+def _lag_oracle(pdf, fact, pfx, wh_key, site_dim, site_key, site_fk,
+                site_name):
+    dd, sm, wh = pdf["date_dim"], pdf["ship_mode"], pdf["warehouse"]
+    dds = dd[dd.d_month_seq.between(0, 11)].d_date_sk
+    j = (fact[fact[f"{pfx}_ship_date_sk"].isin(dds)]
+         .merge(sm[["sm_ship_mode_sk", "sm_type_id"]],
+                left_on=f"{pfx}_ship_mode_sk",
+                right_on="sm_ship_mode_sk"))
+    lag = (j[f"{pfx}_ship_date_sk"]
+           - j[f"{pfx}_sold_date_sk"]).to_numpy(dtype=float)
+    j = j.assign(
+        d30=((lag <= 30)).astype("int64"),
+        d60=((lag > 30) & (lag <= 60)).astype("int64"),
+        d90=((lag > 60) & (lag <= 90)).astype("int64"),
+        d120=((lag > 90) & (lag <= 120)).astype("int64"),
+        dmore=(lag > 120).astype("int64"))
+    keys = [wh_key, "sm_type_id", site_fk]
+    g = (j.groupby(keys, dropna=False)
+         [["d30", "d60", "d90", "d120", "dmore"]].sum().reset_index()
+         .rename(columns={"d30": "days_30", "d60": "days_60",
+                          "d90": "days_90", "d120": "days_120",
+                          "dmore": "days_more"}))
+    for c in ("days_30", "days_60", "days_90", "days_120", "days_more"):
+        g[c] = g[c].astype("int64")
+    g = (g.merge(wh[["w_warehouse_sk", "w_warehouse_name"]],
+                 left_on=wh_key, right_on="w_warehouse_sk")
+         .drop(columns=["w_warehouse_sk"]))
+    g["sm_type"] = [tpcds.SHIP_MODE_TYPES[i - 1] for i in g.sm_type_id]
+    g = (g.merge(site_dim[[site_key, site_name]],
+                 left_on=site_fk, right_on=site_key)
+         .drop(columns=[site_key] if site_key != site_fk else []))
+    return g.sort_values(keys).head(100)
+
+
+def test_q62(data, pdf):
+    got = QUERIES["q62"](data)
+    want = _lag_oracle(pdf, pdf["web_sales"], "ws", "ws_warehouse_sk",
+                       pdf["web_site"], "web_site_sk", "ws_web_site_sk",
+                       "web_name")
+    _assert_frame(got, want)
+
+
+def test_q99(data, pdf):
+    got = QUERIES["q99"](data)
+    want = _lag_oracle(pdf, pdf["catalog_sales"], "cs", "cs_warehouse_sk",
+                       pdf["call_center"], "cc_call_center_sk",
+                       "cs_call_center_sk", "cc_name")
+    _assert_frame(got, want)
+
+
+def test_q21(data, pdf):
+    got = QUERIES["q21"](data)
+    inv, it, wh = pdf["inventory"], pdf["item"], pdf["warehouse"]
+    pivot = tpcds.DATE_SK0 + 360
+    items = it[it.i_current_price.between(20.0, 60.0)].i_item_sk
+    j = inv[inv.inv_item_sk.isin(items)
+            & inv.inv_date_sk.between(pivot - 30, pivot + 30)].copy()
+    j["before"] = j.inv_quantity_on_hand.where(j.inv_date_sk < pivot, 0)
+    j["after"] = j.inv_quantity_on_hand.where(j.inv_date_sk >= pivot, 0)
+    g = (j.groupby(["inv_warehouse_sk", "inv_item_sk"], dropna=False)
+         .agg(inv_before=("before", lambda s: s.sum(min_count=1)),
+              inv_after=("after", lambda s: s.sum(min_count=1)))
+         .reset_index())
+    before = g.inv_before.to_numpy(dtype=float)
+    after = g.inv_after.to_numpy(dtype=float)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ratio = after / before
+    keep = (np.nan_to_num(before) > 0) & (ratio >= 2.0 / 3.0) \
+        & (ratio <= 3.0 / 2.0)
+    g = g[keep]
+    g = (g.merge(wh[["w_warehouse_sk", "w_warehouse_name"]],
+                 left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+         .drop(columns=["w_warehouse_sk"])
+         .merge(it[["i_item_sk", "i_item_id"]],
+                left_on="inv_item_sk", right_on="i_item_sk")
+         .drop(columns=["i_item_sk"]))
+    g = g.sort_values(["inv_warehouse_sk", "inv_item_sk"]).head(100)
+    for c in ("inv_before", "inv_after"):
+        g[c] = g[c].astype("int64")
+    _assert_frame(got, g)
+
+
+def _in_stock_oracle(pdf, fact, date_col, item_col, price_lo, price_hi,
+                     lo_d, hi_d):
+    inv, it = pdf["inventory"], pdf["item"]
+    qoh = inv.inv_quantity_on_hand.to_numpy(dtype=float)
+    inv_items = set(inv[(qoh >= 100) & (qoh <= 500)
+                        & inv.inv_date_sk.between(lo_d, hi_d)
+                        .to_numpy(dtype=bool)].inv_item_sk)
+    dts = fact[date_col].to_numpy(dtype=float)
+    sold = set(fact[(dts >= lo_d) & (dts <= hi_d)][item_col].dropna())
+    price = it.i_current_price.to_numpy(dtype=float)
+    want = it[(price >= price_lo) & (price <= price_hi)
+              & it.i_item_sk.isin(inv_items).to_numpy(dtype=bool)
+              & it.i_item_sk.isin(sold).to_numpy(dtype=bool)]
+    return (want[["i_item_sk", "i_item_id", "i_current_price"]]
+            .sort_values("i_item_sk").head(100))
+
+
+def test_q37(data, pdf):
+    got = QUERIES["q37"](data)
+    want = _in_stock_oracle(pdf, pdf["catalog_sales"], "cs_sold_date_sk",
+                            "cs_item_sk", 20.0, 50.0,
+                            tpcds.DATE_SK0 + 300, tpcds.DATE_SK0 + 360)
+    _assert_frame(got, want, float_cols=("i_current_price",))
+
+
+def test_q82(data, pdf):
+    got = QUERIES["q82"](data)
+    want = _in_stock_oracle(pdf, pdf["store_sales"], "ss_sold_date_sk",
+                            "ss_item_sk", 30.0, 60.0,
+                            tpcds.DATE_SK0 + 60, tpcds.DATE_SK0 + 120)
+    _assert_frame(got, want, float_cols=("i_current_price",))
+
+
+def test_q22(data, pdf):
+    got = QUERIES["q22"](data)
+    inv, it = pdf["inventory"], pdf["item"]
+    base = (inv[inv.inv_date_sk.between(tpcds.DATE_SK0,
+                                        tpcds.DATE_SK0 + 330)]
+            .merge(it[["i_item_sk", "i_category_id", "i_brand_id"]],
+                   left_on="inv_item_sk", right_on="i_item_sk"))
+    rows = []
+    leaf = (base.groupby(["i_category_id", "i_brand_id"], dropna=False)
+            ["inv_quantity_on_hand"].mean().reset_index())
+    for c, b, q in leaf.itertuples(index=False):
+        rows.append((int(c), int(b), float(q)))
+    cat = (base.groupby("i_category_id", dropna=False)
+           ["inv_quantity_on_hand"].mean().reset_index())
+    for c, q in cat.itertuples(index=False):
+        rows.append((int(c), None, float(q)))
+    rows.append((None, None, float(base.inv_quantity_on_hand.mean())))
+    rows.sort(key=lambda r: (round(r[2], 6) if r[2] is not None
+                             else float("inf"),
+                             r[0] if r[0] is not None else -1,
+                             r[1] if r[1] is not None else -1))
+    rows = rows[:100]
+    want = pd.DataFrame({
+        "i_category": pd.array(
+            [None if r[0] is None else tpcds.CATEGORIES[r[0] - 1]
+             for r in rows]),
+        "i_brand": pd.array(
+            [None if r[1] is None else tpcds.BRANDS[r[1] - 1]
+             for r in rows]),
+        "qoh": pd.array([r[2] for r in rows]),
+    })
+    _assert_frame(got, want, float_cols=("qoh",))

@@ -1,0 +1,281 @@
+"""Oracle tests for the TPC-DS reporting family (tpcds_q_report.py).
+
+Same contract as tests/test_tpcds.py: every query is checked against an
+independent pandas re-implementation of the same semantics at a small
+scale (the bank must not be its own oracle, SURVEY.md §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpcds
+from spark_rapids_tpu.models.tpcds_queries import QUERIES
+
+from test_tpcds import _assert_frame
+
+SF_ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpcds.generate(SF_ROWS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def pdf(data):
+    out = {}
+    for nm in data.names():
+        t = getattr(data, nm)
+        out[nm] = pd.DataFrame(
+            {c: pd.array(t[c].to_pylist()) for c in t.names})
+    return out
+
+
+def test_q9(data, pdf):
+    got = QUERIES["q9"](data)
+    ss = pdf["store_sales"]
+    qn = ss.ss_quantity.to_numpy(dtype=float)
+    chosen = []
+    for lo, hi in [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)]:
+        sub = ss[(qn >= lo) & (qn <= hi)]
+        cnt = int(sub.ss_quantity.count())
+        v = (sub.ss_ext_discount_amt.mean() if cnt > 3000
+             else sub.ss_net_paid.mean())
+        chosen.append(v)
+    want = pd.DataFrame({"bucket": np.arange(5, dtype=np.int64),
+                         "chosen_avg": chosen})
+    _assert_frame(got, want, float_cols=("chosen_avg",))
+
+
+def test_q13(data, pdf):
+    got = QUERIES["q13"](data)
+    ss, cd, ca = (pdf["store_sales"], pdf["customer_demographics"],
+                  pdf["customer_address"])
+    hd, dd = pdf["household_demographics"], pdf["date_dim"]
+    cd = cd.copy()
+    cd["cd_tag"] = np.select(
+        [(cd.cd_marital_status == "M")
+         & (cd.cd_education_status == "Advanced Degree"),
+         (cd.cd_marital_status == "S")
+         & (cd.cd_education_status == "College"),
+         (cd.cd_marital_status == "W")
+         & (cd.cd_education_status == "2 yr Degree")], [1, 2, 3], 0)
+    ca = ca.copy()
+    ca["ca_tag"] = np.select(
+        [ca.ca_state.isin(["TX", "OH"]),
+         ca.ca_state.isin(["OR", "NY", "WA"]),
+         ca.ca_state.isin(["GA", "TN", "IL"])], [1, 2, 3], 0)
+    dds = dd[dd.d_year == 1998].d_date_sk
+    j = (ss[ss.ss_sold_date_sk.isin(dds)]
+         .merge(cd[["cd_demo_sk", "cd_tag"]], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(hd[["hd_demo_sk", "hd_dep_count"]],
+                left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .merge(ca[["ca_address_sk", "ca_tag"]], left_on="ss_addr_sk",
+                right_on="ca_address_sk"))
+    sp = j.ss_sales_price.to_numpy(dtype=float)
+    npf = j.ss_net_profit.to_numpy(dtype=float)
+    c1 = (((j.cd_tag == 1) & (sp >= 100) & (sp <= 150)
+           & (j.hd_dep_count == 3))
+          | ((j.cd_tag == 2) & (sp >= 50) & (sp <= 100)
+             & (j.hd_dep_count == 1))
+          | ((j.cd_tag == 3) & (sp >= 150) & (sp <= 200)
+             & (j.hd_dep_count == 1)))
+    c2 = (((j.ca_tag == 1) & (npf >= 100) & (npf <= 200))
+          | ((j.ca_tag == 2) & (npf >= 150) & (npf <= 300))
+          | ((j.ca_tag == 3) & (npf >= 50) & (npf <= 250)))
+    f = j[np.asarray(c1 & c2, dtype=bool)]
+    want = pd.DataFrame({
+        "avg_qty": [float(f.ss_quantity.mean() if len(f) else 0.0)],
+        "avg_esp": [float(f.ss_ext_sales_price.mean() if len(f) else 0.0)],
+        "avg_ewc": [float(f.ss_ext_wholesale_cost.mean()
+                          if len(f) else 0.0)],
+        "sum_ewc": [float(f.ss_ext_wholesale_cost.sum()
+                          if len(f) else 0.0)],
+    })
+    _assert_frame(got, want,
+                  float_cols=("avg_qty", "avg_esp", "avg_ewc", "sum_ewc"))
+
+
+def test_q20(data, pdf):
+    got = QUERIES["q20"](data)
+    cs, it = pdf["catalog_sales"], pdf["item"]
+    lo, hi = tpcds.DATE_SK0 + 200, tpcds.DATE_SK0 + 230
+    j = cs[(cs.cs_sold_date_sk >= lo) & (cs.cs_sold_date_sk <= hi)]
+    its = it[it.i_category_id.isin([2, 5, 8])][["i_item_sk", "i_class_id"]]
+    j = j.merge(its, left_on="cs_item_sk", right_on="i_item_sk")
+    g = (j.groupby(["i_class_id", "cs_item_sk"], dropna=False)
+         ["cs_ext_sales_price"].sum(min_count=1).reset_index()
+         .rename(columns={"cs_ext_sales_price": "itemrevenue"}))
+    g["classrevenue"] = g.groupby("i_class_id")["itemrevenue"] \
+        .transform(lambda s: s.sum(min_count=1))
+    g["revenueratio"] = g.itemrevenue * 100.0 / g.classrevenue
+    g["i_class"] = [tpcds.CLASSES[i - 1] for i in g.i_class_id]
+    g = g.sort_values(["i_class_id", "cs_item_sk"]).head(100)
+    _assert_frame(got, g, float_cols=("itemrevenue", "classrevenue",
+                                      "revenueratio"))
+
+
+def _deviation_oracle(pdf, group_key, time_key, item_mask_fn):
+    ss, dd, it = pdf["store_sales"], pdf["date_dim"], pdf["item"]
+    dts = dd[dd.d_year == 1999][["d_date_sk", time_key]]
+    its = it[item_mask_fn(it)][["i_item_sk", group_key]]
+    j = (ss.merge(dts, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(its, left_on="ss_item_sk", right_on="i_item_sk"))
+    g = (j.groupby([group_key, time_key], dropna=False)["ss_sales_price"]
+         .sum(min_count=1).reset_index()
+         .rename(columns={"ss_sales_price": "sum_sales"}))
+    psum = g.groupby(group_key, dropna=False)["sum_sales"] \
+        .transform(lambda s: s.sum(min_count=1))
+    pcnt = g.groupby(group_key, dropna=False)["sum_sales"] \
+        .transform("count")
+    g["avg_quarterly_sales"] = (psum.to_numpy(dtype=float)
+                                / pcnt.to_numpy(dtype=float))
+    avg = g.avg_quarterly_sales.to_numpy(dtype=float)
+    ssales = g.sum_sales.to_numpy(dtype=float)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ratio = np.where(avg > 0, np.abs(ssales - avg) / avg, 0.0)
+    g = g[np.nan_to_num(ratio, nan=0.0) > 0.1]
+    g = g[[group_key, "sum_sales", "avg_quarterly_sales", time_key]]
+    return (g.sort_values(["avg_quarterly_sales", "sum_sales", group_key,
+                           time_key]).head(100))
+
+
+def test_q53(data, pdf):
+    got = QUERIES["q53"](data)
+    want = _deviation_oracle(
+        pdf, "i_manufact_id", "d_qoy",
+        lambda it: it.i_manufact_id.between(1, 40))
+    _assert_frame(got, want,
+                  float_cols=("sum_sales", "avg_quarterly_sales"))
+
+
+def test_q63(data, pdf):
+    got = QUERIES["q63"](data)
+    want = _deviation_oracle(
+        pdf, "i_manager_id", "d_moy",
+        lambda it: it.i_manager_id.between(1, 40))
+    _assert_frame(got, want,
+                  float_cols=("sum_sales", "avg_quarterly_sales"))
+
+
+def test_q45(data, pdf):
+    got = QUERIES["q45"](data)
+    ws, dd = pdf["web_sales"], pdf["date_dim"]
+    cu, ca = pdf["customer"], pdf["customer_address"]
+    zips = [85669, 86197, 88274, 83405, 86475]
+    item_sks = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+    dds = dd[(dd.d_qoy == 2) & (dd.d_year == 1999)].d_date_sk
+    j = (ws[ws.ws_sold_date_sk.isin(dds)]
+         .merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                left_on="ws_bill_customer_sk", right_on="c_customer_sk")
+         .merge(ca[["ca_address_sk", "ca_zip5", "ca_city_id"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk"))
+    keep = (j.ca_zip5.isin(zips).to_numpy(dtype=bool)
+            | j.ws_item_sk.isin(item_sks).to_numpy(dtype=bool))
+    j = j[keep]
+    g = (j.groupby(["ca_zip5", "ca_city_id"], dropna=False)
+         ["ws_sales_price"].sum(min_count=1).reset_index()
+         .rename(columns={"ws_sales_price": "total_price"}))
+    g["city"] = [tpcds.CITIES[i - 1] for i in g.ca_city_id]
+    g = g.sort_values(["ca_zip5", "ca_city_id"]).head(100)
+    _assert_frame(got, g, float_cols=("total_price",))
+
+
+def test_q90(data, pdf):
+    got = QUERIES["q90"](data)
+    ws, hd, wp = (pdf["web_sales"], pdf["household_demographics"],
+                  pdf["web_page"])
+    td, cu = pdf["time_dim"], pdf["customer"]
+    hds = hd[hd.hd_dep_count == 6].hd_demo_sk
+    wps = wp[wp.wp_char_count.between(4000, 5200)].wp_web_page_sk
+    td = td.copy()
+    td["slot"] = np.select([td.t_hour.between(8, 9),
+                            td.t_hour.between(19, 20)], [0, 1], -1)
+    tds = td[td.slot >= 0][["t_time_sk", "slot"]]
+    j = (ws[ws.ws_web_page_sk.isin(wps)]
+         .merge(cu[["c_customer_sk", "c_current_hdemo_sk"]],
+                left_on="ws_bill_customer_sk", right_on="c_customer_sk"))
+    j = j[j.c_current_hdemo_sk.isin(hds)]
+    j = j.merge(tds, left_on="ws_sold_time_sk", right_on="t_time_sk")
+    am = int((j.slot == 0).sum())
+    pm = int((j.slot == 1).sum())
+    g = got.to_pydict()
+    assert g["am_count"] == [am]
+    assert g["pm_count"] == [pm]
+    np.testing.assert_allclose(g["am_pm_ratio"][0],
+                               (am / pm) if pm else 0.0, rtol=1e-12)
+
+
+def _ticket_oracle(pdf, date_mask_fn, hd_mask_fn, counties, lo, hi):
+    ss, dd, st = pdf["store_sales"], pdf["date_dim"], pdf["store"]
+    hd, cu = pdf["household_demographics"], pdf["customer"]
+    dds = dd[date_mask_fn(dd)
+             & dd.d_year.isin([1998, 1999])].d_date_sk
+    sts = st[st.s_county.isin(counties)].s_store_sk
+    hds = hd[hd_mask_fn(hd)].hd_demo_sk
+    j = ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_store_sk.isin(sts)
+           & ss.ss_hdemo_sk.isin(hds)]
+    g = (j.groupby(["ss_ticket_number", "ss_customer_sk"], dropna=False)
+         ["ss_ticket_number"].count().rename("cnt").reset_index())
+    g["cnt"] = g.cnt.astype("int64")
+    g = g[g.cnt.between(lo, hi)]
+    g = (g.merge(cu[["c_customer_sk", "c_salutation", "c_first_name",
+                     "c_last_name", "c_preferred_cust_flag"]],
+                 left_on="ss_customer_sk", right_on="c_customer_sk")
+         .drop(columns=["c_customer_sk"]))
+    return (g.sort_values(["ss_customer_sk", "cnt", "ss_ticket_number"],
+                          ascending=[True, False, True]).head(100))
+
+
+def test_q34(data, pdf):
+    got = QUERIES["q34"](data)
+    want = _ticket_oracle(
+        pdf, lambda dd: dd.d_dom.between(1, 3) | dd.d_dom.between(25, 28),
+        lambda hd: hd.hd_vehicle_count > 0,
+        ["Fair County 0", "Rich County 1", "Walker County 0",
+         "Ziebach County 1"], 15, 20)
+    _assert_frame(got, want)
+
+
+def test_q73(data, pdf):
+    got = QUERIES["q73"](data)
+    want = _ticket_oracle(
+        pdf, lambda dd: dd.d_dom.between(1, 2),
+        lambda hd: ((hd.hd_dep_count > 0) | (hd.hd_vehicle_count > 1)),
+        ["Fair County 1", "Rich County 0", "Ziebach County 0"], 1, 5)
+    _assert_frame(got, want)
+
+
+def test_q46(data, pdf):
+    got = QUERIES["q46"](data)
+    ss, dd, st, hd = (pdf["store_sales"], pdf["date_dim"], pdf["store"],
+                      pdf["household_demographics"])
+    cu, ca = pdf["customer"], pdf["customer_address"]
+    dds = dd[dd.d_dow.isin([0, 6])
+             & dd.d_year.isin([1998, 1999])].d_date_sk
+    sts = st[st.s_city.isin(["Midway", "Fairview"])].s_store_sk
+    hds = hd[(hd.hd_dep_count == 5) | (hd.hd_vehicle_count == 2)].hd_demo_sk
+    j = (ss[ss.ss_sold_date_sk.isin(dds) & ss.ss_store_sk.isin(sts)
+            & ss.ss_hdemo_sk.isin(hds)]
+         .merge(ca[["ca_address_sk", "ca_city_id"]],
+                left_on="ss_addr_sk", right_on="ca_address_sk"))
+    g = (j.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city_id"],
+                   dropna=False)
+         .agg(amt=("ss_coupon_amt", lambda s: s.sum(min_count=1)),
+              profit=("ss_net_profit", lambda s: s.sum(min_count=1)))
+         .reset_index())
+    g = (g.merge(cu[["c_customer_sk", "c_current_addr_sk",
+                     "c_first_name", "c_last_name"]],
+                 left_on="ss_customer_sk", right_on="c_customer_sk")
+         .merge(ca[["ca_address_sk", "ca_city_id"]]
+                .rename(columns={"ca_address_sk": "__cur_addr",
+                                 "ca_city_id": "cur_city_id"}),
+                left_on="c_current_addr_sk", right_on="__cur_addr")
+         .drop(columns=["c_customer_sk", "__cur_addr"]))
+    g = g[g.cur_city_id != g.ca_city_id]
+    g["city"] = [tpcds.CITIES[i - 1] for i in g.ca_city_id]
+    g = (g.sort_values(["ss_customer_sk", "ss_ticket_number",
+                        "ca_city_id"]).head(100))
+    _assert_frame(got, g, float_cols=("amt", "profit"))
