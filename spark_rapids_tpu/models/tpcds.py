@@ -498,6 +498,7 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("ws_ext_wholesale_cost", price(n_ws)),
         ("ws_sales_price", price(n_ws)),
         ("ws_list_price", price(n_ws)),
+        ("ws_ship_addr_sk", _skewed_fk(rng, n_addr, n_ws)),
     ])
 
     catalog_sales = Table([
@@ -533,12 +534,40 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("cs_net_paid", price(n_cs)),
     ])
 
+    # -- returns: derived from sales rows (dsdgen's referential contract:
+    # every return references a real sale, so composite joins on
+    # (ticket/order, item, customer) actually match and sale-to-return
+    # lags are meaningful) --------------------------------------------------
+
+    def _take(tbl, name, idx):
+        c = tbl[name]
+        vals = np.asarray(c.data)[idx]
+        valid = None if c.validity is None else np.asarray(c.validity)[idx]
+        return vals, valid
+
+    def _ret_dates(src_dates, src_valid, n):
+        """Returned date = sold date + a 1..119-day lag (clipped to the
+        calendar), nulled at the same ~1% rate as sales dates."""
+        lag = rng.integers(1, 120, n)
+        base = (src_dates if src_valid is None
+                else np.where(src_valid, src_dates, DATE_SK0))
+        dates = np.minimum(base + lag, DATE_SK0 + N_DAYS - 1)
+        return Column.from_numpy(dates.astype(np.int64),
+                                 validity=rng.random(n) >= 0.01)
+
+    sr_idx = rng.integers(0, n_ss, n_sr)
+    sr_item, _ = _take(store_sales, "ss_item_sk", sr_idx)
+    sr_tkt, _ = _take(store_sales, "ss_ticket_number", sr_idx)
+    sr_cust, sr_cust_m = _take(store_sales, "ss_customer_sk", sr_idx)
+    sr_store, sr_store_m = _take(store_sales, "ss_store_sk", sr_idx)
+    sr_sold, sr_sold_m = _take(store_sales, "ss_sold_date_sk", sr_idx)
     store_returns = Table([
-        ("sr_returned_date_sk", sales_dates(n_sr)),
-        ("sr_customer_sk", _skewed_fk(rng, n_cust, n_sr)),
-        ("sr_store_sk", _skewed_fk(rng, n_store, n_sr)),
-        ("sr_item_sk", _skewed_fk(rng, n_item, n_sr, null_frac=0.0)),
-        ("sr_ticket_number", _col_i64(rng, 1, max(n_ss // 3, 2), n_sr)),
+        ("sr_returned_date_sk", _ret_dates(sr_sold, sr_sold_m, n_sr)),
+        ("sr_customer_sk", Column.from_numpy(sr_cust,
+                                             validity=sr_cust_m)),
+        ("sr_store_sk", Column.from_numpy(sr_store, validity=sr_store_m)),
+        ("sr_item_sk", Column.from_numpy(sr_item)),
+        ("sr_ticket_number", Column.from_numpy(sr_tkt)),
         ("sr_return_amt", _col_f64(rng, 0.5, 200.0, n_sr,
                                    null_frac=0.02)),
         ("sr_return_quantity", qty(n_sr)),
@@ -550,13 +579,19 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
                                        null_frac=0.01)),
     ])
 
+    wr_idx = rng.integers(0, n_ws, n_wr)
+    wr_ord, _ = _take(web_sales, "ws_order_number", wr_idx)
+    wr_item, _ = _take(web_sales, "ws_item_sk", wr_idx)
+    wr_cust, wr_cust_m = _take(web_sales, "ws_bill_customer_sk", wr_idx)
+    wr_sold, wr_sold_m = _take(web_sales, "ws_sold_date_sk", wr_idx)
     web_returns = Table([
-        ("wr_order_number", _col_i64(rng, 1, max(n_ws // 4, 2), n_wr)),
-        ("wr_returned_date_sk", sales_dates(n_wr)),
+        ("wr_order_number", Column.from_numpy(wr_ord)),
+        ("wr_returned_date_sk", _ret_dates(wr_sold, wr_sold_m, n_wr)),
         ("wr_return_amt", _col_f64(rng, 0.5, 200.0, n_wr,
                                    null_frac=0.02)),
-        ("wr_item_sk", _skewed_fk(rng, n_item, n_wr, null_frac=0.0)),
-        ("wr_returning_customer_sk", _skewed_fk(rng, n_cust, n_wr)),
+        ("wr_item_sk", Column.from_numpy(wr_item)),
+        ("wr_returning_customer_sk", Column.from_numpy(
+            wr_cust, validity=wr_cust_m)),
         ("wr_returning_addr_sk", _skewed_fk(rng, n_addr, n_wr)),
         ("wr_refunded_cdemo_sk", _skewed_fk(rng, n_cd, n_wr)),
         ("wr_refunded_addr_sk", _skewed_fk(rng, n_addr, n_wr)),
@@ -566,18 +601,29 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
         ("wr_return_quantity", qty(n_wr)),
     ])
 
+    cr_idx = rng.integers(0, n_cs, n_cr)
+    cr_ord, _ = _take(catalog_sales, "cs_order_number", cr_idx)
+    cr_item, _ = _take(catalog_sales, "cs_item_sk", cr_idx)
+    cr_cust, cr_cust_m = _take(catalog_sales, "cs_bill_customer_sk",
+                               cr_idx)
+    cr_cc, cr_cc_m = _take(catalog_sales, "cs_call_center_sk", cr_idx)
+    cr_page, cr_page_m = _take(catalog_sales, "cs_catalog_page_sk",
+                               cr_idx)
+    cr_sold, cr_sold_m = _take(catalog_sales, "cs_sold_date_sk", cr_idx)
     catalog_returns = Table([
-        ("cr_order_number", _col_i64(rng, 1, max(n_cs // 4, 2), n_cr)),
-        ("cr_item_sk", _skewed_fk(rng, n_item, n_cr, null_frac=0.0)),
-        ("cr_returned_date_sk", sales_dates(n_cr)),
+        ("cr_order_number", Column.from_numpy(cr_ord)),
+        ("cr_item_sk", Column.from_numpy(cr_item)),
+        ("cr_returned_date_sk", _ret_dates(cr_sold, cr_sold_m, n_cr)),
         ("cr_return_amount", _col_f64(rng, 0.5, 200.0, n_cr,
                                       null_frac=0.02)),
         ("cr_return_quantity", qty(n_cr)),
         ("cr_net_loss", _col_f64(rng, 0.5, 150.0, n_cr, null_frac=0.02)),
-        ("cr_returning_customer_sk", _skewed_fk(rng, n_cust, n_cr)),
+        ("cr_returning_customer_sk", Column.from_numpy(
+            cr_cust, validity=cr_cust_m)),
         ("cr_returning_addr_sk", _skewed_fk(rng, n_addr, n_cr)),
-        ("cr_call_center_sk", _skewed_fk(rng, n_cc, n_cr, null_frac=0.02)),
-        ("cr_catalog_page_sk", _skewed_fk(rng, n_cp, n_cr, null_frac=0.0)),
+        ("cr_call_center_sk", Column.from_numpy(cr_cc, validity=cr_cc_m)),
+        ("cr_catalog_page_sk", Column.from_numpy(cr_page,
+                                                 validity=cr_page_m)),
         ("cr_reason_sk", _skewed_fk(rng, len(REASONS), n_cr,
                                     null_frac=0.02)),
     ])

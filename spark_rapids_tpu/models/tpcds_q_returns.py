@@ -1,0 +1,256 @@
+"""TPC-DS bank, returns & order-flow family: returns-joined facts,
+order-level EXISTS/NOT-EXISTS, and excess-discount scalar shapes.
+
+Same conventions as :mod:`.tpcds_queries` (dimension pre-filtering,
+group-by-id/decode-after, FLOAT64 money); oracle-checked in
+tests/test_tpcds_returns.py.  Imported by :mod:`.tpcds_queries` for the
+registry merge; shared helpers live in :mod:`.tpcds_lib`.
+"""
+
+from __future__ import annotations
+
+from ..table import Table
+from ..exec import col, plan, when
+from .tpcds import DATE_SK0, TpcdsData
+from .tpcds_lib import _dim, _lag_buckets, _scalar_table
+
+
+def _order_flow(fact: Table, returns: Table, pfx: str, rpfx: str,
+                date_lo: int, date_hi: int, addr: Table, addr_key: str,
+                site: Table, site_fact_key: str, site_key: str,
+                returned: bool) -> Table:
+    """Shared q16/q94/q95 shape: distinct-order count + ship cost +
+    profit for orders shipped in a window, from customers in one state,
+    sold through chosen sites, spanning >1 warehouse, with
+    (``returned``) or without (NOT EXISTS) a matching return row."""
+    multi_wh = (plan()
+                .groupby_agg([f"{pfx}_order_number"],
+                             [(f"{pfx}_warehouse_sk", "nunique", "n_wh")])
+                .filter(col("n_wh") > 1)
+                .select(f"{pfx}_order_number")
+                .run(fact)
+                .rename({f"{pfx}_order_number": "__mw_order"}))
+    rets = returns.select([f"{rpfx}_order_number"])
+    p = (plan()
+         .filter(col(f"{pfx}_ship_date_sk").between(date_lo, date_hi))
+         .join_broadcast(addr, left_on=f"{pfx}_ship_addr_sk",
+                         right_on=addr_key, how="semi")
+         .join_broadcast(site, left_on=site_fact_key,
+                         right_on=site_key, how="semi")
+         .join_shuffled(rets, left_on=f"{pfx}_order_number",
+                        right_on=f"{rpfx}_order_number",
+                        how="semi" if returned else "anti")
+         .join_broadcast(multi_wh, left_on=f"{pfx}_order_number",
+                         right_on="__mw_order", how="semi")
+         .with_columns(one=when(col(f"{pfx}_order_number").is_valid(), 1)
+                       .otherwise(1))
+         .groupby_agg(["one"],
+                      [(f"{pfx}_order_number", "nunique", "order_count"),
+                       (f"{pfx}_ext_ship_cost", "sum", "ship_cost"),
+                       (f"{pfx}_net_profit", "sum", "net_profit")],
+                      domains={"one": (1, 1)}))
+    out = p.run(fact)
+    oc = out["order_count"].to_pylist()
+    sc = out["ship_cost"].to_pylist()
+    np_ = out["net_profit"].to_pylist()
+    return _scalar_table(
+        order_count=int(oc[0]) if oc and oc[0] is not None else 0,
+        ship_cost=float(sc[0]) if sc and sc[0] is not None else 0.0,
+        net_profit=float(np_[0]) if np_ and np_[0] is not None else 0.0)
+
+
+def q16(d: TpcdsData) -> Table:
+    """TPC-DS q16: catalog orders shipped in a 60-day window from one
+    state through chosen call centers, spanning >1 warehouse, with no
+    catalog return (NOT EXISTS)."""
+    addr = _dim(d.customer_address, col("ca_state").eq("GA"),
+                ["ca_address_sk"])
+    ccs = _dim(d.call_center,
+               col("cc_county").isin(["Fair County 0", "Rich County 1",
+                                      "Walker County 0"]),
+               ["cc_call_center_sk"])
+    return _order_flow(d.catalog_sales, d.catalog_returns, "cs", "cr",
+                       DATE_SK0 + 60, DATE_SK0 + 120, addr,
+                       "ca_address_sk", ccs, "cs_call_center_sk",
+                       "cc_call_center_sk", returned=False)
+
+
+def q94(d: TpcdsData) -> Table:
+    """TPC-DS q94: q95's web order-flow scalar with NOT EXISTS
+    (un-returned orders) instead of EXISTS."""
+    addr = _dim(d.customer_address, col("ca_state").eq("GA"),
+                ["ca_address_sk"])
+    sites = _dim(d.web_site, col("web_company_name").eq("able"),
+                 ["web_site_sk"])
+    return _order_flow(d.web_sales, d.web_returns, "ws", "wr",
+                       DATE_SK0 + 121, DATE_SK0 + 181, addr,
+                       "ca_address_sk", sites, "ws_web_site_sk",
+                       "web_site_sk", returned=False)
+
+
+def _excess_discount(fact: Table, pfx: str, items: Table,
+                     date_lo: int, date_hi: int) -> Table:
+    """Shared q32/q92 shape: total extended discount on rows whose
+    discount exceeds 1.3x the item's window average."""
+    avg_disc = (plan()
+                .filter(col(f"{pfx}_sold_date_sk").between(date_lo,
+                                                           date_hi))
+                .groupby_agg([f"{pfx}_item_sk"],
+                             [(f"{pfx}_ext_discount_amt", "mean",
+                               "avg_disc")])
+                .run(fact)
+                .rename({f"{pfx}_item_sk": "__adi"}))
+    p = (plan()
+         .filter(col(f"{pfx}_sold_date_sk").between(date_lo, date_hi))
+         .join_broadcast(items, left_on=f"{pfx}_item_sk",
+                         right_on="i_item_sk", how="semi")
+         .join_broadcast(avg_disc, left_on=f"{pfx}_item_sk",
+                         right_on="__adi")
+         .filter(col(f"{pfx}_ext_discount_amt")
+                 > col("avg_disc") * 1.3)
+         .with_columns(one=when(col(f"{pfx}_item_sk").is_valid(), 1)
+                       .otherwise(1))
+         .groupby_agg(["one"],
+                      [(f"{pfx}_ext_discount_amt", "sum",
+                        "excess_discount")],
+                      domains={"one": (1, 1)}))
+    out = p.run(fact)
+    ed = out["excess_discount"].to_pylist()
+    return _scalar_table(
+        excess_discount=float(ed[0]) if ed and ed[0] is not None else 0.0)
+
+
+def q32(d: TpcdsData) -> Table:
+    """TPC-DS q32: catalog excess-discount total for one manufacturer
+    over a 90-day window."""
+    items = _dim(d.item, col("i_manufact_id").eq(29), ["i_item_sk"])
+    return _excess_discount(d.catalog_sales, "cs", items,
+                            DATE_SK0 + 150, DATE_SK0 + 240)
+
+
+def q92(d: TpcdsData) -> Table:
+    """TPC-DS q92: q32's excess-discount shape over the web channel."""
+    items = _dim(d.item, col("i_manufact_id").eq(53), ["i_item_sk"])
+    return _excess_discount(d.web_sales, "ws", items,
+                            DATE_SK0 + 60, DATE_SK0 + 150)
+
+
+def _return_ratio(returns: Table, cust_key: str, addr_key: str,
+                  amt_key: str, date_key: str, date_pred,
+                  d: TpcdsData) -> Table:
+    """Shared q30/q81 shape: customers whose total returns exceed 1.2x
+    their state's average (two aggregation levels + decode).  Deviation:
+    the spec's extra home-state output filter is dropped — the synthetic
+    bank keeps all states so the result stays populated at small
+    scales."""
+    dates = _dim(d.date_dim, date_pred, ["d_date_sk"])
+    addr = d.customer_address.select(["ca_address_sk", "ca_state_id"])
+    ctr = (plan()
+           .join_broadcast(dates, left_on=date_key,
+                           right_on="d_date_sk", how="semi")
+           .join_broadcast(addr, left_on=addr_key,
+                           right_on="ca_address_sk")
+           .groupby_agg([cust_key, "ca_state_id"],
+                        [(amt_key, "sum", "ctr_total_return")])
+           .run(returns))
+    avg = (plan()
+           .groupby_agg(["ca_state_id"],
+                        [("ctr_total_return", "mean", "avg_return")])
+           .run(ctr)
+           .rename({"ca_state_id": "__avg_state"}))
+    cust = d.customer.select(["c_customer_sk", "c_customer_id",
+                              "c_salutation", "c_first_name",
+                              "c_last_name", "c_preferred_cust_flag",
+                              "c_birth_month", "c_birth_year"])
+    p = (plan()
+         .join_broadcast(avg, left_on="ca_state_id",
+                         right_on="__avg_state")
+         .filter(col("ctr_total_return") > col("avg_return") * 1.2)
+         .join_broadcast(cust, left_on=cust_key,
+                         right_on="c_customer_sk")
+         .sort_by([cust_key, "ca_state_id"])
+         .limit(100))
+    return p.run(ctr)
+
+
+def q30(d: TpcdsData) -> Table:
+    """TPC-DS q30: web customers returning more than 1.2x their state's
+    average in 1999, with customer details."""
+    return _return_ratio(d.web_returns, "wr_returning_customer_sk",
+                         "wr_returning_addr_sk", "wr_return_amt",
+                         "wr_returned_date_sk", col("d_year").eq(1999), d)
+
+
+def q81(d: TpcdsData) -> Table:
+    """TPC-DS q81: q30's return-ratio shape over catalog returns in
+    1998."""
+    return _return_ratio(d.catalog_returns, "cr_returning_customer_sk",
+                         "cr_returning_addr_sk", "cr_return_amount",
+                         "cr_returned_date_sk", col("d_year").eq(1998), d)
+
+
+def q93(d: TpcdsData) -> Table:
+    """TPC-DS q93: per-customer actual sales net of returns for one
+    return reason — store_sales joined many-to-many to store_returns on
+    (item, ticket), quantity reduced by the returned quantity when
+    recorded."""
+    reasons = _dim(d.reason, col("r_reason_desc").eq("reason 27"),
+                   ["r_reason_sk"])
+    rets = (plan()
+            .join_broadcast(reasons, left_on="sr_reason_sk",
+                            right_on="r_reason_sk", how="semi")
+            .select("sr_item_sk", "sr_ticket_number",
+                    "sr_return_quantity")
+            .run(d.store_returns))
+    p = (plan()
+         .join_shuffled(rets, left_on=["ss_item_sk", "ss_ticket_number"],
+                        right_on=["sr_item_sk", "sr_ticket_number"])
+         .with_columns(act_sales=when(
+             col("sr_return_quantity").is_valid(),
+             (col("ss_quantity") - col("sr_return_quantity"))
+             * col("ss_sales_price"))
+             .otherwise(col("ss_quantity") * col("ss_sales_price")))
+         .groupby_agg(["ss_customer_sk"],
+                      [("act_sales", "sum", "sumsales")])
+         .sort_by(["sumsales", "ss_customer_sk"])
+         .limit(100))
+    return p.run(d.store_sales)
+
+
+def q50(d: TpcdsData) -> Table:
+    """TPC-DS q50: sale-to-return lag distribution per store for returns
+    landing in one month — five CASE-summed 30-day buckets over the
+    (ticket, item, customer) sales/returns join."""
+    dates = _dim(d.date_dim, col("d_year").eq(1999) & col("d_moy").eq(8),
+                 ["d_date_sk"])
+    rets = (plan()
+            .join_broadcast(dates, left_on="sr_returned_date_sk",
+                            right_on="d_date_sk", how="semi")
+            .select("sr_ticket_number", "sr_item_sk", "sr_customer_sk",
+                    "sr_returned_date_sk")
+            .run(d.store_returns))
+    stores = (d.store.select(["s_store_sk", "s_store_id"])
+              .rename({"s_store_sk": "__s_sk"}))
+    lag = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    p = (plan()
+         .join_shuffled(rets,
+                        left_on=["ss_ticket_number", "ss_item_sk",
+                                 "ss_customer_sk"],
+                        right_on=["sr_ticket_number", "sr_item_sk",
+                                  "sr_customer_sk"]))
+    p = (_lag_buckets(p, lag)
+         .groupby_agg(["ss_store_sk"],
+                      [("d30", "sum", "days_30"), ("d60", "sum", "days_60"),
+                       ("d90", "sum", "days_90"),
+                       ("d120", "sum", "days_120"),
+                       ("dmore", "sum", "days_more")])
+         .join_broadcast(stores, left_on="ss_store_sk", right_on="__s_sk")
+         .sort_by(["ss_store_sk"])
+         .limit(100))
+    return p.run(d.store_sales)
+
+
+QUERIES = {
+    "q16": q16, "q30": q30, "q32": q32, "q50": q50, "q81": q81,
+    "q92": q92, "q93": q93, "q94": q94,
+}

@@ -34,11 +34,9 @@ from __future__ import annotations
 import numpy as np
 
 from ..column import Column
-from ..dtypes import STRING
-from ..exec import col, lit, plan, when
+from ..exec import col, plan, when
 from ..table import Table
-from .tpcds import (BRANDS, CATEGORIES, CITIES, CLASSES, DAY_NAMES, STATES,
-                    TpcdsData)
+from .tpcds import TpcdsData
 
 
 # ---------------------------------------------------------------------------
@@ -826,7 +824,9 @@ QUERIES = {
 # tpcds_lib, so these imports are acyclic whichever module loads first.
 from . import tpcds_q_report as _report        # noqa: E402
 from . import tpcds_q_logistics as _logistics  # noqa: E402
+from . import tpcds_q_returns as _returns      # noqa: E402
 
 QUERIES.update(sorted(
-    list(_report.QUERIES.items()) + list(_logistics.QUERIES.items()),
+    list(_report.QUERIES.items()) + list(_logistics.QUERIES.items())
+    + list(_returns.QUERIES.items()),
     key=lambda kv: int(kv[0][1:])))

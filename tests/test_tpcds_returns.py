@@ -1,0 +1,224 @@
+"""Oracle tests for the TPC-DS returns & order-flow family
+(tpcds_q_returns.py).
+
+Same contract as tests/test_tpcds.py: every query is checked against an
+independent pandas re-implementation of the same semantics at a small
+scale (the bank must not be its own oracle, SURVEY.md §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.models import tpcds
+from spark_rapids_tpu.models.tpcds_queries import QUERIES
+
+from test_tpcds import _assert_frame
+
+SF_ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpcds.generate(SF_ROWS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def pdf(data):
+    out = {}
+    for nm in data.names():
+        t = getattr(data, nm)
+        out[nm] = pd.DataFrame(
+            {c: pd.array(t[c].to_pylist()) for c in t.names})
+    return out
+
+
+def _order_flow_oracle(fact, rets, pfx, rpfx, lo, hi, addr_set, site_col,
+                       site_set, returned):
+    multi = fact.groupby(f"{pfx}_order_number") \
+        [f"{pfx}_warehouse_sk"].nunique()
+    multi = set(multi[multi > 1].index)
+    ship = fact[f"{pfx}_ship_date_sk"].to_numpy(dtype=float)
+    ret_orders = set(rets[f"{rpfx}_order_number"].dropna())
+    in_rets = fact[f"{pfx}_order_number"].isin(ret_orders) \
+        .to_numpy(dtype=bool)
+    j = fact[(ship >= lo) & (ship <= hi)
+             & fact[f"{pfx}_ship_addr_sk"].isin(addr_set)
+             .to_numpy(dtype=bool)
+             & fact[site_col].isin(site_set).to_numpy(dtype=bool)
+             & fact[f"{pfx}_order_number"].isin(multi)
+             .to_numpy(dtype=bool)
+             & (in_rets if returned else ~in_rets)]
+    return (j[f"{pfx}_order_number"].nunique(),
+            j[f"{pfx}_ext_ship_cost"].sum(),
+            j[f"{pfx}_net_profit"].sum())
+
+
+def _check_scalar(got, oc, sc, npf):
+    g = got.to_pydict()
+    assert g["order_count"][0] == oc
+    np.testing.assert_allclose(g["ship_cost"][0], sc, rtol=1e-9)
+    np.testing.assert_allclose(g["net_profit"][0], npf, rtol=1e-9)
+
+
+def test_q16(data, pdf):
+    got = QUERIES["q16"](data)
+    ca, cc = pdf["customer_address"], pdf["call_center"]
+    addr_set = set(ca[ca.ca_state == "GA"].ca_address_sk)
+    cc_set = set(cc[cc.cc_county.isin(
+        ["Fair County 0", "Rich County 1", "Walker County 0"])]
+        .cc_call_center_sk)
+    oc, sc, npf = _order_flow_oracle(
+        pdf["catalog_sales"], pdf["catalog_returns"], "cs", "cr",
+        tpcds.DATE_SK0 + 60, tpcds.DATE_SK0 + 120, addr_set,
+        "cs_call_center_sk", cc_set, returned=False)
+    _check_scalar(got, oc, sc, npf)
+
+
+def test_q94(data, pdf):
+    got = QUERIES["q94"](data)
+    ca, web = pdf["customer_address"], pdf["web_site"]
+    addr_set = set(ca[ca.ca_state == "GA"].ca_address_sk)
+    site_set = set(web[web.web_company_name == "able"].web_site_sk)
+    oc, sc, npf = _order_flow_oracle(
+        pdf["web_sales"], pdf["web_returns"], "ws", "wr",
+        tpcds.DATE_SK0 + 121, tpcds.DATE_SK0 + 181, addr_set,
+        "ws_web_site_sk", site_set, returned=False)
+    _check_scalar(got, oc, sc, npf)
+
+
+def _excess_oracle(fact, it, pfx, manufact, lo, hi):
+    sold = fact[f"{pfx}_sold_date_sk"].to_numpy(dtype=float)
+    win = fact[(sold >= lo) & (sold <= hi)]
+    avg = win.groupby(f"{pfx}_item_sk")[f"{pfx}_ext_discount_amt"] \
+        .mean().rename("avg_disc").reset_index()
+    items = set(it[it.i_manufact_id == manufact].i_item_sk)
+    j = win[win[f"{pfx}_item_sk"].isin(items).to_numpy(dtype=bool)] \
+        .merge(avg, on=f"{pfx}_item_sk")
+    disc = j[f"{pfx}_ext_discount_amt"].to_numpy(dtype=float)
+    keep = disc > 1.3 * j.avg_disc.to_numpy(dtype=float)
+    return j[np.nan_to_num(keep.astype(float), nan=0.0) > 0] \
+        [f"{pfx}_ext_discount_amt"].sum()
+
+
+def test_q32(data, pdf):
+    got = QUERIES["q32"](data)
+    want = _excess_oracle(pdf["catalog_sales"], pdf["item"], "cs", 29,
+                          tpcds.DATE_SK0 + 150, tpcds.DATE_SK0 + 240)
+    np.testing.assert_allclose(
+        got.to_pydict()["excess_discount"][0], want, rtol=1e-9)
+
+
+def test_q92(data, pdf):
+    got = QUERIES["q92"](data)
+    want = _excess_oracle(pdf["web_sales"], pdf["item"], "ws", 53,
+                          tpcds.DATE_SK0 + 60, tpcds.DATE_SK0 + 150)
+    np.testing.assert_allclose(
+        got.to_pydict()["excess_discount"][0], want, rtol=1e-9)
+
+
+def _return_ratio_oracle(pdf, ret_name, cust_key, addr_key, amt_key,
+                         date_key, year):
+    rets, dd, ca, cu = (pdf[ret_name], pdf["date_dim"],
+                        pdf["customer_address"], pdf["customer"])
+    dds = dd[dd.d_year == year].d_date_sk
+    j = (rets[rets[date_key].isin(dds)]
+         .merge(ca[["ca_address_sk", "ca_state_id"]],
+                left_on=addr_key, right_on="ca_address_sk"))
+    ctr = (j.groupby([cust_key, "ca_state_id"], dropna=False)
+           [amt_key].sum(min_count=1).reset_index()
+           .rename(columns={amt_key: "ctr_total_return"}))
+    avg = (ctr.groupby("ca_state_id")["ctr_total_return"].mean()
+           .rename("avg_return").reset_index())
+    g = ctr.merge(avg, on="ca_state_id")
+    tot = g.ctr_total_return.to_numpy(dtype=float)
+    av = g.avg_return.to_numpy(dtype=float)
+    g = g[np.nan_to_num(tot, nan=-np.inf) > 1.2 * av]
+    g = (g.merge(cu[["c_customer_sk", "c_customer_id", "c_salutation",
+                     "c_first_name", "c_last_name",
+                     "c_preferred_cust_flag", "c_birth_month",
+                     "c_birth_year"]],
+                 left_on=cust_key, right_on="c_customer_sk")
+         .drop(columns=["c_customer_sk"]))
+    return g.sort_values([cust_key, "ca_state_id"]).head(100)
+
+
+def test_q30(data, pdf):
+    got = QUERIES["q30"](data)
+    want = _return_ratio_oracle(pdf, "web_returns",
+                                "wr_returning_customer_sk",
+                                "wr_returning_addr_sk", "wr_return_amt",
+                                "wr_returned_date_sk", 1999)
+    _assert_frame(got, want,
+                  float_cols=("ctr_total_return", "avg_return"))
+
+
+def test_q81(data, pdf):
+    got = QUERIES["q81"](data)
+    want = _return_ratio_oracle(pdf, "catalog_returns",
+                                "cr_returning_customer_sk",
+                                "cr_returning_addr_sk",
+                                "cr_return_amount",
+                                "cr_returned_date_sk", 1998)
+    _assert_frame(got, want,
+                  float_cols=("ctr_total_return", "avg_return"))
+
+
+def test_q93(data, pdf):
+    got = QUERIES["q93"](data)
+    ss, sr, rs = pdf["store_sales"], pdf["store_returns"], pdf["reason"]
+    rsk = set(rs[rs.r_reason_desc == "reason 27"].r_reason_sk)
+    rets = sr[sr.sr_reason_sk.isin(rsk)][
+        ["sr_item_sk", "sr_ticket_number", "sr_return_quantity"]]
+    j = ss.merge(rets, left_on=["ss_item_sk", "ss_ticket_number"],
+                 right_on=["sr_item_sk", "sr_ticket_number"])
+    qty = j.ss_quantity.to_numpy(dtype=float)
+    retq = j.sr_return_quantity.to_numpy(dtype=float)
+    price = j.ss_sales_price.to_numpy(dtype=float)
+    act = np.where(~np.isnan(retq), (qty - retq) * price, qty * price)
+    j = j.assign(act=act)
+    g = (j.groupby("ss_customer_sk", dropna=False)["act"]
+         .sum(min_count=1).rename("sumsales").reset_index())
+    # engine sort order places null aggregates first
+    g = g.sort_values(["sumsales", "ss_customer_sk"],
+                      na_position="first").head(100)
+    _assert_frame(got, g, float_cols=("sumsales",))
+
+
+def test_q50(data, pdf):
+    got = QUERIES["q50"](data)
+    ss, sr, dd, st = (pdf["store_sales"], pdf["store_returns"],
+                      pdf["date_dim"], pdf["store"])
+    dds = dd[(dd.d_year == 1999) & (dd.d_moy == 8)].d_date_sk
+    rets = sr[sr.sr_returned_date_sk.isin(dds)][
+        ["sr_ticket_number", "sr_item_sk", "sr_customer_sk",
+         "sr_returned_date_sk"]]
+    # SQL join semantics: null keys never match (pandas merge would
+    # match NA == NA, and returns are sampled from sales rows, so a
+    # null-customer return always has a would-be NA partner)
+    rets = rets[rets.sr_customer_sk.notna()]
+    j = ss.merge(rets,
+                 left_on=["ss_ticket_number", "ss_item_sk",
+                          "ss_customer_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk",
+                           "sr_customer_sk"])
+    lag = (j.sr_returned_date_sk.to_numpy(dtype=float)
+           - j.ss_sold_date_sk.to_numpy(dtype=float))
+    j = j.assign(
+        d30=(lag <= 30).astype("int64"),
+        d60=((lag > 30) & (lag <= 60)).astype("int64"),
+        d90=((lag > 60) & (lag <= 90)).astype("int64"),
+        d120=((lag > 90) & (lag <= 120)).astype("int64"),
+        dmore=(lag > 120).astype("int64"))
+    g = (j.groupby("ss_store_sk", dropna=False)
+         [["d30", "d60", "d90", "d120", "dmore"]].sum().reset_index()
+         .rename(columns={"d30": "days_30", "d60": "days_60",
+                          "d90": "days_90", "d120": "days_120",
+                          "dmore": "days_more"}))
+    for c in ("days_30", "days_60", "days_90", "days_120", "days_more"):
+        g[c] = g[c].astype("int64")
+    g = (g.merge(st[["s_store_sk", "s_store_id"]],
+                 left_on="ss_store_sk", right_on="s_store_sk")
+         .drop(columns=["s_store_sk"]))
+    g = g.sort_values("ss_store_sk").head(100)
+    _assert_frame(got, g)
