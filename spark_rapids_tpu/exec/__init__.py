@@ -22,6 +22,7 @@ no host round trip happens until the caller materializes the result.  See
 from .expr import CaseWhen, Col, Expr, Lit, col, lit, when
 from .lazy import LazyTable, lazy
 from .plan import Plan, plan
+from .setops import except_keys, intersect_keys
 
 __all__ = ["CaseWhen", "Col", "Expr", "LazyTable", "Lit", "Plan", "col",
-           "lazy", "lit", "plan", "when"]
+           "except_keys", "intersect_keys", "lazy", "lit", "plan", "when"]

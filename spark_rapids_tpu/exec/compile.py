@@ -54,7 +54,8 @@ from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate, render
 from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
-                   LimitStep, Plan, ProjectStep, SortStep, WindowStep)
+                   LimitStep, Plan, ProjectStep, SortStep, UnionAllStep,
+                   WindowStep)
 
 def _dense_max_cells() -> int:
     """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
@@ -87,6 +88,15 @@ class _JoinMarkerT:
 _JOIN_MARKER = _JoinMarkerT()
 
 
+class _UnionMarkerT:
+    """Data-free stand-in for UnionAllStep in compiled-program assembly."""
+    def __repr__(self):
+        return "<union>"
+
+
+_UNION_MARKER = _UnionMarkerT()
+
+
 # ---------------------------------------------------------------------------
 # bind-time metadata
 # ---------------------------------------------------------------------------
@@ -111,6 +121,21 @@ class _GroupMeta:
     #: cells per key (dense): domain size + null slot.
     sizes: tuple[int, ...]
     cells: int
+
+
+@dataclass(frozen=True)
+class _UnionMeta:
+    """Static description of one UNION ALL branch (part of the
+    compile-cache key; like :func:`_Bound.assembly_steps` it must not pin
+    the branch table's device buffers)."""
+    index: int
+    steps: tuple                     # branch assembly steps (markers)
+    group_metas: tuple
+    join_metas: tuple
+    union_metas: tuple               # nested unions inside the branch
+    n: int                           # branch input rows
+    exec_names: tuple[str, ...]      # branch program inputs
+    side_names: tuple[str, ...]      # branch side inputs
 
 
 @dataclass(frozen=True)
@@ -174,6 +199,7 @@ class _Bound:
         self.steps: tuple = ()
         self.group_metas: list[_GroupMeta] = []
         self.join_metas: list = []
+        self.union_metas: list[_UnionMeta] = []
         #: the bound input table (shuffled-join bind-time probes read the
         #: original key columns from it)
         self._table = table
@@ -275,7 +301,11 @@ class _Bound:
                 self.group_metas.append(
                     self._group_meta(step, table, passthrough))
                 steps.append(step)
-                passthrough = set(step.keys)
+                # After a grouping-sets step a key column may be null at
+                # rolled-up levels, so its input-column metadata no longer
+                # describes it — keep nothing bind-time-known.
+                passthrough = set() if step.sets is not None \
+                    else set(step.keys)
                 self.probe_sources = {}
                 self._row_aligned = False
                 self._live_strcols = set()
@@ -304,6 +334,8 @@ class _Bound:
                     if out.startswith("__strref__:")}
                 current_names = (list(step.keys)
                                  + [out for _, _, out in step.aggs])
+                if step.sets is not None:
+                    current_names.append(step.grouping_id)
             elif isinstance(step, WindowStep):
                 if step.value is not None and (
                         step.value in self.string_cols
@@ -359,6 +391,37 @@ class _Bound:
                     current_names += [out for _, out in meta.pays]
                     current_names += [out for _, out in meta.str_pays]
                     self._deferred_strs |= {out for _, out in meta.str_pays}
+            elif isinstance(step, UnionAllStep):
+                meta, branch = self._bind_union(step, len(self.union_metas),
+                                                current_names)
+                self.union_metas.append(meta)
+                steps.append(step)
+                # Post-union state: rows are no longer aligned with the
+                # input table; dense group-bys on post-union keys stay
+                # possible by probing BOTH sides' bind-time sources.
+                merged: dict[str, tuple] = {}
+                for nm in current_names:
+                    if _is_engine_hidden(nm):
+                        continue
+                    mine = None
+                    if nm in table and nm in passthrough:
+                        mine = (table[nm], False)
+                    elif nm in self.probe_sources:
+                        mine = self.probe_sources[nm]
+                    theirs = None
+                    if nm in branch._table and nm in branch._passthrough:
+                        theirs = (branch._table[nm], False)
+                    elif nm in branch.probe_sources:
+                        theirs = branch.probe_sources[nm]
+                    if mine is not None and theirs is not None:
+                        srcs = (mine[0] if isinstance(mine[0], tuple)
+                                else (mine[0],))
+                        srcs += (theirs[0] if isinstance(theirs[0], tuple)
+                                 else (theirs[0],))
+                        merged[nm] = (srcs, mine[1] or theirs[1])
+                self.probe_sources = merged
+                passthrough = set()
+                self._row_aligned = False
             else:
                 if isinstance(step, (SortStep, LimitStep)):
                     self._row_aligned = False
@@ -534,7 +597,52 @@ class _Bound:
                     f"(column {value_name!r})")
         if not changed:
             return step
-        return GroupAggStep(step.keys, tuple(new_aggs), step.domains)
+        return GroupAggStep(step.keys, tuple(new_aggs), step.domains,
+                            step.sets, step.grouping_id)
+
+    def _bind_union(self, step: UnionAllStep, index: int,
+                    current_names: list[str]):
+        """Bind a UNION ALL branch: recursively bind its plan over its
+        table, register the branch's program/side inputs under a
+        ``__union{i}__:`` prefix, and emit the static meta.  Returns
+        ``(meta, branch_bound)`` — the bound branch is used at bind time
+        only (probe-source merging); the meta carries no device buffers."""
+        if self.string_cols or self.dictionaries or self._deferred_strs:
+            raise TypeError(
+                "union_all over string-carrying state is not supported "
+                "(dictionary codes from two binds don't share a "
+                "vocabulary); drop/aggregate the string columns first or "
+                "use ops.concat_tables + a fresh plan")
+        tbl = step.table
+        if tbl.num_rows == 0:
+            raise ValueError(
+                "union_all branch table has no rows; drop the branch "
+                "(XLA programs need non-degenerate static shapes)")
+        branch = _Bound(step.plan, tbl)
+        if branch.string_cols or branch.dictionaries \
+                or branch._deferred_strs:
+            raise TypeError(
+                "union_all branch carries string columns; aggregate or "
+                "drop them in the branch plan first")
+        visible = {nm for nm in current_names if not _is_engine_hidden(nm)}
+        b_order = _final_order(step.plan.steps, branch.input_names)
+        b_visible = {nm for nm in b_order if not _is_engine_hidden(nm)}
+        if visible != b_visible:
+            raise TypeError(
+                f"union_all schema mismatch: state has "
+                f"{sorted(visible)}, branch produces {sorted(b_visible)}")
+        prefix = f"__union{index}__:"
+        for nm, c in branch.exec_cols.items():
+            self.side_inputs[prefix + nm] = c
+        for nm, c in branch.side_inputs.items():
+            self.side_inputs[prefix + "side:" + nm] = c
+        meta = _UnionMeta(index, branch.assembly_steps(),
+                          tuple(branch.group_metas),
+                          tuple(branch.join_metas),
+                          tuple(branch.union_metas), branch.n,
+                          tuple(branch.exec_cols),
+                          tuple(branch.side_inputs))
+        return meta, branch
 
     def _group_meta(self, step: GroupAggStep, table: Table,
                     passthrough: set[str]) -> _GroupMeta:
@@ -561,11 +669,17 @@ class _Bound:
                 src, forced_null = self.probe_sources[name]
             else:
                 src, forced_null = None, True
+            # Post-union probe sources are tuples (one per union side):
+            # domains combine as the union of per-source ranges.
+            srcs = (src if isinstance(src, tuple)
+                    else (src,) if src is not None else ())
+            src = srcs[0] if srcs else None
             col = self.exec_cols.get(name) if name in passthrough else None
             if col is not None:
                 nullable = col.validity is not None
-            elif src is not None:
-                nullable = forced_null or src.validity is not None
+            elif srcs:
+                nullable = forced_null or any(
+                    s.validity is not None for s in srcs)
             else:
                 nullable = True
             dtype = (col or src).dtype if (col or src) is not None else INT64
@@ -574,21 +688,27 @@ class _Bound:
                 lo, hi = 0, max(len(dictionary) - 1, 0)
             elif hint is not None:
                 lo, hi = hint
-            elif src is not None and src.dtype == BOOL8:
+            elif srcs and all(s.dtype == BOOL8 for s in srcs):
                 lo, hi = 0, 1
-            elif (dense and src is not None and src.offsets is None
-                  and src.dtype.is_integer and not src.dtype.is_decimal
-                  and not src.dtype.is_timestamp):
+            elif (dense and srcs
+                  and all(s.offsets is None and s.dtype.is_integer
+                          and not s.dtype.is_decimal
+                          and not s.dtype.is_timestamp for s in srcs)):
                 # Probe only while dense is still possible — each first
                 # probe is a blocking host sync.
-                mask = (self.probe_mask
-                        if src.size == self.n and self.probe_mask is not None
-                        else None)
-                rng = column_int_range(src, extra_mask=mask)
-                if rng is None or rng[1] - rng[0] + 1 > _dense_max_cells():
+                rngs = []
+                for s in srcs:
+                    mask = (self.probe_mask
+                            if len(srcs) == 1 and s.size == self.n
+                            and self.probe_mask is not None else None)
+                    rngs.append(column_int_range(s, extra_mask=mask))
+                if any(r is None for r in rngs):
                     dense = False
                 else:
-                    lo, hi = rng
+                    lo = min(r[0] for r in rngs)
+                    hi = max(r[1] for r in rngs)
+                    if hi - lo + 1 > _dense_max_cells():
+                        dense = False
             else:
                 dense = False
             size = (hi - lo + 1) + (1 if nullable else 0)
@@ -602,14 +722,21 @@ class _Bound:
         return _GroupMeta(dense, tuple(keys), tuple(sizes), cells)
 
     def assembly_steps(self) -> tuple:
-        """Steps with JoinStep replaced by a data-free marker: the traced
-        program reads everything it needs from the side inputs and the
-        static JoinMeta, so neither the compile-cache key nor the compiled
-        closure may pin the build Table's device buffers (two build tables
-        with identical signatures correctly share one program)."""
-        return tuple(_JOIN_MARKER
-                     if isinstance(s, (JoinStep, JoinShuffledStep)) else s
-                     for s in self.steps)
+        """Steps with JoinStep/UnionAllStep replaced by data-free markers:
+        the traced program reads everything it needs from the side inputs
+        and the static metas, so neither the compile-cache key nor the
+        compiled closure may pin the build/branch Tables' device buffers
+        (two tables with identical signatures correctly share one
+        program)."""
+        out = []
+        for s in self.steps:
+            if isinstance(s, (JoinStep, JoinShuffledStep)):
+                out.append(_JOIN_MARKER)
+            elif isinstance(s, UnionAllStep):
+                out.append(_UNION_MARKER)
+            else:
+                out.append(s)
+        return tuple(out)
 
     def signature(self):
         cols = tuple(_ColInfo(n, int(c.dtype.type_id), c.dtype.scale,
@@ -619,7 +746,8 @@ class _Bound:
                       c.validity is not None)
                      for n, c in self.side_inputs.items())
         return (self.assembly_steps(), self.n, cols, side,
-                tuple(self.group_metas), tuple(self.join_metas))
+                tuple(self.group_metas), tuple(self.join_metas),
+                tuple(self.union_metas))
 
 
 # ---------------------------------------------------------------------------
@@ -634,6 +762,21 @@ def _trace_filter(cols, sel, step: FilterStep):
     return cols, keep if sel is None else (sel & keep)
 
 
+def lit_column(value, n: int) -> Column:
+    """Broadcast a bare scalar literal to an ``n``-row constant column
+    (Spark ``lit()``); the dtype follows the Python type."""
+    if isinstance(value, bool):
+        return Column(data=jnp.full(n, value, jnp.uint8), dtype=BOOL8)
+    if isinstance(value, int):
+        return Column(data=jnp.full(n, value, jnp.int64), dtype=INT64)
+    if isinstance(value, float):
+        from ..dtypes import FLOAT64
+        return Column(data=jnp.full(n, value, jnp.float64), dtype=FLOAT64)
+    raise TypeError(
+        f"cannot project literal {value!r} as a column (bool/int/float "
+        f"literals broadcast; strings cannot enter a traced program)")
+
+
 def _trace_project(cols, sel, step: ProjectStep):
     new = dict(cols) if not step.narrow else {}
     if step.narrow:
@@ -643,12 +786,13 @@ def _trace_project(cols, sel, step: ProjectStep):
         for nm in cols:
             if _is_engine_hidden(nm):
                 new[nm] = cols[nm]
+    n = next(iter(cols.values())).size
     for name, e in step.cols:
         if isinstance(e, Col) and e.name == name and name not in cols:
             continue          # deferred string passthrough (rowid-carried)
         out = evaluate(e, cols)
         if not isinstance(out, Column):       # bare literal select
-            raise TypeError(f"projection {name!r} is not a column expression")
+            out = lit_column(out, n)
         new[name] = out
     return new, sel
 
@@ -906,13 +1050,81 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
             else:                       # count_all / count / sum / sumsq
                 merged[k] = jax.lax.psum(v, axis)
         acc = merged
-    counts_all = acc["count_all"]
 
+    if step.sets is None:
+        return _dense_level_outputs(cols, step, meta, acc,
+                                    tuple(range(len(meta.keys))), n)
+
+    # Grouping sets: the finest level's accumulators reduce along the
+    # rolled-up key axes (sum for counts/sums, min/max for extrema) — all
+    # levels come from ONE pass over the rows.
+    outs, sels = [], []
+    for active in step.sets:
+        acc_s = _reduce_acc_axes(acc, meta, active)
+        o, s = _dense_level_outputs(cols, step, meta, acc_s, active, n)
+        outs.append(o)
+        sels.append(s)
+    out: dict[str, Column] = {}
+    for nm in outs[0]:
+        pieces = [o[nm] for o in outs]
+        validity = None
+        if any(p.validity is not None for p in pieces):
+            validity = jnp.concatenate([p.valid_mask() for p in pieces])
+        out[nm] = Column(data=jnp.concatenate([p.data for p in pieces]),
+                         validity=validity, dtype=pieces[0].dtype)
+    return out, jnp.concatenate(sels)
+
+
+def _reduce_acc_axes(acc, meta: _GroupMeta, active: tuple[int, ...]):
+    """Reduce finest-level dense accumulators over the inactive key axes.
+    Sum-like accumulators add across merged cells; min/max/firstpos/
+    lastpos take the corresponding extremum."""
+    inactive = tuple(i for i in range(len(meta.keys)) if i not in active)
+    if not inactive:
+        return acc
+    out = {}
+    for k, v in acc.items():
+        grid = v.reshape(meta.sizes)
+        if k.startswith("min:") or k.startswith("firstpos:"):
+            red = grid.min(axis=inactive)
+        elif k.startswith("max:") or k.startswith("lastpos:"):
+            red = grid.max(axis=inactive)
+        else:                           # count_all / count / sum / sumsq
+            red = grid.sum(axis=inactive)
+        out[k] = red.reshape(-1)
+    return out
+
+
+def _dense_level_outputs(cols, step: GroupAggStep, meta: _GroupMeta, acc,
+                         active: tuple[int, ...], n: int):
+    """Key columns + aggregate outputs for one grouping level, given that
+    level's (possibly axis-reduced) accumulators.  ``active`` lists the
+    key indices present at this level; inactive keys come back null and
+    the grouping-id column counts them."""
+    sizes = tuple(meta.sizes[i] for i in active)
+    G = 1
+    for s in sizes:
+        G *= s
+    strides = []
+    s = 1
+    for size in reversed(sizes):
+        strides.append(s)
+        s *= size
+    strides = list(reversed(strides))
+
+    counts_all = acc["count_all"]
     out: dict[str, Column] = {}
     cell = jnp.arange(G, dtype=jnp.int32)
-    for km, stride, size in zip(meta.keys, strides, meta.sizes):
+    pos = {ki: j for j, ki in enumerate(active)}
+    for i, km in enumerate(meta.keys):
         key_dtype = cols[km.name].dtype
-        slot = (cell // jnp.int32(stride)) % jnp.int32(size)
+        if i not in pos:
+            out[km.name] = Column(
+                data=jnp.zeros(G, key_dtype.jnp_dtype),
+                validity=jnp.zeros(G, jnp.bool_), dtype=key_dtype)
+            continue
+        j = pos[i]
+        slot = (cell // jnp.int32(strides[j])) % jnp.int32(sizes[j])
         # Reconstruction mirrors _dense_slot: int32 math when lo/hi fit
         # (narrow dtypes' residuals would wrap natively), otherwise the
         # key's native dtype (lo itself exceeds int32).  The null slot's
@@ -969,6 +1181,10 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
         out[out_name] = Column(data=data.astype(out_dtype.jnp_dtype),
                                validity=has_valid, dtype=out_dtype)
 
+    if step.sets is not None:
+        out[step.grouping_id] = Column(
+            data=jnp.full(G, len(meta.keys) - len(active), jnp.int64),
+            dtype=INT64)
     return out, counts_all > 0
 
 
@@ -976,7 +1192,90 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
 
 def _trace_group_sorted(cols, sel, step: GroupAggStep, meta: _GroupMeta):
     from .sorted_group import sorted_group_agg
-    return sorted_group_agg(cols, sel, step)
+    if step.sets is None:
+        return sorted_group_agg(cols, sel, step)
+    return _trace_group_sets_sorted(cols, sel, step)
+
+
+def _trace_group_sets_sorted(cols, sel, step: GroupAggStep):
+    """Grouping sets on the sorted path: one segmented pass per level
+    (each a multi-operand sort over the key subset), outputs stacked with
+    null inactive keys and the grouping-id column.  Levels stay padded at
+    the input length; a grand-total level groups by a constant key."""
+    from .sorted_group import sorted_group_agg
+    n = next(iter(cols.values())).size
+    outs, sels = [], []
+    for active in step.sets:
+        sub_keys = tuple(step.keys[i] for i in active)
+        level_cols = cols
+        if not sub_keys:                 # grand total: constant key
+            level_cols = dict(cols)
+            level_cols["__gs_total__"] = Column(
+                data=jnp.zeros(n, jnp.int32), dtype=INT32)
+            sub_keys = ("__gs_total__",)
+        sub = GroupAggStep(sub_keys, step.aggs,
+                           tuple(None for _ in sub_keys))
+        o, s = sorted_group_agg(level_cols, sel, sub)
+        o.pop("__gs_total__", None)
+        for i, km_name in enumerate(step.keys):
+            if i not in active:
+                src = cols[km_name]
+                o[km_name] = Column(
+                    data=jnp.zeros(n, src.data.dtype),
+                    validity=jnp.zeros(n, jnp.bool_), dtype=src.dtype)
+        o[step.grouping_id] = Column(
+            data=jnp.full(n, len(step.keys) - len(active), jnp.int64),
+            dtype=INT64)
+        outs.append(o)
+        sels.append(s if s is not None else jnp.ones(n, jnp.bool_))
+    out: dict[str, Column] = {}
+    for nm in outs[0]:
+        pieces = [o[nm] for o in outs]
+        validity = None
+        if any(p.validity is not None for p in pieces):
+            validity = jnp.concatenate([p.valid_mask() for p in pieces])
+        out[nm] = Column(data=jnp.concatenate([p.data for p in pieces]),
+                         validity=validity, dtype=pieces[0].dtype)
+    return out, jnp.concatenate(sels)
+
+
+# -- UNION ALL ---------------------------------------------------------------
+
+def _trace_union(cols, sel, side, meta: _UnionMeta):
+    """Run the branch's program inline and concatenate its padded rows
+    with the current state (one fused program; no host glue)."""
+    prefix = f"__union{meta.index}__:"
+    bcols_in = {nm: side[prefix + nm] for nm in meta.exec_names}
+    bside = {nm: side[prefix + "side:" + nm] for nm in meta.side_names}
+    prog = _assemble(meta.steps, meta.group_metas, meta.join_metas,
+                     union_metas=meta.union_metas, jit=False)
+    bcols, bsel = prog(bcols_in, bside)
+
+    mine = {nm for nm in cols if not _is_engine_hidden(nm)}
+    theirs = {nm for nm in bcols if not _is_engine_hidden(nm)}
+    if mine != theirs:
+        raise TypeError(f"union_all schema mismatch at trace time: "
+                        f"{sorted(mine)} vs {sorted(theirs)}")
+    n1 = next(iter(cols.values())).size
+    n2 = next(iter(bcols.values())).size
+    out: dict[str, Column] = {}
+    for nm in mine:
+        a, b = cols[nm], bcols[nm]
+        if a.dtype != b.dtype:
+            raise TypeError(
+                f"union_all dtype mismatch for {nm!r}: {a.dtype} vs "
+                f"{b.dtype}; cast one side first")
+        validity = None
+        if a.validity is not None or b.validity is not None:
+            validity = jnp.concatenate([a.valid_mask(), b.valid_mask()])
+        out[nm] = Column(data=jnp.concatenate([a.data, b.data]),
+                         validity=validity, dtype=a.dtype)
+    new_sel = None
+    if sel is not None or bsel is not None:
+        s1 = jnp.ones(n1, jnp.bool_) if sel is None else sel
+        s2 = jnp.ones(n2, jnp.bool_) if bsel is None else bsel
+        new_sel = jnp.concatenate([s1, s2])
+    return out, new_sel
 
 
 # ---------------------------------------------------------------------------
@@ -993,7 +1292,8 @@ _DECODED_DICTS: dict = {}
 
 def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
               join_metas: tuple, axis: Optional[str] = None,
-              axis_size: int = 1):
+              axis_size: int = 1, union_metas: tuple = (),
+              jit: bool = True):
     """Build the traced function for a plan (independent of concrete data).
 
     With ``axis`` the program runs per-shard under ``shard_map`` over
@@ -1007,7 +1307,7 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
     def program(cols: dict[str, Column], side: dict[str, Column],
                 init_sel=None):
         sel = init_sel
-        gi = ji = 0
+        gi = ji = ui = 0
         sharded = axis is not None
         for step in steps:
             if isinstance(step, FilterStep):
@@ -1044,6 +1344,14 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
                     cols, sel = trace_join_shuffled(cols, sel, side, meta)
                 else:
                     cols, sel = trace_join(cols, sel, side, meta)
+            elif step is _UNION_MARKER:
+                if sharded:
+                    raise TypeError(
+                        "union_all of still-sharded rows is not supported "
+                        "in a distributed plan; aggregate first")
+                meta = union_metas[ui]
+                ui += 1
+                cols, sel = _trace_union(cols, sel, side, meta)
             elif isinstance(step, WindowStep):
                 if sharded:
                     raise TypeError(
@@ -1068,7 +1376,9 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
                 raise TypeError(f"unknown plan step {step!r}")
         return cols, sel
 
-    return program if axis is not None else jax.jit(program)
+    if axis is not None or not jit:
+        return program
+    return jax.jit(program)
 
 
 def _compiled_for(bound: _Bound):
@@ -1078,7 +1388,8 @@ def _compiled_for(bound: _Bound):
     fn = _COMPILED.get(key)
     if fn is None:
         fn = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
-                       tuple(bound.join_metas))
+                       tuple(bound.join_metas),
+                       union_metas=tuple(bound.union_metas))
         _COMPILED[key] = fn
     return fn
 
@@ -1101,6 +1412,8 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
                         order.append(nm)
         elif isinstance(step, GroupAggStep):
             order = list(step.keys) + [out for _, _, out in step.aggs]
+            if step.sets is not None:
+                order.append(step.grouping_id)
         elif isinstance(step, (JoinStep, JoinShuffledStep)) \
                 and step.how in ("inner", "left"):
             order += [nm for nm in step.table.names
@@ -1238,17 +1551,21 @@ def explain_plan(plan: Plan, table: Table) -> str:
         elif isinstance(step, GroupAggStep):
             meta = bound.group_metas[gi]
             gi += 1
+            sets = ("" if step.sets is None
+                    else f" x{len(step.sets)} grouping sets"
+                         f" -> {step.grouping_id}")
             if meta.dense:
                 doms = ", ".join(
                     f"{km.name}:[{km.lo},{km.hi}]"
                     + ("+null" if km.nullable else "")
                     for km in meta.keys)
-                lines.append(f"  GroupBy[dense, {meta.cells} cells; {doms}] "
+                lines.append(f"  GroupBy[dense, {meta.cells} cells{sets}; "
+                             f"{doms}] "
                              f"aggs={[h for _, h, _ in step.aggs]}")
             else:
                 lines.append(
-                    f"  GroupBy[sorted: multi-key sort + segmented scans] "
-                    f"keys={list(step.keys)} "
+                    f"  GroupBy[sorted: multi-key sort + segmented "
+                    f"scans{sets}] keys={list(step.keys)} "
                     f"aggs={[h for _, h, _ in step.aggs]}")
         elif isinstance(step, JoinStep):
             meta = bound.join_metas[ji]
@@ -1265,6 +1582,10 @@ def explain_plan(plan: Plan, table: Table) -> str:
                 f"  ShuffledJoin[{meta.how}, right={meta.right_rows} rows, "
                 f"capacity={meta.capacity}; bind-time factorize probe] on "
                 f"{', '.join(step.left_on)}")
+        elif isinstance(step, UnionAllStep):
+            lines.append(
+                f"  UnionAll[branch over {step.table.num_rows} rows, "
+                f"{len(step.plan.steps)} branch steps traced inline]")
         elif isinstance(step, WindowStep):
             lines.append(
                 f"  Window[{step.func} -> {step.out}; partition by "
@@ -1287,6 +1608,46 @@ def explain_plan(plan: Plan, table: Table) -> str:
 # eager fallback (empty inputs; also the test oracle)
 # ---------------------------------------------------------------------------
 
+def _eager_grouping_sets(t: Table, step: GroupAggStep) -> Table:
+    """Eager grouping sets: one eager group-by per level, levels stacked
+    with null inactive keys + the grouping-id column (the oracle mirror
+    of the compiled dense/sorted sets paths)."""
+    from .. import ops
+    from ..dtypes import STRING
+
+    levels = []
+    order = (list(step.keys) + [out for _, _, out in step.aggs]
+             + [step.grouping_id])
+    for active in step.sets:
+        sub_keys = [step.keys[i] for i in active]
+        tl = t
+        if not sub_keys:
+            tl = t.with_column("__gs_total__", Column(
+                data=jnp.zeros(t.num_rows, jnp.int32), dtype=INT32))
+            sub_keys = ["__gs_total__"]
+        g = ops.groupby_agg(tl, sub_keys, list(step.aggs))
+        if "__gs_total__" in g:
+            g = g.drop(["__gs_total__"])
+        rows = g.num_rows
+        for i, key in enumerate(step.keys):
+            if i in active:
+                continue
+            src = t[key]
+            if src.dtype == STRING:
+                from ..ops.strings import strings_from_pylist
+                null_col = strings_from_pylist([None] * rows)
+            else:
+                null_col = Column(
+                    data=jnp.zeros(rows, src.data.dtype),
+                    validity=jnp.zeros(rows, jnp.bool_), dtype=src.dtype)
+            g = g.with_column(key, null_col)
+        g = g.with_column(step.grouping_id, Column(
+            data=jnp.full(rows, len(step.keys) - len(active), jnp.int64),
+            dtype=INT64))
+        levels.append(g.select(order))
+    return ops.concat_tables(levels)
+
+
 def run_plan_eager(plan: Plan, table: Table) -> Table:
     """Execute a plan step-by-step with the eager ops layer.
 
@@ -1301,6 +1662,12 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
             t = ops.apply_boolean_mask(t, evaluate(step.pred, env))
         elif isinstance(step, ProjectStep):
             env = dict(t.items())
+
+            def _ev(e):
+                out = evaluate(e, env)
+                return out if isinstance(out, Column) \
+                    else lit_column(out, t.num_rows)
+
             if step.narrow:
                 # Hidden engine columns survive narrowing, mirroring the
                 # compiled path (_trace_project): rowid indirection,
@@ -1309,13 +1676,24 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
                 cols = [(nm, t[nm]) for nm in t.names
                         if _is_engine_hidden(nm)
                         and nm not in {n for n, _ in step.cols}]
-                cols += [(nm, evaluate(e, env)) for nm, e in step.cols]
+                cols += [(nm, _ev(e)) for nm, e in step.cols]
                 t = Table(cols)
             else:
                 for nm, e in step.cols:
-                    t = t.with_column(nm, evaluate(e, env))
+                    t = t.with_column(nm, _ev(e))
         elif isinstance(step, GroupAggStep):
-            t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
+            if step.sets is None:
+                t = ops.groupby_agg(t, list(step.keys), list(step.aggs))
+            else:
+                t = _eager_grouping_sets(t, step)
+        elif isinstance(step, UnionAllStep):
+            branch = run_plan_eager(step.plan, step.table)
+            names = list(t.names)
+            if set(branch.names) != set(names):
+                raise TypeError(
+                    f"union_all schema mismatch: {sorted(t.names)} vs "
+                    f"{sorted(branch.names)}")
+            t = ops.concat_tables([t, branch.select(names)])
         elif isinstance(step, (JoinStep, JoinShuffledStep)):
             # Rename build keys to hidden temporaries first so a build-key
             # name equal to a PROBE column can never be suffix-renamed by

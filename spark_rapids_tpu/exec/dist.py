@@ -170,7 +170,8 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     if fn is None:
         program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
                             tuple(bound.join_metas), axis=axis,
-                            axis_size=axis_size)
+                            axis_size=axis_size,
+                            union_metas=tuple(bound.union_metas))
 
         def sharded_program(cols, row_mask, side):
             # Padding slots enter as dead rows via the initial selection.

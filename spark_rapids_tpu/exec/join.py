@@ -131,9 +131,11 @@ def _build_probe(key_cols: list[Column], dedupe: bool = False):
     packed = np.zeros(rows.size, np.int64)
     for k, lo, sh in zip(np_keys, los, shifts):
         packed |= (k.astype(np.int64) - lo) << sh
+    was_unique = True
     if dedupe:
-        packed, first = np.unique(packed, return_index=True)
-        rows = rows[first]
+        uniq, first = np.unique(packed, return_index=True)
+        was_unique = uniq.size == packed.size
+        packed, rows = uniq, rows[first]
     elif np.unique(packed).size != packed.size:
         raise ValueError(
             "broadcast join requires unique build-side keys "
@@ -154,6 +156,12 @@ def _build_probe(key_cols: list[Column], dedupe: bool = False):
     result = (tuple(zip(los, his, shifts)), mode, packed_hi,
               int(rows.size), arrays)
     _guarded_cache_put(_PROBE_CACHE, cache_key, buffers, result)
+    if was_unique:
+        # Unique build keys make the deduped and plain probe structures
+        # identical — store under both cache keys so a dimension probed
+        # by an inner and a semi join in the same bank builds one probe.
+        other = ((not dedupe,) + cache_key[1:])
+        _guarded_cache_put(_PROBE_CACHE, other, buffers, result)
     return result
 
 

@@ -62,6 +62,13 @@ class GroupAggStep:
     aggs: tuple[tuple[str, str, str], ...]
     #: per-key explicit domain hints: (lo, hi) inclusive, or None to infer.
     domains: tuple[Optional[tuple[int, int]], ...]
+    #: grouping sets: each entry lists the ACTIVE key indices for one
+    #: output level (Spark GROUPING SETS / ROLLUP); None = plain group-by.
+    #: Inactive keys come back null with a grouping-id column counting them.
+    sets: Optional[tuple[tuple[int, ...], ...]] = None
+    #: output column name for the per-row grouping id (number of
+    #: rolled-up keys — TPC-DS's ``lochierarchy``); required with sets.
+    grouping_id: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -118,6 +125,20 @@ class WindowStep:
 
 
 @dataclass(frozen=True)
+class UnionAllStep:
+    """UNION ALL with a sub-plan over another bound table (Spark's union
+    of child plans).  The branch compiles INTO the same program: its steps
+    trace inline and its (padded) output rows concatenate with the current
+    state — no host glue, one fused XLA program for the whole union.
+
+    The branch's user-visible output schema must match the current state's
+    (same names and dtypes; fixed-width only — strings cannot ride a union
+    because dictionary codes from two binds don't share a vocabulary)."""
+    table: object                      # Table (identity hash/eq)
+    plan: object                       # Plan for the branch
+
+
+@dataclass(frozen=True)
 class SortStep:
     by: tuple[str, ...]
     ascending: tuple[bool, ...]
@@ -130,7 +151,8 @@ class LimitStep:
 
 
 Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep,
-             JoinShuffledStep, WindowStep, SortStep, LimitStep]
+             JoinShuffledStep, UnionAllStep, WindowStep, SortStep,
+             LimitStep]
 
 WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
                 "sum", "min", "max", "count")
@@ -186,6 +208,65 @@ class Plan:
                                  f"(have {PLAN_AGGS})")
         dom = tuple((domains or {}).get(k) for k in keys)
         return Plan(self.steps + (GroupAggStep(keys, tuple(aggs), dom),))
+
+    def groupby_grouping_sets(self, keys: Sequence[str],
+                              aggs: Sequence[tuple[str, str, str]],
+                              sets: Sequence[Sequence[str]],
+                              domains: Optional[dict[str,
+                                                     tuple[int, int]]] = None,
+                              grouping_id: str = "lochierarchy") -> "Plan":
+        """Group by each grouping set and stack the levels (Spark
+        ``GROUPING SETS``): every entry of ``sets`` names the key subset
+        active at that level; the other keys come back null and
+        ``grouping_id`` counts them per output row (0 = finest level).
+
+        All levels compute in ONE program: on the dense path the finest
+        level's cell accumulators reduce along the rolled-up key axes (no
+        second pass over the rows); the sorted path runs one segmented
+        pass per level."""
+        keys = tuple(keys)
+        for _, how, _ in aggs:
+            if how not in PLAN_AGGS:
+                raise ValueError(f"unsupported aggregation {how!r} "
+                                 f"(have {PLAN_AGGS})")
+            if how in ("first", "last"):
+                raise ValueError(
+                    f"{how!r} is not defined across grouping-set levels "
+                    f"(row order within merged groups is not preserved)")
+        index = {k: i for i, k in enumerate(keys)}
+        norm: list[tuple[int, ...]] = []
+        for s in sets:
+            try:
+                norm.append(tuple(sorted(index[k] for k in s)))
+            except KeyError as e:
+                raise ValueError(f"grouping set names unknown key {e}; "
+                                 f"keys are {list(keys)}") from None
+        if not norm:
+            raise ValueError("grouping sets must name at least one level")
+        dom = tuple((domains or {}).get(k) for k in keys)
+        return Plan(self.steps + (GroupAggStep(
+            keys, tuple(aggs), dom, tuple(norm), grouping_id),))
+
+    def groupby_rollup(self, keys: Sequence[str],
+                       aggs: Sequence[tuple[str, str, str]],
+                       domains: Optional[dict[str, tuple[int, int]]] = None,
+                       grouping_id: str = "lochierarchy") -> "Plan":
+        """Spark ``ROLLUP(k1, k2, ...)``: grouping sets (k1..kn),
+        (k1..kn-1), ..., (k1,), () — the TPC-DS report-total shape
+        (q18/q27/q36/q70/q86 class).  See :meth:`groupby_grouping_sets`."""
+        keys = tuple(keys)
+        sets = [keys[:i] for i in range(len(keys), -1, -1)]
+        return self.groupby_grouping_sets(keys, aggs, sets, domains=domains,
+                                          grouping_id=grouping_id)
+
+    def union_all(self, table: Table, branch: "Plan" = None) -> "Plan":
+        """Concatenate the rows of ``branch`` run over ``table`` (UNION
+        ALL of child plans).  ``branch=None`` unions the raw table.  The
+        branch traces inline into the same compiled program; its output
+        schema must match the current state's (names and dtypes,
+        fixed-width columns only)."""
+        return Plan(self.steps + (UnionAllStep(
+            table, branch if branch is not None else Plan()),))
 
     def distinct(self, *keys: str,
                  domains: Optional[dict[str, tuple[int, int]]] = None

@@ -1,0 +1,51 @@
+"""SQL set operations over key projections (INTERSECT / EXCEPT).
+
+TPC-DS uses INTERSECT/EXCEPT as *membership* operations over compact key
+tuples (q8/q38/q87: customers present in all three channels, zip lists).
+On TPU the idiomatic lowering is distinct (a group-by with no aggregates,
+dense or sorted — both sync-free in-program) followed by a broadcast
+semi/anti join against the other side's key set (deduped at bind time).
+Both pieces are compiled plans; no host-side set logic runs.
+
+The reference's counterpart is cuDF's distinct + join envelope (SURVEY.md
+§2.3.1); Spark lowers INTERSECT/EXCEPT DISTINCT to exactly this
+aggregate + left-semi/anti-join shape.
+
+Keys must be fixed-width (broadcast-join contract); dictionary-encode
+strings first.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..table import Table
+from .plan import plan
+
+
+def _distinct_keys(table: Table, on: Sequence[str]) -> Table:
+    return plan().distinct(*on).run(table)
+
+
+def intersect_keys(left: Table, right: Table, on: Sequence[str]) -> Table:
+    """Distinct ``on``-tuples present in BOTH tables (SQL
+    ``SELECT <on> FROM left INTERSECT SELECT <on> FROM right``)."""
+    on = list(on)
+    dl = _distinct_keys(left, on)
+    if dl.num_rows == 0:
+        return dl
+    return (plan()
+            .join_broadcast(right.select(on), on=on, how="semi")
+            .run(dl))
+
+
+def except_keys(left: Table, right: Table, on: Sequence[str]) -> Table:
+    """Distinct ``on``-tuples of ``left`` absent from ``right`` (SQL
+    ``EXCEPT`` / ``MINUS`` over the key projection)."""
+    on = list(on)
+    dl = _distinct_keys(left, on)
+    if dl.num_rows == 0 or right.num_rows == 0:
+        return dl
+    return (plan()
+            .join_broadcast(right.select(on), on=on, how="anti")
+            .run(dl))

@@ -547,13 +547,17 @@ def generate(sf_rows: int = 100_000, seed: int = 20260802) -> TpcdsData:
 
     def _ret_dates(src_dates, src_valid, n):
         """Returned date = sold date + a 1..119-day lag (clipped to the
-        calendar), nulled at the same ~1% rate as sales dates."""
+        calendar), nulled at the same ~1% rate as sales dates; a return
+        whose source sale has a null sold date gets a null returned date
+        too (dsdgen derives the return date from the sale date)."""
         lag = rng.integers(1, 120, n)
         base = (src_dates if src_valid is None
                 else np.where(src_valid, src_dates, DATE_SK0))
         dates = np.minimum(base + lag, DATE_SK0 + N_DAYS - 1)
-        return Column.from_numpy(dates.astype(np.int64),
-                                 validity=rng.random(n) >= 0.01)
+        validity = rng.random(n) >= 0.01
+        if src_valid is not None:
+            validity &= src_valid
+        return Column.from_numpy(dates.astype(np.int64), validity=validity)
 
     sr_idx = rng.integers(0, n_ss, n_sr)
     sr_item, _ = _take(store_sales, "ss_item_sk", sr_idx)

@@ -14,7 +14,7 @@ import numpy as np
 from ..column import Column
 from ..dtypes import FLOAT64, STRING
 from ..table import Table
-from ..exec import col, plan, when
+from ..exec import col, lit, plan, when
 from .tpcds import (BRANDS, CATEGORIES, DATE_SK0, SHIP_MODE_TYPES,
                     TpcdsData)
 from .tpcds_lib import _dim, _lag_buckets, _vocab_map
@@ -190,8 +190,7 @@ def q22(d: TpcdsData) -> Table:
                         [("inv_quantity_on_hand", "mean", "qoh")])
            .run(base).to_pydict())
     total = (plan()
-             .with_columns(one=when(col("inv_date_sk").is_null(), 1)
-                           .otherwise(1))
+             .with_columns(one=lit(1))
              .groupby_agg(["one"],
                           [("inv_quantity_on_hand", "mean", "qoh")],
                           domains={"one": (1, 1)})

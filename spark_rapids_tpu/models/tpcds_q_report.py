@@ -13,7 +13,7 @@ import numpy as np
 
 from ..column import Column
 from ..table import Table
-from ..exec import col, plan, when
+from ..exec import col, lit, plan, when
 from .tpcds import TpcdsData
 from .tpcds_lib import _city_map, _class_map, _dim, _scalar_table
 
@@ -100,8 +100,7 @@ def q13(d: TpcdsData) -> Table:
                        & col("ss_net_profit").between(150.0, 300.0))
                     | (col("ca_tag").eq(3)
                        & col("ss_net_profit").between(50.0, 250.0))))
-         .with_columns(one=when(col("ss_quantity").is_null(), 1)
-                       .otherwise(1))
+         .with_columns(one=lit(1))
          .groupby_agg(["one"],
                       [("ss_quantity", "mean", "avg_qty"),
                        ("ss_ext_sales_price", "mean", "avg_esp"),

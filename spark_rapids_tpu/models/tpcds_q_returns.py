@@ -10,7 +10,7 @@ registry merge; shared helpers live in :mod:`.tpcds_lib`.
 from __future__ import annotations
 
 from ..table import Table
-from ..exec import col, plan, when
+from ..exec import col, lit, plan, when
 from .tpcds import DATE_SK0, TpcdsData
 from .tpcds_lib import _dim, _lag_buckets, _scalar_table
 
@@ -42,8 +42,7 @@ def _order_flow(fact: Table, returns: Table, pfx: str, rpfx: str,
                         how="semi" if returned else "anti")
          .join_broadcast(multi_wh, left_on=f"{pfx}_order_number",
                          right_on="__mw_order", how="semi")
-         .with_columns(one=when(col(f"{pfx}_order_number").is_valid(), 1)
-                       .otherwise(1))
+         .with_columns(one=lit(1))
          .groupby_agg(["one"],
                       [(f"{pfx}_order_number", "nunique", "order_count"),
                        (f"{pfx}_ext_ship_cost", "sum", "ship_cost"),
@@ -108,8 +107,7 @@ def _excess_discount(fact: Table, pfx: str, items: Table,
                          right_on="__adi")
          .filter(col(f"{pfx}_ext_discount_amt")
                  > col("avg_disc") * 1.3)
-         .with_columns(one=when(col(f"{pfx}_item_sk").is_valid(), 1)
-                       .otherwise(1))
+         .with_columns(one=lit(1))
          .groupby_agg(["one"],
                       [(f"{pfx}_ext_discount_amt", "sum",
                         "excess_discount")],

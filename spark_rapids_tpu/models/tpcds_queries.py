@@ -34,7 +34,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..column import Column
-from ..exec import col, plan, when
+from ..exec import col, lit, plan, when
 from ..table import Table
 from .tpcds import TpcdsData
 
@@ -413,8 +413,7 @@ def q48(d: TpcdsData) -> Table:
                        & col("ss_net_profit").between(150.0, 3000.0))
                     | (col("ca_tag").eq(3)
                        & col("ss_net_profit").between(50.0, 25000.0))))
-         .with_columns(one=when(col("ss_quantity").is_valid(), 1)
-                       .otherwise(1))
+         .with_columns(one=lit(1))
          .groupby_agg(["one"], [("ss_quantity", "sum", "qty_sum")],
                       domains={"one": (1, 1)}))
     out = p.run(d.store_sales)
@@ -455,8 +454,7 @@ def q61(d: TpcdsData) -> Table:
                               right_on="c_customer_sk")
              .join_broadcast(addr, left_on="c_current_addr_sk",
                              right_on="ca_address_sk", how="semi")
-             .with_columns(one=when(col("ss_ext_sales_price").is_null(), 1)
-                           .otherwise(1))
+             .with_columns(one=lit(1))
              .groupby_agg(["one"],
                           [("ss_ext_sales_price", "sum", "total")],
                           domains={"one": (1, 1)}))
@@ -794,8 +792,7 @@ def q95(d: TpcdsData) -> Table:
                         right_on="wr_order_number", how="semi")
          .join_broadcast(multi_wh, left_on="ws_order_number",
                          right_on="__mw_order", how="semi")
-         .with_columns(one=when(col("ws_order_number").is_valid(), 1)
-                       .otherwise(1))
+         .with_columns(one=lit(1))
          .groupby_agg(["one"],
                       [("ws_order_number", "nunique", "order_count"),
                        ("ws_ext_ship_cost", "sum", "ship_cost"),

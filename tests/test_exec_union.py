@@ -1,0 +1,258 @@
+"""Unit tests for the round-5 plan primitives: compiled UNION ALL,
+grouping sets / ROLLUP, set-op helpers, and literal projections.
+
+Every compiled result is cross-checked against the eager oracle
+(run_plan_eager) and, for the numeric cores, a pandas reference — the
+same oracle discipline as the TPC-DS bank (SURVEY.md §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.dtypes import INT32, STRING
+from spark_rapids_tpu.exec import (col, except_keys, intersect_keys, lit,
+                                   plan)
+from spark_rapids_tpu.exec.compile import run_plan_eager
+from spark_rapids_tpu.table import Table
+
+
+def _table(rng, n, klo=0, khi=10, with_null=True):
+    k = rng.integers(klo, khi, n).astype(np.int64)
+    v = np.round(rng.uniform(-10, 10, n), 3)
+    kv = rng.random(n) >= 0.1 if with_null else None
+    vv = rng.random(n) >= 0.1 if with_null else None
+    return Table([
+        ("k", Column.from_numpy(k, validity=kv)),
+        ("v", Column.from_numpy(v, validity=vv)),
+    ])
+
+
+def _pdf(t):
+    return pd.DataFrame({c: pd.array(t[c].to_pylist()) for c in t.names})
+
+
+def _sorted_records(t):
+    df = _pdf(t)
+    return sorted(
+        df.itertuples(index=False, name=None),
+        key=lambda r: tuple((x is None or x != x, x if (
+            x is not None and x == x) else 0) for x in r))
+
+
+def assert_tables_equal(got, want, float_cols=()):
+    assert set(got.names) == set(want.names)
+    gr = _sorted_records(got.select(list(want.names)))
+    wr = _sorted_records(want)
+    assert len(gr) == len(wr), f"{len(gr)} vs {len(wr)} rows"
+    for g, w in zip(gr, wr):
+        for name, gv, wv in zip(want.names, g, w):
+            if gv is None or (isinstance(gv, float) and gv != gv):
+                assert wv is None or (isinstance(wv, float) and wv != wv), \
+                    f"{name}: {gv} vs {wv}"
+            elif name in float_cols:
+                assert abs(gv - wv) < 1e-9 * max(1, abs(wv)), \
+                    f"{name}: {gv} vs {wv}"
+            else:
+                assert gv == wv, f"{name}: {gv} vs {wv}"
+
+
+class TestUnionAll:
+    def test_raw_union_groupby(self, rng):
+        t1, t2 = _table(rng, 500), _table(rng, 300)
+        p = (plan().union_all(t2)
+             .groupby_agg(["k"], [("v", "sum", "s"),
+                                  ("v", "count", "c")])
+             .sort_by(["k"]))
+        assert_tables_equal(p.run(t1), run_plan_eager(p, t1),
+                            float_cols=("s",))
+        # pandas cross-check
+        df = pd.concat([_pdf(t1), _pdf(t2)])
+        want = (df.groupby("k", dropna=False)
+                .agg(s=("v", "sum"), c=("v", "count")))
+        got = _pdf(p.run(t1))
+        got_nn = got[got.k.notna()].set_index("k").sort_index()
+        want_nn = want[[i == i for i in want.index]].sort_index()
+        np.testing.assert_allclose(
+            got_nn.s.to_numpy(float), want_nn.s.to_numpy(float))
+        np.testing.assert_array_equal(
+            got_nn.c.to_numpy(int), want_nn.c.to_numpy(int))
+
+    def test_branch_plan_with_filter_and_project(self, rng):
+        t1, t2 = _table(rng, 400), _table(rng, 400)
+        branch = (plan().filter(col("v") > 0)
+                  .with_columns(v=col("v") * 2.0))
+        p = (plan().filter(col("k") < 8)
+             .union_all(t2, branch)
+             .groupby_agg(["k"], [("v", "sum", "s")])
+             .sort_by(["k"]))
+        assert_tables_equal(p.run(t1), run_plan_eager(p, t1),
+                            float_cols=("s",))
+
+    def test_branch_with_broadcast_join(self, rng):
+        t1, t2 = _table(rng, 300, khi=5), _table(rng, 200, khi=5)
+        dim = Table([
+            ("dk", Column.from_numpy(np.arange(5, dtype=np.int64))),
+            ("w", Column.from_numpy(np.arange(5, dtype=np.float64))),
+        ])
+        branch = (plan().join_broadcast(dim, left_on="k", right_on="dk")
+                  .with_columns(v=col("v") + col("w"))
+                  .select("k", "v"))
+        p = (plan().union_all(t2, branch)
+             .groupby_agg(["k"], [("v", "sum", "s")]).sort_by(["k"]))
+        assert_tables_equal(p.run(t1), run_plan_eager(p, t1),
+                            float_cols=("s",))
+
+    def test_three_way_union(self, rng):
+        t1, t2, t3 = _table(rng, 200), _table(rng, 150), _table(rng, 100)
+        p = (plan().union_all(t2).union_all(t3)
+             .groupby_agg(["k"], [("v", "mean", "m")]).sort_by(["k"]))
+        assert_tables_equal(p.run(t1), run_plan_eager(p, t1),
+                            float_cols=("m",))
+
+    def test_nested_union_in_branch(self, rng):
+        t1, t2, t3 = _table(rng, 200), _table(rng, 150), _table(rng, 100)
+        branch = plan().union_all(t3)
+        p = (plan().union_all(t2, branch)
+             .groupby_agg(["k"], [("v", "sum", "s")]).sort_by(["k"]))
+        assert_tables_equal(p.run(t1), run_plan_eager(p, t1),
+                            float_cols=("s",))
+
+    def test_high_cardinality_sorted_groupby_after_union(self, rng):
+        t1 = _table(rng, 600, khi=3000)
+        t2 = _table(rng, 400, khi=3000)
+        p = (plan().union_all(t2)
+             .groupby_agg(["k"], [("v", "sum", "s")])
+             .sort_by(["s"], ascending=[False]).limit(20))
+        got, want = p.run(t1), run_plan_eager(p, t1)
+        g, w = _pdf(got), _pdf(want)
+        np.testing.assert_allclose(
+            np.sort(g.s.to_numpy(float)), np.sort(w.s.to_numpy(float)))
+
+    def test_schema_mismatch_raises(self, rng):
+        t1 = _table(rng, 50)
+        t2 = t1.rename({"v": "w"})
+        with pytest.raises(TypeError, match="schema mismatch"):
+            plan().union_all(t2).run(t1)
+
+    def test_dtype_mismatch_raises(self, rng):
+        t1 = _table(rng, 50)
+        t2 = Table([("k", Column.from_numpy(
+            np.arange(5, dtype=np.int64))),
+            ("v", Column.from_numpy(np.arange(5, dtype=np.int64)))])
+        with pytest.raises(TypeError, match="dtype mismatch"):
+            plan().union_all(t2).run(t1)
+
+    def test_string_state_raises(self, rng):
+        t1 = Table([
+            ("k", Column.from_numpy(np.arange(10, dtype=np.int64))),
+            ("s", Column.from_pylist(list("abcdefghij"), STRING)),
+        ])
+        t2 = t1
+        with pytest.raises(TypeError, match="string"):
+            plan().union_all(t2).run(t1)
+
+    def test_empty_branch_raises(self, rng):
+        t1 = _table(rng, 50)
+        t2 = Table([("k", Column.from_numpy(np.zeros(0, np.int64))),
+                    ("v", Column.from_numpy(np.zeros(0, np.float64)))])
+        with pytest.raises(ValueError, match="no rows"):
+            plan().union_all(t2).run(t1)
+
+
+class TestGroupingSets:
+    def test_rollup_dense_matches_pandas(self, rng):
+        t = _table(rng, 800, khi=6)
+        t = t.with_column("k2", Column.from_numpy(
+            rng.integers(0, 4, 800).astype(np.int64)))
+        p = (plan().groupby_rollup(["k", "k2"], [("v", "sum", "s"),
+                                                 ("v", "count", "c")])
+             .sort_by(["lochierarchy", "k", "k2"]))
+        got = p.run(t)
+        assert_tables_equal(got, run_plan_eager(p, t), float_cols=("s",))
+        # level-2 grand total vs pandas
+        df = _pdf(t)
+        total = got.select(["s", "c", "lochierarchy"])
+        tdf = _pdf(total)
+        grand = tdf[tdf.lochierarchy == 2]
+        assert len(grand) == 1
+        np.testing.assert_allclose(float(grand.s.iloc[0]),
+                                   df.v.sum(), rtol=1e-9)
+        assert int(grand.c.iloc[0]) == int(df.v.count())
+
+    def test_rollup_sorted_path(self, rng):
+        # High-cardinality key forces the sorted grouping-sets path.
+        t = _table(rng, 700, khi=5000)
+        p = (plan().groupby_rollup(["k"], [("v", "sum", "s"),
+                                           ("v", "max", "mx")]))
+        got, want = p.run(t), run_plan_eager(p, t)
+        assert_tables_equal(got, want, float_cols=("s", "mx"))
+
+    def test_explicit_grouping_sets(self, rng):
+        t = _table(rng, 500, khi=5)
+        t = t.with_column("k2", Column.from_numpy(
+            rng.integers(0, 3, 500).astype(np.int64)))
+        p = plan().groupby_grouping_sets(
+            ["k", "k2"], [("v", "mean", "m")],
+            sets=[["k"], ["k2"]], grouping_id="gid")
+        assert_tables_equal(p.run(t), run_plan_eager(p, t),
+                            float_cols=("m",))
+
+    def test_rollup_with_nunique_sorted(self, rng):
+        t = _table(rng, 400, khi=4)
+        p = plan().groupby_rollup(["k"], [("v", "nunique", "nu")])
+        assert_tables_equal(p.run(t), run_plan_eager(p, t))
+
+    def test_first_rejected(self, rng):
+        with pytest.raises(ValueError, match="not defined across"):
+            plan().groupby_rollup(["k"], [("v", "first", "f")])
+
+    def test_having_on_grouping_id(self, rng):
+        t = _table(rng, 300, khi=4)
+        p = (plan().groupby_rollup(["k"], [("v", "sum", "s")])
+             .filter(col("lochierarchy").eq(1)))
+        got = p.run(t)
+        assert got.num_rows == 1
+        assert got["k"].to_pylist() == [None]
+
+
+class TestSetOps:
+    def test_intersect_and_except(self, rng):
+        a = _table(rng, 300, khi=40)
+        b = _table(rng, 300, klo=20, khi=60)
+        ka = {k for k in _pdf(a).k.dropna().astype(int)}
+        kb = {k for k in _pdf(b).k.dropna().astype(int)}
+        inter = intersect_keys(a, b, ["k"])
+        exc = except_keys(a, b, ["k"])
+        gi = {int(x) for x in inter["k"].to_pylist() if x is not None}
+        ge = {int(x) for x in exc["k"].to_pylist() if x is not None}
+        assert gi == (ka & kb)
+        assert ge == (ka - kb)
+        # null key tuples never match (SQL equi-join semantics), but
+        # distinct keeps the null group on the left side
+        null_left = any(x is None for x in _pdf(a).k)
+        assert any(x is None for x in exc["k"].to_pylist()) == null_left
+
+
+class TestLitProjection:
+    def test_with_columns_lit(self, rng):
+        t = _table(rng, 100)
+        p = (plan().with_columns(one=lit(1))
+             .groupby_agg(["one"], [("v", "count", "c")],
+                          domains={"one": (1, 1)}))
+        got = p.run(t)
+        assert got["one"].to_pylist() == [1]
+        assert_tables_equal(got, run_plan_eager(p, t))
+
+    def test_select_lit_float_and_bool(self, rng):
+        t = _table(rng, 10)
+        p = plan().select("k", ("half", lit(0.5)), ("flag", lit(True)))
+        got = p.run(t)
+        assert got["half"].to_pylist() == [0.5] * 10
+        assert got["flag"].to_pylist() == [True] * 10
+
+    def test_string_lit_raises(self, rng):
+        t = _table(rng, 10)
+        with pytest.raises(TypeError, match="literal"):
+            plan().select(("s", lit("x"))).run(t)

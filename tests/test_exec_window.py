@@ -9,6 +9,9 @@ from spark_rapids_tpu import dtypes as dt
 from spark_rapids_tpu.exec import col, plan
 from spark_rapids_tpu.exec.compile import run_plan_eager
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 def _table(rng, n=800):
     return Table([

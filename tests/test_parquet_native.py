@@ -15,6 +15,9 @@ from spark_rapids_tpu import assert_tables_equal
 from spark_rapids_tpu.io import from_arrow, read_parquet, read_parquet_native
 from spark_rapids_tpu.io.parquet_native import decode_rle_bp, parse_rle_runs
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 def _mixed_arrow_table(n=1000, seed=3, with_nulls=True):
     rng = np.random.default_rng(seed)

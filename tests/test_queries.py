@@ -16,6 +16,9 @@ from spark_rapids_tpu import ops
 from spark_rapids_tpu.io.parquet import read_parquet, write_parquet
 from spark_rapids_tpu.ops.binary import binary_op
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 N = 20_000
 CUTOFF_DAYS = 10_500     # the l_shipdate <= date '1998-09-02' analog

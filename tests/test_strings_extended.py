@@ -9,6 +9,9 @@ from spark_rapids_tpu import Column, dtypes as dt
 from spark_rapids_tpu.ops import strings as S
 from spark_rapids_tpu.ops.cast import cast as _cast
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 def _col(vals):
     return S.strings_from_pylist(vals)

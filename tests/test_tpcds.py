@@ -13,6 +13,9 @@ import pytest
 from spark_rapids_tpu.models import tpcds
 from spark_rapids_tpu.models.tpcds_queries import QUERIES
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 SF_ROWS = 20_000
 
 

@@ -17,6 +17,9 @@ from spark_rapids_tpu.models import tpcds
 from spark_rapids_tpu.models.tpcds_queries import _dim
 from spark_rapids_tpu.parallel.mesh import make_mesh, shard_table
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 @pytest.fixture(scope="module")
 def data():

@@ -14,6 +14,9 @@ from spark_rapids_tpu.models.tpcds_queries import QUERIES
 
 from test_tpcds import _assert_frame
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 SF_ROWS = 20_000
 
 

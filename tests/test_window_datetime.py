@@ -13,6 +13,9 @@ from spark_rapids_tpu.dtypes import DType, TypeId
 from spark_rapids_tpu.ops import window
 from spark_rapids_tpu.ops import datetime as sdt
 
+#: compile-heavy module: full tier only (smoke = -m 'not full').
+pytestmark = pytest.mark.full
+
 
 def sample_table():
     return srt.Table.from_pydict({
