@@ -160,6 +160,10 @@ def main():
         "unit": "rows/sec",
         "vs_baseline": round(device_rps / cpu_rps, 3),
     }))
+    from spark_rapids_tpu.config import metrics_enabled
+    if metrics_enabled():
+        from spark_rapids_tpu.obs import bench_metrics_line
+        print(bench_metrics_line())
 
 
 if __name__ == "__main__":

@@ -103,6 +103,11 @@ def main():
 
     bench_plans(lineitem, fact, dim)
 
+    from spark_rapids_tpu.config import metrics_enabled
+    if metrics_enabled():
+        from spark_rapids_tpu.obs import bench_metrics_line
+        print(bench_metrics_line())
+
 
 def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
     """Device-chained throughput of a compiled plan (zero host syncs in

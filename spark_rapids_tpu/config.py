@@ -17,6 +17,12 @@ Knobs (all optional):
   ``SRT_TRACE``                ``1`` enables named profiler scopes
                                (utils/tracing.py) — the NVTX-ranges toggle
                                ``-Dai.rapids.cudf.nvtx.enabled`` analog.
+  ``SRT_METRICS``              ``1`` enables the query-metrics registry
+                               (obs/) — per-plan compile/cache/host-sync
+                               accounting and ``Plan.explain_analyze``
+                               measurements, the Spark SQL-metrics-UI
+                               analog.  Off: all metric handles are shared
+                               no-op singletons.
   ``SRT_LEAK_DEBUG``           ``1`` records creation stacks for native blob
                                handles and reports leaks at exit — the
                                ``-Dai.rapids.refcount.debug`` analog.
@@ -143,6 +149,15 @@ def trace_enabled() -> bool:
     return _flag("SRT_TRACE")
 
 
+def metrics_enabled() -> bool:
+    """Query-metrics registry on/off (Spark SQL-metrics-UI analog).
+
+    Read live on every metric lookup so tests can monkeypatch it; when off,
+    :mod:`..obs.metrics` hands back shared null objects and instrumented
+    code pays one env lookup per *metered region* (never per row)."""
+    return _flag("SRT_METRICS")
+
+
 def leak_debug_enabled() -> bool:
     """Native-handle leak tracking on/off (refcount.debug analog)."""
     return _flag("SRT_LEAK_DEBUG")
@@ -167,8 +182,8 @@ def get_logger(name: str = "spark_rapids_tpu") -> logging.Logger:
 def knob_table() -> dict[str, str]:
     """Current values of every knob (for diagnostics / bug reports)."""
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
-             "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_LEAK_DEBUG",
-             "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE", "SRT_CPP_PARALLEL_LEVEL",
-             "SRT_DENSE_MAX_CELLS", "SRT_COMPILE_CACHE",
-             "SRT_CPU_COMPILE_CACHE")
+             "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_METRICS",
+             "SRT_LEAK_DEBUG", "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE",
+             "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
+             "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -1290,6 +1290,96 @@ _COMPILED: dict = {}
 _DECODED_DICTS: dict = {}
 
 
+def _step_closures(steps: tuple, group_metas: tuple[_GroupMeta, ...],
+                   join_metas: tuple, axis: Optional[str] = None,
+                   axis_size: int = 1, union_metas: tuple = ()):
+    """Per-step trace callables ``fn(cols, sel, side) -> (cols, sel)`` —
+    THE single step-dispatch table, shared by :func:`_assemble` (which
+    chains them into one fused program) and :func:`analyze_plan` (which
+    jits each one separately for per-step measurement).  Static plan-shape
+    validation (the sharded-state rules) happens here, at build time."""
+    from .join import ShuffledJoinMeta, trace_join, trace_join_shuffled
+    fns = []
+    gi = ji = ui = 0
+    sharded = axis is not None
+    for step in steps:
+        if isinstance(step, FilterStep):
+            fns.append(lambda cols, sel, side, step=step:
+                       _trace_filter(cols, sel, step))
+        elif isinstance(step, ProjectStep):
+            fns.append(lambda cols, sel, side, step=step:
+                       _trace_project(cols, sel, step))
+        elif isinstance(step, GroupAggStep):
+            meta = group_metas[gi]
+            gi += 1
+            if not meta.dense:
+                if sharded:
+                    raise TypeError(
+                        "distributed plans need a dense-domain group-by "
+                        "(small static key domains); use "
+                        "parallel.dist_groupby for the shuffle-based "
+                        "general case")
+                fns.append(lambda cols, sel, side, step=step, meta=meta:
+                           _trace_group_sorted(cols, sel, step, meta))
+            else:
+                g_axis = axis if sharded else None
+                fns.append(lambda cols, sel, side, step=step, meta=meta,
+                           g_axis=g_axis:
+                           _trace_group_dense(cols, sel, step, meta,
+                                              axis=g_axis,
+                                              axis_size=axis_size))
+            sharded = False
+        elif step is _JOIN_MARKER:
+            meta = join_metas[ji]
+            ji += 1
+            if isinstance(meta, ShuffledJoinMeta):
+                if sharded:
+                    raise TypeError(
+                        "shuffled join inside a sharded program — "
+                        "run_plan_dist lowers it through the mesh "
+                        "shuffle before assembly (internal error)")
+                fns.append(lambda cols, sel, side, meta=meta:
+                           trace_join_shuffled(cols, sel, side, meta))
+            else:
+                fns.append(lambda cols, sel, side, meta=meta:
+                           trace_join(cols, sel, side, meta))
+        elif step is _UNION_MARKER:
+            if sharded:
+                raise TypeError(
+                    "union_all of still-sharded rows is not supported "
+                    "in a distributed plan; aggregate first")
+            meta = union_metas[ui]
+            ui += 1
+            fns.append(lambda cols, sel, side, meta=meta:
+                       _trace_union(cols, sel, side, meta))
+        elif isinstance(step, WindowStep):
+            if sharded:
+                raise TypeError(
+                    "window functions over still-sharded rows are not "
+                    "supported in a distributed plan (partitions span "
+                    "shards); aggregate first or window locally")
+            from .window import trace_window
+            fns.append(lambda cols, sel, side, step=step:
+                       trace_window(cols, sel, step))
+        elif isinstance(step, SortStep):
+            if sharded:
+                raise TypeError(
+                    "global sort of still-sharded rows is not supported "
+                    "in a distributed plan; aggregate first")
+            fns.append(lambda cols, sel, side, step=step:
+                       _trace_sort(cols, sel, step))
+        elif isinstance(step, LimitStep):
+            if sharded:
+                raise TypeError(
+                    "limit over still-sharded rows is not supported in "
+                    "a distributed plan; aggregate first")
+            fns.append(lambda cols, sel, side, step=step:
+                       _trace_limit(cols, sel, step))
+        else:
+            raise TypeError(f"unknown plan step {step!r}")
+    return fns
+
+
 def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
               join_metas: tuple, axis: Optional[str] = None,
               axis_size: int = 1, union_metas: tuple = (),
@@ -1300,80 +1390,16 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
     row-sharded inputs: the first (dense) group-by merges its accumulators
     with mesh collectives, after which state is replicated and every later
     step runs identically on all shards.  Steps that would need a global
-    view of still-sharded rows raise at trace time.
+    view of still-sharded rows raise at assembly time.
     """
-    from .join import trace_join
+    fns = _step_closures(steps, group_metas, join_metas, axis=axis,
+                         axis_size=axis_size, union_metas=union_metas)
 
     def program(cols: dict[str, Column], side: dict[str, Column],
                 init_sel=None):
         sel = init_sel
-        gi = ji = ui = 0
-        sharded = axis is not None
-        for step in steps:
-            if isinstance(step, FilterStep):
-                cols, sel = _trace_filter(cols, sel, step)
-            elif isinstance(step, ProjectStep):
-                cols, sel = _trace_project(cols, sel, step)
-            elif isinstance(step, GroupAggStep):
-                meta = group_metas[gi]
-                gi += 1
-                if not meta.dense:
-                    if sharded:
-                        raise TypeError(
-                            "distributed plans need a dense-domain group-by "
-                            "(small static key domains); use "
-                            "parallel.dist_groupby for the shuffle-based "
-                            "general case")
-                    cols, sel = _trace_group_sorted(cols, sel, step, meta)
-                else:
-                    cols, sel = _trace_group_dense(
-                        cols, sel, step, meta,
-                        axis=axis if sharded else None,
-                        axis_size=axis_size)
-                sharded = False
-            elif step is _JOIN_MARKER:
-                from .join import ShuffledJoinMeta, trace_join_shuffled
-                meta = join_metas[ji]
-                ji += 1
-                if isinstance(meta, ShuffledJoinMeta):
-                    if sharded:
-                        raise TypeError(
-                            "shuffled join inside a sharded program — "
-                            "run_plan_dist lowers it through the mesh "
-                            "shuffle before assembly (internal error)")
-                    cols, sel = trace_join_shuffled(cols, sel, side, meta)
-                else:
-                    cols, sel = trace_join(cols, sel, side, meta)
-            elif step is _UNION_MARKER:
-                if sharded:
-                    raise TypeError(
-                        "union_all of still-sharded rows is not supported "
-                        "in a distributed plan; aggregate first")
-                meta = union_metas[ui]
-                ui += 1
-                cols, sel = _trace_union(cols, sel, side, meta)
-            elif isinstance(step, WindowStep):
-                if sharded:
-                    raise TypeError(
-                        "window functions over still-sharded rows are not "
-                        "supported in a distributed plan (partitions span "
-                        "shards); aggregate first or window locally")
-                from .window import trace_window
-                cols, sel = trace_window(cols, sel, step)
-            elif isinstance(step, SortStep):
-                if sharded:
-                    raise TypeError(
-                        "global sort of still-sharded rows is not supported "
-                        "in a distributed plan; aggregate first")
-                cols, sel = _trace_sort(cols, sel, step)
-            elif isinstance(step, LimitStep):
-                if sharded:
-                    raise TypeError(
-                        "limit over still-sharded rows is not supported in "
-                        "a distributed plan; aggregate first")
-                cols, sel = _trace_limit(cols, sel, step)
-            else:
-                raise TypeError(f"unknown plan step {step!r}")
+        for fn in fns:
+            cols, sel = fn(cols, sel, side)
         return cols, sel
 
     if axis is not None or not jit:
@@ -1383,14 +1409,18 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
 
 def _compiled_for(bound: _Bound):
     from ..config import ensure_compile_cache
+    from ..obs.metrics import counter
     ensure_compile_cache()
     key = bound.signature()
     fn = _COMPILED.get(key)
     if fn is None:
+        counter("plan.compile_cache.miss").inc()
         fn = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
                        tuple(bound.join_metas),
                        union_metas=tuple(bound.union_metas))
         _COMPILED[key] = fn
+    else:
+        counter("plan.compile_cache.hit").inc()
     return fn
 
 
@@ -1439,10 +1469,51 @@ def run_plan_padded(plan: Plan, table: Table):
 def run_plan(plan: Plan, table: Table) -> Table:
     if table.num_rows == 0:
         return run_plan_eager(plan, table)
+    from ..config import metrics_enabled
+    if metrics_enabled():
+        return _run_plan_metered(plan, table)[0]
     bound = _Bound(plan, table)
     fn = _compiled_for(bound)
     out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
     return materialize(bound, out_cols, sel)
+
+
+def _run_plan_metered(plan: Plan, table: Table):
+    """run_plan with QueryMetrics accounting (``SRT_METRICS=1``): phase
+    wall times, compile-cache status, registry counter deltas.  The
+    program invocation is explicitly blocked on (jax.block_until_ready)
+    so execute_seconds means device wall, not dispatch latency — a
+    measurement barrier the unmetered path does not pay, which is why
+    this is a separate function and not inline ifs."""
+    import time as _time
+    from ..obs.metrics import counters_delta, registry
+    from ..obs.query import QueryMetrics, next_query_id, \
+        set_last_query_metrics
+    qm = QueryMetrics(query_id=next_query_id(), mode="run",
+                      input_rows=table.num_rows,
+                      input_columns=table.num_columns)
+    before = registry().counters_snapshot()
+    t_all = _time.perf_counter()
+    bound = _Bound(plan, table)
+    qm.bind_seconds = _time.perf_counter() - t_all
+    qm.compile_cache = ("hit" if bound.signature() in _COMPILED
+                        else "miss")
+    fn = _compiled_for(bound)
+    t0 = _time.perf_counter()
+    out_cols, sel = jax.block_until_ready(
+        fn(bound.exec_cols, bound.side_inputs))
+    qm.execute_seconds = _time.perf_counter() - t0
+    if qm.compile_cache == "miss":
+        qm.compile_seconds = qm.execute_seconds
+    t0 = _time.perf_counter()
+    t = materialize(bound, out_cols, sel)
+    qm.materialize_seconds = _time.perf_counter() - t0
+    qm.total_seconds = _time.perf_counter() - t_all
+    qm.output_rows = t.num_rows
+    qm.steps = _static_step_metrics(bound)
+    qm.finish_counters(counters_delta(before))
+    set_last_query_metrics(qm)
+    return t, qm
 
 
 def materialize(bound: _Bound, out_cols: dict[str, Column], sel) -> Table:
@@ -1451,7 +1522,9 @@ def materialize(bound: _Bound, out_cols: dict[str, Column], sel) -> Table:
     if sel is None:
         return _rebuild(bound, out_cols)
     from ..ops.common import pow2_bucket
+    from ..utils.memory import record_host_sync
     count = int(jnp.sum(sel))                     # THE host sync
+    record_host_sync("materialize.count", 8)
     n = next(iter(out_cols.values())).size
     bucket = min(pow2_bucket(count), n)
     from ..ops.filter import _compact_kernel
@@ -1530,24 +1603,20 @@ def _rebuild(bound: _Bound, out_cols: dict[str, Column]) -> Table:
     return Table([(nm, result[nm]) for nm in ordered])
 
 
-def explain_plan(plan: Plan, table: Table) -> str:
-    """Human-readable bound physical plan (see Plan.explain)."""
-    bound = _Bound(plan, table)
-    lines = [f"Plan over {table.num_rows} rows x "
-             f"{table.num_columns} cols"]
-    if bound.dictionaries:
-        lines.append(f"  strings dictionary-encoded as keys: "
-                     f"{sorted(bound.dictionaries)}")
-    if bound.string_cols:
-        lines.append(f"  strings via rowid indirection: "
-                     f"{sorted(bound.string_cols)}")
+def _step_descriptions(bound: _Bound) -> list[tuple[str, str]]:
+    """``(kind, text)`` per bound step — the single source of the per-step
+    explain text, shared by :func:`explain_plan` and the analyzed tree
+    (indices line up with :func:`_step_closures` over assembly_steps)."""
+    out: list[tuple[str, str]] = []
     gi = ji = 0
     for step in bound.steps:
         if isinstance(step, FilterStep):
-            lines.append(f"  Filter[{render(step.pred)}] -> selection mask")
+            out.append(("Filter",
+                        f"Filter[{render(step.pred)}] -> selection mask"))
         elif isinstance(step, ProjectStep):
             kind = "Select" if step.narrow else "Project"
-            lines.append(f"  {kind}[{', '.join(nm for nm, _ in step.cols)}]")
+            out.append((kind,
+                        f"{kind}[{', '.join(nm for nm, _ in step.cols)}]"))
         elif isinstance(step, GroupAggStep):
             meta = bound.group_metas[gi]
             gi += 1
@@ -1559,49 +1628,170 @@ def explain_plan(plan: Plan, table: Table) -> str:
                     f"{km.name}:[{km.lo},{km.hi}]"
                     + ("+null" if km.nullable else "")
                     for km in meta.keys)
-                lines.append(f"  GroupBy[dense, {meta.cells} cells{sets}; "
-                             f"{doms}] "
-                             f"aggs={[h for _, h, _ in step.aggs]}")
+                out.append(("GroupBy[dense]",
+                            f"GroupBy[dense, {meta.cells} cells{sets}; "
+                            f"{doms}] "
+                            f"aggs={[h for _, h, _ in step.aggs]}"))
             else:
-                lines.append(
-                    f"  GroupBy[sorted: multi-key sort + segmented "
-                    f"scans{sets}] keys={list(step.keys)} "
-                    f"aggs={[h for _, h, _ in step.aggs]}")
+                out.append(("GroupBy[sorted]",
+                            f"GroupBy[sorted: multi-key sort + segmented "
+                            f"scans{sets}] keys={list(step.keys)} "
+                            f"aggs={[h for _, h, _ in step.aggs]}"))
         elif isinstance(step, JoinStep):
             meta = bound.join_metas[ji]
             ji += 1
             keys = ", ".join(
                 f"{km.probe_name}:[{km.lo},{km.hi}]" for km in meta.keys)
-            lines.append(
-                f"  BroadcastJoin[{meta.how}, probe={meta.mode}, "
-                f"build={meta.dim_rows} rows] on {keys}")
+            out.append(("BroadcastJoin",
+                        f"BroadcastJoin[{meta.how}, probe={meta.mode}, "
+                        f"build={meta.dim_rows} rows] on {keys}"))
         elif isinstance(step, JoinShuffledStep):
             meta = bound.join_metas[ji]
             ji += 1
-            lines.append(
-                f"  ShuffledJoin[{meta.how}, right={meta.right_rows} rows, "
-                f"capacity={meta.capacity}; bind-time factorize probe] on "
-                f"{', '.join(step.left_on)}")
+            out.append(("ShuffledJoin",
+                        f"ShuffledJoin[{meta.how}, "
+                        f"right={meta.right_rows} rows, "
+                        f"capacity={meta.capacity}; bind-time factorize "
+                        f"probe] on {', '.join(step.left_on)}"))
         elif isinstance(step, UnionAllStep):
-            lines.append(
-                f"  UnionAll[branch over {step.table.num_rows} rows, "
-                f"{len(step.plan.steps)} branch steps traced inline]")
+            out.append(("UnionAll",
+                        f"UnionAll[branch over {step.table.num_rows} rows, "
+                        f"{len(step.plan.steps)} branch steps traced "
+                        f"inline]"))
         elif isinstance(step, WindowStep):
-            lines.append(
-                f"  Window[{step.func} -> {step.out}; partition by "
-                f"{', '.join(step.partition_by)}"
-                + (f"; order by {', '.join(step.order_by)}"
-                   if step.order_by else "") + "]")
+            out.append(("Window",
+                        f"Window[{step.func} -> {step.out}; partition by "
+                        f"{', '.join(step.partition_by)}"
+                        + (f"; order by {', '.join(step.order_by)}"
+                           if step.order_by else "") + "]"))
         elif isinstance(step, SortStep):
-            lines.append(f"  Sort[{', '.join(step.by)}]")
+            out.append(("Sort", f"Sort[{', '.join(step.by)}]"))
         elif isinstance(step, LimitStep):
-            lines.append(f"  Limit[{step.k}]")
+            out.append(("Limit", f"Limit[{step.k}]"))
+    return out
+
+
+def _static_step_metrics(bound: _Bound) -> list:
+    """Describe-only StepMetrics (rows/timings unmeasured) for the plain
+    metered run path, which never breaks the fused program apart."""
+    from ..obs.query import StepMetrics
+    return [StepMetrics(index=i, kind=kind, describe=text)
+            for i, (kind, text) in enumerate(_step_descriptions(bound))]
+
+
+def explain_plan(plan: Plan, table: Table) -> str:
+    """Human-readable bound physical plan (see Plan.explain)."""
+    bound = _Bound(plan, table)
+    lines = [f"Plan over {table.num_rows} rows x "
+             f"{table.num_columns} cols"]
+    if bound.dictionaries:
+        lines.append(f"  strings dictionary-encoded as keys: "
+                     f"{sorted(bound.dictionaries)}")
+    if bound.string_cols:
+        lines.append(f"  strings via rowid indirection: "
+                     f"{sorted(bound.string_cols)}")
+    for _, text in _step_descriptions(bound):
+        lines.append("  " + text)
     lines.append("  Materialize[compact by selection; "
                  + ("1 host sync]" if any(
                      isinstance(s, (FilterStep, GroupAggStep, JoinStep,
                                     JoinShuffledStep))
                      for s in bound.steps) else "0 host syncs]"))
     return "\n".join(lines)
+
+
+def analyze_plan(plan: Plan, table: Table):
+    """Execute ``plan`` one jitted program per step, measuring per-step
+    wall time and live rows in/out — ``explain_analyze``'s engine.
+
+    Deliberately NOT the production execution shape: each step dispatches
+    separately and its live-row count is read back (one small host sync
+    per step, kept OUT of the ``host.sync`` counters — the instrument
+    does not meter itself).  The whole-plan compile cache is still
+    consulted first, so the report's ``cache=``/compile/execute fields
+    describe the production fused program.  Returns
+    ``(materialized Table, QueryMetrics)``.
+    """
+    import time as _time
+    from ..obs.metrics import counters_delta, registry
+    from ..obs.query import QueryMetrics, StepMetrics, next_query_id, \
+        set_last_query_metrics
+    qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
+                      input_rows=table.num_rows,
+                      input_columns=table.num_columns)
+    before = registry().counters_snapshot()
+    t_all = _time.perf_counter()
+    bound = _Bound(plan, table)
+    qm.bind_seconds = _time.perf_counter() - t_all
+    qm.compile_cache = ("hit" if bound.signature() in _COMPILED
+                        else "miss")
+    fn = _compiled_for(bound)
+    t0 = _time.perf_counter()
+    out_cols, sel = jax.block_until_ready(
+        fn(bound.exec_cols, bound.side_inputs))
+    qm.execute_seconds = _time.perf_counter() - t0
+    if qm.compile_cache == "miss":
+        qm.compile_seconds = qm.execute_seconds
+    # Per-step measured pass: fresh single-step jits over the same bound
+    # inputs.  Diagnostic cost (re-traces every call) is acceptable —
+    # explain_analyze is a debugging surface, not a hot path.
+    fns = _step_closures(bound.assembly_steps(), tuple(bound.group_metas),
+                         tuple(bound.join_metas),
+                         union_metas=tuple(bound.union_metas))
+    descs = _step_descriptions(bound)
+    cols, step_sel = bound.exec_cols, None
+    live_in = bound.n
+    for i, (step_fn, (kind, text)) in enumerate(zip(fns, descs)):
+        t0 = _time.perf_counter()
+        cols, step_sel = jax.block_until_ready(
+            jax.jit(step_fn)(cols, step_sel, bound.side_inputs))
+        dt = _time.perf_counter() - t0
+        padded = int(next(iter(cols.values())).data.shape[0])
+        live = (padded if step_sel is None
+                else int(jnp.sum(step_sel)))      # analyzer-only sync
+        qm.steps.append(StepMetrics(
+            index=i, kind=kind, describe=text, rows_in=live_in,
+            rows_out=live, padded_out=padded, seconds=dt,
+            density=(live / padded) if padded else 0.0))
+        live_in = live
+    t0 = _time.perf_counter()
+    t = materialize(bound, out_cols, sel)
+    qm.materialize_seconds = _time.perf_counter() - t0
+    qm.total_seconds = _time.perf_counter() - t_all
+    qm.output_rows = t.num_rows
+    qm.finish_counters(counters_delta(before))
+    set_last_query_metrics(qm)
+    return t, qm
+
+
+def explain_analyze_plan(plan: Plan, table: Table) -> str:
+    """The analyzed tree behind ``Plan.explain_analyze``.
+
+    With ``SRT_METRICS=1`` runs :func:`analyze_plan` and renders measured
+    per-step rows/timings; otherwise renders the same tree with metrics
+    marked unavailable (still binds the plan, so the step text is real).
+    """
+    from ..config import metrics_enabled
+    from ..obs.query import UNMEASURED_FLOAT, QueryMetrics
+    header = (f"Plan over {table.num_rows} rows x "
+              f"{table.num_columns} cols")
+    if not metrics_enabled() or table.num_rows == 0:
+        qm = QueryMetrics(mode="analyze", input_rows=table.num_rows,
+                          input_columns=table.num_columns,
+                          bind_seconds=UNMEASURED_FLOAT,
+                          compile_seconds=UNMEASURED_FLOAT,
+                          execute_seconds=UNMEASURED_FLOAT,
+                          materialize_seconds=UNMEASURED_FLOAT,
+                          total_seconds=UNMEASURED_FLOAT)
+        if table.num_rows:
+            qm.steps = _static_step_metrics(_Bound(plan, table))
+        note = ("  (empty input: eager path, nothing to measure)"
+                if table.num_rows == 0 and metrics_enabled()
+                else "  (metrics unavailable: set SRT_METRICS=1 "
+                     "to measure)")
+        return qm.render(header) + "\n" + note
+    _, qm = analyze_plan(plan, table)
+    return qm.render(header)
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,8 @@ def _live_count_cached(row_mask) -> int:
     if hit is not None:
         return hit
     count = int(jnp.sum(row_mask))
+    from ..utils.memory import record_host_sync
+    record_host_sync("dist.live_count", 8)
     _guarded_cache_put(_LIVE_COUNT, key, (row_mask,), count)
     return count
 
@@ -166,7 +168,10 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     # just its shape.
     mesh_key = (axis, tuple(d.id for d in mesh.devices.flat))
     key = bound.signature() + (mesh_key, replicated_out)
+    from ..obs.metrics import counter, gauge
     fn = _DIST_COMPILED.get(key)
+    counter(f"dist.compile_cache.{'miss' if fn is None else 'hit'}").inc()
+    gauge("dist.mesh_devices").set(axis_size)
     if fn is None:
         program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
                             tuple(bound.join_metas), axis=axis,

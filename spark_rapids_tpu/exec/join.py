@@ -105,6 +105,9 @@ def _build_probe(key_cols: list[Column], dedupe: bool = False):
             valid &= np.asarray(c.validity)
     rows = np.arange(n, dtype=np.int32)[valid]
     np_keys = [np.asarray(c.data)[valid] for c in key_cols]
+    from ..utils.memory import record_host_sync
+    record_host_sync("join.build_probe",
+                     sum(c.data.nbytes for c in key_cols))
 
     if rows.size == 0:
         result = ((tuple((0, 0, 0) for _ in key_cols)), "search", 0, 0,
@@ -377,6 +380,8 @@ def _shuffled_probe(left_keys: list[Column], right, right_on):
                         jnp.maximum(counts, 1).sum()])
     import jax
     t_inner, t_left = (int(x) for x in jax.device_get(totals))  # bind sync
+    from ..utils.memory import record_host_sync
+    record_host_sync("join.bind_probe", int(totals.nbytes))
     result = (rorder, lo.astype(jnp.int32), counts32, t_inner, t_left)
     _guarded_cache_put(_SHUFFLE_PROBE_CACHE, cache_key, buffers, result)
     return result

@@ -425,6 +425,17 @@ class Plan:
         from .compile import explain_plan
         return explain_plan(self, table)
 
+    def explain_analyze(self, table: Table) -> str:
+        """``explain`` annotated with MEASURED per-step metrics (Spark
+        ``EXPLAIN ANALYZE`` analog): live rows in/out, selection density,
+        per-step wall time, plus bind/compile/execute/materialize phase
+        times and the compile-cache status of the fused program.  Runs
+        the query (once fused for phase times, once step-by-step for the
+        per-step numbers) when ``SRT_METRICS=1``; otherwise renders the
+        same tree with metrics marked unavailable."""
+        from .compile import explain_analyze_plan
+        return explain_analyze_plan(self, table)
+
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`
         over ``mesh``: the per-shard program runs under ``shard_map`` and

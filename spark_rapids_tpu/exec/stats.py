@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column import Column
+from ..utils.memory import record_host_sync
 
 #: (id(data), id(validity) or None) -> ((weakrefs), (lo, hi)).  The cache
 #: identity is the *pair* of device buffers — two columns may share a data
@@ -74,11 +75,13 @@ def column_int_range(col: Column,
         # One batched transfer (a blocking round trip costs ~400 ms on a
         # tunneled device; three separate int()/bool() reads would triple it).
         lo_v, hi_v, ok = jax.device_get((lo, hi, jnp.any(valid)))
+        record_host_sync("stats.probe", 17)
         if not bool(ok):
             return None
         lo_v, hi_v = int(lo_v), int(hi_v)
     else:
         lo_v, hi_v = map(int, jax.device_get((jnp.min(data), jnp.max(data))))
+        record_host_sync("stats.probe", 16)
 
     result = (lo_v, hi_v)
     _guarded_cache_put(_CACHE, key, buffers, result)

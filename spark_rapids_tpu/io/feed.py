@@ -140,7 +140,11 @@ def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
         paths = [paths]
 
     def all_groups():
+        from ..obs.metrics import counter
         for p in paths:
-            yield from _row_group_reader(p, columns)
+            for t in _row_group_reader(p, columns):
+                counter("io.feed.row_groups").inc()
+                counter("io.feed.rows").inc(t.num_rows)
+                yield t
 
     return prefetch(all_groups(), depth=depth)

@@ -1096,34 +1096,45 @@ def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
     callers fall back to the Arrow-backed
     :func:`spark_rapids_tpu.io.parquet.read_parquet`.
     """
-    cols, row_groups = read_metadata(path)
-    want = list(columns) if columns is not None else [c.name for c in cols]
-    missing = set(want) - {c.name for c in cols}
-    if missing:
-        raise KeyError(f"columns not in file: {sorted(missing)}")
-    per_name: Dict[str, List] = {name: [] for name in want}
-    with open(path, "rb") as f:
-        for rg in row_groups:
-            for chunk in rg:
-                if chunk.column.name not in per_name:
-                    continue
-                f.seek(chunk.start_offset)
-                chunk_bytes = f.read(chunk.total_compressed)
-                per_name[chunk.column.name].append(
-                    _decode_chunk(chunk_bytes, chunk))
-    dtypes_by_name = {c.name: c.dtype for c in cols}
-    out = []
-    for name in want:
-        pieces = per_name[name]
-        if not pieces:                       # zero row groups in the file
-            col = _empty_column(dtypes_by_name[name])
-        elif all(isinstance(x, _DictStrChunk) for x in pieces):
-            col = _fuse_dict_str_chunks(pieces)
-        else:
-            mats = [_materialize_piece(x) for x in pieces]
-            col = mats[0] if len(mats) == 1 else _concat_columns(mats)
-        out.append((name, col))
-    return Table(out)
+    from ..obs.metrics import counter, timer
+    with timer("io.parquet.read").time():
+        cols, row_groups = read_metadata(path)
+        want = (list(columns) if columns is not None
+                else [c.name for c in cols])
+        missing = set(want) - {c.name for c in cols}
+        if missing:
+            raise KeyError(f"columns not in file: {sorted(missing)}")
+        per_name: Dict[str, List] = {name: [] for name in want}
+        bytes_read = 0
+        with open(path, "rb") as f:
+            for rg in row_groups:
+                for chunk in rg:
+                    if chunk.column.name not in per_name:
+                        continue
+                    f.seek(chunk.start_offset)
+                    chunk_bytes = f.read(chunk.total_compressed)
+                    bytes_read += len(chunk_bytes)
+                    per_name[chunk.column.name].append(
+                        _decode_chunk(chunk_bytes, chunk))
+        dtypes_by_name = {c.name: c.dtype for c in cols}
+        out = []
+        for name in want:
+            pieces = per_name[name]
+            if not pieces:                   # zero row groups in the file
+                col = _empty_column(dtypes_by_name[name])
+            elif all(isinstance(x, _DictStrChunk) for x in pieces):
+                col = _fuse_dict_str_chunks(pieces)
+            else:
+                mats = [_materialize_piece(x) for x in pieces]
+                col = mats[0] if len(mats) == 1 else _concat_columns(mats)
+            out.append((name, col))
+        t = Table(out)
+        counter("io.parquet.files").inc()
+        counter("io.parquet.row_groups").inc(len(row_groups))
+        counter("io.parquet.rows").inc(t.num_rows)
+        counter("io.parquet.columns").inc(t.num_columns)
+        counter("io.parquet.bytes_read").inc(bytes_read)
+    return t
 
 
 def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:

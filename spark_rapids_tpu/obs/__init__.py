@@ -1,0 +1,37 @@
+"""Observability: query metrics, counters, and host-sync accounting.
+
+The reference stack (spark-rapids-jni) inherits Spark's SQL-metrics UI —
+every exec node reports rows/bytes/time for free.  This engine's
+whole-plan XLA programs are opaque by construction, so :mod:`.metrics`
+provides the substrate (named counters/gauges/timers, no-op unless
+``SRT_METRICS=1``) and :mod:`.query` the per-plan record populated by
+exec/compile.py and surfaced through ``Plan.explain_analyze`` and the
+benchmarks' JSON output.
+
+Import hygiene: nothing under ``obs`` imports jax at module load (tested
+by tests/test_import_hygiene.py) — metrics post-processing must not drag
+in the XLA stack.
+"""
+
+from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
+                      counter, counters_delta, gauge, registry, timer)
+from .query import (QueryMetrics, StepMetrics, bench_metrics_line,
+                    last_query_metrics, set_last_query_metrics)
+
+__all__ = [
+    "NULL_METRIC",
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "QueryMetrics",
+    "StepMetrics",
+    "Timer",
+    "bench_metrics_line",
+    "counter",
+    "counters_delta",
+    "gauge",
+    "last_query_metrics",
+    "registry",
+    "set_last_query_metrics",
+    "timer",
+]

@@ -1,0 +1,287 @@
+"""Metrics registry: counters, gauges, timers — the SQL-metrics substrate.
+
+The reference stack inherits Spark's per-exec SQL metrics for free (every
+exec node reports rows/bytes/time into the Spark UI); this engine's
+whole-plan XLA programs have no such surface, so the registry below is the
+in-tree replacement.  Instrumented code asks for a handle by name::
+
+    from spark_rapids_tpu.obs.metrics import counter, timer
+
+    counter("shuffle.bytes_moved").inc(nbytes)
+    with timer("io.parquet.read").time():
+        ...
+
+Contract (the ``SRT_METRICS`` knob, config.metrics_enabled):
+
+* **off (default)** — every lookup returns the ONE shared
+  :data:`NULL_METRIC` singleton whose methods do nothing; the cost of an
+  instrumented region is one env read + an attribute call.  Nothing here
+  ever runs per row: instrumentation sits at region boundaries (a plan
+  run, a shuffle, a file read), never inside traced kernels.
+* **on** — handles are real, thread-safe (one lock per metric; shuffle
+  prefetch workers and the IO feed thread write concurrently), and
+  :func:`registry` exposes a snapshot for per-query deltas.
+
+A timed region is also a named profiler scope (utils/tracing.py) when
+``SRT_TRACE`` is on, so every metered region shows up in TensorBoard/
+Perfetto captures under the same name — one naming scheme for both the
+numbers and the timeline.
+
+This module must not import jax at module load (the lazy-import rule of
+config.py): it is reachable from ``import spark_rapids_tpu.obs`` on hosts
+that only post-process metrics JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, Optional, Union
+
+from ..config import metrics_enabled
+
+
+class _NullTimeScope:
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_TIME_SCOPE = _NullTimeScope()
+
+
+class NullMetric:
+    """The shared no-op handle returned by every lookup while
+    ``SRT_METRICS`` is unset.  Duck-types Counter, Gauge, and Timer; all
+    mutators discard, all reads are zero."""
+    __slots__ = ()
+
+    name = ""
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, value: Union[int, float]) -> None:
+        pass
+
+    def observe(self, seconds: float) -> None:
+        pass
+
+    def time(self) -> "_NullTimeScope":
+        return _NULL_TIME_SCOPE
+
+    @property
+    def value(self) -> int:
+        return 0
+
+    @property
+    def count(self) -> int:
+        return 0
+
+    @property
+    def total_seconds(self) -> float:
+        return 0.0
+
+
+#: THE null object — identity-comparable so tests can assert the no-op
+#: contract (`counter("x") is NULL_METRIC` when metrics are off).
+NULL_METRIC = NullMetric()
+
+
+class Counter:
+    """Monotonic count (rows scanned, cache hits, host syncs)."""
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-written value (shuffle partition count, bucket size)."""
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, value: Union[int, float]) -> None:
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self) -> Union[int, float]:
+        return self._value
+
+
+class _TimeScope:
+    __slots__ = ("_timer", "_scope", "_t0")
+
+    def __init__(self, timer: "Timer", scope):
+        self._timer = timer
+        self._scope = scope
+
+    def __enter__(self) -> "_TimeScope":
+        if self._scope is not None:
+            self._scope.__enter__()
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timer.observe(_time.perf_counter() - self._t0)
+        if self._scope is not None:
+            self._scope.__exit__(*exc)
+        return None
+
+
+class Timer:
+    """Accumulated wall time + invocation count for a named region."""
+    __slots__ = ("name", "_total", "_count", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._total = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._total += seconds
+            self._count += 1
+
+    def time(self) -> "_TimeScope":
+        """Context manager timing the region; doubles as a named profiler
+        scope when ``SRT_TRACE`` is on (the metered-region == trace-scope
+        integration)."""
+        from ..config import trace_enabled
+        scope = None
+        if trace_enabled():
+            from ..utils.tracing import trace   # lazy: pulls in jax
+            scope = trace(self.name)
+        return _TimeScope(self, scope)
+
+    @property
+    def total_seconds(self) -> float:
+        return self._total
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class MetricsRegistry:
+    """Process-global named-metric table.
+
+    One instance per process (:func:`registry`); creation is
+    double-checked under a registry lock, reads after creation are
+    lock-free dict hits.  ``reset()`` exists for tests and for per-run
+    benchmark isolation only.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} is a {type(m).__name__}, "
+                            f"not a {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        """Current counter values (the delta basis for per-query
+        accounting in obs.query)."""
+        with self._lock:
+            return {n: m.value for n, m in self._metrics.items()
+                    if isinstance(m, Counter)}
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """Flat view of everything: counters/gauges by name, timers as
+        ``name.seconds`` / ``name.count`` — the payload benchmarks emit."""
+        out: Dict[str, Union[int, float]] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Timer):
+                out[name + ".seconds"] = round(m.total_seconds, 6)
+                out[name + ".count"] = m.count
+            else:
+                out[name] = m.value
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry (always real; gating happens in the
+    module-level accessors below)."""
+    return _REGISTRY
+
+
+def counter(name: str):
+    """``registry().counter(name)`` when metrics are on, else the shared
+    :data:`NULL_METRIC` (zero-overhead no-op path)."""
+    if not metrics_enabled():
+        return NULL_METRIC
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str):
+    if not metrics_enabled():
+        return NULL_METRIC
+    return _REGISTRY.gauge(name)
+
+
+def timer(name: str):
+    if not metrics_enabled():
+        return NULL_METRIC
+    return _REGISTRY.timer(name)
+
+
+def counters_delta(before: Optional[Dict[str, int]]) -> Dict[str, int]:
+    """Counter increments since ``before`` (a ``counters_snapshot()``),
+    dropping zero entries; ``{}`` when metrics are off."""
+    if not metrics_enabled() or before is None:
+        return {}
+    after = _REGISTRY.counters_snapshot()
+    out = {}
+    for name, val in after.items():
+        d = val - before.get(name, 0)
+        if d:
+            out[name] = d
+    return out

@@ -1,0 +1,208 @@
+"""Per-plan query metrics record — the Spark SQL-metrics-tab analog.
+
+One :class:`QueryMetrics` describes one plan execution end to end: bind /
+compile / execute / materialize wall times, compile-cache status, the
+host-sync and device→host-byte deltas accounted by utils/memory.py, the
+dictionary-encode cache hit rate, and (when produced by
+``Plan.explain_analyze``) per-step measured rows in/out and timings.
+
+Producers live in exec/compile.py (``run_plan`` when ``SRT_METRICS=1``,
+and ``analyze_plan`` behind ``Plan.explain_analyze``); consumers are
+:func:`last_query_metrics` (the benchmarks' second JSON line) and
+:meth:`QueryMetrics.render` (the ``explain_analyze`` tree).
+
+``to_json()`` is a STABLE schema (``schema_version`` bumps on change;
+tests/golden/query_metrics_schema.json pins the key set): BENCH runs diff
+these payloads across PRs, so fields are append-only.
+
+No jax at module load (lazy-import rule, see obs/metrics.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: Sentinels for "not measured" (explain_analyze measures per-step rows
+#: and times; the plain metered run records static step info only).
+UNMEASURED_INT = -1
+UNMEASURED_FLOAT = -1.0
+
+_QUERY_IDS = itertools.count(1)
+_LAST_LOCK = threading.Lock()
+_LAST: Optional["QueryMetrics"] = None
+
+
+def next_query_id() -> int:
+    return next(_QUERY_IDS)
+
+
+@dataclass
+class StepMetrics:
+    """One plan step's contribution.
+
+    ``rows_in``/``rows_out`` count LIVE rows (selection-mask semantics:
+    the program keeps every slot padded; live rows are the ones a
+    materialization would keep).  ``padded_out`` is the physical slot
+    count after the step, and ``density`` = rows_out / padded_out — low
+    density after a filter is exactly the compaction opportunity the
+    selection-mask design defers to materialization."""
+    index: int
+    kind: str
+    describe: str
+    rows_in: int = UNMEASURED_INT
+    rows_out: int = UNMEASURED_INT
+    padded_out: int = UNMEASURED_INT
+    seconds: float = UNMEASURED_FLOAT
+    density: float = UNMEASURED_FLOAT
+
+    def to_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "kind": self.kind,
+            "describe": self.describe,
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+            "padded_out": self.padded_out,
+            "seconds": round(self.seconds, 6),
+            "density": round(self.density, 6),
+        }
+
+
+@dataclass
+class QueryMetrics:
+    """End-to-end accounting for one plan execution."""
+    query_id: int = 0
+    mode: str = "run"                  # run | analyze | dist
+    input_rows: int = 0
+    input_columns: int = 0
+    output_rows: int = UNMEASURED_INT
+    bind_seconds: float = 0.0
+    #: wall of the first program invocation when it missed the in-process
+    #: program cache — dominated by trace + XLA compile (BASELINE.md:
+    #: minutes on TPU, vs ms of execute); 0.0 on a hit.
+    compile_seconds: float = 0.0
+    #: program invocation wall (device dispatch + compute + the blocking
+    #: wait); on a compile-cache miss this equals compile_seconds.
+    execute_seconds: float = 0.0
+    materialize_seconds: float = 0.0
+    total_seconds: float = 0.0
+    compile_cache: str = "unavailable"  # hit | miss | unavailable
+    host_syncs: int = 0
+    d2h_bytes: int = 0
+    dict_encode_hits: int = 0
+    dict_encode_misses: int = 0
+    steps: List[StepMetrics] = field(default_factory=list)
+    #: raw registry counter deltas over the run (shuffle bytes, parquet
+    #: rows, ... — whatever the layers underneath incremented).
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    def finish_counters(self, delta: Dict[str, int]) -> None:
+        """Fold a registry counters-delta into the summary fields."""
+        self.counters = dict(delta)
+        self.host_syncs = delta.get("host.sync", 0)
+        self.d2h_bytes = delta.get("host.d2h_bytes", 0)
+        self.dict_encode_hits = delta.get("strings.dict_encode.hit", 0)
+        self.dict_encode_misses = delta.get("strings.dict_encode.miss", 0)
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": 1,
+            "metric": "query_metrics",
+            "query_id": self.query_id,
+            "mode": self.mode,
+            "input": {"rows": self.input_rows,
+                      "columns": self.input_columns},
+            "output": {"rows": self.output_rows},
+            "timings": {
+                "bind_seconds": round(self.bind_seconds, 6),
+                "compile_seconds": round(self.compile_seconds, 6),
+                "execute_seconds": round(self.execute_seconds, 6),
+                "materialize_seconds": round(self.materialize_seconds, 6),
+                "total_seconds": round(self.total_seconds, 6),
+            },
+            "compile_cache": self.compile_cache,
+            "host": {"syncs": self.host_syncs,
+                     "d2h_bytes": self.d2h_bytes},
+            "caches": {"dict_encode_hits": self.dict_encode_hits,
+                       "dict_encode_misses": self.dict_encode_misses},
+            "steps": [s.to_dict() for s in self.steps],
+            "counters": self.counters,
+        }
+
+    def to_json(self) -> str:
+        """ONE line, stable key order — the benchmarks' second JSON line."""
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    # -- rendering ---------------------------------------------------------
+
+    def render(self, header: str = "") -> str:
+        """The ``explain_analyze`` tree: per-step lines annotated with
+        measured rows/time where available."""
+        lines = []
+        if header:
+            lines.append(header)
+        lines.append(
+            f"  == Analyzed ({self.mode}) == "
+            f"bind={_ms(self.bind_seconds)} "
+            f"compile={_ms(self.compile_seconds)} "
+            f"cache={self.compile_cache} "
+            f"execute={_ms(self.execute_seconds)} "
+            f"materialize={_ms(self.materialize_seconds)} "
+            f"total={_ms(self.total_seconds)}")
+        lines.append(
+            f"  host_syncs={self.host_syncs} d2h_bytes={self.d2h_bytes} "
+            f"dict_encode={self.dict_encode_hits} hit"
+            f"/{self.dict_encode_misses} miss")
+        n = len(self.steps)
+        for i, s in enumerate(self.steps):
+            branch = "└─" if i == n - 1 else "├─"
+            if s.rows_in == UNMEASURED_INT:
+                ann = "  [metrics unavailable: set SRT_METRICS=1]"
+            else:
+                ann = (f"  rows: {s.rows_in} -> {s.rows_out}"
+                       f" (density {s.density:.1%}"
+                       f" of {s.padded_out} slots)")
+                if s.seconds != UNMEASURED_FLOAT:
+                    ann += f"  {_ms(s.seconds)}"
+            lines.append(f"  {branch} {s.describe}{ann}")
+        out_rows = ("?" if self.output_rows == UNMEASURED_INT
+                    else self.output_rows)
+        lines.append(f"     Materialize -> {out_rows} rows")
+        return "\n".join(lines)
+
+
+def _ms(seconds: float) -> str:
+    if seconds < 0:
+        return "n/a"
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def set_last_query_metrics(qm: QueryMetrics) -> None:
+    global _LAST
+    with _LAST_LOCK:
+        _LAST = qm
+
+
+def last_query_metrics() -> Optional[QueryMetrics]:
+    """The most recent plan execution's metrics (None before any metered
+    run) — how benchmarks fetch the payload without plumbing a return
+    value through ``Plan.run``."""
+    with _LAST_LOCK:
+        return _LAST
+
+
+def bench_metrics_line() -> str:
+    """The benchmarks' second JSON line (behind ``SRT_METRICS=1``): the
+    last query's ``to_json()`` when a metered plan ran, else the global
+    registry snapshot (bench programs that never build a Plan still get
+    their cache/IO/host-sync counters captured)."""
+    qm = last_query_metrics()
+    if qm is not None:
+        return qm.to_json()
+    from .metrics import registry
+    return json.dumps({"metric": "srt_metrics",
+                       "counters": registry().snapshot()}, sort_keys=True)

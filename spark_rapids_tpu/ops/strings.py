@@ -737,6 +737,10 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
     chars = np.asarray(col.data, dtype=np.uint8)
     offsets = np.asarray(col.offsets)
     mask = None if col.validity is None else np.asarray(col.validity)
+    from ..utils.memory import record_host_sync
+    record_host_sync("strings.dict_encode",
+                     chars.nbytes + offsets.nbytes
+                     + (mask.nbytes if mask is not None else 0))
     n = len(offsets) - 1
     lengths = (offsets[1:] - offsets[:-1]).astype(np.int64)
     if mask is not None:
@@ -801,14 +805,18 @@ _ENCODE_CACHE: dict = {}
 
 def dictionary_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
     from ..exec.stats import _guarded_cache_get, _guarded_cache_put
+    from ..obs.metrics import counter
     buffers = tuple(b for b in (col.data, col.offsets, col.validity)
                     if b is not None)
     key = tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_ENCODE_CACHE, key, buffers)
     if hit is None:
+        counter("strings.dict_encode.miss").inc()
         codes, uniq = dictionary_encode(col)
         hit = (codes, tuple(uniq))
         _guarded_cache_put(_ENCODE_CACHE, key, buffers, hit)
+    else:
+        counter("strings.dict_encode.hit").inc()
     return hit
 
 

@@ -47,6 +47,8 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     chained distributed ops (join -> groupby) therefore keep capacity
     proportional to real rows instead of doubling it at every stage.
     """
+    from ..obs.metrics import counter, gauge
+    from ..utils.memory import record_host_sync
     P = mesh.devices.size
     capacity = dist.capacity_total // P
     if bucket_size is None:
@@ -54,6 +56,7 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         # skew, floor of 8 so tiny shards don't thrash the overflow retry.
         per_shard_live = jnp.sum(dist.row_mask.reshape(P, capacity), axis=1)
         max_live = int(jnp.max(per_shard_live))   # host sync (P scalars)
+        record_host_sync("shuffle.sizing", 8)
         # Power-of-two bucketing keeps the shard_map's static shapes (and the
         # downstream kernels keyed off capacity_total) from recompiling on
         # every slightly-different live-row count (ops/common.py contract).
@@ -61,8 +64,22 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
 
     pids = partition_ids([dist.table[k] for k in keys], P, seed)
 
+    counter("shuffle.invocations").inc()
+    gauge("shuffle.partitions").set(P)
+    # Cross-chip traffic: every shard all_to_alls its P*bucket_size slots
+    # of every column (data + validity + mask), so the mesh-wide payload
+    # is the full slab set regardless of how many slots are live.
+    slab_rows = P * P * bucket_size
+    data_bytes = sum(slab_rows * c.data.dtype.itemsize
+                     for c in dist.table.columns)
+    mask_bytes = slab_rows * (len(dist.table.columns) + 1)  # valids + row mask
+    counter("shuffle.bytes_moved").inc(data_bytes + mask_bytes)
+
     out, overflow = _shuffle_arrays(dist, mesh, pids, P, capacity, bucket_size)
-    if bool(overflow):   # host sync; rerun with more slack
+    ov = bool(overflow)   # host sync; rerun with more slack
+    record_host_sync("shuffle.overflow_check", 1)
+    if ov:
+        counter("shuffle.retries").inc()
         return shuffle(dist, mesh, keys, bucket_size=bucket_size * 2, seed=seed)
     return out
 

@@ -1,15 +1,18 @@
 """Utilities: tracing/profiling scopes and device-memory management."""
 
-from .memory import (MemoryScope, device_memory_stats, donating_jit, free,
-                     no_implicit_transfers)
+from .memory import (MemoryScope, device_get_counted, device_memory_stats,
+                     donating_jit, free, no_implicit_transfers,
+                     record_host_sync)
 from .tracing import start_server, trace, traced
 
 __all__ = [
     "MemoryScope",
+    "device_get_counted",
     "device_memory_stats",
     "donating_jit",
     "free",
     "no_implicit_transfers",
+    "record_host_sync",
     "start_server",
     "trace",
     "traced",

@@ -22,6 +22,15 @@ resource, so the idiomatic equivalents are
     makes accidental device→host syncs raise (jax transfer guard), since
     unintended syncs are the TPU profile's equivalent of unintended
     pageable-memory copies.
+  * **transfer accounting** — :func:`record_host_sync` /
+    :func:`device_get_counted`, the metering hooks every INTENTIONAL
+    blocking round trip in the engine goes through (plan materialization,
+    stats probes, shuffle sizing, join bind probes).  BASELINE.md measures
+    ~400 ms per round trip on a tunneled device, so the per-query sync
+    COUNT is the engine's single most important metric; counts and
+    device→host bytes land in the obs registry (``host.sync``,
+    ``host.sync.<label>``, ``host.d2h_bytes``) when ``SRT_METRICS=1`` and
+    cost one env read otherwise.
 
 Everything degrades gracefully on backends whose PJRT client reports no
 memory stats (CPU): stats return empty dicts and scopes report zeros.
@@ -122,6 +131,41 @@ class MemoryScope:
             self.report.peak_in_use = max(self.report.begin_in_use,
                                           self.report.end_in_use)
         return None
+
+
+def record_host_sync(label: str = "", nbytes: int = 0) -> None:
+    """Account one blocking device→host round trip.
+
+    Call at the point the host actually blocks (``int(...)``,
+    ``jax.device_get``, ``np.asarray`` of a device array).  ``label``
+    names the sync site (``materialize.count``, ``stats.probe``, ...);
+    ``nbytes`` is the device→host payload.  No-op (one env read) unless
+    ``SRT_METRICS=1``.
+    """
+    from ..obs.metrics import counter
+    c = counter("host.sync")
+    c.inc()
+    if c.name:                        # real registry, not the null object
+        if label:
+            counter(f"host.sync.{label}").inc()
+        if nbytes:
+            counter("host.d2h_bytes").inc(int(nbytes))
+
+
+def _tree_nbytes(tree: Any) -> int:
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += getattr(leaf, "nbytes", 0) or 0
+    return total
+
+
+def device_get_counted(tree: Any, label: str = "") -> Any:
+    """``jax.device_get`` with transfer accounting: records one host sync
+    and the transferred byte count against ``label``."""
+    out = jax.device_get(tree)
+    record_host_sync(label, _tree_nbytes(out))
+    return out
 
 
 @contextlib.contextmanager

@@ -24,32 +24,52 @@ process).
 
 from __future__ import annotations
 
-import contextlib
 import functools
-from typing import Callable, Iterator, TypeVar
+from typing import Callable, TypeVar
 
 from ..config import trace_enabled
 
 _F = TypeVar("_F", bound=Callable)
 
 
-@contextlib.contextmanager
-def trace(name: str) -> Iterator[None]:
-    """Named scope visible in jax profiler captures (NVTX push/pop analog)."""
+class _NullScope:
+    """Shared disabled-tracing context (no generator machinery on the
+    cold path — instrumented hot loops enter/exit two empty methods)."""
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SCOPE = _NullScope()
+
+
+def trace(name: str, **attrs):
+    """Named scope visible in jax profiler captures (NVTX push/pop analog).
+
+    ``attrs`` pass through as annotation metadata (profiler-visible metric
+    labels, e.g. ``trace("shuffle", partitions=8)``).  When tracing is off
+    this returns a shared null context: no profiler import, no annotation
+    construction, no attr formatting."""
     if not trace_enabled():
-        yield
-        return
+        return _NULL_SCOPE
     import jax.profiler
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    return jax.profiler.TraceAnnotation(name, **attrs)
 
 
 def traced(fn: _F) -> _F:
-    """Decorator form of :func:`trace`, scope named after the function."""
+    """Decorator form of :func:`trace`, scope named after the function
+    (name computed once at decoration time; the disabled path is a single
+    flag check before a plain call — no contextmanager entry)."""
     name = f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__qualname__}"
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        if not trace_enabled():
+            return fn(*args, **kwargs)
         with trace(name):
             return fn(*args, **kwargs)
 

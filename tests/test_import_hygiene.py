@@ -25,3 +25,51 @@ def test_import_does_not_initialize_backend():
                          text=True, timeout=300)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "clean" in out.stdout
+
+
+def test_obs_imports_without_jax():
+    """``spark_rapids_tpu.obs`` must stay importable without jax: metrics
+    post-processing (reading benchmark JSON on a laptop, rendering a
+    QueryMetrics) must not drag in the XLA stack.
+
+    The package __init__ itself imports jax, so graft ``obs`` onto a stub
+    parent package and import it alone.
+    """
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        f"pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs as obs\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing spark_rapids_tpu.obs pulled in jax'\n"
+        "qm = obs.QueryMetrics(query_id=1, input_rows=10, input_columns=2)\n"
+        "assert 'query_metrics' in qm.to_json()\n"
+        "assert obs.counter('x') is obs.NULL_METRIC  # SRT_METRICS unset\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    env.pop("SRT_METRICS", None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
+def test_cold_import_does_not_load_obs():
+    """A plain ``import spark_rapids_tpu`` must not pay for the metrics
+    subsystem (it is lazy-imported at the first metered region)."""
+    code = (
+        "import sys\n"
+        "import spark_rapids_tpu\n"
+        "assert 'spark_rapids_tpu.obs' not in sys.modules, \\\n"
+        "    'cold import loaded the obs subsystem'\n"
+        "print('lazy')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "lazy" in out.stdout

@@ -1,0 +1,310 @@
+"""Observability contracts: the obs registry, QueryMetrics, explain_analyze.
+
+Three contracts, mirroring the reference's SQL-metrics guarantees:
+
+1. **No-op when off** — with ``SRT_METRICS`` unset every registry lookup
+   returns the shared null objects, the hot trace kernels contain no
+   metrics code at all (per-ROW overhead is structurally impossible, not
+   just measured-small), and ``explain_analyze`` still renders the plan
+   tree with metrics marked unavailable.
+2. **Correct when on** — a filter→project→groupby run reports a
+   compile-cache miss then a hit, per-step rows in/out chain
+   monotonically, and the single materialization host sync is counted.
+3. **Stable JSON schema** — ``QueryMetrics.to_json()`` key paths are
+   pinned by tests/golden/query_metrics_schema.json (BENCH runs diff the
+   payloads across PRs; fields are append-only, bump schema_version on
+   change).
+"""
+
+import inspect
+import json
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import (NULL_METRIC, QueryMetrics, StepMetrics,
+                                  counter, gauge, last_query_metrics,
+                                  registry, timer)
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / \
+    "query_metrics_schema.json"
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+@pytest.fixture
+def metrics_off(monkeypatch):
+    monkeypatch.delenv("SRT_METRICS", raising=False)
+
+
+def _table(prefix, n=1000):
+    """Unique column names per call: the whole-plan compile cache is
+    process-global and keyed on the bound signature, so a fresh name set
+    guarantees the first run is a cache miss."""
+    return Table.from_pydict({
+        f"{prefix}_k": (np.arange(n) % 7).astype(np.int32),
+        f"{prefix}_v": np.arange(n, dtype=np.float32),
+    })
+
+
+def _query(prefix):
+    return (plan()
+            .filter(col(f"{prefix}_v") > 100.0)
+            .with_columns(**{f"{prefix}_d": col(f"{prefix}_v") * 2.0})
+            .groupby_agg([f"{prefix}_k"],
+                         [(f"{prefix}_d", "sum", f"{prefix}_t")]))
+
+
+# ---------------------------------------------------------------------------
+# 1. no-op contract (SRT_METRICS unset)
+# ---------------------------------------------------------------------------
+
+def test_disabled_returns_shared_null_objects(metrics_off):
+    assert counter("a") is NULL_METRIC
+    assert counter("b") is NULL_METRIC
+    assert gauge("c") is NULL_METRIC
+    assert timer("d") is NULL_METRIC
+    # the null object swallows the whole metric API
+    NULL_METRIC.inc(5)
+    NULL_METRIC.set(3)
+    NULL_METRIC.observe(0.1)
+    with NULL_METRIC.time():
+        pass
+    assert NULL_METRIC.value == 0
+    assert registry().counters_snapshot() == {}
+
+
+def test_disabled_run_records_nothing(metrics_off):
+    t = _table("off")
+    out = _query("off").run(t)
+    assert out.num_rows == 7
+    assert registry().counters_snapshot() == {}
+
+
+def test_explain_analyze_renders_without_metrics(metrics_off):
+    t = _table("offea")
+    text = _query("offea").explain_analyze(t)
+    assert "Filter" in text and "GroupBy" in text
+    assert "SRT_METRICS" in text          # points at the enable knob
+    assert "unavailable" in text
+
+
+def test_hot_kernels_contain_no_metrics_code(metrics_off):
+    """The per-row no-overhead guarantee, enforced structurally: the
+    traced step kernels must not reference the metrics registry at all
+    (metering happens at region boundaries in the driver, never inside
+    traced code)."""
+    from spark_rapids_tpu.exec import compile as c
+    for fn in (c._trace_filter, c._trace_project, c._trace_sort,
+               c._trace_limit):
+        src = inspect.getsource(fn)
+        assert "obs" not in src and "metric" not in src.lower(), \
+            f"{fn.__name__} references metrics from traced code"
+
+
+def test_disabled_metric_calls_are_cheap(metrics_off):
+    """200k null-object lookups+incs must be far from per-row cost
+    territory (generous wall bound: this is an anti-regression tripwire,
+    not a benchmark)."""
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        counter("hot.loop").inc()
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"null metric path too slow: {dt:.3f}s / 200k calls"
+
+
+# ---------------------------------------------------------------------------
+# 2. correctness when enabled
+# ---------------------------------------------------------------------------
+
+def test_metered_run_miss_then_hit(metrics_on):
+    t = _table("mh")
+    p = _query("mh")
+    p.run(t)
+    qm1 = last_query_metrics()
+    assert qm1.mode == "run"
+    assert qm1.compile_cache == "miss"
+    assert qm1.compile_seconds > 0
+    p.run(t)
+    qm2 = last_query_metrics()
+    assert qm2.compile_cache == "hit"
+    assert qm2.compile_seconds == 0.0
+    assert qm2.query_id > qm1.query_id
+    # first run: the binder's group-domain stats probe + the materialize
+    # count; second run: the stats cache absorbs the probe, leaving the
+    # ONE materialization sync the engine design promises.
+    assert qm1.host_syncs == 2
+    assert qm1.counters.get("host.sync.stats.probe") == 1
+    assert qm1.counters.get("host.sync.materialize.count") == 1
+    assert qm2.host_syncs == 1
+    assert qm2.counters.get("host.sync.materialize.count") == 1
+    # registry accumulated across both runs
+    snap = registry().counters_snapshot()
+    assert snap["plan.compile_cache.miss"] == 1
+    assert snap["plan.compile_cache.hit"] == 1
+
+
+def test_explain_analyze_measures_step_rows(metrics_on):
+    t = _table("ea")
+    p = _query("ea")
+    text = p.explain_analyze(t)
+    qm = last_query_metrics()
+    assert qm.mode == "analyze"
+    assert [s.kind for s in qm.steps] == \
+        ["Filter", "Project", "GroupBy[dense]"]
+    # rows chain: each step's output feeds the next step's input
+    for a, b in zip(qm.steps, qm.steps[1:]):
+        assert a.rows_out == b.rows_in
+    assert qm.steps[0].rows_in == 1000
+    assert qm.steps[0].rows_out == 899          # v > 100.0
+    assert qm.steps[-1].rows_out == 7           # 7 groups
+    assert qm.output_rows == 7
+    assert all(s.seconds >= 0 for s in qm.steps)
+    assert 0 < qm.steps[0].density <= 1
+    # and the rendering carries the measurements
+    assert "1000 -> 899" in text
+    assert "-> 7 rows" in text
+    # second analyze reports the fused-program cache hit
+    p.explain_analyze(t)
+    assert last_query_metrics().compile_cache == "hit"
+
+
+def test_registry_counter_math(metrics_on):
+    c = counter("t.c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert counter("t.c") is c                  # same registered object
+    gauge("t.g").set(42)
+    with timer("t.t").time():
+        pass
+    snap = registry().snapshot()
+    assert snap["t.c"] == 5
+    assert snap["t.g"] == 42
+    assert snap["t.t.count"] == 1
+    assert snap["t.t.seconds"] >= 0
+    with pytest.raises(TypeError):
+        gauge("t.c")                            # kind mismatch
+
+
+def test_dict_encode_cache_counters(metrics_on):
+    from spark_rapids_tpu.ops.strings import (dictionary_encode_cached,
+                                              strings_from_pylist)
+    s = strings_from_pylist(["b", "a", "b", None, "c"])
+    dictionary_encode_cached(s)
+    dictionary_encode_cached(s)
+    snap = registry().counters_snapshot()
+    assert snap["strings.dict_encode.miss"] == 1
+    assert snap["strings.dict_encode.hit"] == 1
+    assert snap["host.d2h_bytes"] > 0           # the encode's transfers
+
+
+# ---------------------------------------------------------------------------
+# 3. stable JSON schema (golden)
+# ---------------------------------------------------------------------------
+
+def _key_paths(obj, prefix=""):
+    """Flattened key paths; list values descend into the first element
+    (steps all share StepMetrics' shape), dict leaves under ``counters``
+    stay opaque (free-form counter names)."""
+    paths = []
+    if isinstance(obj, dict):
+        for k in sorted(obj):
+            p = f"{prefix}.{k}" if prefix else k
+            if p == "counters":
+                paths.append(p)
+            else:
+                paths.extend(_key_paths(obj[k], p))
+    elif isinstance(obj, list):
+        if obj:
+            paths.extend(_key_paths(obj[0], prefix + "[]"))
+        else:
+            paths.append(prefix + "[]")
+    else:
+        paths.append(prefix)
+    return paths
+
+
+def _example_metrics() -> QueryMetrics:
+    qm = QueryMetrics(query_id=1, mode="analyze", input_rows=10,
+                      input_columns=2, output_rows=3)
+    qm.steps = [StepMetrics(index=0, kind="Filter", describe="Filter[x]",
+                            rows_in=10, rows_out=3, padded_out=10,
+                            seconds=0.001, density=0.3)]
+    qm.finish_counters({"host.sync": 1})
+    return qm
+
+
+def test_query_metrics_schema_is_stable():
+    got = sorted(_key_paths(_example_metrics().to_dict()))
+    want = json.loads(GOLDEN.read_text())
+    assert got == want["key_paths"], (
+        "QueryMetrics.to_json() schema drifted. The payload is diffed "
+        "across PRs by BENCH runs: fields are append-only; if this change "
+        "is intentional, bump schema_version and regenerate the golden "
+        "file (see tests/golden/query_metrics_schema.json).")
+
+
+def test_query_metrics_json_round_trips(metrics_on):
+    t = _table("js")
+    _query("js").explain_analyze(t)
+    payload = json.loads(last_query_metrics().to_json())
+    assert payload["schema_version"] == 1
+    assert payload["metric"] == "query_metrics"
+    assert payload["output"]["rows"] == 7
+    # bind-time stats probe + materialize count (first run of this table)
+    assert payload["host"]["syncs"] == 2
+    # the measured run exercises every schema path of the golden file
+    assert sorted(_key_paths(payload)) == \
+        json.loads(GOLDEN.read_text())["key_paths"]
+
+
+# ---------------------------------------------------------------------------
+# TPC-DS-shaped acceptance query (q3 shape: two broadcast joins + groupby
+# + decode join + sort + limit over the synthetic star schema)
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_tpcds_q3_shape(metrics_on):
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.models.tpcds_queries import _brand_map, _dim
+
+    d = tpcds.generate(4000, seed=11)
+    dates = _dim(d.date_dim, col("d_moy").eq(11), ["d_date_sk", "d_year"])
+    items = _dim(d.item, col("i_manufact_id").eq(28),
+                 ["i_item_sk", "i_brand_id"])
+    p = (plan()
+         .join_broadcast(dates, left_on="ss_sold_date_sk",
+                         right_on="d_date_sk")
+         .join_broadcast(items, left_on="ss_item_sk",
+                         right_on="i_item_sk")
+         .groupby_agg(["d_year", "i_brand_id"],
+                      [("ss_ext_sales_price", "sum", "sum_agg")])
+         .join_broadcast(_brand_map(), left_on="i_brand_id",
+                         right_on="__brand_id")
+         .sort_by(["d_year", "sum_agg", "i_brand_id"],
+                  ascending=[True, False, True])
+         .limit(100))
+    text = p.explain_analyze(d.store_sales)
+    qm = last_query_metrics()
+    kinds = [s.kind for s in qm.steps]
+    assert kinds == ["BroadcastJoin", "BroadcastJoin", "GroupBy[dense]",
+                     "BroadcastJoin", "Sort", "Limit"]
+    assert qm.steps[0].rows_in == d.store_sales.num_rows
+    for a, b in zip(qm.steps, qm.steps[1:]):
+        assert a.rows_out == b.rows_in
+    assert qm.output_rows == qm.steps[-1].rows_out
+    assert qm.compile_cache == "miss"
+    assert "cache=miss" in text
+    assert "BroadcastJoin" in text and "rows:" in text
+    # second run: fused program comes from the cache
+    assert "cache=hit" in p.explain_analyze(d.store_sales)
