@@ -162,8 +162,9 @@ def main():
     }))
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
-        from spark_rapids_tpu.obs import bench_metrics_line
+        from spark_rapids_tpu.obs import bench_cache_line, bench_metrics_line
         print(bench_metrics_line())
+        print(bench_cache_line())
 
 
 if __name__ == "__main__":

@@ -105,8 +105,9 @@ def main():
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
-        from spark_rapids_tpu.obs import bench_metrics_line
+        from spark_rapids_tpu.obs import bench_cache_line, bench_metrics_line
         print(bench_metrics_line())
+        print(bench_cache_line())
 
 
 def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
@@ -117,10 +118,13 @@ def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
     import jax.numpy as jnp
 
     from spark_rapids_tpu.column import Column
-    from spark_rapids_tpu.exec.compile import _Bound, _compiled_for
+    from spark_rapids_tpu.exec.compile import _bind, _compiled_for
 
     n = table.num_rows
-    bound = _Bound(p, table)
+    # _bind routes through the shape-bucketing layer (exec/bucketing.py),
+    # so the chained loop exercises the padded program exactly as plan
+    # runs do and the cache/bucketing JSON line reflects the bench.
+    bound = _bind(p, table)
     fn = _compiled_for(bound)
 
     @jax.jit
@@ -129,18 +133,18 @@ def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
                     (leaf.ravel()[-1:] != 0).astype(x.dtype))
 
     cols = dict(bound.exec_cols)
-    out_cols, _ = fn(cols, bound.side_inputs)
+    out_cols, _ = fn(cols, bound.side_inputs, bound.init_sel)
     leaf = out_cols[leaf_col].data
     cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
                              dtype=cols[chain_col].dtype)
-    out_cols, _ = fn(cols, bound.side_inputs)
+    out_cols, _ = fn(cols, bound.side_inputs, bound.init_sel)
     leaf = out_cols[leaf_col].data
     _ = np.asarray(leaf[-1:])
     t0 = time.perf_counter()
     for _ in range(reps):
         cols[chain_col] = Column(data=perturb(cols[chain_col].data, leaf),
                                  dtype=cols[chain_col].dtype)
-        out_cols, _ = fn(cols, bound.side_inputs)
+        out_cols, _ = fn(cols, bound.side_inputs, bound.init_sel)
         leaf = out_cols[leaf_col].data
     _ = np.asarray(leaf[-1:])
     dt = (time.perf_counter() - t0) / reps

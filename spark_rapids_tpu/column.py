@@ -258,6 +258,40 @@ class Column:
     def with_validity(self, validity: Optional[jax.Array]) -> "Column":
         return replace(self, validity=validity)
 
+    def pad_to(self, capacity: int) -> "Column":
+        """Grow to ``capacity`` physical slots; appended slots are NULL rows
+        with deterministic zero payloads (empty strings / empty lists).
+
+        The shape-bucketing layer (exec/bucketing.py) pads bound inputs to
+        bucket capacities and carries a live-row selection mask alongside,
+        so the pad slots are dead to the engine; null validity here keeps
+        them inert for anything that looks at the column without the mask
+        (stats probes take an explicit live mask instead).
+        """
+        pad = capacity - self.size
+        if pad < 0:
+            raise ValueError(
+                f"pad_to: capacity {capacity} < column size {self.size}")
+        if pad == 0:
+            return self
+        validity = jnp.concatenate(
+            [self.valid_mask(), jnp.zeros(pad, jnp.bool_)])
+        if self.dtype is not None and self.dtype.is_struct:
+            children = tuple(c.pad_to(capacity) for c in self.children)
+            return Column(validity=validity, dtype=self.dtype,
+                          children=children)
+        if self.offsets is not None:
+            # Strings/lists: pad rows are empty — repeat the final offset;
+            # the char/element buffer is untouched.
+            offsets = jnp.concatenate(
+                [self.offsets,
+                 jnp.full(pad, self.offsets[-1], jnp.int32)])
+            return replace(self, offsets=offsets, validity=validity)
+        zeros_shape = (pad,) + tuple(self.data.shape[1:])
+        data = jnp.concatenate(
+            [self.data, jnp.zeros(zeros_shape, self.data.dtype)])
+        return replace(self, data=data, validity=validity)
+
     def gather(self, indices: jax.Array, fill_invalid: bool = False) -> "Column":
         """Row gather.
 

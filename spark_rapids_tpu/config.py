@@ -30,6 +30,12 @@ Knobs (all optional):
                                logger (``RMM_LOGGING_LEVEL`` analog).
   ``SRT_SKIP_NATIVE``          ``1`` skips the native build in setup.py
                                (``-Dsubmodule.check.skip``-style escape).
+  ``SRT_SHAPE_BUCKETS``        shape-bucketing schedule for pad-to-bucket
+                               binding (exec/bucketing.py): unset/``1`` =
+                               default (floor 64, growth 1.3), ``0``/``off``
+                               disables, ``FLOOR:GROWTH`` customizes.
+  ``SRT_COMPILE_CACHE_CAP``    max in-process whole-plan programs kept
+                               before LRU eviction (default 512).
   ``SRT_CPP_PARALLEL_LEVEL``   native build parallelism (``CPP_PARALLEL_LEVEL``).
 
 Accessors return live values (no import-time caching) because the reference's
@@ -139,6 +145,58 @@ def dense_groupby_max_cells() -> int:
     return val
 
 
+def shape_buckets() -> tuple[int, float] | None:
+    """Shape-bucketing schedule ``(floor, growth)`` or None when disabled.
+
+    ``SRT_SHAPE_BUCKETS`` controls the pad-to-bucket binding layer
+    (exec/bucketing.py): input tables are padded up to a geometric bucket
+    capacity before whole-plan binding so the compile cache keys on a
+    bounded set of capacities instead of every exact row count.
+
+      unset / ``1``      default schedule: floor 64, growth 1.3
+      ``0`` / ``off``    disabled — bind exact shapes (pre-bucketing
+                         behavior; every distinct row count recompiles)
+      ``FLOOR:GROWTH``   custom schedule, e.g. ``128:1.5`` (growth > 1)
+
+    The trade-off: larger growth → fewer buckets → fewer compiles but more
+    pad waste (worst-case waste fraction ≈ 1 - 1/growth).
+    """
+    raw = os.environ.get("SRT_SHAPE_BUCKETS")
+    if raw is None:
+        return (64, 1.3)
+    raw = raw.strip().lower()
+    if raw in ("0", "off", "false", "no", ""):
+        return None
+    if raw in _TRUTHY:
+        return (64, 1.3)
+    try:
+        floor_s, growth_s = raw.split(":")
+        floor, growth = int(floor_s), float(growth_s)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SHAPE_BUCKETS must be '0'/'off', '1', or 'FLOOR:GROWTH' "
+            f"(e.g. '64:1.3'), got {raw!r}") from None
+    if floor < 1 or growth <= 1.0:
+        raise ValueError(
+            f"SRT_SHAPE_BUCKETS needs floor >= 1 and growth > 1, got {raw!r}")
+    return (floor, growth)
+
+
+def compile_cache_cap() -> int:
+    """Max entries in the in-process whole-plan program cache before LRU
+    eviction (exec/compile.py ``_COMPILED``).  Generous default: each entry
+    is a jitted callable plus a signature tuple, so hundreds are cheap; the
+    cap exists so week-long sessions over churning schemas don't grow
+    without bound.  Tune with ``SRT_COMPILE_CACHE_CAP`` (>= 1)."""
+    raw = os.environ.get("SRT_COMPILE_CACHE_CAP")
+    if raw is None:
+        return 512
+    val = int(raw)
+    if val < 1:
+        raise ValueError(f"SRT_COMPILE_CACHE_CAP must be >= 1, got {val}")
+    return val
+
+
 def native_lib_override() -> str | None:
     """Explicit native-library path, or None for the packaged/dev build."""
     return os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB") or None
@@ -185,5 +243,6 @@ def knob_table() -> dict[str, str]:
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_METRICS",
              "SRT_LEAK_DEBUG", "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE",
              "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
-             "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE")
+             "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",
+             "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP")
     return {n: os.environ.get(n, "<default>") for n in names}

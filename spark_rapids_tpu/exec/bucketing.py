@@ -1,0 +1,202 @@
+"""Shape-bucketed execution: pad-to-bucket binding for whole-plan reuse.
+
+The whole-plan compile cache (exec/compile.py ``_COMPILED``) keys on the
+bound table's exact row count, so every Parquet row group or shuffle slab
+with a new length recompiles the program — on tunneled TPUs that is seconds
+of XLA compile per shape, dwarfing execution (BASELINE.md).  The engine
+already executes *padded* internally: every traced step carries a live-row
+selection mask and materialization compacts at the end (compile.py's
+selection-mask design).  This module extends that invariant to the program
+boundary:
+
+  1. round the input row count up to a **geometric bucket capacity**
+     (floor 64, growth ~1.3 by default; ``SRT_SHAPE_BUCKETS`` tunes or
+     disables — config.shape_buckets),
+  2. pad every column to that capacity with null rows (Table.pad_to),
+  3. bind with an initial selection mask that marks only the logical rows
+     live, and a probe mask so bind-time stats probes never see pad rows.
+
+All row counts in one bucket then share one signature → one XLA program:
+the dominant cold-path cost becomes a bounded set of compiles per plan
+(log_growth(max_rows / floor) buckets) instead of one per distinct length.
+The price is pad waste, worst-case fraction ≈ 1 - 1/growth per bucket.
+
+Padded tables are memoized per source-buffer identity (the weakref-guarded
+cache idiom of exec/stats.py) so steady-state reruns of the same table
+reuse the same padded buffers and mask — keeping the binder's stats-probe
+and dict-encode caches hot (host-sync counts identical to exact-shape
+reruns).
+
+Gating: bucketing silently falls back to exact-shape binding for plans
+containing ``JoinShuffledStep`` (it binds a row-aligned probe table whose
+rows must match 1:1 — and its signature embeds data-dependent capacities
+anyway, so padding buys no reuse) and for tables with nested or two-word
+columns (the binder rejects those with a typed error that must surface
+unchanged).
+
+This module must not import jax at module load (the lazy-import rule of
+config.py): the schedule math is plain integer arithmetic usable by
+planning/diagnostic tooling on hosts without the XLA stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..config import shape_buckets
+
+#: capacity -> set of logical row counts bound into that bucket; the
+#: process-lifetime evidence for the recompiles-avoided gauge (every
+#: distinct length beyond the first per bucket is one whole-plan compile
+#: the exact-shape cache would have paid).
+_SHAPES_SEEN: dict[int, set] = {}
+
+#: (capacity, *buffer ids) -> ((weakrefs), (padded Table, live mask)).
+#: See exec/stats.py for the guarded-identity-cache idiom.
+_PAD_CACHE: dict = {}
+
+
+@dataclass(frozen=True)
+class BucketedInput:
+    """A bucket-padded bind input: the padded-capacity vs logical-length
+    pair plus the live-row mask carried from bind time."""
+    table: object            # Table, padded to ``capacity`` slots
+    live_mask: object        # bool_ (capacity,), True for the logical rows
+    logical_rows: int        # live row count (the caller's table length)
+    capacity: int            # physical slot count (bucket capacity)
+
+    @property
+    def pad_rows(self) -> int:
+        return self.capacity - self.logical_rows
+
+    @property
+    def waste_frac(self) -> float:
+        return self.pad_rows / self.capacity if self.capacity else 0.0
+
+
+def enabled() -> bool:
+    """Live read of the ``SRT_SHAPE_BUCKETS`` knob (tests monkeypatch it)."""
+    return shape_buckets() is not None
+
+
+def bucket_capacity(n: int, floor: Optional[int] = None,
+                    growth: Optional[float] = None) -> int:
+    """Smallest bucket capacity >= ``n`` on the geometric schedule.
+
+    Capacities start at ``floor`` and grow by ``growth`` per step, each
+    rounded up to a multiple of 8 (TPU lane-friendly, and matches the
+    engine's existing pow2/pad alignment) and forced strictly increasing.
+    Defaults come from ``SRT_SHAPE_BUCKETS``; explicit arguments let other
+    layers (shuffle sizing, feed coalescing) reuse the schedule with their
+    own floor.
+    """
+    sched = shape_buckets()
+    if floor is None or growth is None:
+        if sched is None:
+            sched = (64, 1.3)           # schedule math stays usable when off
+        floor = sched[0] if floor is None else floor
+        growth = sched[1] if growth is None else growth
+    cap = _round8(floor)
+    target = float(floor)
+    while cap < n:
+        target *= growth
+        cap = max(_round8(int(-(-target // 1))), cap + 8)
+    return cap
+
+
+def _round8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
+
+
+def plan_bucketable(plan) -> bool:
+    """False for plans that bind row-aligned side tables: a
+    ``JoinShuffledStep`` probe must stay 1:1 with the input's physical
+    rows, and its signature embeds data-dependent build capacities, so
+    padding the main input would corrupt alignment for zero reuse."""
+    return not any(type(s).__name__ == "JoinShuffledStep"
+                   for s in getattr(plan, "steps", ()))
+
+
+def table_bucketable(table) -> bool:
+    """False when any column would change the binder's typed rejection
+    (nested/two-word columns raise TypeError from ``_Bound``) — the error
+    must surface for the caller's table, not a padded copy."""
+    for col in table.columns:
+        dt = col.dtype
+        if dt is None:
+            return False
+        if getattr(dt, "is_list", False) or getattr(dt, "is_struct", False) \
+                or getattr(dt, "is_two_word", False):
+            return False
+    return True
+
+
+def prepare_input(plan, table) -> Optional[BucketedInput]:
+    """The bind-time gate: a :class:`BucketedInput` when bucketing applies,
+    else None (bind exact shapes).
+
+    Padding is memoized per source-buffer identity so repeated runs over
+    the same table hand the binder the *same* padded buffers and mask —
+    the stats-probe / dict-encode identity caches stay hot and the rerun's
+    host-sync count matches exact-shape execution.
+    """
+    if not enabled():
+        return None
+    n = table.num_rows
+    if n == 0:                           # empty tables take the eager path
+        return None
+    if not plan_bucketable(plan) or not table_bucketable(table):
+        return None
+    capacity = bucket_capacity(n)
+
+    from .stats import _guarded_cache_get, _guarded_cache_put
+    import jax
+    buffers = tuple(b for b in jax.tree_util.tree_leaves(table)
+                    if b is not None)
+    key = (capacity,) + tuple(id(b) for b in buffers)
+    hit = _guarded_cache_get(_PAD_CACHE, key, buffers)
+    if hit is not None:
+        padded, mask = hit
+    else:
+        import jax.numpy as jnp
+        padded = table.pad_to(capacity)
+        mask = jnp.arange(capacity, dtype=jnp.int32) < n
+        _guarded_cache_put(_PAD_CACHE, key, buffers, (padded, mask))
+
+    _record(capacity, n)
+    return BucketedInput(table=padded, live_mask=mask,
+                         logical_rows=n, capacity=capacity)
+
+
+# ---------------------------------------------------------------------------
+# accounting
+# ---------------------------------------------------------------------------
+
+def _record(capacity: int, n: int) -> None:
+    _SHAPES_SEEN.setdefault(capacity, set()).add(n)
+    from ..obs.metrics import counter, gauge
+    counter("plan.bucket.pad_rows").inc(capacity - n)
+    counter("plan.bucket.rows_total").inc(capacity)
+    gauge("plan.bucket.waste_frac").set(
+        round((capacity - n) / capacity, 6))
+    gauge("plan.bucket.recompiles_avoided").set(recompiles_avoided())
+    gauge("plan.bucket.distinct_capacities").set(len(_SHAPES_SEEN))
+
+
+def recompiles_avoided() -> int:
+    """Distinct input lengths absorbed into already-seen buckets over the
+    process lifetime — each is one whole-plan XLA compile the exact-shape
+    cache would have paid."""
+    return sum(len(lengths) - 1 for lengths in _SHAPES_SEEN.values())
+
+
+def bucket_stats() -> dict:
+    """Summary for the benchmarks' JSON line (obs/query.bench_extras)."""
+    distinct_shapes = sum(len(v) for v in _SHAPES_SEEN.values())
+    return {
+        "enabled": enabled(),
+        "distinct_input_shapes": distinct_shapes,
+        "distinct_capacities": len(_SHAPES_SEEN),
+        "recompiles_avoided": recompiles_avoided(),
+    }

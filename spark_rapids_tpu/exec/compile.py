@@ -42,6 +42,7 @@ plan must avoid, which is why this file exists.
 from __future__ import annotations
 
 import re
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional
 
@@ -162,13 +163,21 @@ def _dict_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
 class _Bound:
     """Everything needed to run a plan against one input signature."""
 
-    def __init__(self, plan: Plan, table: Table, probe_mask=None):
+    def __init__(self, plan: Plan, table: Table, probe_mask=None,
+                 init_sel=None, logical_rows=None):
         self.plan = plan
         self.n = table.num_rows
         self.input_names = tuple(table.names)
         #: restricts stats probes to live rows (a DistTable's row mask —
         #: zero-filled padding slots must not widen key domains)
         self.probe_mask = probe_mask
+        #: bind-time live-row selection (shape bucketing: the input was
+        #: padded to a bucket capacity and only the leading logical rows
+        #: are real) — passed as the program's initial selection so every
+        #: row count in the bucket shares one compiled program.
+        self.init_sel = init_sel
+        #: the caller's pre-padding row count (== n for exact-shape binds)
+        self.logical_rows = self.n if logical_rows is None else logical_rows
         self.exec_cols: dict[str, Column] = {}   # traced program inputs
         #: non-row-aligned program inputs (join probe structures, build-side
         #: payload columns) — kept out of the row-state dict so row-wise
@@ -745,9 +754,13 @@ class _Bound:
         side = tuple((n, int(c.dtype.type_id), int(c.data.shape[0]),
                       c.validity is not None)
                      for n, c in self.side_inputs.items())
+        # The bucketed flag keeps the counters honest when bucketed and
+        # exact-shape binds of the same capacity coexist in one process
+        # (the program is invoked with a different arity in each mode, so
+        # jit would compile twice behind one cache entry otherwise).
         return (self.assembly_steps(), self.n, cols, side,
                 tuple(self.group_metas), tuple(self.join_metas),
-                tuple(self.union_metas))
+                tuple(self.union_metas), self.init_sel is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -952,7 +965,15 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
             need.add("lastpos")
 
     # Pad to a chunk multiple; padded rows get gid=G (match nothing).
-    B = min(DENSE_CHUNK_ROWS, max(n, 1))
+    # The chunk width snaps to the shape-bucket schedule rather than the
+    # exact row count: an exact-shape bind of n rows and a bucket-padded
+    # bind of the same rows then reduce over IDENTICAL arrays (live
+    # values in the same slots, exact zeros in the same pad slots), so
+    # float sums/means associate identically and bucketed execution is
+    # bit-for-bit equal to exact-shape (for n <= DENSE_CHUNK_ROWS; above
+    # that, chunk boundaries shift with length as before).
+    from .bucketing import bucket_capacity
+    B = min(DENSE_CHUNK_ROWS, bucket_capacity(max(n, 1)))
     n_pad = -n % B
     npad = n + n_pad
 
@@ -1282,7 +1303,13 @@ def _trace_union(cols, sel, side, meta: _UnionMeta):
 # program assembly + cache
 # ---------------------------------------------------------------------------
 
-_COMPILED: dict = {}
+#: signature -> assembled program, LRU-ordered (most recent last).  Bounded
+#: by config.compile_cache_cap(): a long session over churning schemas
+#: must not grow the program table without bound.  Eviction drops the
+#: python closure; the XLA executable stays reusable via the persistent
+#: compile cache (config.ensure_compile_cache), so an evicted signature
+#: re-traces but does not re-compile.
+_COMPILED: "OrderedDict" = OrderedDict()
 
 #: dictionary tuple -> device strings column of the uniques, so repeat
 #: materializations of a string-keyed plan skip the host rebuild +
@@ -1408,8 +1435,8 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
 
 
 def _compiled_for(bound: _Bound):
-    from ..config import ensure_compile_cache
-    from ..obs.metrics import counter
+    from ..config import compile_cache_cap, ensure_compile_cache
+    from ..obs.metrics import counter, gauge
     ensure_compile_cache()
     key = bound.signature()
     fn = _COMPILED.get(key)
@@ -1419,9 +1446,31 @@ def _compiled_for(bound: _Bound):
                        tuple(bound.join_metas),
                        union_metas=tuple(bound.union_metas))
         _COMPILED[key] = fn
+        cap = compile_cache_cap()
+        while len(_COMPILED) > cap:
+            _COMPILED.popitem(last=False)
+            counter("plan.compile_cache.evictions").inc()
     else:
         counter("plan.compile_cache.hit").inc()
+        _COMPILED.move_to_end(key)
+    gauge("plan.compile_cache.size").set(len(_COMPILED))
     return fn
+
+
+def _bind(plan: Plan, table: Table) -> _Bound:
+    """Bind through the shape-bucketing layer: pad the input up to its
+    bucket capacity (exec/bucketing.py) and carry the live-row mask as
+    both the program's initial selection and the stats-probe mask, so
+    every row count in a bucket shares one compiled program and pad rows
+    never widen key domains.  Exact-shape bind when bucketing is off or
+    inapplicable (SRT_SHAPE_BUCKETS=0, shuffled-join plans, nested/
+    two-word columns)."""
+    from .bucketing import prepare_input
+    bi = prepare_input(plan, table)
+    if bi is None:
+        return _Bound(plan, table)
+    return _Bound(plan, bi.table, probe_mask=bi.live_mask,
+                  init_sel=bi.live_mask, logical_rows=bi.logical_rows)
 
 
 # ---------------------------------------------------------------------------
@@ -1457,9 +1506,9 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
 def run_plan_padded(plan: Plan, table: Table):
     if table.num_rows == 0:
         return run_plan_eager(plan, table), None
-    bound = _Bound(plan, table)
+    bound = _bind(plan, table)
     fn = _compiled_for(bound)
-    out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
+    out_cols, sel = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
     t = _rebuild(bound, out_cols)
     sel_col = None if sel is None else Column(data=sel.astype(jnp.uint8),
                                               dtype=BOOL8)
@@ -1472,9 +1521,9 @@ def run_plan(plan: Plan, table: Table) -> Table:
     from ..config import metrics_enabled
     if metrics_enabled():
         return _run_plan_metered(plan, table)[0]
-    bound = _Bound(plan, table)
+    bound = _bind(plan, table)
     fn = _compiled_for(bound)
-    out_cols, sel = fn(bound.exec_cols, bound.side_inputs)
+    out_cols, sel = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
     return materialize(bound, out_cols, sel)
 
 
@@ -1494,14 +1543,14 @@ def _run_plan_metered(plan: Plan, table: Table):
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
     t_all = _time.perf_counter()
-    bound = _Bound(plan, table)
+    bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
     qm.compile_cache = ("hit" if bound.signature() in _COMPILED
                         else "miss")
     fn = _compiled_for(bound)
     t0 = _time.perf_counter()
     out_cols, sel = jax.block_until_ready(
-        fn(bound.exec_cols, bound.side_inputs))
+        fn(bound.exec_cols, bound.side_inputs, bound.init_sel))
     qm.execute_seconds = _time.perf_counter() - t0
     if qm.compile_cache == "miss":
         qm.compile_seconds = qm.execute_seconds
@@ -1721,14 +1770,14 @@ def analyze_plan(plan: Plan, table: Table):
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
     t_all = _time.perf_counter()
-    bound = _Bound(plan, table)
+    bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
     qm.compile_cache = ("hit" if bound.signature() in _COMPILED
                         else "miss")
     fn = _compiled_for(bound)
     t0 = _time.perf_counter()
     out_cols, sel = jax.block_until_ready(
-        fn(bound.exec_cols, bound.side_inputs))
+        fn(bound.exec_cols, bound.side_inputs, bound.init_sel))
     qm.execute_seconds = _time.perf_counter() - t0
     if qm.compile_cache == "miss":
         qm.compile_seconds = qm.execute_seconds
@@ -1739,8 +1788,10 @@ def analyze_plan(plan: Plan, table: Table):
                          tuple(bound.join_metas),
                          union_metas=tuple(bound.union_metas))
     descs = _step_descriptions(bound)
-    cols, step_sel = bound.exec_cols, None
-    live_in = bound.n
+    # Bucketed binds start from the bind-time live mask; rows in/out stay
+    # LIVE counts, so the report reads the same at any bucket capacity.
+    cols, step_sel = bound.exec_cols, bound.init_sel
+    live_in = bound.logical_rows
     for i, (step_fn, (kind, text)) in enumerate(zip(fns, descs)):
         t0 = _time.perf_counter()
         cols, step_sel = jax.block_until_ready(

@@ -38,8 +38,9 @@ def prefetch(iterable: Iterable, depth: int = 2,
     keeping up to ``depth`` results ready ahead of the consumer.
 
     ``depth=2`` is classic double buffering.  Exceptions raised by the
-    producer surface at the consumer's ``next()`` call with the original
-    traceback as ``__cause__``.
+    producer re-raise at the consumer's ``next()`` call as the original
+    exception object (original type and traceback intact — a decode error
+    three frames deep in the worker reads exactly as it would inline).
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
@@ -67,7 +68,11 @@ def prefetch(iterable: Iterable, depth: int = 2,
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):
-                    raise RuntimeError("prefetch worker failed") from item
+                    # Re-raise the worker's exception itself: python
+                    # attaches the worker-side traceback to the object, so
+                    # the consumer sees the real failure frames instead of
+                    # an opaque RuntimeError wrapper.
+                    raise item
                 yield item
         finally:
             stop.set()
@@ -128,13 +133,84 @@ def _row_group_reader(path, columns):
             yield table
 
 
+def coalesce_to_buckets(tables: Iterable[Table],
+                        target_rows: int) -> Iterator[Table]:
+    """Merge consecutive same-schema tables until each batch reaches at
+    least ``target_rows`` rows (the tail batch may be smaller).
+
+    The shape-bucketing layer (exec/bucketing.py) pads every bound batch
+    up to a bucket capacity; tiny trailing row groups would each pay a
+    near-total pad waste and, worse, land in *different* small buckets.
+    Coalescing feed batches to one target first makes consecutive row
+    groups share a single bucket — one XLA program for the whole scan.
+    A schema change (different names/dtypes mid-stream) flushes the
+    pending batch rather than erroring.
+    """
+    from ..obs.metrics import counter
+    from ..ops.common import concat_tables
+    pending: list[Table] = []
+    pending_rows = 0
+
+    def schema_of(t: Table):
+        return (t.names, tuple(t.schema()))
+
+    def flush():
+        nonlocal pending, pending_rows
+        if not pending:
+            return None
+        out = pending[0] if len(pending) == 1 else concat_tables(pending)
+        if len(pending) > 1:
+            counter("io.feed.coalesced_batches").inc(len(pending))
+        pending, pending_rows = [], 0
+        return out
+
+    for t in tables:
+        if pending and schema_of(t) != schema_of(pending[0]):
+            merged = flush()
+            if merged is not None:
+                yield merged
+        pending.append(t)
+        pending_rows += t.num_rows
+        if pending_rows >= target_rows:
+            yield flush()
+    merged = flush()
+    if merged is not None:
+        yield merged
+
+
+def _bucket_coalesce_target(paths, columns) -> int:
+    """Footer-only pass over ``paths``: the bucket capacity of the largest
+    row group — coalescing to it lands every non-tail batch in one shape
+    bucket (exec/bucketing.py), so the scan runs under one program."""
+    from ..exec.bucketing import bucket_capacity
+    counts: list[int] = []
+    for p in paths:
+        try:
+            from .parquet_native import row_group_row_counts
+            counts.extend(row_group_row_counts(p))
+        except NotImplementedError:
+            import pyarrow.parquet as pq
+            md = pq.ParquetFile(p).metadata
+            counts.extend(md.row_group(i).num_rows
+                          for i in range(md.num_row_groups))
+    return bucket_capacity(max(counts) if counts else 1)
+
+
 def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
-                 depth: int = 2) -> Iterator[Table]:
+                 depth: int = 2,
+                 coalesce_rows: Optional[object] = None) -> Iterator[Table]:
     """Stream device Tables row-group by row-group across ``paths``.
 
     IO + host decode for the next row group overlap with the caller's
     device compute on the current one (the GDS-analog pipeline).  ``paths``
     may be one path or a sequence.
+
+    ``coalesce_rows`` merges consecutive row groups until each yielded
+    batch holds at least that many rows (see :func:`coalesce_to_buckets`).
+    Pass an int target, or ``"bucket"`` to derive one from the files'
+    footers (the bucket capacity of the largest row group,
+    ``exec.bucketing.bucket_capacity``) so a many-file scan executes as
+    one compiled program instead of one per distinct row-group length.
     """
     if isinstance(paths, (str, bytes)) or hasattr(paths, "__fspath__"):
         paths = [paths]
@@ -147,4 +223,13 @@ def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
                 counter("io.feed.rows").inc(t.num_rows)
                 yield t
 
-    return prefetch(all_groups(), depth=depth)
+    groups = all_groups()
+    if coalesce_rows is not None:
+        if coalesce_rows == "bucket":
+            coalesce_rows = _bucket_coalesce_target(paths, columns)
+        if not isinstance(coalesce_rows, int) or coalesce_rows < 1:
+            raise ValueError(
+                f"coalesce_rows must be a positive int or 'bucket', "
+                f"got {coalesce_rows!r}")
+        groups = coalesce_to_buckets(groups, coalesce_rows)
+    return prefetch(groups, depth=depth)

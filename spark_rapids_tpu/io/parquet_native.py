@@ -1087,6 +1087,28 @@ def _concat_columns(pieces: Sequence[Column]) -> Column:
     return concat_columns(list(pieces))
 
 
+def row_group_row_counts(path) -> List[int]:
+    """Per-row-group row counts from the footer alone (no page IO).
+
+    Scan drivers use this to pick a bucket-aligned coalesce target for
+    :func:`spark_rapids_tpu.io.feed.scan_parquet`: coalescing row groups
+    up to ``exec.bucketing.bucket_capacity`` of the typical group length
+    makes consecutive batches land in one shape bucket, so the whole scan
+    executes under a single compiled program.  Raises
+    ``NotImplementedError`` outside the native envelope (callers fall back
+    to the Arrow reader's metadata).
+    """
+    _, row_groups = read_metadata(path)
+    out = []
+    for rg in row_groups:
+        # A flat chunk's num_values (nulls included) equals the group's
+        # row count; LIST chunks count elements, so prefer a flat one.
+        flat = [c for c in rg if c.column.max_rep == 0]
+        chunk = flat[0] if flat else rg[0]
+        out.append(chunk.num_values)
+    return out
+
+
 def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
     """Read a Parquet file via the native page decoder into a device Table.
 

@@ -15,8 +15,9 @@ in the XLA stack.
 
 from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
                       counter, counters_delta, gauge, registry, timer)
-from .query import (QueryMetrics, StepMetrics, bench_metrics_line,
-                    last_query_metrics, set_last_query_metrics)
+from .query import (QueryMetrics, StepMetrics, bench_cache_line,
+                    bench_metrics_line, last_query_metrics,
+                    set_last_query_metrics)
 
 __all__ = [
     "NULL_METRIC",
@@ -26,6 +27,7 @@ __all__ = [
     "QueryMetrics",
     "StepMetrics",
     "Timer",
+    "bench_cache_line",
     "bench_metrics_line",
     "counter",
     "counters_delta",

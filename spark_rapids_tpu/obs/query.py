@@ -206,3 +206,33 @@ def bench_metrics_line() -> str:
     from .metrics import registry
     return json.dumps({"metric": "srt_metrics",
                        "counters": registry().snapshot()}, sort_keys=True)
+
+
+def bench_cache_line() -> str:
+    """The benchmarks' compile-cache/bucketing JSON line (one line, stable
+    key order): whole-plan cache hit rate, distinct shapes bound, and the
+    pad-waste fraction of the shape-bucketing layer — the bench-trajectory
+    view of the bucketing win.  Separate from ``bench_metrics_line`` so
+    the golden-pinned QueryMetrics schema stays untouched."""
+    from .metrics import registry
+    snap = registry().snapshot()
+    hits = int(snap.get("plan.compile_cache.hit", 0))
+    misses = int(snap.get("plan.compile_cache.miss", 0))
+    lookups = hits + misses
+    pad_rows = int(snap.get("plan.bucket.pad_rows", 0))
+    rows_total = int(snap.get("plan.bucket.rows_total", 0))
+    from ..exec.bucketing import bucket_stats   # lazy: exec pulls in jax
+    payload = {
+        "metric": "compile_cache",
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+        "size": int(snap.get("plan.compile_cache.size", 0)),
+        "evictions": int(snap.get("plan.compile_cache.evictions", 0)),
+        "bucketing": dict(bucket_stats(),
+                          pad_rows=pad_rows,
+                          rows_total=rows_total,
+                          pad_waste_frac=(round(pad_rows / rows_total, 6)
+                                          if rows_total else 0.0)),
+    }
+    return json.dumps(payload, sort_keys=True)

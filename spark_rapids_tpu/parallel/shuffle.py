@@ -17,8 +17,11 @@ per-target overruns); callers re-run with a larger ``bucket_size``.  The
 default ``bucket_size`` is derived from the *live*-row distribution (the
 busiest sender's rows spread over P buckets, 2x slack for hash skew) — not
 from the input's padded capacity — so chained distributed ops keep output
-capacity proportional to real rows; hot-key skew is absorbed by the
-overflow retry doubling instead of by permanent padding.
+capacity proportional to real rows.  Both the initial size and the
+overflow retry snap onto the shared geometric bucket schedule
+(exec/bucketing.py), so hot-key skew is absorbed by stepping up the same
+capacity ladder every other stage compiles against, not by drifting into
+fresh doubled shapes.
 """
 
 from __future__ import annotations
@@ -31,7 +34,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
 from ..column import Column
-from ..ops.common import pow2_bucket
 from ..table import Table
 from .hashing import partition_ids
 from .mesh import AXIS, DistTable
@@ -47,6 +49,7 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     chained distributed ops (join -> groupby) therefore keep capacity
     proportional to real rows instead of doubling it at every stage.
     """
+    from ..exec.bucketing import bucket_capacity
     from ..obs.metrics import counter, gauge
     from ..utils.memory import record_host_sync
     P = mesh.devices.size
@@ -57,10 +60,12 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         per_shard_live = jnp.sum(dist.row_mask.reshape(P, capacity), axis=1)
         max_live = int(jnp.max(per_shard_live))   # host sync (P scalars)
         record_host_sync("shuffle.sizing", 8)
-        # Power-of-two bucketing keeps the shard_map's static shapes (and the
-        # downstream kernels keyed off capacity_total) from recompiling on
-        # every slightly-different live-row count (ops/common.py contract).
-        bucket_size = max(8, pow2_bucket(2 * (-(-max_live // P))))
+        # Snap to the shared geometric bucket schedule (exec/bucketing.py)
+        # so the shard_map's static shapes — and every downstream kernel
+        # keyed off capacity_total — recompile once per bucket instead of
+        # once per slightly-different live-row count, and chained
+        # distributed ops land on capacities other stages already compiled.
+        bucket_size = bucket_capacity(2 * (-(-max_live // P)), floor=8)
 
     pids = partition_ids([dist.table[k] for k in keys], P, seed)
 
@@ -80,7 +85,11 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     record_host_sync("shuffle.overflow_check", 1)
     if ov:
         counter("shuffle.retries").inc()
-        return shuffle(dist, mesh, keys, bucket_size=bucket_size * 2, seed=seed)
+        # Retry roughly doubles, but snapped onto the bucket schedule:
+        # hot-key skew lands back on a capacity other shuffles (and the
+        # compile cache) already know instead of a fresh 2^k * initial.
+        retry_size = bucket_capacity(2 * bucket_size, floor=8)
+        return shuffle(dist, mesh, keys, bucket_size=retry_size, seed=seed)
     return out
 
 

@@ -70,6 +70,14 @@ class Table:
     def num_rows(self) -> int:
         return self._columns[0].size
 
+    @property
+    def capacity(self) -> int:
+        """Physical slot count.  For a plain table this equals ``num_rows``;
+        a bucket-padded table (exec/bucketing.py) has ``capacity`` slots of
+        which only the leading logical rows are live — the live count
+        travels separately as a selection mask, never in the Table."""
+        return self._columns[0].size
+
     def __len__(self) -> int:
         return self.num_rows
 
@@ -108,6 +116,14 @@ class Table:
 
     def gather(self, indices) -> "Table":
         return Table([(n, c.gather(indices)) for n, c in self.items()])
+
+    def pad_to(self, capacity: int) -> "Table":
+        """Every column padded to ``capacity`` slots (pad rows are null;
+        see Column.pad_to).  Callers owning the pad must carry the live-row
+        mask themselves — exec/bucketing.py is the intended caller."""
+        if capacity == self.num_rows:
+            return self
+        return Table([(n, c.pad_to(capacity)) for n, c in self.items()])
 
     # -- host materialization ------------------------------------------------
     def to_pydict(self) -> dict[str, list]:

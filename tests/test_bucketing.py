@@ -1,0 +1,317 @@
+"""Shape-bucketed execution contracts (exec/bucketing.py).
+
+Three guarantees, in order of importance:
+
+1. **Result identity** — bucketed execution (the default) is bit-for-bit
+   identical to the eager oracle across row counts straddling bucket
+   boundaries, including null-laden columns, string/dict columns, and
+   inputs that filter down to zero rows.  Pad rows are NULL and masked
+   out from bind time, so no aggregate, join, sort, or vocab may ever
+   observe them.
+2. **One compile per bucket** — two different row counts landing in the
+   same bucket bind to the same signature: exactly one whole-plan
+   compile-cache miss then a hit (the acceptance criterion, observable
+   through the SRT_METRICS counters and the benchmarks' JSON line).
+3. **Schedule + knobs** — the geometric capacity schedule is deterministic
+   and 8-aligned, ``SRT_SHAPE_BUCKETS=0`` restores exact-shape binding,
+   and ``SRT_COMPILE_CACHE_CAP`` LRU-bounds the program cache.
+"""
+
+import json
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.config import shape_buckets
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.exec import compile as compile_mod
+from spark_rapids_tpu.exec.bucketing import (bucket_capacity, bucket_stats,
+                                             enabled, prepare_input,
+                                             plan_bucketable)
+from spark_rapids_tpu.exec.compile import run_plan_eager
+from spark_rapids_tpu.obs import registry
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+def _table(prefix, n, with_strings=False, rng=None):
+    """Null-laden mixed table; value domains depend only on ``prefix`` and
+    row position (NOT on ``n``), so two lengths in one bucket probe the
+    same key domains / string vocab and share one bound signature."""
+    rng = rng or np.random.default_rng(7)
+    cols = [
+        (f"{prefix}_k", Column.from_numpy(
+            (np.arange(n) % 7).astype(np.int32),
+            validity=(np.arange(n) % 11) != 0)),
+        (f"{prefix}_v", Column.from_numpy(
+            np.arange(n, dtype=np.int64) - n // 2,
+            validity=(np.arange(n) % 13) != 0)),
+        (f"{prefix}_f", Column.from_numpy(rng.normal(size=n))),
+    ]
+    if with_strings:
+        words = ["alpha", "beta", "gamma", "", "delta"]
+        vals = [None if i % 9 == 0 else words[i % 5] for i in range(n)]
+        cols.append((f"{prefix}_s", Column.from_pylist(vals, dt.STRING)))
+    return Table(cols)
+
+
+def _query(prefix):
+    """filter -> project -> groupby -> sort.  Aggregates are chosen to be
+    reduction-order independent (int sums, max, count) so the eager oracle
+    comparison is exact: float mean/sum over unordered reductions differs
+    in the last ulp between the compiled and eager paths regardless of
+    bucketing (see test_bit_for_bit_vs_exact_shape for that case)."""
+    return (plan()
+            .filter(col(f"{prefix}_v") > -10_000)
+            .with_columns(**{f"{prefix}_w": col(f"{prefix}_f") * 2.0})
+            .groupby_agg([f"{prefix}_k"],
+                         [(f"{prefix}_v", "sum", "vs"),
+                          (f"{prefix}_w", "max", "wx"),
+                          (f"{prefix}_v", "mean", "vm"),
+                          (f"{prefix}_v", "count", "n")])
+            .sort_by([f"{prefix}_k"]))
+
+
+class TestBucketCapacity:
+    def test_default_schedule_values(self):
+        # Pinned observations of the default floor=64 growth=1.3 schedule.
+        for n, cap in [(1, 64), (64, 64), (65, 88), (88, 88), (89, 112),
+                       (100, 112), (110, 112), (120, 144), (1000, 1152)]:
+            assert bucket_capacity(n) == cap, n
+
+    def test_schedule_invariants(self):
+        prev = 0
+        for n in range(1, 5000, 17):
+            cap = bucket_capacity(n)
+            assert cap >= n
+            assert cap % 8 == 0
+            assert cap >= prev          # monotone in n
+            prev = cap
+
+    def test_explicit_floor_growth(self):
+        assert bucket_capacity(1, floor=8, growth=2.0) == 8
+        assert bucket_capacity(9, floor=8, growth=2.0) == 16
+        assert bucket_capacity(17, floor=8, growth=2.0) == 32
+
+    def test_env_schedule(self, monkeypatch):
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", "32:2.0")
+        assert shape_buckets() == (32, 2.0)
+        assert bucket_capacity(1) == 32
+        assert bucket_capacity(33) == 64
+        assert bucket_capacity(65) == 128
+
+    @pytest.mark.parametrize("raw", ["abc", "64:0.9", "0:2", "64:1.0"])
+    def test_env_schedule_invalid(self, monkeypatch, raw):
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", raw)
+        with pytest.raises(ValueError, match="SRT_SHAPE_BUCKETS"):
+            shape_buckets()
+
+    @pytest.mark.parametrize("raw", ["0", "off", "false", "no"])
+    def test_env_disable(self, monkeypatch, raw):
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", raw)
+        assert shape_buckets() is None
+        assert not enabled()
+
+
+class TestResultIdentity:
+    """Bucketed run == eager oracle, across bucket-boundary row counts."""
+
+    # Straddles the 64 | 88 | 112 boundaries plus a deep interior point.
+    BOUNDARY_NS = [1, 63, 64, 65, 88, 89, 112, 113, 200]
+
+    @pytest.mark.parametrize("n", BOUNDARY_NS)
+    def test_mixed_nulls(self, rng, n):
+        t = _table("bi", n, rng=rng)
+        p = _query("bi")
+        assert_tables_equal(run_plan_eager(p, t), p.run(t))
+
+    @pytest.mark.parametrize("n", [63, 64, 65, 100])
+    def test_strings_dict_columns(self, rng, n):
+        t = _table("bs", n, with_strings=True, rng=rng)
+        p = (plan()
+             .filter(col("bs_v") > -10_000)
+             .groupby_agg(["bs_s"], [("bs_v", "sum", "vs"),
+                                     ("bs_v", "count", "cnt")])
+             .sort_by(["bs_s"]))
+        assert_tables_equal(run_plan_eager(p, t), p.run(t))
+
+    @pytest.mark.parametrize("n", [65, 100])
+    def test_empty_after_filter(self, rng, n):
+        t = _table("be", n, rng=rng)
+        p = (plan().filter(col("be_v") > 10_000_000)
+             .groupby_agg(["be_k"], [("be_v", "sum", "vs")])
+             .sort_by(["be_k"]))
+        got = p.run(t)
+        assert got.num_rows == 0
+        assert_tables_equal(run_plan_eager(p, t), got)
+
+    @pytest.mark.parametrize("n", [63, 65, 100])
+    def test_bit_for_bit_vs_exact_shape(self, monkeypatch, rng, n):
+        """The acceptance criterion proper: bucketed output is bit-for-bit
+        identical to exact-shape compiled output, including float means
+        (pad rows are masked zeros — they must not perturb reductions)."""
+        t = _table("bb", n, rng=rng)
+        p = (plan()
+             .filter(col("bb_v") > -10_000)
+             .groupby_agg(["bb_k"], [("bb_f", "mean", "fm"),
+                                     ("bb_f", "sum", "fs")])
+             .sort_by(["bb_k"]))
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", "0")
+        exact = p.run(t)
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", "1")
+        bucketed = p.run(t)
+        assert_tables_equal(exact, bucketed)
+
+    def test_run_padded_capacity_and_live_count(self, rng):
+        t = _table("bp", 100, rng=rng)
+        p = plan().filter(col("bp_v") > 0)
+        padded, sel = p.run_padded(t)
+        assert padded.num_rows == bucket_capacity(100)  # 112 slots
+        keep = np.asarray(sel.data).astype(bool)
+        assert int(keep.sum()) == run_plan_eager(p, t).num_rows
+        # Pad slots are never live.
+        assert not keep[100:].any()
+
+
+class TestOneCompilePerBucket:
+    """The acceptance criterion: two row counts in one bucket -> exactly
+    one whole-plan compile-cache miss, then a hit."""
+
+    def test_one_miss_one_hit(self, metrics_on):
+        n1, n2 = 90, 100
+        cap = bucket_capacity(n1)
+        assert bucket_capacity(n2) == cap   # same bucket by construction
+        p = _query("b1")
+        out1 = p.run(_table("b1", n1))
+        out2 = p.run(_table("b1", n2))
+        snap = registry().snapshot()
+        assert snap.get("plan.compile_cache.miss", 0) == 1
+        assert snap.get("plan.compile_cache.hit", 0) == 1
+        # Both results still match the oracle, padded or not.
+        assert_tables_equal(run_plan_eager(p, _table("b1", n1)), out1)
+        assert_tables_equal(run_plan_eager(p, _table("b1", n2)), out2)
+
+    def test_bucket_counters(self, metrics_on):
+        n = 90
+        cap = bucket_capacity(n)
+        p = _query("b2")
+        p.run(_table("b2", n))
+        snap = registry().snapshot()
+        assert snap.get("plan.bucket.pad_rows", 0) == cap - n
+        assert snap.get("plan.bucket.rows_total", 0) == cap
+        assert snap.get("plan.bucket.waste_frac") == pytest.approx(
+            (cap - n) / cap, abs=1e-5)
+
+    def test_bench_cache_line_payload(self, metrics_on):
+        from spark_rapids_tpu.obs import bench_cache_line
+        p = _query("b3")
+        p.run(_table("b3", 90))
+        p.run(_table("b3", 100))
+        payload = json.loads(bench_cache_line())
+        assert payload["metric"] == "compile_cache"
+        assert payload["hits"] == 1 and payload["misses"] == 1
+        assert payload["hit_rate"] == pytest.approx(0.5)
+        b = payload["bucketing"]
+        assert b["enabled"] is True
+        assert b["pad_rows"] > 0 and b["rows_total"] > 0
+        assert 0.0 < b["pad_waste_frac"] < 1.0
+        assert b["distinct_input_shapes"] >= 2
+        assert b["recompiles_avoided"] >= 1
+
+
+class TestDisableKnob:
+    def test_exact_shape_when_off(self, monkeypatch, rng):
+        monkeypatch.setenv("SRT_SHAPE_BUCKETS", "0")
+        t = _table("bd", 100, rng=rng)
+        p = plan().filter(col("bd_v") > 0)
+        assert prepare_input(p, t) is None
+        padded, _sel = p.run_padded(t)
+        assert padded.num_rows == t.num_rows     # pre-bucketing behavior
+        assert_tables_equal(run_plan_eager(p, t), p.run(t))
+
+    def test_gates(self, rng):
+        # Empty tables take the eager path.
+        empty = Table([("g_k", Column.from_numpy(
+            np.array([], dtype=np.int32)))])
+        assert prepare_input(plan(), empty) is None
+        # JoinShuffledStep plans bind row-aligned probes: never bucketed.
+        dim = Table([("g_d", Column.from_numpy(
+            np.arange(4, dtype=np.int64)))])
+        pj = plan().join_shuffled(dim, left_on="g_k", right_on="g_d")
+        assert not plan_bucketable(pj)
+
+
+class TestCompileCacheLRU:
+    def test_eviction_respects_cap(self, monkeypatch, rng):
+        monkeypatch.setenv("SRT_COMPILE_CACHE_CAP", "2")
+        # Fresh cache for the test so the process-global one (and the
+        # other tests' entries) survives untouched.
+        monkeypatch.setattr(compile_mod, "_COMPILED", OrderedDict())
+        tables = [(_query(f"lru{i}"), _table(f"lru{i}", 64, rng=rng))
+                  for i in range(3)]
+        for p, t in tables:
+            p.run(t)
+        assert len(compile_mod._COMPILED) == 2
+        # The evicted (oldest) program re-binds and still runs correctly.
+        p0, t0 = tables[0]
+        assert_tables_equal(run_plan_eager(p0, t0), p0.run(t0))
+        assert len(compile_mod._COMPILED) == 2
+
+    def test_lru_order_hit_refreshes(self, monkeypatch, rng):
+        monkeypatch.setenv("SRT_COMPILE_CACHE_CAP", "2")
+        monkeypatch.setattr(compile_mod, "_COMPILED", OrderedDict())
+        pa, ta = _query("lra"), _table("lra", 64, rng=rng)
+        pb, tb = _query("lrb"), _table("lrb", 64, rng=rng)
+        pc, tc = _query("lrc"), _table("lrc", 64, rng=rng)
+        pa.run(ta)
+        pb.run(tb)
+        pa.run(ta)                       # refresh A: B becomes LRU
+        keys_before = list(compile_mod._COMPILED)
+        pc.run(tc)                       # evicts B, not A
+        assert keys_before[1] in compile_mod._COMPILED   # A survived
+        assert keys_before[0] not in compile_mod._COMPILED
+
+    def test_eviction_counter_and_size_gauge(self, metrics_on, monkeypatch,
+                                             rng):
+        monkeypatch.setenv("SRT_COMPILE_CACHE_CAP", "1")
+        monkeypatch.setattr(compile_mod, "_COMPILED", OrderedDict())
+        for i in range(2):
+            p = _query(f"lrg{i}")
+            p.run(_table(f"lrg{i}", 64, rng=rng))
+        snap = registry().snapshot()
+        assert snap.get("plan.compile_cache.evictions", 0) == 1
+        assert snap.get("plan.compile_cache.size") == 1
+
+
+class TestPadMemoization:
+    def test_rerun_reuses_padded_buffers(self, rng):
+        t = _table("pm", 90, rng=rng)
+        p = plan().filter(col("pm_v") > 0)
+        b1 = prepare_input(p, t)
+        b2 = prepare_input(p, t)
+        assert b1 is not None and b2 is not None
+        # Identity (not just equality): the stats-probe and dict-encode
+        # caches key on buffer ids, so reruns must hand the binder the
+        # same padded objects to stay sync-free.
+        assert b1.table is b2.table
+        assert b1.live_mask is b2.live_mask
+        assert b1.pad_rows == bucket_capacity(90) - 90
+
+    def test_bucket_stats_shape(self):
+        s = bucket_stats()
+        assert set(s) == {"enabled", "distinct_input_shapes",
+                          "distinct_capacities", "recompiles_avoided"}

@@ -780,7 +780,9 @@ class TestCaching:
         t = _mixed_table(rng)
         p = plan().filter(col("v64") > 0)
         padded, sel = p.run_padded(t)
-        assert padded.num_rows == t.num_rows
+        # Shape bucketing may pad the program's slot count above the
+        # logical length; live rows travel in the selection mask.
+        assert padded.num_rows >= t.num_rows
         assert sel is not None
         keep = np.asarray(sel.data).astype(bool)
         want = run_plan_eager(p, t)

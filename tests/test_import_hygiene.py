@@ -59,6 +59,37 @@ def test_obs_imports_without_jax():
     assert "jaxfree" in out.stdout
 
 
+def test_bucketing_imports_without_jax():
+    """``exec.bucketing`` must stay importable without jax: the bucket
+    schedule math (capacity planning, waste estimation) is plain integer
+    arithmetic that diagnostic tooling runs on hosts without the XLA
+    stack.  ``exec/__init__`` itself pulls in jax, so graft both the
+    package and an ``exec`` stub and import the module alone."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "ex = types.ModuleType('spark_rapids_tpu.exec')\n"
+        f"ex.__path__ = [{str(pkg_dir / 'spark_rapids_tpu' / 'exec')!r}]\n"
+        "sys.modules['spark_rapids_tpu.exec'] = ex\n"
+        "import spark_rapids_tpu.exec.bucketing as bk\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing exec.bucketing pulled in jax'\n"
+        "assert bk.bucket_capacity(100) == 112\n"
+        "assert bk.bucket_capacity(9, floor=8, growth=2.0) == 16\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'bucket_capacity pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

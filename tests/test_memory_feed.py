@@ -138,6 +138,30 @@ class TestScanParquet:
         total = sum(b.num_rows for b in scan_parquet([p1, p2]))
         assert total == a1.num_rows + a2.num_rows
 
+    def test_coalesce_rows_int(self, tmp_path):
+        path, at = self._write(tmp_path)          # 2000 rows, 300/group
+        batches = list(scan_parquet(path, coalesce_rows=900))
+        # 300-row groups coalesce in threes: 900, 900, tail 200.
+        assert [b.num_rows for b in batches] == [900, 900, 200]
+        from spark_rapids_tpu.ops.common import concat_columns
+        from spark_rapids_tpu import Table
+        merged = Table([(n, concat_columns([b[n] for b in batches]))
+                        for n in batches[0].names])
+        assert_tables_equal(merged, from_arrow(pq.read_table(path)))
+
+    def test_coalesce_rows_bucket(self, tmp_path):
+        from spark_rapids_tpu.exec.bucketing import bucket_capacity
+        path, at = self._write(tmp_path)
+        target = bucket_capacity(300)             # largest row group
+        batches = list(scan_parquet(path, coalesce_rows="bucket"))
+        assert all(b.num_rows >= target for b in batches[:-1])
+        assert sum(b.num_rows for b in batches) == at.num_rows
+
+    def test_coalesce_rows_invalid(self, tmp_path):
+        path, _ = self._write(tmp_path)
+        with pytest.raises(ValueError, match="coalesce_rows"):
+            list(scan_parquet(path, coalesce_rows=0))
+
     def test_arrow_fallback_for_delta(self, tmp_path):
         path = tmp_path / "d.parquet"
         pq.write_table(pa.table({"x": pa.array(range(1000), pa.int64())}),
