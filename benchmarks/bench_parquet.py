@@ -111,6 +111,37 @@ def main():
                 {"metric": f"parquet_scan_{engine}_4M_contended",
                  "value": round(v, 1), "unit": "rows/sec"}), flush=True)
 
+        bench_stream_scan(warm_path)
+
+
+def bench_stream_scan(path):
+    """File → streaming executor: ``scan_parquet`` row groups drive
+    ``run_plan_stream`` (the scan already prefetches, so prefetch=False),
+    an aggregation-terminated plan stream-combines on device and
+    materializes once at the end."""
+    from spark_rapids_tpu.exec import col, plan, run_plan_stream
+    from spark_rapids_tpu.io import scan_parquet
+    from spark_rapids_tpu.obs import bench_stream_line
+
+    p = (plan()
+         .filter(col("i64") > 0)
+         .with_columns(bucket=col("i32") % 64)
+         .groupby_agg(["bucket"], [("f64", "sum", "f_sum"),
+                                   ("f64", "count", "n")],
+                      domains={"bucket": (-63, 63)}))
+    for _ in run_plan_stream(p, scan_parquet(path, columns=["i64", "i32",
+                                                            "f64"])):
+        pass                                     # warm compile
+    t0 = time.perf_counter()
+    for _ in run_plan_stream(p, scan_parquet(path, columns=["i64", "i32",
+                                                            "f64"])):
+        pass
+    dt_s = time.perf_counter() - t0
+    print(json.dumps({"metric": "parquet_stream_combine_4M",
+                      "value": round(N / dt_s, 1), "unit": "rows/sec"}),
+          flush=True)
+    print(bench_stream_line(), flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -103,6 +103,19 @@ class Column:
             return 0
         return int(jnp.sum(~self.validity))
 
+    def is_deleted(self) -> bool:
+        """True when a backing device buffer has been invalidated by
+        buffer donation (exec/stream.py donates bucket-padded inputs via
+        ``donate_argnums``; jax deletes the donated arrays at dispatch).
+        Reading a deleted column raises in jax — callers holding cached
+        references (exec/bucketing's pad cache) check this first.  Host
+        (numpy) buffers are never donated and report False."""
+        for buf in (self.data, self.validity, self.offsets):
+            probe = getattr(buf, "is_deleted", None)
+            if probe is not None and probe():
+                return True
+        return any(c.is_deleted() for c in self.children)
+
     # -- constructors --------------------------------------------------------
     @staticmethod
     def from_numpy(values: np.ndarray, validity: Optional[np.ndarray] = None,

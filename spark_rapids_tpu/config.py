@@ -36,6 +36,11 @@ Knobs (all optional):
                                disables, ``FLOOR:GROWTH`` customizes.
   ``SRT_COMPILE_CACHE_CAP``    max in-process whole-plan programs kept
                                before LRU eviction (default 512).
+  ``SRT_PREFETCH_DEPTH``       queue depth of the IO feed's decode-ahead
+                               thread (io/feed.prefetch, default 2).
+  ``SRT_STREAM_INFLIGHT``      max batches dispatched-but-unmaterialized in
+                               the streaming executor (exec/stream.py,
+                               default 2).
   ``SRT_CPP_PARALLEL_LEVEL``   native build parallelism (``CPP_PARALLEL_LEVEL``).
 
 Accessors return live values (no import-time caching) because the reference's
@@ -197,6 +202,39 @@ def compile_cache_cap() -> int:
     return val
 
 
+def prefetch_depth() -> int:
+    """Decode-ahead queue depth for the IO feed (io/feed.prefetch).
+
+    How many batches the background worker decodes past the consumer's
+    position — the GDS read-ahead analog.  Deeper queues hide burstier
+    storage latency at the cost of holding more decoded batches in host
+    memory.  Tune with ``SRT_PREFETCH_DEPTH`` (>= 1, default 2)."""
+    raw = os.environ.get("SRT_PREFETCH_DEPTH")
+    if raw is None:
+        return 2
+    val = int(raw)
+    if val < 1:
+        raise ValueError(f"SRT_PREFETCH_DEPTH must be >= 1, got {val}")
+    return val
+
+
+def stream_inflight() -> int:
+    """Max in-flight batches for the streaming executor (exec/stream.py).
+
+    Up to this many batches sit dispatched-but-unmaterialized at once, so
+    device compute of batch N overlaps decode of N+1 and the D2H drain of
+    N-1.  Each in-flight batch pins one bucket's worth of output buffers
+    in device memory, so the knob is a latency-hiding vs. memory
+    trade-off.  Tune with ``SRT_STREAM_INFLIGHT`` (>= 1, default 2)."""
+    raw = os.environ.get("SRT_STREAM_INFLIGHT")
+    if raw is None:
+        return 2
+    val = int(raw)
+    if val < 1:
+        raise ValueError(f"SRT_STREAM_INFLIGHT must be >= 1, got {val}")
+    return val
+
+
 def native_lib_override() -> str | None:
     """Explicit native-library path, or None for the packaged/dev build."""
     return os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB") or None
@@ -244,5 +282,6 @@ def knob_table() -> dict[str, str]:
              "SRT_LEAK_DEBUG", "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE",
              "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
              "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",
-             "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP")
+             "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP",
+             "SRT_PREFETCH_DEPTH", "SRT_STREAM_INFLIGHT")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -156,6 +156,11 @@ def prepare_input(plan, table) -> Optional[BucketedInput]:
                     if b is not None)
     key = (capacity,) + tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_PAD_CACHE, key, buffers)
+    if hit is not None and hit[0].is_deleted():
+        # The streaming executor donated this padded copy's buffers to a
+        # jitted program (exec/stream.py) — the source buffers are still
+        # alive so the weakref guard can't evict the entry.  Re-pad.
+        hit = None
     if hit is not None:
         padded, mask = hit
     else:

@@ -918,12 +918,18 @@ def _psum_gather(v: jax.Array, axis: str, axis_size: int) -> jax.Array:
     return jax.lax.psum(buf, axis)
 
 
-def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
-                       axis: Optional[str] = None,
-                       axis_size: int = 1):
-    """Dense-cell aggregation; with ``axis`` the accumulators are merged
-    across mesh shards by psum-based collectives — the whole distributed
-    group-by is (cells,)-sized traffic, no shuffle."""
+def _dense_accumulate(cols, sel, step: GroupAggStep, meta: _GroupMeta):
+    """One scan pass over the rows → the dense ``(cells,)``-shaped
+    accumulator dict for ``meta``'s cell layout.
+
+    Shared by :func:`_trace_group_dense` (which turns the accumulators
+    into output columns in the same trace) and the streaming executor's
+    partial-aggregate programs (exec/stream.py), which keep the
+    accumulators on device across batches and merge them with
+    :func:`stream_combine` — every accumulator here is combinable
+    cell-wise (sums add, extrema take min/max) EXCEPT firstpos/lastpos,
+    whose row positions are batch-local; streaming combine excludes
+    first/last for exactly that reason."""
     n = next(iter(cols.values())).size
     G = meta.cells
     strides = []
@@ -1056,6 +1062,17 @@ def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
         return out, None
 
     acc, _ = jax.lax.scan(body, init, xs)
+    return acc
+
+
+def _trace_group_dense(cols, sel, step: GroupAggStep, meta: _GroupMeta,
+                       axis: Optional[str] = None,
+                       axis_size: int = 1):
+    """Dense-cell aggregation; with ``axis`` the accumulators are merged
+    across mesh shards by psum-based collectives — the whole distributed
+    group-by is (cells,)-sized traffic, no shuffle."""
+    n = next(iter(cols.values())).size
+    acc = _dense_accumulate(cols, sel, step, meta)
     if axis is not None:
         merged = {}
         for k, v in acc.items():
@@ -1434,17 +1451,19 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
     return jax.jit(program)
 
 
-def _compiled_for(bound: _Bound):
+def _cache_lookup(key, build):
+    """LRU lookup in the program table with hit/miss/eviction accounting;
+    ``build()`` runs on a miss.  Returns ``(program, was_hit)`` — the
+    streaming executor reports the hit flag as its donation-reuse
+    counter."""
     from ..config import compile_cache_cap, ensure_compile_cache
     from ..obs.metrics import counter, gauge
     ensure_compile_cache()
-    key = bound.signature()
     fn = _COMPILED.get(key)
+    hit = fn is not None
     if fn is None:
         counter("plan.compile_cache.miss").inc()
-        fn = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
-                       tuple(bound.join_metas),
-                       union_metas=tuple(bound.union_metas))
+        fn = build()
         _COMPILED[key] = fn
         cap = compile_cache_cap()
         while len(_COMPILED) > cap:
@@ -1454,7 +1473,135 @@ def _compiled_for(bound: _Bound):
         counter("plan.compile_cache.hit").inc()
         _COMPILED.move_to_end(key)
     gauge("plan.compile_cache.size").set(len(_COMPILED))
-    return fn
+    return fn, hit
+
+
+def _compiled_for(bound: _Bound):
+    def build():
+        return _assemble(bound.assembly_steps(), tuple(bound.group_metas),
+                         tuple(bound.join_metas),
+                         union_metas=tuple(bound.union_metas))
+    return _cache_lookup(bound.signature(), build)[0]
+
+
+# -- streaming-executor entry points (exec/stream.py) ------------------------
+
+def compiled_stream_for(bound: _Bound):
+    """The buffer-donating variant of :func:`_compiled_for`.
+
+    Same trace as the plain program (so streamed results are bit-for-bit
+    identical to ``run_plan``) but jitted with ``donate_argnums=0``: XLA
+    reuses the input columns' device buffers for the outputs, so a stream
+    of same-bucket batches cycles one buffer set instead of allocating per
+    batch.  The caller must only pass engine-owned buffers (the streaming
+    executor donates bucket-padded copies exclusively — never the user's
+    table, whose buffers the pad cache and the user still reference).
+    Returns ``(program, was_cache_hit)``.
+    """
+    def build():
+        program = _assemble(bound.assembly_steps(),
+                            tuple(bound.group_metas),
+                            tuple(bound.join_metas),
+                            union_metas=tuple(bound.union_metas), jit=False)
+        return jax.jit(program, donate_argnums=(0,))
+    return _cache_lookup(("stream/donate", bound.signature()), build)
+
+
+def stream_prefix_dtypes(bound: _Bound) -> dict[str, DType]:
+    """Dtypes of the columns reaching the plan's final (group-by) step:
+    ``jax.eval_shape`` over the prefix program — Column dtype is static
+    pytree aux, so this traces without touching device data.  The
+    streaming combine setup uses these to build its batch-invariant cell
+    layout and the dtype stubs for :func:`stream_finalize`."""
+    fns = _step_closures(bound.assembly_steps()[:-1], (),
+                         tuple(bound.join_metas),
+                         union_metas=tuple(bound.union_metas))
+
+    def prefix(cols, side, init_sel):
+        sel = init_sel
+        for fn in fns:
+            cols, sel = fn(cols, sel, side)
+        return cols
+
+    out = jax.eval_shape(prefix, bound.exec_cols, bound.side_inputs,
+                         bound.init_sel)
+    return {name: c.dtype for name, c in out.items()}
+
+
+def compiled_stream_partial(bound: _Bound, smeta: _GroupMeta,
+                            donate: bool):
+    """Jitted partial-aggregate program for streaming combine mode:
+    prefix steps → :func:`_dense_accumulate` under the batch-invariant
+    ``smeta`` cell layout, returning the on-device accumulator dict
+    instead of output columns (no per-batch materialize, no host sync).
+    ``donate`` applies ``donate_argnums=0`` (engine-owned padded inputs
+    only, as in :func:`compiled_stream_for`).  The cache key swaps the
+    bound's batch-probed group metas for ``smeta`` so every same-bucket
+    batch reuses one program.  Returns ``(program, was_cache_hit)``."""
+    sig = bound.signature()
+    step = bound.steps[-1]
+    key = ("stream/partial", donate, sig[0][:-1], sig[1], sig[2], sig[3],
+           sig[5], sig[6], sig[7], step, smeta)
+
+    def build():
+        fns = _step_closures(sig[0][:-1], (), tuple(bound.join_metas),
+                             union_metas=tuple(bound.union_metas))
+
+        def partial_program(cols, side, init_sel=None):
+            sel = init_sel
+            for fn in fns:
+                cols, sel = fn(cols, sel, side)
+            return _dense_accumulate(cols, sel, step, smeta)
+
+        return jax.jit(partial_program,
+                       donate_argnums=(0,) if donate else ())
+    return _cache_lookup(key, build)
+
+
+_STREAM_COMBINE = None
+
+
+def stream_combine():
+    """The jitted cell-wise accumulator merge for streaming combine mode:
+    sums/counts add, extrema take min/max.  Donates the first input —
+    outputs match its buffers one-to-one, so each merge runs in place and
+    the stream's aggregation state stays one accumulator-set of HBM per
+    combine-tree level (the second input's buffers free by refcount as
+    the caller drops them).  One jit handles every accumulator pytree
+    (jax re-specializes per structure)."""
+    global _STREAM_COMBINE
+    if _STREAM_COMBINE is None:
+        def combine(a, b):
+            out = {}
+            for k, v in a.items():
+                if k.startswith("min:"):
+                    out[k] = jnp.minimum(v, b[k])
+                elif k.startswith("max:"):
+                    out[k] = jnp.maximum(v, b[k])
+                else:           # count_all / count: / sum: / sumsq:
+                    out[k] = v + b[k]
+            return out
+        _STREAM_COMBINE = jax.jit(combine, donate_argnums=(0,))
+    return _STREAM_COMBINE
+
+
+def stream_finalize(bound: _Bound, smeta: _GroupMeta, acc,
+                    col_dtypes: dict[str, DType]) -> Table:
+    """Output columns + materialization from a combined streaming
+    accumulator — the stream's ONE host sync.  ``bound`` is any batch's
+    binding (used for output order only).  The dense-cell outputs read
+    nothing but dtypes from their input columns except for first/last —
+    which streaming combine excludes — so dtype-only stubs suffice."""
+    step = bound.steps[-1]
+    stubs = {name: Column(data=None, dtype=dt)
+             for name, dt in col_dtypes.items()}
+
+    def outputs(acc):
+        return _dense_level_outputs(stubs, step, smeta, acc,
+                                    tuple(range(len(smeta.keys))), 1)
+
+    out_cols, live = jax.jit(outputs)(acc)
+    return materialize(bound, out_cols, live)
 
 
 def _bind(plan: Plan, table: Table) -> _Bound:

@@ -436,6 +436,16 @@ class Plan:
         from .compile import explain_analyze_plan
         return explain_analyze_plan(self, table)
 
+    def run_stream(self, batches, inflight=None, combine="auto",
+                   prefetch=False):
+        """Execute over a batch iterator with up to ``inflight`` batches
+        dispatched but unmaterialized (async pipelining + buffer
+        donation; see :mod:`.stream`).  Yields one Table per batch, or a
+        single aggregated Table in streaming combine mode."""
+        from .stream import run_plan_stream
+        return run_plan_stream(self, batches, inflight=inflight,
+                               combine=combine, prefetch=prefetch)
+
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`
         over ``mesh``: the per-shard program runs under ``shard_map`` and

@@ -1,0 +1,459 @@
+"""Streaming plan executor: in-flight batches, buffer donation, and
+on-device partial-aggregate combine.
+
+The reference keeps the GPU saturated by overlapping storage IO, decode,
+and kernels (GDS DMA plus async operator execution); the serial
+``run_plan`` loop here idles the device during every host phase instead —
+decode, bind, dispatch, and the materialize host sync run strictly
+back-to-back.  :func:`run_plan_stream` drives a plan over any batch
+iterator (notably ``io.feed.scan_parquet``) with up to K batches
+dispatched but *not* blocked on, so jax's async dispatch computes batch N
+while the feed thread decodes N+1 and the materialization of N-1 drains
+its D2H copy.
+
+Two modes, picked per plan:
+
+* **per-batch** — one output Table per input batch, bit-for-bit equal to
+  ``run_plan`` on that batch.  Because shape bucketing makes consecutive
+  batches shape-identical, each bucket's program is compiled once with
+  ``donate_argnums`` on the input columns (compile.compiled_stream_for):
+  same-bucket batches recycle one set of HBM buffers instead of
+  allocating per batch.  Donation only takes effect when an output can
+  alias the input (row-shaped outputs: filter/project/sort plans) — the
+  ``stream.donation.hit`` counter reports buffers actually reclaimed at
+  dispatch, not dispatches merely eligible.  Only engine-owned
+  bucket-pad copies are ever donated — the user's table always survives.
+* **streaming combine** — for plans ending in a group-by: every batch
+  folds into a dense on-device accumulator (compile._dense_accumulate
+  under one batch-invariant cell layout), partials merge in a binomial
+  tree (compile.stream_combine), and ONE materialize at the end is the
+  stream's only host sync.  Requires static key domains (``domains=``
+  hints or bool keys) and batch-combinable aggregations; ``"auto"``
+  falls back to per-batch mode otherwise.
+
+This module stays jax-free at module import (the config.py lazy-import
+rule): the engine, plan types, and metrics all load at first call.
+"""
+
+from __future__ import annotations
+
+import time as _time
+import warnings
+from collections import deque
+from typing import Iterable, Iterator, Optional, Union
+
+#: Aggregations whose dense accumulators merge cell-wise across batches
+#: (sums/counts add, extrema min/max; mean/var/std derive from sums).
+#: first/last read batch-local row positions and nunique/median force the
+#: sorted path — none of them can stream-combine.
+COMBINABLE_AGGS = frozenset(
+    {"count", "count_all", "sum", "mean", "var", "std", "min", "max"})
+
+
+def combine_obstacles(plan) -> list[str]:
+    """Why ``plan`` cannot run in streaming combine mode (plan-level
+    checks only; empty list = viable so far).  Bind-level conditions —
+    static key domains, no string keys, cell-count cap — are checked
+    against the first batch and fall back the same way under
+    ``combine="auto"``."""
+    from .plan import FilterStep, GroupAggStep, JoinStep, ProjectStep
+    steps = plan.steps
+    if not steps or not isinstance(steps[-1], GroupAggStep):
+        return ["plan does not end in a group-by"]
+    out = []
+    last = steps[-1]
+    if last.sets is not None:
+        out.append("grouping sets need per-level outputs, not one "
+                   "accumulator")
+    bad = sorted({how for _, how, _ in last.aggs
+                  if how not in COMBINABLE_AGGS})
+    if bad:
+        out.append(f"aggregations {bad} do not combine across batches")
+    for s in steps[:-1]:
+        if not isinstance(s, (FilterStep, ProjectStep, JoinStep)):
+            out.append(f"{type(s).__name__} before the group-by is not "
+                       "row-local (per-batch results would differ from "
+                       "the concatenated input)")
+            break
+    return out
+
+
+class _Account:
+    """Per-stream phase accounting.  ``source_s`` may be written from the
+    feed's worker thread (single writer) and is read once at the end."""
+    __slots__ = ("batches", "rows", "columns", "out_rows", "source_s",
+                 "bind_s", "dispatch_s", "mat_s", "idle_s",
+                 "donation_hits", "donation_misses", "peak_inflight")
+
+    def __init__(self):
+        self.batches = self.rows = self.columns = self.out_rows = 0
+        self.source_s = self.bind_s = self.dispatch_s = 0.0
+        self.mat_s = self.idle_s = 0.0
+        self.donation_hits = self.donation_misses = 0
+        self.peak_inflight = 0
+
+
+def _counted_source(source: Iterator, acct: _Account, batch_counter
+                    ) -> Iterator:
+    """Input-side batch/row accounting, applied ONCE on the outermost
+    iterator so the combine→per-batch fallback (which replays consumed
+    batches) never double-counts."""
+    for batch in source:
+        acct.batches += 1
+        acct.rows += batch.num_rows
+        if acct.columns == 0:
+            acct.columns = batch.num_columns
+        batch_counter.inc()
+        yield batch
+
+
+def _timed_source(batches: Iterable, acct: _Account) -> Iterator:
+    """Meter time spent pulling from the source iterator (decode cost).
+    When the stream is wrapped in ``io.feed.prefetch`` this runs inside
+    the worker thread, so the measurement is true decode time, not the
+    consumer's queue wait."""
+    it = iter(batches)
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            acct.source_s += _time.perf_counter() - t0
+            return
+        acct.source_s += _time.perf_counter() - t0
+        yield item
+
+
+def _donatable(bound) -> bool:
+    """Donate only engine-owned buffers: a bucket-pad copy exists exactly
+    when the bind padded (``logical_rows < n``) — ``Table.pad_to`` returns
+    the caller's table itself at exact capacity, and donating THAT would
+    delete buffers the user (and the pad cache's key identity) still
+    holds.  String/dictionary plans keep their encode caches keyed on
+    live buffers, so they opt out entirely."""
+    return (bound.init_sel is not None
+            and bound.logical_rows < bound.n
+            and not bound.string_cols
+            and not bound.dictionaries
+            and not bound._deferred_strs)
+
+
+def _dispatch_donated(fn, bound):
+    """Invoke a donating program and report whether the donation actually
+    took effect.  XLA only consumes a donated buffer when some output can
+    alias it (same shape/dtype) — aggregation-terminated programs emit
+    cells-shaped outputs, so their n-sized inputs survive and the backend
+    warns per call ("Some donated buffers were not usable").  The fallback
+    is an ordinary copy, so keep the stream quiet and let the post-
+    dispatch ``is_deleted`` probe tell the truth: returns
+    ``(result, consumed)`` where ``consumed`` means the input HBM was
+    reclaimed at dispatch."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message=".*[Dd]onat.*", category=UserWarning)
+        out = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
+    consumed = any(c.is_deleted() for c in bound.exec_cols.values())
+    return out, consumed
+
+
+def _combine_setup(bound):
+    """Build the batch-invariant dense layout for streaming combine from
+    the first batch's binding, or raise TypeError when the plan needs a
+    per-batch layout.  Keys are forced nullable so every batch — with or
+    without nulls — shares one cell numbering, and domains must be static
+    (``domains=`` hints or bool keys): a per-batch stats probe would give
+    each batch its own incompatible accumulator."""
+    from ..dtypes import BOOL8
+    from .compile import (_GroupMeta, _KeyMeta, _dense_max_cells,
+                          stream_prefix_dtypes)
+    if bound.string_cols or bound.dictionaries or bound._deferred_strs:
+        raise TypeError("streaming combine does not support string "
+                        "columns (per-batch dictionary vocabularies "
+                        "cannot share one accumulator)")
+    step = bound.steps[-1]
+    dtypes = stream_prefix_dtypes(bound)
+    keys = []
+    for name, hint in zip(step.keys, step.domains):
+        dt = dtypes[name]
+        if hint is not None:
+            lo, hi = int(hint[0]), int(hint[1])
+        elif dt == BOOL8:
+            lo, hi = 0, 1
+        else:
+            raise TypeError(
+                f"streaming combine needs a static domain for group key "
+                f"{name!r}: pass domains={{{name!r}: (lo, hi)}} to "
+                f"groupby_agg (a per-batch probe would change the cell "
+                f"layout between batches)")
+        keys.append(_KeyMeta(name, lo, hi, True, None, dt))
+    sizes = tuple((km.hi - km.lo + 1) + 1 for km in keys)
+    cells = 1
+    for s in sizes:
+        cells *= s
+    if cells > _dense_max_cells():
+        raise TypeError(
+            f"streaming combine needs a dense key domain: {cells} cells "
+            f"exceeds the cap ({_dense_max_cells()}, SRT_DENSE_MAX_CELLS)")
+    return _GroupMeta(True, tuple(keys), sizes, cells), dtypes
+
+
+def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
+                    combine: Union[str, bool] = "auto",
+                    prefetch: Union[bool, int] = False) -> Iterator:
+    """Drive ``plan`` over ``batches`` with up to ``inflight`` batches
+    dispatched but unmaterialized.  Yields one Table per batch (bit-equal
+    to ``run_plan`` on that batch), or — in streaming combine mode — ONE
+    Table aggregating the whole stream.
+
+    ``inflight``   max dispatched-but-unmaterialized batches (default
+                   ``SRT_STREAM_INFLIGHT``); each in-flight batch pins a
+                   bucket's worth of output buffers in device memory.
+    ``combine``    ``"auto"`` (combine when the plan allows, else
+                   per-batch), ``True`` (combine or raise TypeError),
+                   ``False`` (always per-batch).
+    ``prefetch``   wrap the source in ``io.feed.prefetch`` so decode runs
+                   in a worker thread; ``True`` uses ``SRT_PREFETCH_DEPTH``,
+                   an int sets the queue depth.  Leave False for sources
+                   that already prefetch (``scan_parquet``).
+
+    Stream metrics (batch count, donation hits, peak in-flight depth,
+    overlap ratio) land in ``obs.last_stream_metrics()`` after the
+    final yield; registry counters additionally fire under SRT_METRICS.
+    """
+    if inflight is None:
+        from ..config import stream_inflight
+        inflight = stream_inflight()
+    if not isinstance(inflight, int) or inflight < 1:
+        raise ValueError(f"inflight must be an int >= 1, got {inflight!r}")
+    if combine not in ("auto", True, False):
+        raise ValueError(f"combine must be 'auto', True, or False, "
+                         f"got {combine!r}")
+    if prefetch is not False and prefetch is not True \
+            and (not isinstance(prefetch, int) or prefetch < 1):
+        raise ValueError(f"prefetch must be a bool or an int >= 1, "
+                         f"got {prefetch!r}")
+    if combine is True:
+        obstacles = combine_obstacles(plan)
+        if obstacles:
+            raise TypeError("plan cannot stream-combine: "
+                            + "; ".join(obstacles))
+    return _stream(plan, batches, inflight, combine, prefetch)
+
+
+def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
+    from ..config import metrics_enabled
+    from ..obs.metrics import counter, counters_delta, gauge, registry
+
+    acct = _Account()
+    feed = _timed_source(batches, acct)
+    if prefetch is not False:
+        from ..io.feed import prefetch as _prefetch
+        feed = _prefetch(feed, depth=None if prefetch is True else prefetch)
+    source = _counted_source(feed, acct, counter("stream.batches"))
+
+    want_combine = combine is True or (combine == "auto"
+                                       and not combine_obstacles(plan))
+    before = registry().counters_snapshot() if metrics_enabled() else None
+    t_all = _time.perf_counter()
+    if want_combine:
+        driver = _drive_combine(plan, source, k, acct,
+                                strict=combine is True)
+    else:
+        driver = _drive_batches(plan, source, k, acct)
+    try:
+        for out in driver:
+            acct.out_rows += out.num_rows
+            pause = _time.perf_counter()
+            yield out
+            acct.idle_s += _time.perf_counter() - pause
+    finally:
+        # Deterministic teardown (an abandoned stream must not leave the
+        # feed's prefetch worker running until GC); idempotent on normal
+        # exhaustion.
+        driver.close()
+        source.close()
+        feed.close()
+
+    wall = _time.perf_counter() - t_all - acct.idle_s
+    serial = acct.source_s + acct.bind_s + acct.dispatch_s + acct.mat_s
+    overlap = max(0.0, serial - wall) / serial if serial > 0 else 0.0
+    gauge("stream.inflight_depth").set(acct.peak_inflight)
+    gauge("stream.overlap_ratio").set(round(overlap, 6))
+
+    from ..obs.query import (QueryMetrics, next_query_id,
+                             set_last_stream_metrics)
+    qm = QueryMetrics(query_id=next_query_id(), mode="stream",
+                      input_rows=acct.rows, input_columns=acct.columns)
+    qm.output_rows = acct.out_rows
+    qm.bind_seconds = acct.bind_s
+    qm.execute_seconds = acct.dispatch_s       # dispatch wall (async)
+    qm.materialize_seconds = acct.mat_s
+    qm.total_seconds = wall
+    qm.stream_batches = acct.batches
+    qm.stream_inflight = k
+    qm.stream_peak_inflight = acct.peak_inflight
+    qm.stream_donation_hits = acct.donation_hits
+    qm.stream_donation_misses = acct.donation_misses
+    qm.stream_source_seconds = acct.source_s
+    qm.stream_serial_seconds = serial
+    qm.stream_overlap_ratio = overlap
+    qm.finish_counters(counters_delta(before))
+    set_last_stream_metrics(qm)
+
+
+def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
+    """Per-batch pipeline: dispatch first, then materialize the OLDEST
+    entry only once more than ``k`` are in flight — by then its device
+    work has had the longest time to finish, so the materialize host sync
+    waits least.  Empty batches ride the deque as ready results to keep
+    output order equal to input order."""
+    from ..obs.metrics import counter, gauge
+    from .compile import (_bind, _compiled_for, compiled_stream_for,
+                          materialize, run_plan_eager)
+
+    pending: deque = deque()    # ("exec", bound, out_cols, sel) | ("ready", t)
+    inflight_gauge = gauge("stream.inflight_depth")
+
+    def drain_oldest():
+        entry = pending.popleft()
+        if entry[0] == "ready":
+            return entry[1]
+        _, bound, out_cols, sel = entry
+        t0 = _time.perf_counter()
+        out = materialize(bound, out_cols, sel)
+        acct.mat_s += _time.perf_counter() - t0
+        return out
+
+    for batch in source:
+        if batch.num_rows == 0:
+            pending.append(("ready", run_plan_eager(plan, batch)))
+        else:
+            t0 = _time.perf_counter()
+            bound = _bind(plan, batch)
+            acct.bind_s += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            if _donatable(bound):
+                fn, _ = compiled_stream_for(bound)
+                (out_cols, sel), reclaimed = _dispatch_donated(fn, bound)
+            else:
+                reclaimed = False
+                fn = _compiled_for(bound)
+                out_cols, sel = fn(bound.exec_cols, bound.side_inputs,
+                                   bound.init_sel)
+            if reclaimed:
+                acct.donation_hits += 1
+                counter("stream.donation.hit").inc()
+            else:
+                acct.donation_misses += 1
+                counter("stream.donation.miss").inc()
+            acct.dispatch_s += _time.perf_counter() - t0
+            pending.append(("exec", bound, out_cols, sel))
+        while len(pending) > k:
+            yield drain_oldest()
+        depth = sum(1 for e in pending if e[0] == "exec")
+        if depth > acct.peak_inflight:
+            acct.peak_inflight = depth
+            inflight_gauge.set(depth)
+    while pending:
+        yield drain_oldest()
+
+
+def _drive_combine(plan, source, k: int, acct: _Account,
+                   strict: bool) -> Iterator:
+    """Streaming combine: per-batch partial accumulators fold into a
+    binomial tree (level i holds 2^i batches' worth), bounding both the
+    number of live accumulator sets (log2 of the stream) and the
+    float-add depth any one value sees.  Every ``k`` batches the newest
+    level is blocked on — backpressure without any D2H.  Yields the one
+    final Table (or nothing for an all-missing stream); falls back to
+    the per-batch driver when the first bind shows the layout cannot be
+    batch-invariant — unless ``strict``."""
+    import jax
+
+    from ..obs.metrics import counter, gauge
+    from .compile import (_bind, compiled_stream_partial, run_plan_eager,
+                          stream_combine, stream_finalize)
+
+    levels: list = []           # levels[i]: acc of 2^i batches, or None
+    bound0 = smeta = dtypes = None
+    last_empty = None
+    consumed: list = []         # batches seen before viability is decided
+    since_block = 0
+    inflight_gauge = gauge("stream.inflight_depth")
+
+    for batch in source:
+        if smeta is None:
+            consumed.append(batch)
+        if batch.num_rows == 0:
+            last_empty = batch          # contributes no groups
+            continue
+        t0 = _time.perf_counter()
+        bound = _bind(plan, batch)
+        acct.bind_s += _time.perf_counter() - t0
+        if smeta is None:
+            try:
+                smeta, dtypes = _combine_setup(bound)
+            except TypeError:
+                if strict:
+                    raise
+                # The layout is not batch-invariant: replay everything
+                # consumed so far (leading empties included, in order)
+                # through the per-batch driver instead.
+                yield from _drive_batches(
+                    plan, _chain_batches(consumed, source), k, acct)
+                return
+            bound0 = bound
+            consumed.clear()
+        donate = _donatable(bound)
+        t0 = _time.perf_counter()
+        fn, _ = compiled_stream_partial(bound, smeta, donate)
+        if donate:
+            acc, reclaimed = _dispatch_donated(fn, bound)
+        else:
+            reclaimed = False
+            acc = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
+        if reclaimed:
+            acct.donation_hits += 1
+            counter("stream.donation.hit").inc()
+        else:
+            acct.donation_misses += 1
+            counter("stream.donation.miss").inc()
+        merge = stream_combine()
+        i = 0
+        while i < len(levels) and levels[i] is not None:
+            acc = merge(levels[i], acc)
+            levels[i] = None
+            i += 1
+        if i == len(levels):
+            levels.append(acc)
+        else:
+            levels[i] = acc
+        acct.dispatch_s += _time.perf_counter() - t0
+        since_block += 1
+        if since_block > acct.peak_inflight:
+            acct.peak_inflight = since_block
+            inflight_gauge.set(since_block)
+        if since_block >= k:
+            jax.block_until_ready(levels[i])
+            since_block = 0
+
+    if smeta is None:
+        if last_empty is not None:      # schema known, zero groups
+            yield run_plan_eager(plan, last_empty)
+        return
+    total = None
+    merge = stream_combine()
+    for lv in levels:
+        if lv is None:
+            continue
+        total = lv if total is None else merge(total, lv)
+    t0 = _time.perf_counter()
+    out = stream_finalize(bound0, smeta, total, dtypes)
+    acct.mat_s += _time.perf_counter() - t0
+    yield out
+
+
+def _chain_batches(*parts) -> Iterator:
+    for part in parts:
+        for item in part:
+            yield item

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Callable, Iterable, Iterator, Optional, Sequence
 
 from ..table import Table
@@ -32,36 +33,58 @@ from ..table import Table
 _SENTINEL = object()
 
 
-def prefetch(iterable: Iterable, depth: int = 2,
+def prefetch(iterable: Iterable, depth: Optional[int] = None,
              transform: Optional[Callable] = None) -> Iterator:
     """Run ``iter(iterable)`` (and ``transform``) in a background thread,
     keeping up to ``depth`` results ready ahead of the consumer.
 
-    ``depth=2`` is classic double buffering.  Exceptions raised by the
-    producer re-raise at the consumer's ``next()`` call as the original
-    exception object (original type and traceback intact — a decode error
-    three frames deep in the worker reads exactly as it would inline).
+    ``depth`` defaults to ``SRT_PREFETCH_DEPTH`` (config.prefetch_depth,
+    2 = classic double buffering).  Exceptions raised by the producer
+    re-raise at the consumer's ``next()`` call as the original exception
+    object (original type and traceback intact — a decode error three
+    frames deep in the worker reads exactly as it would inline).
+
+    The worker starts lazily at the consumer's first ``next()`` and every
+    put is a timeout-put that rechecks the stop flag: a generator that is
+    closed (or garbage-collected) while the queue is full cannot leave the
+    worker wedged in a blocking ``q.put`` — close drains until the worker
+    exits.
     """
+    if depth is None:
+        from ..config import prefetch_depth
+        depth = prefetch_depth()
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
+
+    def put(item) -> bool:
+        """Enqueue unless the consumer is gone; True when delivered."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in iterable:
                 if stop.is_set():
                     return
-                q.put(transform(item) if transform is not None else item)
-            q.put(_SENTINEL)
+                if not put(transform(item) if transform is not None
+                           else item):
+                    return
+            put(_SENTINEL)
         except BaseException as e:          # propagate to the consumer
-            q.put(e)
+            put(e)
 
     thread = threading.Thread(target=worker, daemon=True,
                               name="srt-prefetch")
-    thread.start()
 
     def generator():
+        thread.start()
         try:
             while True:
                 item = q.get()
@@ -76,9 +99,16 @@ def prefetch(iterable: Iterable, depth: int = 2,
                 yield item
         finally:
             stop.set()
-            # Drain so a blocked producer can observe the stop flag.
-            while not q.empty():
-                q.get_nowait()
+            # Unblock a producer mid-put and wait for it to exit; the
+            # timeout-put rechecks ``stop`` so bounded draining suffices
+            # (no race against items landing after a q.empty() check).
+            deadline = _time.monotonic() + 2.0
+            while thread.is_alive() and _time.monotonic() < deadline:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(0.02)
 
     return generator()
 
@@ -197,13 +227,14 @@ def _bucket_coalesce_target(paths, columns) -> int:
 
 
 def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
-                 depth: int = 2,
+                 depth: Optional[int] = None,
                  coalesce_rows: Optional[object] = None) -> Iterator[Table]:
     """Stream device Tables row-group by row-group across ``paths``.
 
     IO + host decode for the next row group overlap with the caller's
     device compute on the current one (the GDS-analog pipeline).  ``paths``
-    may be one path or a sequence.
+    may be one path or a sequence.  ``depth`` defaults to
+    ``SRT_PREFETCH_DEPTH`` (config.prefetch_depth).
 
     ``coalesce_rows`` merges consecutive row groups until each yielded
     batch holds at least that many rows (see :func:`coalesce_to_buckets`).

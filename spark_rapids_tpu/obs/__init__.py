@@ -16,8 +16,9 @@ in the XLA stack.
 from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
                       counter, counters_delta, gauge, registry, timer)
 from .query import (QueryMetrics, StepMetrics, bench_cache_line,
-                    bench_metrics_line, last_query_metrics,
-                    set_last_query_metrics)
+                    bench_metrics_line, bench_stream_line,
+                    last_query_metrics, last_stream_metrics,
+                    set_last_query_metrics, set_last_stream_metrics)
 
 __all__ = [
     "NULL_METRIC",
@@ -29,11 +30,14 @@ __all__ = [
     "Timer",
     "bench_cache_line",
     "bench_metrics_line",
+    "bench_stream_line",
     "counter",
     "counters_delta",
     "gauge",
     "last_query_metrics",
+    "last_stream_metrics",
     "registry",
     "set_last_query_metrics",
+    "set_last_stream_metrics",
     "timer",
 ]

@@ -34,6 +34,7 @@ UNMEASURED_FLOAT = -1.0
 _QUERY_IDS = itertools.count(1)
 _LAST_LOCK = threading.Lock()
 _LAST: Optional["QueryMetrics"] = None
+_LAST_STREAM: Optional["QueryMetrics"] = None
 
 
 def next_query_id() -> int:
@@ -76,7 +77,7 @@ class StepMetrics:
 class QueryMetrics:
     """End-to-end accounting for one plan execution."""
     query_id: int = 0
-    mode: str = "run"                  # run | analyze | dist
+    mode: str = "run"                  # run | analyze | dist | stream
     input_rows: int = 0
     input_columns: int = 0
     output_rows: int = UNMEASURED_INT
@@ -99,6 +100,17 @@ class QueryMetrics:
     #: raw registry counter deltas over the run (shuffle bytes, parquet
     #: rows, ... — whatever the layers underneath incremented).
     counters: Dict[str, int] = field(default_factory=dict)
+    # -- streaming executor (exec/stream.py; zero for non-stream modes) --
+    stream_batches: int = 0
+    stream_inflight: int = 0            # configured window (K)
+    stream_peak_inflight: int = 0       # deepest observed pipeline depth
+    stream_donation_hits: int = 0       # donating dispatches reusing HBM
+    stream_donation_misses: int = 0
+    stream_source_seconds: float = 0.0  # decode time inside the feed
+    #: decode + bind + dispatch + materialize, as if run serially; the
+    #: overlap ratio is (serial - wall) / serial, > 0 when pipelining won.
+    stream_serial_seconds: float = 0.0
+    stream_overlap_ratio: float = 0.0
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -110,7 +122,7 @@ class QueryMetrics:
 
     def to_dict(self) -> dict:
         return {
-            "schema_version": 1,
+            "schema_version": 2,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "mode": self.mode,
@@ -131,6 +143,18 @@ class QueryMetrics:
                        "dict_encode_misses": self.dict_encode_misses},
             "steps": [s.to_dict() for s in self.steps],
             "counters": self.counters,
+            # Always present (zeroed outside mode="stream") so the golden
+            # key set stays one set across modes.
+            "stream": {
+                "batches": self.stream_batches,
+                "inflight": self.stream_inflight,
+                "peak_inflight": self.stream_peak_inflight,
+                "donation_hits": self.stream_donation_hits,
+                "donation_misses": self.stream_donation_misses,
+                "source_seconds": round(self.stream_source_seconds, 6),
+                "serial_seconds": round(self.stream_serial_seconds, 6),
+                "overlap_ratio": round(self.stream_overlap_ratio, 6),
+            },
         }
 
     def to_json(self) -> str:
@@ -195,6 +219,22 @@ def last_query_metrics() -> Optional[QueryMetrics]:
         return _LAST
 
 
+def set_last_stream_metrics(qm: QueryMetrics) -> None:
+    global _LAST_STREAM
+    with _LAST_LOCK:
+        _LAST_STREAM = qm
+
+
+def last_stream_metrics() -> Optional[QueryMetrics]:
+    """The most recent streaming execution's metrics (mode="stream";
+    None before any stream completes).  Unlike the metered ``run`` path
+    this is populated even with SRT_METRICS off — the stream's phase
+    timings cost nothing extra to record, and the overlap ratio is the
+    whole point of running the executor."""
+    with _LAST_LOCK:
+        return _LAST_STREAM
+
+
 def bench_metrics_line() -> str:
     """The benchmarks' second JSON line (behind ``SRT_METRICS=1``): the
     last query's ``to_json()`` when a metered plan ran, else the global
@@ -234,5 +274,34 @@ def bench_cache_line() -> str:
                           rows_total=rows_total,
                           pad_waste_frac=(round(pad_rows / rows_total, 6)
                                           if rows_total else 0.0)),
+    }
+    return json.dumps(payload, sort_keys=True)
+
+
+def bench_stream_line() -> str:
+    """The benchmarks' streaming-pipeline JSON line (one line, stable key
+    order): wall vs. serial phase-sum time, the overlap ratio, and the
+    donation-reuse counters of the last ``run_plan_stream`` — the
+    bench-trajectory view of pipeline efficiency.  Separate from
+    ``bench_metrics_line`` so the golden-pinned QueryMetrics schema stays
+    untouched.  ``{"runs": 0}`` before any stream completes."""
+    qm = last_stream_metrics()
+    if qm is None:
+        return json.dumps({"metric": "stream_exec", "runs": 0},
+                          sort_keys=True)
+    payload = {
+        "metric": "stream_exec",
+        "runs": 1,
+        "batches": qm.stream_batches,
+        "input_rows": qm.input_rows,
+        "output_rows": qm.output_rows,
+        "inflight": qm.stream_inflight,
+        "peak_inflight": qm.stream_peak_inflight,
+        "donation_hits": qm.stream_donation_hits,
+        "donation_misses": qm.stream_donation_misses,
+        "wall_seconds": round(qm.total_seconds, 6),
+        "serial_seconds": round(qm.stream_serial_seconds, 6),
+        "source_seconds": round(qm.stream_source_seconds, 6),
+        "overlap_ratio": round(qm.stream_overlap_ratio, 6),
     }
     return json.dumps(payload, sort_keys=True)

@@ -81,6 +81,12 @@ class Table:
     def __len__(self) -> int:
         return self.num_rows
 
+    def is_deleted(self) -> bool:
+        """True when any column's device buffer was invalidated by buffer
+        donation (see Column.is_deleted); such a table must be re-built,
+        never read."""
+        return any(c.is_deleted() for c in self._columns)
+
     def schema(self) -> list[DType]:
         return [c.dtype for c in self._columns]
 

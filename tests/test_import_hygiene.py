@@ -90,6 +90,48 @@ def test_bucketing_imports_without_jax():
     assert "jaxfree" in out.stdout
 
 
+def test_stream_imports_without_jax():
+    """``exec.stream`` must stay importable without jax (the config.py
+    lazy-import rule): a scheduler deciding whether a plan can
+    stream-combine, or validating knob values, must not pay for the XLA
+    stack.  Argument validation runs before any engine import, so bad
+    arguments raise ValueError while jax stays unloaded."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "ex = types.ModuleType('spark_rapids_tpu.exec')\n"
+        f"ex.__path__ = [{str(pkg_dir / 'spark_rapids_tpu' / 'exec')!r}]\n"
+        "sys.modules['spark_rapids_tpu.exec'] = ex\n"
+        "import spark_rapids_tpu.exec.stream as st\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing exec.stream pulled in jax'\n"
+        "assert 'sum' in st.COMBINABLE_AGGS\n"
+        "try:\n"
+        "    st.run_plan_stream(None, [], inflight=0)\n"
+        "except ValueError:\n"
+        "    pass\n"
+        "else:\n"
+        "    raise AssertionError('inflight=0 did not raise')\n"
+        "try:\n"
+        "    st.run_plan_stream(None, [], combine='bogus')\n"
+        "except ValueError:\n"
+        "    pass\n"
+        "else:\n"
+        "    raise AssertionError(\"combine='bogus' did not raise\")\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'run_plan_stream validation pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

@@ -88,6 +88,48 @@ class TestPrefetch:
         with pytest.raises(ValueError):
             prefetch([1], depth=0)
 
+    def test_dropped_generator_unblocks_producer(self):
+        # A consumer that abandons the stream mid-way must not leave the
+        # worker wedged in a blocking q.put forever.
+        import threading
+
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        it = prefetch(endless(), depth=1)
+        assert next(it) == 0
+        it.close()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if not [t for t in threading.enumerate()
+                    if t.name == "srt-prefetch"]:
+                return
+            time.sleep(0.01)
+        alive = [t.name for t in threading.enumerate()
+                 if t.name == "srt-prefetch"]
+        assert not alive, f"prefetch worker leaked: {alive}"
+
+    def test_unstarted_generator_spawns_no_thread(self):
+        import threading
+        before = sum(t.name == "srt-prefetch"
+                     for t in threading.enumerate())
+        it = prefetch(range(100), depth=2)
+        after = sum(t.name == "srt-prefetch"
+                    for t in threading.enumerate())
+        assert after == before      # lazy start: nothing until first next()
+        it.close()
+
+    def test_depth_defaults_from_env(self, monkeypatch):
+        monkeypatch.setenv("SRT_PREFETCH_DEPTH", "4")
+        # depth=None must read the knob (a bad value proves it is read)
+        assert list(prefetch(range(10))) == list(range(10))
+        monkeypatch.setenv("SRT_PREFETCH_DEPTH", "0")
+        with pytest.raises(ValueError):
+            prefetch(range(10))
+
     def test_overlap_actually_pipelines(self):
         # Producer 30ms/item x6 + consumer 30ms/item x6: serial is >=360ms;
         # pipelined ideal ~210ms.  Bound at 300ms leaves ~90ms of scheduler

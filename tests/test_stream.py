@@ -1,0 +1,416 @@
+"""Streaming plan executor contracts (exec/stream.py).
+
+Four contracts:
+
+1. **Bit-identity** — per-batch mode yields exactly what ``run_plan``
+   produces on each batch (same programs, same materialization), across
+   bucket-boundary-straddling sizes, null/string columns, and empty
+   batches mid-stream; streaming combine mode's one output equals
+   ``run_plan`` over the concatenated stream.
+2. **Donation safety** — only engine-owned bucket-pad copies are ever
+   consumed; the user's tables always survive, exact-capacity binds are
+   never donated, and a donated (deleted) pad-cache entry is re-padded
+   on the next sequential run, never served.
+3. **Overlap** — on a feed with real decode latency the pipeline's wall
+   time beats the serial phase sum (overlap_ratio > 0).
+4. **Observability** — stream counters land in ``QueryMetrics.to_json()``
+   and in the registry under SRT_METRICS, and knobs parse/validate.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.exec.compile import run_plan
+from spark_rapids_tpu.obs import (bench_stream_line, counter,
+                                  last_stream_metrics, registry)
+from spark_rapids_tpu.ops import concat_tables
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+def _mk(n, seed, prefix="", hi=3):
+    r = np.random.default_rng(seed)
+    return Table.from_pydict({
+        f"{prefix}k": r.integers(0, hi, n),
+        f"{prefix}v": r.integers(0, 100, n),
+    })
+
+
+def _rowset(t: Table):
+    """Order-insensitive exact row multiset (values and nulls)."""
+    cols = [t[n].to_pylist() for n in t.names]
+    return sorted(zip(*cols), key=repr)
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity
+# ---------------------------------------------------------------------------
+
+class TestPerBatchIdentity:
+    # 60/65/89 pad to a bucket; 64/88 sit exactly on a capacity boundary
+    SIZES = [60, 64, 65, 88, 89, 1]
+
+    def test_bit_identical_across_bucket_boundaries(self):
+        p = (plan().filter(col("v") > 10)
+                   .with_columns(w=col("v") * 2)
+                   .sort_by(["v"]))
+        batches = [_mk(n, seed) for seed, n in enumerate(self.SIZES)]
+        outs = list(run_plan_stream(p, iter(batches), inflight=2))
+        assert len(outs) == len(batches)
+        for out, batch in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, batch))
+
+    def test_plan_run_stream_method(self):
+        p = plan().filter(col("v") > 50)
+        batches = [_mk(70, s) for s in range(3)]
+        outs = list(p.run_stream(iter(batches)))
+        for out, batch in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, batch))
+
+    def test_null_and_string_columns(self):
+        def batch(seed, n=75):
+            r = np.random.default_rng(seed)
+            return Table([
+                ("k", Column.from_pylist(
+                    [None if i % 11 == 0 else int(r.integers(0, 5))
+                     for i in range(n)], dt.INT64)),
+                ("v", Column.from_numpy(r.normal(size=n),
+                                        validity=r.random(n) > 0.2)),
+                ("s", Column.from_pylist(
+                    [None if i % 7 == 0 else f"s{i % 4}"
+                     for i in range(n)], dt.STRING)),
+            ])
+        p = plan().filter(col("v") > 0.0)
+        batches = [batch(s) for s in range(4)]
+        outs = list(run_plan_stream(p, iter(batches), inflight=2))
+        for out, b in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, b))
+
+    def test_empty_batch_mid_stream_preserves_order(self):
+        p = plan().with_columns(w=col("v") + 1)
+        batches = [_mk(60, 0), _mk(0, 1), _mk(70, 2)]
+        outs = list(run_plan_stream(p, iter(batches), inflight=2))
+        assert [o.num_rows for o in outs] == [60, 0, 70]
+        for out, b in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, b))
+
+    def test_zero_batches_yields_nothing(self):
+        assert list(run_plan_stream(plan().filter(col("v") > 0),
+                                    iter([]))) == []
+
+    def test_groupby_terminated_plan_per_batch(self):
+        # no domains hint -> combine="auto" falls back to per-batch mode
+        p = plan().groupby_agg(["k"], [("v", "sum", "vs")])
+        batches = [_mk(n, s) for s, n in enumerate([60, 64, 89])]
+        outs = list(run_plan_stream(p, iter(batches), inflight=2))
+        assert len(outs) == len(batches)
+        for out, b in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, b))
+
+
+# ---------------------------------------------------------------------------
+# 2. donation safety
+# ---------------------------------------------------------------------------
+
+class TestDonation:
+    # row-shaped outputs: XLA can alias the donated input buffers
+    P = plan().filter(col("v") > 10).with_columns(w=col("v") * 2)
+
+    def test_padded_copies_consumed_user_tables_survive(self):
+        batches = [_mk(100, s) for s in range(6)]     # all pad 100 -> 112
+        oracles = [run_plan(self.P, b) for b in batches]
+        outs = list(run_plan_stream(self.P, iter(batches), inflight=3))
+        qm = last_stream_metrics()
+        assert qm.stream_donation_hits == 6
+        assert qm.stream_donation_misses == 0
+        for b in batches:
+            assert not b.is_deleted()
+        for out, want in zip(outs, oracles):
+            assert_tables_equal(out, want)
+
+    def test_deleted_pad_cache_entry_is_repadded(self):
+        t = _mk(100, 7, prefix="rp_")
+        p = plan().filter(col("rp_v") > 10).with_columns(w=col("rp_v") * 2)
+        oracle = run_plan(p, t)
+        outs = list(run_plan_stream(p, iter([t]), inflight=1))
+        assert last_stream_metrics().stream_donation_hits == 1
+        assert_tables_equal(outs[0], oracle)
+        # the pad cache now holds a deleted (donated) copy for t; the
+        # sequential path must re-pad instead of serving it
+        assert_tables_equal(run_plan(p, t), oracle)
+
+    def test_same_table_object_twice(self):
+        t = _mk(100, 3)
+        oracle = run_plan(self.P, t)
+        outs = list(run_plan_stream(self.P, iter([t, t, t]), inflight=2))
+        assert len(outs) == 3
+        for out in outs:
+            assert_tables_equal(out, oracle)
+        assert not t.is_deleted()
+
+    def test_no_donation_at_exact_bucket_capacity(self):
+        # 64 rows bind at exact capacity: pad_to returns the user's table
+        # itself, so donating would destroy caller-owned buffers
+        batches = [_mk(64, s) for s in range(3)]
+        outs = list(run_plan_stream(self.P, iter(batches), inflight=2))
+        qm = last_stream_metrics()
+        assert qm.stream_donation_hits == 0
+        assert qm.stream_donation_misses == 3
+        for b in batches:
+            assert not b.is_deleted()
+        for out, b in zip(outs, batches):
+            assert_tables_equal(out, run_plan(self.P, b))
+
+    def test_agg_outputs_cannot_alias_counted_as_miss(self):
+        # a group-by program emits cells-shaped outputs, so the n-sized
+        # donated buffers are never consumed — the hit counter must not lie
+        p = plan().groupby_agg(["k"], [("v", "sum", "vs")])
+        outs = list(run_plan_stream(p, iter([_mk(100, s) for s in range(4)]),
+                                    inflight=2, combine=False))
+        qm = last_stream_metrics()
+        assert qm.stream_donation_hits == 0
+        assert qm.stream_donation_misses == 4
+        assert len(outs) == 4
+
+    def test_outputs_never_read_donated_buffers(self):
+        # with K batches in flight the donated inputs of batch N are dead
+        # while N+1..N+K dispatch over recycled HBM; every output must
+        # still equal its oracle after the whole stream drains
+        batches = [_mk(100, 40 + s) for s in range(8)]
+        oracles = [run_plan(self.P, b) for b in batches]
+        outs = list(run_plan_stream(self.P, iter(batches), inflight=4))
+        for out, want in zip(outs, oracles):
+            assert_tables_equal(out, want)
+
+    def test_inflight_depth_bounded(self):
+        batches = [_mk(100, s) for s in range(7)]
+        list(run_plan_stream(self.P, iter(batches), inflight=2))
+        qm = last_stream_metrics()
+        assert 1 <= qm.stream_peak_inflight <= 2
+
+
+# ---------------------------------------------------------------------------
+# combine mode
+# ---------------------------------------------------------------------------
+
+class TestCombine:
+    AGGS = [("v", "sum", "vs"), ("v", "count", "vc"), ("v", "mean", "vm"),
+            ("v", "min", "vlo"), ("v", "max", "vhi")]
+
+    def _plan(self):
+        return plan().groupby_agg(["k"], self.AGGS, domains={"k": (0, 2)})
+
+    def test_combine_matches_concat_oracle(self):
+        batches = [_mk(n, s) for s, n in enumerate([60, 64, 89, 100, 33])]
+        outs = list(run_plan_stream(self._plan(), iter(batches), inflight=2,
+                                    combine=True))
+        assert len(outs) == 1
+        oracle = run_plan(self._plan(), concat_tables(batches))
+        assert _rowset(outs[0]) == _rowset(oracle)
+        assert outs[0].names == oracle.names
+
+    def test_combine_with_filter_project_prefix(self):
+        p = (plan().filter(col("v") > 20)
+                   .with_columns(w=col("v") * 3)
+                   .groupby_agg(["k"], [("w", "sum", "ws"),
+                                        ("w", "var", "wv")],
+                                domains={"k": (0, 2)}))
+        batches = [_mk(n, 10 + s) for s, n in enumerate([80, 100, 64])]
+        outs = list(run_plan_stream(p, iter(batches), combine=True))
+        oracle = run_plan(p, concat_tables(batches))
+        assert _rowset(outs[0]) == _rowset(oracle)
+
+    def test_combine_bool_key_needs_no_hint(self):
+        def b(seed):
+            r = np.random.default_rng(seed)
+            return Table.from_pydict({
+                "flag": r.integers(0, 2, 90).astype(np.bool_),
+                "v": r.integers(0, 50, 90)})
+        p = plan().groupby_agg(["flag"], [("v", "sum", "vs")])
+        batches = [b(s) for s in range(3)]
+        outs = list(run_plan_stream(p, iter(batches), combine=True))
+        oracle = run_plan(p, concat_tables(batches))
+        assert _rowset(outs[0]) == _rowset(oracle)
+
+    def test_combine_with_null_keys(self):
+        def b(seed, n=77):
+            r = np.random.default_rng(seed)
+            return Table([
+                ("k", Column.from_numpy(r.integers(0, 3, n),
+                                        validity=r.random(n) > 0.2)),
+                ("v", Column.from_numpy(r.integers(0, 9, n)))])
+        p = plan().groupby_agg(["k"], [("v", "sum", "vs")],
+                               domains={"k": (0, 2)})
+        batches = [b(s) for s in range(4)]
+        outs = list(run_plan_stream(p, iter(batches), combine=True))
+        oracle = run_plan(p, concat_tables(batches))
+        assert _rowset(outs[0]) == _rowset(oracle)
+
+    def test_combine_empty_batches(self):
+        batches = [_mk(0, 0), _mk(80, 1), _mk(0, 2), _mk(64, 3), _mk(0, 4)]
+        outs = list(run_plan_stream(self._plan(), iter(batches),
+                                    combine=True))
+        assert len(outs) == 1
+        oracle = run_plan(self._plan(),
+                          concat_tables([b for b in batches if b.num_rows]))
+        assert _rowset(outs[0]) == _rowset(oracle)
+
+    def test_combine_all_empty_stream(self):
+        outs = list(run_plan_stream(self._plan(), iter([_mk(0, 0)]),
+                                    combine=True))
+        assert len(outs) == 1
+        assert outs[0].num_rows == 0
+
+    def test_strict_raises_on_non_groupby_plan(self):
+        p = plan().sort_by(["v"])
+        with pytest.raises(TypeError, match="does not end in a group-by"):
+            run_plan_stream(p, iter([]), combine=True)
+
+    def test_strict_raises_without_static_domain(self):
+        p = plan().groupby_agg(["k"], [("v", "sum", "vs")])  # no hint
+        it = run_plan_stream(p, iter([_mk(60, 0)]), combine=True)
+        with pytest.raises(TypeError, match="static domain"):
+            list(it)
+
+    def test_auto_falls_back_to_per_batch(self):
+        p = plan().groupby_agg(["k"], [("v", "sum", "vs")])  # no hint
+        batches = [_mk(60, s) for s in range(3)]
+        outs = list(run_plan_stream(p, iter(batches), combine="auto"))
+        assert len(outs) == 3
+        for out, b in zip(outs, batches):
+            assert_tables_equal(out, run_plan(p, b))
+
+    def test_combine_false_forces_per_batch(self):
+        batches = [_mk(60, s) for s in range(2)]
+        outs = list(run_plan_stream(self._plan(), iter(batches),
+                                    combine=False))
+        assert len(outs) == 2
+
+
+# ---------------------------------------------------------------------------
+# 3. overlap on a delayed feed
+# ---------------------------------------------------------------------------
+
+class TestOverlap:
+    def test_overlap_ratio_positive_with_prefetch(self):
+        # fresh column names force a compile miss, so the stream overlaps
+        # real work (compile + dispatch) with the feed's decode latency
+        p = (plan().filter(col("ov_v") > 10)
+                   .with_columns(ov_w=col("ov_v") * 2))
+
+        def feed():
+            for i in range(8):
+                time.sleep(0.02)
+                yield _mk(100, i, prefix="ov_")
+
+        outs = list(run_plan_stream(p, feed(), inflight=3, prefetch=4))
+        assert len(outs) == 8
+        qm = last_stream_metrics()
+        assert qm.stream_source_seconds > 0.1
+        assert qm.stream_overlap_ratio > 0
+        assert qm.total_seconds < qm.stream_serial_seconds
+
+    def test_abandoned_stream_shuts_down_prefetch(self):
+        p = plan().filter(col("v") > 0)
+
+        def feed():
+            for i in range(1000):
+                yield _mk(60, i)
+
+        it = run_plan_stream(p, feed(), inflight=1, prefetch=1)
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if not [t for t in threading.enumerate()
+                    if t.name == "srt-prefetch"]:
+                break
+            time.sleep(0.01)
+        assert not [t for t in threading.enumerate()
+                    if t.name == "srt-prefetch"]
+
+
+# ---------------------------------------------------------------------------
+# 4. observability + knobs
+# ---------------------------------------------------------------------------
+
+class TestStreamMetrics:
+    P = plan().filter(col("v") > 10).with_columns(w=col("v") * 2)
+
+    def test_stream_block_in_to_json(self):
+        import json
+        batches = [_mk(100, s) for s in range(5)]
+        list(run_plan_stream(self.P, iter(batches), inflight=2))
+        payload = json.loads(last_stream_metrics().to_json())
+        assert payload["mode"] == "stream"
+        assert payload["schema_version"] == 2
+        s = payload["stream"]
+        assert s["batches"] == 5
+        assert s["inflight"] == 2
+        assert 1 <= s["peak_inflight"] <= 2
+        assert s["donation_hits"] == 5
+        assert s["donation_misses"] == 0
+        assert s["serial_seconds"] >= 0
+
+    def test_registry_counters_fire(self, metrics_on):
+        batches = [_mk(100, s) for s in range(4)]
+        list(run_plan_stream(self.P, iter(batches), inflight=2))
+        assert counter("stream.batches").value >= 4
+        assert counter("stream.donation.hit").value >= 4
+
+    def test_bench_stream_line(self):
+        import json
+        list(run_plan_stream(self.P, iter([_mk(100, 0)])))
+        line = json.loads(bench_stream_line())
+        assert line["metric"] == "stream_exec"
+        assert line["runs"] == 1
+        assert line["batches"] == 1
+        assert "overlap_ratio" in line and "donation_hits" in line
+
+
+class TestKnobs:
+    def test_stream_inflight_default_and_env(self, monkeypatch):
+        from spark_rapids_tpu.config import stream_inflight
+        monkeypatch.delenv("SRT_STREAM_INFLIGHT", raising=False)
+        assert stream_inflight() == 2
+        monkeypatch.setenv("SRT_STREAM_INFLIGHT", "5")
+        assert stream_inflight() == 5
+        monkeypatch.setenv("SRT_STREAM_INFLIGHT", "0")
+        with pytest.raises(ValueError):
+            stream_inflight()
+
+    def test_prefetch_depth_default_and_env(self, monkeypatch):
+        from spark_rapids_tpu.config import prefetch_depth
+        monkeypatch.delenv("SRT_PREFETCH_DEPTH", raising=False)
+        assert prefetch_depth() == 2
+        monkeypatch.setenv("SRT_PREFETCH_DEPTH", "7")
+        assert prefetch_depth() == 7
+        monkeypatch.setenv("SRT_PREFETCH_DEPTH", "-1")
+        with pytest.raises(ValueError):
+            prefetch_depth()
+
+    def test_inflight_env_reaches_stream(self, monkeypatch):
+        monkeypatch.setenv("SRT_STREAM_INFLIGHT", "3")
+        p = plan().filter(col("v") > 0)
+        list(run_plan_stream(p, iter([_mk(60, s) for s in range(2)])))
+        assert last_stream_metrics().stream_inflight == 3
+
+    @pytest.mark.parametrize("kwargs", [
+        {"inflight": 0}, {"inflight": "2"}, {"combine": "always"},
+        {"prefetch": 0}, {"prefetch": -3},
+    ])
+    def test_bad_arguments_raise_eagerly(self, kwargs):
+        with pytest.raises(ValueError):
+            run_plan_stream(plan().filter(col("v") > 0), iter([]), **kwargs)
