@@ -7,6 +7,12 @@ with the tunnel-safe protocol from BASELINE.md (chained data dependencies,
 host-read fencing, exact-composition warmup).
 
 Run: python benchmarks/bench_queries.py
+
+``--faults`` additionally arms a deterministic HBM-OOM injection
+(``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec) and
+appends a ``recovery`` JSON line (retries / splits / evictions /
+backoff / faults injected) — the bench-trajectory proof that the
+resilience ladder engages and costs what it claims.
 """
 
 from __future__ import annotations
@@ -109,6 +115,9 @@ def main():
         from spark_rapids_tpu.obs import bench_cache_line, bench_metrics_line
         print(bench_metrics_line())
         print(bench_cache_line())
+    if "--faults" in sys.argv:
+        from spark_rapids_tpu.obs import bench_recovery_line
+        print(bench_recovery_line())
 
 
 def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
@@ -230,4 +239,7 @@ def bench_plans(lineitem, fact, dim):
 
 
 if __name__ == "__main__":
+    if "--faults" in sys.argv:
+        import os
+        os.environ.setdefault("SRT_FAULT", "oom:materialize:1")
     main()

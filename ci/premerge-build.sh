@@ -40,6 +40,13 @@ fi
 # set SRT_TEST_PLATFORM to run the same tests on real hardware).
 python -m pytest tests/ -q
 
+# Faulted smoke lane: rerun the fault-injection goldens with a live
+# HBM-OOM injection armed process-wide — proves the recovery ladder
+# engages outside the tests' own monkeypatching (counters asserted
+# non-zero, results asserted equal to the no-fault goldens).
+SRT_FAULT="oom:materialize:1" SRT_METRICS=1 \
+python -m pytest tests/test_resilience.py -m faulted -q
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

@@ -42,6 +42,22 @@ Knobs (all optional):
                                the streaming executor (exec/stream.py,
                                default 2).
   ``SRT_CPP_PARALLEL_LEVEL``   native build parallelism (``CPP_PARALLEL_LEVEL``).
+  ``SRT_RETRY_MAX``            retry budget for the resilience layer
+                               (resilience/): re-attempts after a
+                               retryable failure (default 3, 0 disables).
+  ``SRT_RETRY_BACKOFF``        base backoff seconds between retries,
+                               doubled per attempt and capped (default
+                               0.05; 0 retries immediately).
+  ``SRT_SHUFFLE_RETRY_MAX``    overflow re-attempts of the mesh shuffle
+                               before ``ShuffleOverflowError`` (default 3).
+  ``SRT_STREAM_TIMEOUT``       IO-feed stall watchdog in seconds: raise
+                               ``StreamStallError`` when the source
+                               produces nothing for this long (unset/0 =
+                               no watchdog).
+  ``SRT_FAULT``                deterministic fault injection spec
+                               (resilience/faults.py), e.g.
+                               ``oom:materialize:2`` or
+                               ``io:read:0.5:seed=7``; unset = no faults.
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -235,6 +251,76 @@ def stream_inflight() -> int:
     return val
 
 
+def retry_max() -> int:
+    """Retry budget for the resilience layer (resilience/retry.py): how
+    many RE-attempts follow a retryable failure (OOM after a cache evict,
+    transient IO).  0 disables retries entirely — the first error
+    surfaces.  Tune with ``SRT_RETRY_MAX`` (>= 0, default 3)."""
+    raw = os.environ.get("SRT_RETRY_MAX")
+    if raw is None:
+        return 3
+    val = int(raw)
+    if val < 0:
+        raise ValueError(f"SRT_RETRY_MAX must be >= 0, got {val}")
+    return val
+
+
+def retry_backoff() -> float:
+    """Base backoff between retries in seconds, doubled per attempt and
+    capped (resilience/retry.RetryPolicy).  0 retries immediately — what
+    the test suite uses so fault-injected recovery paths run at full
+    speed.  Tune with ``SRT_RETRY_BACKOFF`` (>= 0, default 0.05)."""
+    raw = os.environ.get("SRT_RETRY_BACKOFF")
+    if raw is None:
+        return 0.05
+    val = float(raw)
+    if val < 0:
+        raise ValueError(f"SRT_RETRY_BACKOFF must be >= 0, got {val}")
+    return val
+
+
+def shuffle_retry_max() -> int:
+    """Bucket-overflow re-attempts of the mesh shuffle
+    (parallel/shuffle.py) before it raises ``ShuffleOverflowError``.
+    Each retry steps ``bucket_size`` up the shared geometric bucket
+    schedule, jumping at least to the observed max-bucket occupancy.
+    Tune with ``SRT_SHUFFLE_RETRY_MAX`` (>= 0, default 3)."""
+    raw = os.environ.get("SRT_SHUFFLE_RETRY_MAX")
+    if raw is None:
+        return 3
+    val = int(raw)
+    if val < 0:
+        raise ValueError(f"SRT_SHUFFLE_RETRY_MAX must be >= 0, got {val}")
+    return val
+
+
+def stream_timeout() -> float | None:
+    """IO-feed stall watchdog window in seconds, or None when disabled.
+
+    When set, ``io.feed.prefetch`` raises ``StreamStallError`` if the
+    source iterator produces nothing for this long while the consumer
+    waits — a stream that would otherwise hang forever surfaces a
+    descriptive error instead.  Tune with ``SRT_STREAM_TIMEOUT`` (> 0
+    seconds; unset/``0``/``off`` disables)."""
+    raw = os.environ.get("SRT_STREAM_TIMEOUT")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    val = float(raw)
+    if val <= 0:
+        raise ValueError(
+            f"SRT_STREAM_TIMEOUT must be > 0 seconds (or 0/off), got {val}")
+    return val
+
+
+def fault_spec() -> str | None:
+    """The raw ``SRT_FAULT`` injection spec (resilience/faults.py parses
+    and arms it), or None when no faults are configured."""
+    return os.environ.get("SRT_FAULT") or None
+
+
 def native_lib_override() -> str | None:
     """Explicit native-library path, or None for the packaged/dev build."""
     return os.environ.get("SPARK_RAPIDS_TPU_NATIVE_LIB") or None
@@ -283,5 +369,7 @@ def knob_table() -> dict[str, str]:
              "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
              "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",
              "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP",
-             "SRT_PREFETCH_DEPTH", "SRT_STREAM_INFLIGHT")
+             "SRT_PREFETCH_DEPTH", "SRT_STREAM_INFLIGHT",
+             "SRT_RETRY_MAX", "SRT_RETRY_BACKOFF",
+             "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT")
     return {n: os.environ.get(n, "<default>") for n in names}

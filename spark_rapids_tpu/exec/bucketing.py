@@ -189,6 +189,20 @@ def _record(capacity: int, n: int) -> None:
     gauge("plan.bucket.distinct_capacities").set(len(_SHAPES_SEEN))
 
 
+def clear_pad_cache() -> int:
+    """Drop every memoized padded copy, returning the entry count.
+
+    The pad cache holds full device-resident copies of recently bound
+    tables — after the program cache it is the engine's largest HBM
+    retainer, so the OOM recovery ladder (resilience/recovery.py) clears
+    it before every retry.  ``_SHAPES_SEEN`` survives: it is host-side
+    accounting, not device memory, and the recompiles-avoided gauge must
+    keep its process-lifetime meaning across recoveries."""
+    dropped = len(_PAD_CACHE)
+    _PAD_CACHE.clear()
+    return dropped
+
+
 def recompiles_avoided() -> int:
     """Distinct input lengths absorbed into already-seen buckets over the
     process lifetime — each is one whole-plan XLA compile the exact-shape

@@ -1668,53 +1668,186 @@ def run_plan(plan: Plan, table: Table) -> Table:
     from ..config import metrics_enabled
     if metrics_enabled():
         return _run_plan_metered(plan, table)[0]
-    bound = _bind(plan, table)
-    fn = _compiled_for(bound)
-    out_cols, sel = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
-    return materialize(bound, out_cols, sel)
+    return _execute_resilient(plan, table)
 
 
 def _run_plan_metered(plan: Plan, table: Table):
     """run_plan with QueryMetrics accounting (``SRT_METRICS=1``): phase
-    wall times, compile-cache status, registry counter deltas.  The
-    program invocation is explicitly blocked on (jax.block_until_ready)
-    so execute_seconds means device wall, not dispatch latency — a
-    measurement barrier the unmetered path does not pay, which is why
-    this is a separate function and not inline ifs."""
+    wall times, compile-cache status, registry counter deltas, and the
+    recovery block (retries / splits / cache evictions — resilience/).
+    The program invocation is explicitly blocked on
+    (jax.block_until_ready) so execute_seconds means device wall, not
+    dispatch latency — a measurement barrier the unmetered path does not
+    pay, which is why metering is a flag into the shared resilient core
+    and not inline ifs at every call site."""
     import time as _time
     from ..obs.metrics import counters_delta, registry
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
+    from ..resilience import recovery_stats
     qm = QueryMetrics(query_id=next_query_id(), mode="run",
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
+    r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
-    bound = _bind(plan, table)
-    qm.bind_seconds = _time.perf_counter() - t_all
-    qm.compile_cache = ("hit" if bound.signature() in _COMPILED
-                        else "miss")
-    fn = _compiled_for(bound)
-    t0 = _time.perf_counter()
-    out_cols, sel = jax.block_until_ready(
-        fn(bound.exec_cols, bound.side_inputs, bound.init_sel))
-    qm.execute_seconds = _time.perf_counter() - t0
-    if qm.compile_cache == "miss":
-        qm.compile_seconds = qm.execute_seconds
-    t0 = _time.perf_counter()
-    t = materialize(bound, out_cols, sel)
-    qm.materialize_seconds = _time.perf_counter() - t0
+    t = _execute_resilient(plan, table, qm=qm)
     qm.total_seconds = _time.perf_counter() - t_all
     qm.output_rows = t.num_rows
-    qm.steps = _static_step_metrics(bound)
     qm.finish_counters(counters_delta(before))
+    qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)
     return t, qm
+
+
+def _execute_resilient(plan: Plan, table: Table, qm=None,
+                       depth: int = 0) -> Table:
+    """bind → dispatch → materialize under the HBM-OOM recovery ladder.
+
+    Each phase runs inside ``resilience.recovery.oom_ladder`` (evict the
+    program + pad caches, backoff, retry — bounded by ``SRT_RETRY_MAX``);
+    when dispatch or materialize stays OOM past the budget the batch is
+    split in half along rows (:func:`_split_batch`) and the pieces rerun
+    through this same function.  ``qm`` switches on phase metering
+    (blocking the invocation so execute_seconds is device wall).  The
+    named fault sites (``bind``, ``dispatch``, ``materialize``) let
+    ``SRT_FAULT`` provoke every path deterministically on CPU."""
+    import time as _time
+    from ..resilience import fault_point
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+
+    def do_bind():
+        fault_point("bind")
+        return _bind(plan, table)
+
+    t0 = _time.perf_counter()
+    bound = oom_ladder("bind", do_bind)
+    if qm is not None:
+        qm.bind_seconds += _time.perf_counter() - t0
+        qm.compile_cache = ("hit" if bound.signature() in _COMPILED
+                            else "miss")
+        qm.steps = _static_step_metrics(bound)
+
+    def do_dispatch():
+        fault_point("dispatch")
+        fn = _compiled_for(bound)
+        out = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
+        if qm is not None:
+            out = jax.block_until_ready(out)
+        return out
+
+    try:
+        t0 = _time.perf_counter()
+        out_cols, sel = oom_ladder("dispatch", do_dispatch)
+        if qm is not None:
+            qm.execute_seconds += _time.perf_counter() - t0
+            if qm.compile_cache == "miss":
+                qm.compile_seconds = qm.execute_seconds
+        t0 = _time.perf_counter()
+        t = oom_ladder("materialize",
+                       lambda: materialize(bound, out_cols, sel))
+        if qm is not None:
+            qm.materialize_seconds += _time.perf_counter() - t0
+        return t
+    except ExecutionRecoveryError as err:
+        # Last rung: split the batch along rows and re-run the pieces.
+        if err.category != "oom":
+            raise
+        try:
+            return _split_batch(plan, table, qm, depth)
+        except SplitUnavailable as unavailable:
+            err.add_step(f"split-unavailable: {unavailable}")
+            raise err
+
+
+def _split_mode(plan: Plan):
+    """How a split batch's piece results recombine: ``"concat"`` for
+    row-local plans (every step maps rows independently, so outputs
+    concatenate), ``"combine"`` for stream-combinable group-by plans
+    (pieces partial-aggregate and merge cell-wise), None when splitting
+    cannot preserve semantics (sort/limit/window/non-combinable agg)."""
+    steps = plan.steps
+    if all(isinstance(s, (FilterStep, ProjectStep, JoinStep))
+           for s in steps):
+        return "concat"
+    from .stream import combine_obstacles
+    if not combine_obstacles(plan):
+        return "combine"
+    return None
+
+
+def _split_batch(plan: Plan, table: Table, qm, depth: int) -> Table:
+    """The recovery ladder's split rung: halve ``table`` along rows —
+    with the cut snapped to the bucket schedule so both pieces land in
+    already-compiled buckets — and re-run the pieces.  Row-local plans
+    concatenate piece outputs; stream-combinable group-bys merge piece
+    accumulators (bit-identical grouping, one final materialize).  Raises
+    ``SplitUnavailable`` when the plan or batch cannot split."""
+    from ..resilience import recovery_stats
+    from ..resilience.recovery import MAX_SPLIT_DEPTH, SplitUnavailable
+    n = table.num_rows
+    if depth >= MAX_SPLIT_DEPTH:
+        raise SplitUnavailable(
+            f"split depth {depth} reached (MAX_SPLIT_DEPTH="
+            f"{MAX_SPLIT_DEPTH}); the OOM is not batch-size-driven")
+    if n < 2:
+        raise SplitUnavailable(f"batch of {n} row(s) cannot split")
+    mode = _split_mode(plan)
+    if mode is None:
+        raise SplitUnavailable(
+            "plan is neither row-local nor stream-combinable (sort/"
+            "limit/window or a non-combinable aggregation blocks "
+            "piecewise re-execution)")
+    from .bucketing import bucket_capacity
+    cut = min(bucket_capacity((n + 1) // 2), n - 1)
+    recovery_stats().add_split()
+    from ..obs.metrics import counter
+    counter("recovery.split_rows").inc(n)
+    pieces = (table.gather(jnp.arange(0, cut, dtype=jnp.int32)),
+              table.gather(jnp.arange(cut, n, dtype=jnp.int32)))
+    if mode == "concat":
+        from ..ops.common import concat_tables
+        return concat_tables([_execute_resilient(plan, piece, qm=qm,
+                                                 depth=depth + 1)
+                              for piece in pieces])
+    return _split_combine(plan, pieces, qm, depth)
+
+
+def _split_combine(plan: Plan, pieces, qm, depth: int) -> Table:
+    """Recombine split pieces of a group-by plan through the streaming
+    partial-aggregate machinery: each piece folds into a dense
+    accumulator under ONE batch-invariant cell layout, accumulators
+    merge cell-wise, and a single finalize materializes — the same
+    carry-preserving path ``run_plan_stream`` uses, so grouping is
+    independent of where the split landed."""
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+    from .stream import _combine_setup
+    smeta = dtypes = bound0 = total = None
+    for piece in pieces:
+        bound = oom_ladder("bind", lambda p=piece: _bind(plan, p))
+        if smeta is None:
+            try:
+                smeta, dtypes = _combine_setup(bound)
+            except TypeError as exc:
+                raise SplitUnavailable(
+                    f"no batch-invariant accumulator layout: {exc}"
+                ) from exc
+            bound0 = bound
+        def do_partial(b=bound):
+            fn, _ = compiled_stream_partial(b, smeta, donate=False)
+            return fn(b.exec_cols, b.side_inputs, b.init_sel)
+        acc = oom_ladder("dispatch", do_partial)
+        total = acc if total is None else stream_combine()(total, acc)
+    return oom_ladder("materialize",
+                      lambda: stream_finalize(bound0, smeta, total, dtypes))
 
 
 def materialize(bound: _Bound, out_cols: dict[str, Column], sel) -> Table:
     """Compact padded program outputs (ONE host sync when ``sel`` is set)
     and rebuild the user-visible table."""
+    from ..resilience import fault_point
+    fault_point("materialize")
     if sel is None:
         return _rebuild(bound, out_cols)
     from ..ops.common import pow2_bucket

@@ -200,6 +200,13 @@ class Plan:
         must cover the key's actual values: rows outside the hinted range
         belong to no group and are dropped (never aliased into another
         cell).
+
+        Static domains also make the plan *stream-combinable* — batches
+        share one accumulator layout (exec/stream.py) — which doubles as
+        the OOM-recovery split path: a batch too large for HBM can be
+        halved and its pieces' partial aggregates merged bit-identically
+        (resilience/).  Probe-derived domains are per-batch and get
+        neither.
         """
         keys = tuple(keys)
         for _, how, _ in aggs:
@@ -406,7 +413,17 @@ class Plan:
     def run(self, table: Table) -> Table:
         """Execute against ``table``: one device program, then one host
         sync to slice data-dependent output sizes (zero syncs when every
-        output size is static)."""
+        output size is static).
+
+        Execution is resilient to device memory exhaustion: an HBM
+        ``RESOURCE_EXHAUSTED`` during dispatch or materialize evicts the
+        engine's device caches and retries with backoff
+        (``SRT_RETRY_MAX``/``SRT_RETRY_BACKOFF``), and — when the plan is
+        row-local or stream-combinable — splits the batch in half along
+        the bucket schedule as a last resort, recombining pieces so the
+        result is identical to the unsplit run (see
+        :mod:`spark_rapids_tpu.resilience`).  Unrecoverable failures raise
+        ``ExecutionRecoveryError`` chained to the original error."""
         from .compile import run_plan
         return run_plan(self, table)
 

@@ -243,8 +243,10 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
 def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
     from ..config import metrics_enabled
     from ..obs.metrics import counter, counters_delta, gauge, registry
+    from ..resilience import recovery_stats
 
     acct = _Account()
+    r_before = recovery_stats().snapshot()
     feed = _timed_source(batches, acct)
     if prefetch is not False:
         from ..io.feed import prefetch as _prefetch
@@ -298,6 +300,7 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
     qm.stream_serial_seconds = serial
     qm.stream_overlap_ratio = overlap
     qm.finish_counters(counters_delta(before))
+    qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_stream_metrics(qm)
 
 
@@ -306,21 +309,43 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
     entry only once more than ``k`` are in flight — by then its device
     work has had the longest time to finish, so the materialize host sync
     waits least.  Empty batches ride the deque as ready results to keep
-    output order equal to input order."""
+    output order equal to input order.
+
+    Every phase runs under the HBM-OOM recovery ladder.  Recovery at
+    dispatch first DRAINS the in-flight window (materializing pending
+    batches frees their pinned output buffers — the stream's cheapest
+    memory), then evicts caches and retries; if the batch still OOMs it
+    is split via ``compile._split_batch`` and its pieces' output rides
+    the deque as a ready result, so output order — and therefore the
+    yielded stream — is bit-identical to a no-fault run."""
     from ..obs.metrics import counter, gauge
-    from .compile import (_bind, _compiled_for, compiled_stream_for,
-                          materialize, run_plan_eager)
+    from ..resilience import fault_point
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+    from .compile import (_bind, _compiled_for, _split_batch,
+                          compiled_stream_for, materialize, run_plan_eager)
 
     pending: deque = deque()    # ("exec", bound, out_cols, sel) | ("ready", t)
     inflight_gauge = gauge("stream.inflight_depth")
+
+    def materialize_entry(idx_or_entry):
+        _, bound, out_cols, sel = idx_or_entry
+        return oom_ladder("materialize",
+                          lambda: materialize(bound, out_cols, sel))
+
+    def drain_inflight():
+        """Recovery hook: turn every pending dispatch into a ready
+        Table in place, releasing its device output buffers."""
+        for i, entry in enumerate(pending):
+            if entry[0] == "exec":
+                pending[i] = ("ready", materialize_entry(entry))
 
     def drain_oldest():
         entry = pending.popleft()
         if entry[0] == "ready":
             return entry[1]
-        _, bound, out_cols, sel = entry
         t0 = _time.perf_counter()
-        out = materialize(bound, out_cols, sel)
+        out = materialize_entry(entry)
         acct.mat_s += _time.perf_counter() - t0
         return out
 
@@ -329,25 +354,49 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
             pending.append(("ready", run_plan_eager(plan, batch)))
         else:
             t0 = _time.perf_counter()
-            bound = _bind(plan, batch)
+            bound_holder = [oom_ladder(
+                "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
+                drain=drain_inflight)]
             acct.bind_s += _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            if _donatable(bound):
-                fn, _ = compiled_stream_for(bound)
-                (out_cols, sel), reclaimed = _dispatch_donated(fn, bound)
-            else:
-                reclaimed = False
+
+            def do_dispatch():
+                fault_point("dispatch")
+                bound = bound_holder[0]
+                # A prior attempt may have donated (and lost) this
+                # binding's padded buffers — rebind from the user's
+                # table, which is never donated.
+                if any(c.is_deleted() for c in bound.exec_cols.values()):
+                    bound = bound_holder[0] = _bind(plan, batch)
+                if _donatable(bound):
+                    fn, _ = compiled_stream_for(bound)
+                    return _dispatch_donated(fn, bound)
                 fn = _compiled_for(bound)
-                out_cols, sel = fn(bound.exec_cols, bound.side_inputs,
-                                   bound.init_sel)
-            if reclaimed:
-                acct.donation_hits += 1
-                counter("stream.donation.hit").inc()
+                return (fn(bound.exec_cols, bound.side_inputs,
+                           bound.init_sel), False)
+
+            t0 = _time.perf_counter()
+            try:
+                (out_cols, sel), reclaimed = oom_ladder(
+                    "dispatch", do_dispatch, drain=drain_inflight)
+            except ExecutionRecoveryError as err:
+                if err.category != "oom":
+                    raise
+                try:    # last rung: split the batch, ride as ready
+                    pending.append(
+                        ("ready", _split_batch(plan, batch, None, 0)))
+                except SplitUnavailable as unavailable:
+                    err.add_step(f"split-unavailable: {unavailable}")
+                    raise err
+                acct.dispatch_s += _time.perf_counter() - t0
             else:
-                acct.donation_misses += 1
-                counter("stream.donation.miss").inc()
-            acct.dispatch_s += _time.perf_counter() - t0
-            pending.append(("exec", bound, out_cols, sel))
+                if reclaimed:
+                    acct.donation_hits += 1
+                    counter("stream.donation.hit").inc()
+                else:
+                    acct.donation_misses += 1
+                    counter("stream.donation.miss").inc()
+                acct.dispatch_s += _time.perf_counter() - t0
+                pending.append(("exec", bound_holder[0], out_cols, sel))
         while len(pending) > k:
             yield drain_oldest()
         depth = sum(1 for e in pending if e[0] == "exec")
@@ -371,6 +420,9 @@ def _drive_combine(plan, source, k: int, acct: _Account,
     import jax
 
     from ..obs.metrics import counter, gauge
+    from ..resilience import fault_point
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
     from .compile import (_bind, compiled_stream_partial, run_plan_eager,
                           stream_combine, stream_finalize)
 
@@ -381,6 +433,42 @@ def _drive_combine(plan, source, k: int, acct: _Account,
     since_block = 0
     inflight_gauge = gauge("stream.inflight_depth")
 
+    def drain_levels():
+        """Recovery hook: force the whole accumulator tree to finish so
+        its transient dispatch scratch frees before a retry."""
+        for lv in levels:
+            if lv is not None:
+                jax.block_until_ready(lv)
+
+    def split_partial(batch):
+        """Last recovery rung for a combine-mode batch: halve it (cut
+        snapped to the bucket schedule), partial-aggregate each piece
+        without donation, and merge into the ONE accumulator the batch
+        would have produced — so the binomial-tree carry downstream is
+        identical to a no-fault run."""
+        import jax.numpy as jnp
+
+        from ..resilience import recovery_stats
+        from .bucketing import bucket_capacity
+        n = batch.num_rows
+        if n < 2:
+            raise SplitUnavailable(f"batch of {n} row(s) cannot split")
+        cut = min(bucket_capacity((n + 1) // 2), n - 1)
+        recovery_stats().add_split()
+        accs = []
+        for lo, hi in ((0, cut), (cut, n)):
+            piece = batch.gather(jnp.arange(lo, hi, dtype=jnp.int32))
+            b = oom_ladder("bind", lambda p=piece: _bind(plan, p),
+                           drain=drain_levels)
+
+            def do_piece(b=b):
+                fn, _ = compiled_stream_partial(b, smeta, False)
+                return fn(b.exec_cols, b.side_inputs, b.init_sel)
+
+            accs.append(oom_ladder("dispatch", do_piece,
+                                   drain=drain_levels))
+        return stream_combine()(accs[0], accs[1])
+
     for batch in source:
         if smeta is None:
             consumed.append(batch)
@@ -388,11 +476,13 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             last_empty = batch          # contributes no groups
             continue
         t0 = _time.perf_counter()
-        bound = _bind(plan, batch)
+        bound_holder = [oom_ladder(
+            "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
+            drain=drain_levels)]
         acct.bind_s += _time.perf_counter() - t0
         if smeta is None:
             try:
-                smeta, dtypes = _combine_setup(bound)
+                smeta, dtypes = _combine_setup(bound_holder[0])
             except TypeError:
                 if strict:
                     raise
@@ -402,16 +492,36 @@ def _drive_combine(plan, source, k: int, acct: _Account,
                 yield from _drive_batches(
                     plan, _chain_batches(consumed, source), k, acct)
                 return
-            bound0 = bound
+            bound0 = bound_holder[0]
             consumed.clear()
-        donate = _donatable(bound)
+
+        def do_partial():
+            fault_point("dispatch")
+            bound = bound_holder[0]
+            # A prior attempt may have donated (and lost) this binding's
+            # padded buffers — rebind from the user's table.
+            if any(c.is_deleted() for c in bound.exec_cols.values()):
+                bound = bound_holder[0] = _bind(plan, batch)
+            donate = _donatable(bound)
+            fn, _ = compiled_stream_partial(bound, smeta, donate)
+            if donate:
+                return _dispatch_donated(fn, bound)
+            return (fn(bound.exec_cols, bound.side_inputs,
+                       bound.init_sel), False)
+
         t0 = _time.perf_counter()
-        fn, _ = compiled_stream_partial(bound, smeta, donate)
-        if donate:
-            acc, reclaimed = _dispatch_donated(fn, bound)
-        else:
+        try:
+            acc, reclaimed = oom_ladder("dispatch", do_partial,
+                                        drain=drain_levels)
+        except ExecutionRecoveryError as err:
+            if err.category != "oom":
+                raise
+            try:
+                acc = split_partial(batch)
+            except SplitUnavailable as unavailable:
+                err.add_step(f"split-unavailable: {unavailable}")
+                raise err
             reclaimed = False
-            acc = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
         if reclaimed:
             acct.donation_hits += 1
             counter("stream.donation.hit").inc()
@@ -421,7 +531,12 @@ def _drive_combine(plan, source, k: int, acct: _Account,
         merge = stream_combine()
         i = 0
         while i < len(levels) and levels[i] is not None:
-            acc = merge(levels[i], acc)
+            lv, acc_in = levels[i], acc
+            acc = oom_ladder(
+                "stream-combine",
+                lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
+                                         merge(lv, a))[1],
+                drain=drain_levels)
             levels[i] = None
             i += 1
         if i == len(levels):
@@ -446,9 +561,18 @@ def _drive_combine(plan, source, k: int, acct: _Account,
     for lv in levels:
         if lv is None:
             continue
-        total = lv if total is None else merge(total, lv)
+        if total is None:
+            total = lv
+            continue
+        t, l = total, lv
+        total = oom_ladder(
+            "stream-combine",
+            lambda t=t, l=l: (fault_point("stream-combine"),
+                              merge(t, l))[1])
     t0 = _time.perf_counter()
-    out = stream_finalize(bound0, smeta, total, dtypes)
+    out = oom_ladder(
+        "materialize",
+        lambda: stream_finalize(bound0, smeta, total, dtypes))
     acct.mat_s += _time.perf_counter() - t0
     yield out
 

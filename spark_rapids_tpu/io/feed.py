@@ -84,10 +84,30 @@ def prefetch(iterable: Iterable, depth: Optional[int] = None,
                               name="srt-prefetch")
 
     def generator():
+        from ..config import stream_timeout
         thread.start()
         try:
             while True:
-                item = q.get()
+                timeout = stream_timeout()
+                if timeout is None:
+                    item = q.get()
+                else:
+                    # Stall watchdog (SRT_STREAM_TIMEOUT): a producer
+                    # wedged in IO leaves q.get() blocked forever; bound
+                    # the wait so the pipeline fails loudly instead.
+                    deadline = _time.monotonic() + timeout
+                    while True:
+                        try:
+                            item = q.get(timeout=0.05)
+                            break
+                        except queue.Empty:
+                            if _time.monotonic() >= deadline:
+                                from ..resilience import StreamStallError
+                                raise StreamStallError(
+                                    f"prefetch source produced nothing "
+                                    f"for {timeout:.1f}s "
+                                    f"(SRT_STREAM_TIMEOUT); worker "
+                                    f"alive={thread.is_alive()}")
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):
@@ -120,6 +140,24 @@ def _arrow_row_group(path, i, columns):
         i, columns=list(columns) if columns is not None else None))
 
 
+def _read_retry(fn, site: str = "read"):
+    """Run one row-group read/decode under the transient-IO retry policy
+    (resilience.with_retries, ``SRT_RETRY_MAX``/``SRT_RETRY_BACKOFF``).
+    Only IO-classified errors retry — decode bugs and missing files
+    surface on the first raise — and exhaustion re-raises the ORIGINAL
+    exception (worker-side traceback and chain intact) with the
+    attempted-recovery summary attached.  ``site`` is the fault-injection
+    hook: ``SRT_FAULT=io:read:...`` flakes exactly here."""
+    from ..resilience import fault_point, with_retries
+    from ..resilience.classify import CATEGORY_IO
+
+    def attempt():
+        fault_point(site)
+        return fn()
+
+    return with_retries(attempt, retryable=(CATEGORY_IO,), site=site)
+
+
 def _row_group_reader(path, columns):
     """Yield one decoded device Table per row group of one file.
 
@@ -137,7 +175,8 @@ def _row_group_reader(path, columns):
     except NotImplementedError:
         import pyarrow.parquet as pq
         for i in range(pq.ParquetFile(path).num_row_groups):
-            yield _arrow_row_group(path, i, columns)
+            yield _read_retry(
+                lambda i=i: _arrow_row_group(path, i, columns))
         return
 
     want = list(columns) if columns is not None else [c.name for c in cols]
@@ -146,7 +185,7 @@ def _row_group_reader(path, columns):
         raise KeyError(f"columns not in file: {sorted(missing)}")
     with open(path, "rb") as f:
         for i, rg in enumerate(row_groups):
-            try:
+            def decode_group(i=i, rg=rg):
                 by_name = {}
                 for chunk in rg:
                     if chunk.column.name in want:
@@ -157,9 +196,14 @@ def _row_group_reader(path, columns):
                         # a stream hands each group on as it decodes).
                         by_name[chunk.column.name] = _materialize_piece(
                             _decode_chunk(raw, chunk))
-                table = Table([(n, by_name[n]) for n in want])
+                return Table([(n, by_name[n]) for n in want])
+            try:
+                # Seek + read restart inside the closure, so a transient
+                # IO failure mid-group retries from the group's start.
+                table = _read_retry(decode_group)
             except NotImplementedError:
-                table = _arrow_row_group(path, i, columns)
+                table = _read_retry(
+                    lambda i=i: _arrow_row_group(path, i, columns))
             yield table
 
 

@@ -16,9 +16,10 @@ in the XLA stack.
 from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
                       counter, counters_delta, gauge, registry, timer)
 from .query import (QueryMetrics, StepMetrics, bench_cache_line,
-                    bench_metrics_line, bench_stream_line,
-                    last_query_metrics, last_stream_metrics,
-                    set_last_query_metrics, set_last_stream_metrics)
+                    bench_metrics_line, bench_recovery_line,
+                    bench_stream_line, last_query_metrics,
+                    last_stream_metrics, set_last_query_metrics,
+                    set_last_stream_metrics)
 
 __all__ = [
     "NULL_METRIC",
@@ -30,6 +31,7 @@ __all__ = [
     "Timer",
     "bench_cache_line",
     "bench_metrics_line",
+    "bench_recovery_line",
     "bench_stream_line",
     "counter",
     "counters_delta",

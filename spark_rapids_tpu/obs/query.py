@@ -111,6 +111,11 @@ class QueryMetrics:
     #: overlap ratio is (serial - wall) / serial, > 0 when pipelining won.
     stream_serial_seconds: float = 0.0
     stream_overlap_ratio: float = 0.0
+    # -- execution resilience (resilience/; zero on a fault-free run) ----
+    recovery_retries: int = 0           # evict-and-retry rounds taken
+    recovery_splits: int = 0            # batch halvings (the last rung)
+    recovery_cache_evictions: int = 0   # device-cache entries dropped
+    recovery_backoff_seconds: float = 0.0
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -120,9 +125,19 @@ class QueryMetrics:
         self.dict_encode_hits = delta.get("strings.dict_encode.hit", 0)
         self.dict_encode_misses = delta.get("strings.dict_encode.miss", 0)
 
+    def apply_recovery(self, delta: Dict[str, float]) -> None:
+        """Fold a ``RecoveryStats.delta`` (resilience/retry.py) taken over
+        the run into the recovery fields."""
+        self.recovery_retries = int(delta.get("retries", 0))
+        self.recovery_splits = int(delta.get("splits", 0))
+        self.recovery_cache_evictions = int(delta.get("cache_evictions", 0))
+        self.recovery_backoff_seconds = float(
+            delta.get("backoff_seconds", 0.0))
+
     def to_dict(self) -> dict:
         return {
-            "schema_version": 2,
+            # v3: added the always-present "recovery" block.
+            "schema_version": 3,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "mode": self.mode,
@@ -155,6 +170,14 @@ class QueryMetrics:
                 "serial_seconds": round(self.stream_serial_seconds, 6),
                 "overlap_ratio": round(self.stream_overlap_ratio, 6),
             },
+            # Always present (zeroed on a fault-free run) for the same
+            # one-key-set-across-modes reason as "stream".
+            "recovery": {
+                "retries": self.recovery_retries,
+                "splits": self.recovery_splits,
+                "cache_evictions": self.recovery_cache_evictions,
+                "backoff_seconds": round(self.recovery_backoff_seconds, 6),
+            },
         }
 
     def to_json(self) -> str:
@@ -181,6 +204,12 @@ class QueryMetrics:
             f"  host_syncs={self.host_syncs} d2h_bytes={self.d2h_bytes} "
             f"dict_encode={self.dict_encode_hits} hit"
             f"/{self.dict_encode_misses} miss")
+        if self.recovery_retries or self.recovery_splits:
+            lines.append(
+                f"  recovery: retries={self.recovery_retries} "
+                f"splits={self.recovery_splits} "
+                f"cache_evictions={self.recovery_cache_evictions} "
+                f"backoff={_ms(self.recovery_backoff_seconds)}")
         n = len(self.steps)
         for i, s in enumerate(self.steps):
             branch = "└─" if i == n - 1 else "├─"
@@ -303,5 +332,25 @@ def bench_stream_line() -> str:
         "serial_seconds": round(qm.stream_serial_seconds, 6),
         "source_seconds": round(qm.stream_source_seconds, 6),
         "overlap_ratio": round(qm.stream_overlap_ratio, 6),
+    }
+    return json.dumps(payload, sort_keys=True)
+
+
+def bench_recovery_line() -> str:
+    """The benchmarks' resilience JSON line (one line, stable key order):
+    the process-lifetime recovery totals — retries taken, batch splits,
+    cache evictions, backoff slept, faults injected — so a
+    ``--faults`` bench run shows recovery actually engaging.  Separate
+    from ``bench_metrics_line`` so the golden-pinned QueryMetrics schema
+    stays untouched."""
+    from ..resilience import recovery_stats
+    snap = recovery_stats().snapshot()
+    payload = {
+        "metric": "recovery",
+        "retries": int(snap["retries"]),
+        "splits": int(snap["splits"]),
+        "cache_evictions": int(snap["cache_evictions"]),
+        "backoff_seconds": round(float(snap["backoff_seconds"]), 6),
+        "faults_injected": int(snap["faults_injected"]),
     }
     return json.dumps(payload, sort_keys=True)

@@ -13,15 +13,21 @@ single jitted ``shard_map``.  No host round-trips, no dynamic shapes:
      live rows marked in the new row mask.
 
 Overflow handling is cooperative: the op returns an overflow flag (psum of
-per-target overruns); callers re-run with a larger ``bucket_size``.  The
-default ``bucket_size`` is derived from the *live*-row distribution (the
-busiest sender's rows spread over P buckets, 2x slack for hash skew) — not
-from the input's padded capacity — so chained distributed ops keep output
-capacity proportional to real rows.  Both the initial size and the
-overflow retry snap onto the shared geometric bucket schedule
-(exec/bucketing.py), so hot-key skew is absorbed by stepping up the same
-capacity ladder every other stage compiles against, not by drifting into
-fresh doubled shapes.
+per-target overruns) plus the observed max bucket occupancy (pmax across
+shards); the driver re-runs with a larger ``bucket_size``, jumping straight
+to the occupancy the mesh actually reported.  The default ``bucket_size``
+is derived from the *live*-row distribution (the busiest sender's rows
+spread over P buckets, 2x slack for hash skew) — not from the input's
+padded capacity — so chained distributed ops keep output capacity
+proportional to real rows.  Both the initial size and the overflow retry
+snap onto the shared geometric bucket schedule (exec/bucketing.py), so
+hot-key skew is absorbed by stepping up the same capacity ladder every
+other stage compiles against, not by drifting into fresh doubled shapes.
+
+The retry loop is BOUNDED (``SRT_SHUFFLE_RETRY_MAX``, default 3): a
+pathological key distribution raises
+:class:`~spark_rapids_tpu.resilience.ShuffleOverflowError` naming the
+observed occupancy instead of recursing until HBM gives out.
 """
 
 from __future__ import annotations
@@ -49,8 +55,10 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     chained distributed ops (join -> groupby) therefore keep capacity
     proportional to real rows instead of doubling it at every stage.
     """
+    from ..config import shuffle_retry_max
     from ..exec.bucketing import bucket_capacity
     from ..obs.metrics import counter, gauge
+    from ..resilience import ShuffleOverflowError
     from ..utils.memory import record_host_sync
     P = mesh.devices.size
     capacity = dist.capacity_total // P
@@ -68,29 +76,42 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         bucket_size = bucket_capacity(2 * (-(-max_live // P)), floor=8)
 
     pids = partition_ids([dist.table[k] for k in keys], P, seed)
+    retries_left = shuffle_retry_max()
 
-    counter("shuffle.invocations").inc()
-    gauge("shuffle.partitions").set(P)
-    # Cross-chip traffic: every shard all_to_alls its P*bucket_size slots
-    # of every column (data + validity + mask), so the mesh-wide payload
-    # is the full slab set regardless of how many slots are live.
-    slab_rows = P * P * bucket_size
-    data_bytes = sum(slab_rows * c.data.dtype.itemsize
-                     for c in dist.table.columns)
-    mask_bytes = slab_rows * (len(dist.table.columns) + 1)  # valids + row mask
-    counter("shuffle.bytes_moved").inc(data_bytes + mask_bytes)
+    while True:
+        counter("shuffle.invocations").inc()
+        gauge("shuffle.partitions").set(P)
+        # Cross-chip traffic: every shard all_to_alls its P*bucket_size
+        # slots of every column (data + validity + mask), so the mesh-wide
+        # payload is the full slab set regardless of how many slots are
+        # live.
+        slab_rows = P * P * bucket_size
+        data_bytes = sum(slab_rows * c.data.dtype.itemsize
+                         for c in dist.table.columns)
+        mask_bytes = slab_rows * (len(dist.table.columns) + 1)
+        counter("shuffle.bytes_moved").inc(data_bytes + mask_bytes)
 
-    out, overflow = _shuffle_arrays(dist, mesh, pids, P, capacity, bucket_size)
-    ov = bool(overflow)   # host sync; rerun with more slack
-    record_host_sync("shuffle.overflow_check", 1)
-    if ov:
+        out, overflow, occupancy = _shuffle_arrays(
+            dist, mesh, pids, P, capacity, bucket_size)
+        ov = bool(overflow)   # host sync; rerun with more slack
+        record_host_sync("shuffle.overflow_check", 1)
+        if not ov:
+            return out
+        occ = int(occupancy)  # mesh-wide max rows any one bucket needed
+        if retries_left <= 0:
+            raise ShuffleOverflowError(
+                f"shuffle overflow persists after {shuffle_retry_max()} "
+                f"retry attempt(s) (SRT_SHUFFLE_RETRY_MAX): observed max "
+                f"bucket occupancy {occ} rows > bucket_size {bucket_size} "
+                f"across {P} partitions; pass bucket_size >= "
+                f"{bucket_capacity(occ, floor=8)} explicitly")
+        retries_left -= 1
         counter("shuffle.retries").inc()
-        # Retry roughly doubles, but snapped onto the bucket schedule:
-        # hot-key skew lands back on a capacity other shuffles (and the
-        # compile cache) already know instead of a fresh 2^k * initial.
-        retry_size = bucket_capacity(2 * bucket_size, floor=8)
-        return shuffle(dist, mesh, keys, bucket_size=retry_size, seed=seed)
-    return out
+        # Jump straight to what the mesh reported it needs (at least a
+        # doubling), snapped onto the bucket schedule: hot-key skew lands
+        # back on a capacity other shuffles (and the compile cache)
+        # already know instead of a fresh 2^k * initial.
+        bucket_size = bucket_capacity(max(occ, 2 * bucket_size), floor=8)
 
 
 def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
@@ -103,7 +124,7 @@ def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * (2 + len(datas) + len(valids)),
              out_specs=((PartitionSpec(axis),) * (1 + len(datas) + len(valids))
-                        + (PartitionSpec(),)))
+                        + (PartitionSpec(), PartitionSpec())))
     def body(pids_l, mask_l, *cols_l):
         datas_l = cols_l[:len(datas)]
         valids_l = cols_l[len(datas):]
@@ -136,17 +157,22 @@ def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
         new_datas = tuple(exchange(d) for d in datas_l)
         new_valids = tuple(exchange(v, mask_with_live=True) for v in valids_l)
         overflow_any = jax.lax.psum(overflow.astype(jnp.int32), axis) > 0
-        return (new_mask,) + new_datas + new_valids + (overflow_any,)
+        # Mesh-wide max bucket occupancy: what bucket_size would have
+        # sufficed.  The bounded retry loop jumps straight to it, and the
+        # overflow error names it so a manual rerun needs no bisection.
+        occupancy = jax.lax.pmax(jnp.max(counts), axis)
+        return (new_mask,) + new_datas + new_valids + (overflow_any,
+                                                       occupancy)
 
     results = jax.jit(body)(pids, dist.row_mask, *datas, *valids)
     new_mask = results[0]
     new_datas = results[1:1 + len(datas)]
-    new_valids = results[1 + len(datas):-1]
-    overflow = results[-1]
+    new_valids = results[1 + len(datas):-2]
+    overflow, occupancy = results[-2], results[-1]
 
     cols = []
     for name, old, data, valid in zip(names, dist.table.columns, new_datas,
                                       new_valids):
         validity = None if old.validity is None else valid
         cols.append((name, Column(data=data, validity=validity, dtype=old.dtype)))
-    return DistTable(table=Table(cols), row_mask=new_mask), overflow
+    return DistTable(table=Table(cols), row_mask=new_mask), overflow, occupancy

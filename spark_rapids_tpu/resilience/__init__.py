@@ -1,0 +1,51 @@
+"""Execution-resilience layer: classification, bounded retry, HBM-OOM
+recovery, and deterministic fault injection.
+
+The reference engine earns production trust by surviving device OOM and
+transient IO failure — Spark retries tasks, the RAPIDS plugin falls back
+or splits its input batches under GPU memory pressure.  This package is
+that layer for the TPU engine, threaded through bind/compile/dispatch/
+materialize (exec/compile.py) and the streaming executor (exec/stream.py):
+
+  * :func:`classify` — ONE mapping from raised exceptions to retryable
+    categories: ``"oom"`` (``XlaRuntimeError``/``RESOURCE_EXHAUSTED``),
+    ``"compile"`` (XLA compilation failures), ``"io"`` (transient
+    reader/network errors), ``"fatal"`` (everything else — never retried).
+  * :func:`with_retries` — bounded retry with capped exponential backoff
+    (``SRT_RETRY_MAX``, ``SRT_RETRY_BACKOFF``); on budget exhaustion the
+    ORIGINAL error re-raises with a :class:`RecoverySummary` attached.
+  * the HBM-OOM recovery ladder (:mod:`.recovery`): evict the whole-plan
+    compile cache + bucket pad cache and retry; if the OOM recurs, split
+    the batch in half along rows (snapped to the bucket schedule) and
+    re-run the pieces; only then fail — raising
+    :class:`ExecutionRecoveryError` chained to the original error and
+    naming every step attempted.
+  * :func:`fault_point` — deterministic fault injection via ``SRT_FAULT``
+    (e.g. ``oom:materialize:2``, ``io:read:0.5:seed=7``) so every
+    recovery path above runs on CPU in tier-1 CI.
+
+Recovery is observable: :func:`recovery_stats` accumulates retries /
+splits / cache evictions / backoff seconds, surfaced as the ``recovery``
+block of QueryMetrics (obs/query.py, schema_version 3) and the
+benchmarks' ``recovery`` JSON line.
+
+This package must not import jax at module load (the lazy-import rule of
+config.py): classification is string/type-name based and injection is
+pure python, so failure-model tooling runs on hosts without the XLA
+stack.  jax loads only inside :mod:`.recovery` at recovery time — by
+which point the engine (and therefore jax) is necessarily live.
+"""
+
+from .classify import (CATEGORY_COMPILE, CATEGORY_FATAL, CATEGORY_IO,
+                       CATEGORY_OOM, ExecutionRecoveryError, RecoverySummary,
+                       ShuffleOverflowError, StreamStallError, classify)
+from .faults import InjectedFault, fault_point, reset_faults
+from .retry import (RecoveryStats, RetryPolicy, recovery_stats, with_retries)
+
+__all__ = [
+    "CATEGORY_COMPILE", "CATEGORY_FATAL", "CATEGORY_IO", "CATEGORY_OOM",
+    "ExecutionRecoveryError", "InjectedFault", "RecoveryStats",
+    "RecoverySummary", "RetryPolicy", "ShuffleOverflowError",
+    "StreamStallError", "classify", "fault_point", "recovery_stats",
+    "reset_faults", "with_retries",
+]

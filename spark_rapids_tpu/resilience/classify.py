@@ -1,0 +1,127 @@
+"""Error classification + the resilience layer's exception types.
+
+``classify`` is THE single mapping from a raised exception to a recovery
+category; every retry/recovery decision in the engine routes through it
+so "what counts as an OOM" is defined in exactly one place.  It matches
+by type name and message substring, never by importing jaxlib: the module
+stays jax-free (lazy-import rule), and injected faults
+(:class:`.faults.InjectedFault`) classify identically to the real errors
+they imitate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+#: Device memory exhaustion (``RESOURCE_EXHAUSTED`` / HBM OOM) — the
+#: recovery ladder applies: evict caches, retry, split the batch.
+CATEGORY_OOM = "oom"
+#: XLA compilation failure — retryable after a cache evict (a poisoned
+#: in-process program entry rebuilds), never split.
+CATEGORY_COMPILE = "compile"
+#: Transient reader/network errors — plain bounded retry with backoff.
+CATEGORY_IO = "io"
+#: Everything else — never retried, surfaces unchanged.
+CATEGORY_FATAL = "fatal"
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM_WHEN_ALLOCATING")
+_COMPILE_MARKERS = ("XLA compilation", "during compilation",
+                    "Compilation failure", "while lowering")
+
+#: OSError subclasses that describe a *state* of the filesystem, not a
+#: transient fault — retrying cannot help.
+_FATAL_OS = (FileNotFoundError, PermissionError, IsADirectoryError,
+             NotADirectoryError, FileExistsError)
+
+
+def classify(exc: BaseException) -> str:
+    """Map ``exc`` to ``"oom"`` | ``"compile"`` | ``"io"`` | ``"fatal"``.
+
+    Covers real engine failures (``jaxlib`` ``XlaRuntimeError`` carrying
+    ``RESOURCE_EXHAUSTED``, XLA compile errors, transient ``OSError``s
+    from the parquet reader) and their injected stand-ins.  Matching is
+    name/message based so classification works without jax installed and
+    across jaxlib versions that move the exception type.
+    """
+    from .faults import InjectedFault
+    if isinstance(exc, InjectedFault):
+        return exc.category
+    if isinstance(exc, MemoryError):
+        return CATEGORY_OOM
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return CATEGORY_OOM
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "InternalError") \
+            and any(m in msg for m in _COMPILE_MARKERS):
+        return CATEGORY_COMPILE
+    if isinstance(exc, _FATAL_OS):
+        return CATEGORY_FATAL
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError,
+                        EOFError)):
+        return CATEGORY_IO
+    if isinstance(exc, OSError):
+        # Remaining OS errors (EIO, EAGAIN, ENOSPC-adjacent flakes from
+        # network filesystems) are worth one more read attempt.
+        return CATEGORY_IO
+    return CATEGORY_FATAL
+
+
+@dataclass
+class RecoverySummary:
+    """What recovery was attempted before an error surfaced — attached to
+    the re-raised original (``exc.recovery_summary``) by
+    :func:`.retry.with_retries` and carried by
+    :class:`ExecutionRecoveryError`."""
+    site: str = ""
+    category: str = CATEGORY_FATAL
+    steps: List[str] = field(default_factory=list)
+    retries: int = 0
+    splits: int = 0
+    cache_evictions: int = 0
+    backoff_seconds: float = 0.0
+
+    def describe(self) -> str:
+        steps = ", ".join(self.steps) if self.steps else "none"
+        return (f"site={self.site!r} attempted=[{steps}] "
+                f"retries={self.retries} splits={self.splits} "
+                f"cache_evictions={self.cache_evictions} "
+                f"backoff={self.backoff_seconds:.3f}s")
+
+
+class ExecutionRecoveryError(RuntimeError):
+    """Raised when the HBM-OOM recovery ladder is exhausted: every rung
+    (cache evict → bounded retry → batch split) was attempted and the
+    failure persisted.  ``__cause__`` chains the ORIGINAL error (the
+    first ``RESOURCE_EXHAUSTED``) and the message names each attempted
+    step, so an operator reads what was tried without a debugger."""
+
+    def __init__(self, site: str, summary: RecoverySummary):
+        self.site = site
+        self.summary = summary
+        self.category = summary.category
+        super().__init__(self._message())
+
+    def _message(self) -> str:
+        return (f"unrecoverable {self.summary.category} failure at "
+                f"{self.site!r} after recovery: {self.summary.describe()}")
+
+    def add_step(self, step: str) -> None:
+        """Record a further rung attempted by an outer layer (e.g. the
+        batch split tried after the retry ladder raised)."""
+        self.summary.steps.append(step)
+        self.args = (self._message(),)
+
+
+class StreamStallError(RuntimeError):
+    """The IO feed's stall watchdog (``SRT_STREAM_TIMEOUT``): the source
+    iterator produced nothing for the configured window while the
+    consumer waited — surfaced instead of hanging forever."""
+
+
+class ShuffleOverflowError(RuntimeError):
+    """The mesh shuffle could not place every row within its retry
+    budget (``SRT_SHUFFLE_RETRY_MAX``): the message names the observed
+    max-bucket occupancy so the caller can size ``bucket_size``."""

@@ -1,0 +1,149 @@
+"""Deterministic fault injection — the ``SRT_FAULT`` harness.
+
+None of the recovery paths are reachable on CPU CI without a way to
+provoke HBM OOM and reader flakes on demand, so the engine's failure
+sites each call :func:`fault_point` with a stable site name and this
+module decides — purely from the ``SRT_FAULT`` spec — whether to raise a
+classified stand-in error there.  Injection is deterministic: count
+specs fire on exactly the first N passes through a site, probability
+specs draw from a seeded PRNG, so a faulted run replays bit-identically.
+
+Spec grammar (comma-separated)::
+
+    SRT_FAULT=KIND:SITE:ARG[:seed=N][,...]
+
+    KIND   oom | compile | io        (the classify() category to inject)
+    SITE   bind | dispatch | materialize | stream-combine | read | ...
+    ARG    integer count  -> fire on the first ARG calls, then pass
+           float in (0,1] -> fire with that probability (seeded PRNG,
+                             seed=0 unless given)
+
+Examples: ``oom:materialize:2``, ``oom:dispatch:1``,
+``io:read:0.5:seed=7``.
+
+Injected errors are :class:`InjectedFault` instances whose message
+carries the real marker text (``RESOURCE_EXHAUSTED`` for oom), so both
+the isinstance fast path and the message-matching path of
+``classify`` exercise against them.  jax-free at import.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic stand-in for a classified engine failure; carries
+    its category so ``classify`` maps it exactly like the real error."""
+
+    def __init__(self, category: str, site: str, detail: str):
+        self.category = category
+        self.site = site
+        super().__init__(detail)
+
+
+@dataclass
+class _FaultSpec:
+    kind: str
+    site: str
+    remaining: Optional[int]        # count mode: calls left to fail
+    prob: Optional[float]           # probability mode
+    rng: Optional[random.Random]
+
+
+_KINDS = ("oom", "compile", "io")
+
+_LOCK = threading.Lock()
+_STATE: dict = {"raw": None, "specs": []}
+
+
+def _parse(raw: str) -> List[_FaultSpec]:
+    specs = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 3:
+            raise ValueError(
+                f"SRT_FAULT spec {part!r} must be KIND:SITE:ARG"
+                f"[:seed=N] (e.g. 'oom:materialize:2')")
+        kind, site, arg = fields[0], fields[1], fields[2]
+        if kind not in _KINDS:
+            raise ValueError(
+                f"SRT_FAULT kind must be one of {_KINDS}, got {kind!r}")
+        seed = 0
+        for extra in fields[3:]:
+            if extra.startswith("seed="):
+                seed = int(extra[len("seed="):])
+            else:
+                raise ValueError(
+                    f"SRT_FAULT: unknown option {extra!r} in {part!r}")
+        if "." in arg:
+            prob = float(arg)
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(
+                    f"SRT_FAULT probability must be in (0, 1], got {arg!r}")
+            specs.append(_FaultSpec(kind, site, None, prob,
+                                    random.Random(seed)))
+        else:
+            count = int(arg)
+            if count < 1:
+                raise ValueError(
+                    f"SRT_FAULT count must be >= 1, got {arg!r}")
+            specs.append(_FaultSpec(kind, site, count, None, None))
+    return specs
+
+
+def _make_error(kind: str, site: str, raw: str) -> InjectedFault:
+    if kind == "oom":
+        return InjectedFault(
+            "oom", site,
+            f"RESOURCE_EXHAUSTED: injected HBM OOM at site {site!r} "
+            f"(SRT_FAULT={raw})")
+    if kind == "compile":
+        return InjectedFault(
+            "compile", site,
+            f"injected XLA compilation failure at site {site!r} "
+            f"(SRT_FAULT={raw})")
+    return InjectedFault(
+        "io", site,
+        f"injected transient IO error at site {site!r} (SRT_FAULT={raw})")
+
+
+def fault_point(site: str) -> None:
+    """The engine's named failure sites call this; a matching armed
+    ``SRT_FAULT`` spec raises its classified error here.  One env read
+    when unset — cheap enough for per-batch paths, never per-row."""
+    from ..config import fault_spec
+    raw = fault_spec()
+    if not raw:
+        return
+    with _LOCK:
+        if raw != _STATE["raw"]:
+            _STATE["raw"] = raw
+            _STATE["specs"] = _parse(raw)
+        for spec in _STATE["specs"]:
+            if spec.site != site:
+                continue
+            if spec.remaining is not None:
+                if spec.remaining <= 0:
+                    continue
+                spec.remaining -= 1
+            elif spec.rng.random() >= spec.prob:
+                continue
+            from .retry import recovery_stats
+            recovery_stats().add_injection()
+            raise _make_error(spec.kind, site, raw)
+
+
+def reset_faults() -> None:
+    """Forget injection state (remaining counts, PRNG position) so the
+    next :func:`fault_point` reparses ``SRT_FAULT`` — tests call this
+    around every monkeypatched spec."""
+    with _LOCK:
+        _STATE["raw"] = None
+        _STATE["specs"] = []

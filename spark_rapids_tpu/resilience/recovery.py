@@ -1,0 +1,103 @@
+"""The HBM-OOM recovery ladder: evict → bounded retry → (caller splits).
+
+The engine's memory consumers are the whole-plan program cache
+(exec/compile.py ``_COMPILED`` — live executables pin HBM for constants
+and donated scratch) and the bucket pad cache (exec/bucketing.py
+``_PAD_CACHE`` — full padded copies of recent input tables).  On a
+``RESOURCE_EXHAUSTED`` both are dropped wholesale before each retry:
+reruns recompile/re-pad (the persistent XLA cache keeps recompiles
+cheap), but the device gets its memory back.
+
+:func:`oom_ladder` runs the evict-and-retry rungs and raises
+:class:`ExecutionRecoveryError` (chained to the ORIGINAL error) when the
+budget is spent; batch *splitting* — the last rung — lives with the
+callers (exec/compile.py ``_split_batch``, exec/stream.py) because only
+they know how to recombine the pieces (concat for row-local plans,
+accumulator merge for streaming combine).  They catch the ladder's error
+and append their split outcome to its step list.
+
+This module is jax-free at import; jax is only touched inside the
+eviction path at recovery time, when the engine is necessarily live.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .classify import (CATEGORY_COMPILE, CATEGORY_OOM,
+                       ExecutionRecoveryError, RecoverySummary, classify)
+from .retry import RetryPolicy, recovery_stats
+
+#: Recursion bound for the split rung: each level halves the batch, so 4
+#: levels shrink it 16x — past that the OOM is not batch-size-driven.
+MAX_SPLIT_DEPTH = 4
+
+
+class SplitUnavailable(RuntimeError):
+    """Internal signal from a split callback: this plan/batch cannot be
+    split (single row, non-row-local and non-combinable plan, depth
+    exhausted).  The caller appends the reason to the ladder's error."""
+
+
+def evict_device_caches() -> int:
+    """Rung 1: drop every engine-owned device-buffer cache — the
+    whole-plan program LRU, the bucket pad cache, and the decoded
+    dictionary table.  Returns entries dropped (recorded in
+    ``recovery.cache_evictions``)."""
+    from ..exec import compile as _compile
+    from ..exec.bucketing import clear_pad_cache
+    dropped = len(_compile._COMPILED) + len(_compile._DECODED_DICTS)
+    _compile._COMPILED.clear()
+    _compile._DECODED_DICTS.clear()
+    dropped += clear_pad_cache()
+    recovery_stats().add_evictions(dropped)
+    return dropped
+
+
+def oom_ladder(site: str, fn: Callable,
+               policy: Optional[RetryPolicy] = None,
+               drain: Optional[Callable] = None):
+    """Run ``fn()`` under the evict-and-retry rungs of the recovery
+    ladder for OOM/compile-classified failures.
+
+    On the first qualifying failure: ``drain()`` once (the streaming
+    executor materializes its in-flight batches here, freeing their
+    output buffers), then up to ``policy.max_retries`` rounds of cache
+    evict + backoff + retry.  Exhaustion raises
+    :class:`ExecutionRecoveryError` chained to the ORIGINAL error; the
+    caller may catch it and attempt the split rung.  Non-OOM errors
+    propagate untouched.
+    """
+    try:
+        return fn()
+    except Exception as exc:
+        category = classify(exc)
+        if category not in (CATEGORY_OOM, CATEGORY_COMPILE):
+            raise
+        original = exc
+    if policy is None:
+        policy = RetryPolicy.from_env()
+    stats = recovery_stats()
+    summary = RecoverySummary(site=site, category=category)
+    if drain is not None:
+        drain()
+        summary.steps.append("drain-inflight")
+    for attempt in range(policy.max_retries):
+        dropped = evict_device_caches()
+        summary.cache_evictions += dropped
+        summary.steps.append(f"evict-caches[{dropped}]")
+        delay = policy.delay(attempt)
+        if delay > 0:
+            time.sleep(delay)
+        summary.backoff_seconds += delay
+        stats.add_backoff(delay)
+        stats.add_retry()
+        summary.retries += 1
+        summary.steps.append("retry")
+        try:
+            return fn()
+        except Exception as exc:
+            if classify(exc) not in (CATEGORY_OOM, CATEGORY_COMPILE):
+                raise
+    raise ExecutionRecoveryError(site, summary) from original

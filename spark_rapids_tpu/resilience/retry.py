@@ -1,0 +1,147 @@
+"""Bounded retry with capped exponential backoff + recovery accounting.
+
+:func:`with_retries` is the generic retry helper (transient IO, flaky
+readers); the HBM-OOM ladder in :mod:`.recovery` builds on the same
+policy and stats.  All recovery activity in the process accumulates in
+ONE :class:`RecoveryStats` (global, locked): executions snapshot before
+and delta after to fill the per-query ``recovery`` block of QueryMetrics
+(obs/query.py), and registry counters mirror every increment under
+``SRT_METRICS=1`` so CI lanes can assert on them.  jax-free at import.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence
+
+from .classify import (CATEGORY_COMPILE, CATEGORY_IO, CATEGORY_OOM,
+                       RecoverySummary, classify)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry budget + backoff shape.  ``max_retries`` counts RE-attempts
+    (0 = try once, never retry); sleep before retry k (0-based) is
+    ``backoff * 2**k`` capped at ``backoff_cap`` seconds."""
+    max_retries: int = 3
+    backoff: float = 0.05
+    backoff_cap: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        from ..config import retry_backoff, retry_max
+        return cls(max_retries=retry_max(), backoff=retry_backoff())
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff * (2 ** attempt), self.backoff_cap)
+
+
+class RecoveryStats:
+    """Process-wide recovery accounting (single instance, locked).
+
+    Mutators mirror into the metrics registry (no-ops unless
+    ``SRT_METRICS=1``); ``snapshot``/``delta`` give executions their
+    per-query view without a reset that would race concurrent streams.
+    """
+
+    _FIELDS = ("retries", "splits", "cache_evictions", "backoff_seconds",
+               "faults_injected")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.retries = 0
+        self.splits = 0
+        self.cache_evictions = 0
+        self.backoff_seconds = 0.0
+        self.faults_injected = 0
+
+    def _bump(self, name: str, amount, counter_name: str) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + amount)
+        from ..obs.metrics import counter
+        counter(counter_name).inc(amount if name != "backoff_seconds"
+                                  else 1)
+
+    def add_retry(self) -> None:
+        self._bump("retries", 1, "recovery.retries")
+
+    def add_split(self) -> None:
+        self._bump("splits", 1, "recovery.splits")
+
+    def add_evictions(self, n: int) -> None:
+        self._bump("cache_evictions", n, "recovery.cache_evictions")
+
+    def add_backoff(self, seconds: float) -> None:
+        if seconds > 0:
+            self._bump("backoff_seconds", seconds, "recovery.backoffs")
+
+    def add_injection(self) -> None:
+        self._bump("faults_injected", 1, "resilience.faults_injected")
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {f: getattr(self, f) for f in self._FIELDS}
+
+    def delta(self, before: Dict[str, float]) -> Dict[str, float]:
+        now = self.snapshot()
+        return {f: now[f] - before.get(f, 0) for f in self._FIELDS}
+
+
+_STATS = RecoveryStats()
+
+
+def recovery_stats() -> RecoveryStats:
+    """The process-wide recovery accounting object."""
+    return _STATS
+
+
+#: Categories :func:`with_retries` retries by default; ``"fatal"`` is
+#: structurally excluded (classify never lands a retryable on it).
+DEFAULT_RETRYABLE = (CATEGORY_IO, CATEGORY_OOM, CATEGORY_COMPILE)
+
+
+def with_retries(fn: Callable, policy: Optional[RetryPolicy] = None,
+                 retryable: Sequence[str] = DEFAULT_RETRYABLE,
+                 on_retry: Optional[Callable] = None,
+                 site: str = ""):
+    """Call ``fn()`` with up to ``policy.max_retries`` re-attempts when
+    the raised error classifies into ``retryable``.
+
+    On budget exhaustion the ORIGINAL (first) error re-raises with a
+    :class:`RecoverySummary` attached as ``exc.recovery_summary`` — the
+    caller sees the real failure, annotated with what recovery was
+    attempted.  ``on_retry(attempt, exc)`` runs before each sleep (the
+    OOM ladder hooks cache eviction here).  Non-retryable errors
+    propagate untouched on the first raise.
+    """
+    if policy is None:
+        policy = RetryPolicy.from_env()
+    stats = recovery_stats()
+    original: Optional[BaseException] = None
+    backoff_total = 0.0
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn()
+        except Exception as exc:
+            category = classify(exc)
+            if category not in retryable:
+                raise
+            if original is None:
+                original = exc
+            if attempt >= policy.max_retries:
+                summary = RecoverySummary(
+                    site=site, category=classify(original),
+                    steps=["retry"] * attempt, retries=attempt,
+                    backoff_seconds=backoff_total)
+                original.recovery_summary = summary
+                raise original
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            delay = policy.delay(attempt)
+            if delay > 0:
+                time.sleep(delay)
+            backoff_total += delay
+            stats.add_backoff(delay)
+            stats.add_retry()
