@@ -112,12 +112,29 @@ def main():
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
-        from spark_rapids_tpu.obs import bench_cache_line, bench_metrics_line
-        print(bench_metrics_line())
-        print(bench_cache_line())
+        from spark_rapids_tpu.obs import bench_line
+        print(bench_line("metrics"))
+        print(bench_line("cache"))
     if "--faults" in sys.argv:
-        from spark_rapids_tpu.obs import bench_recovery_line
-        print(bench_recovery_line())
+        from spark_rapids_tpu.obs import bench_line
+        print(bench_line("recovery"))
+    timeline_path = _timeline_arg()
+    if timeline_path is not None:
+        from spark_rapids_tpu.obs import timeline
+        payload = timeline.export_chrome_trace(timeline_path)
+        print(json.dumps({"metric": "timeline", "path": timeline_path,
+                          "events": len(payload["traceEvents"])},
+                         sort_keys=True))
+
+
+def _timeline_arg():
+    """``--timeline out.json``: Chrome-trace export path, or None."""
+    if "--timeline" not in sys.argv:
+        return None
+    i = sys.argv.index("--timeline")
+    if i + 1 >= len(sys.argv):
+        raise SystemExit("--timeline requires an output path")
+    return sys.argv[i + 1]
 
 
 def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
@@ -239,7 +256,12 @@ def bench_plans(lineitem, fact, dim):
 
 
 if __name__ == "__main__":
+    import os
     if "--faults" in sys.argv:
-        import os
         os.environ.setdefault("SRT_FAULT", "oom:materialize:1")
+    if "--timeline" in sys.argv:
+        # Arm the recorder before any engine work so the whole bench —
+        # stream lanes included — lands in the export.
+        _timeline_arg()                       # validate the argument early
+        os.environ["SRT_TRACE_TIMELINE"] = "1"
     main()

@@ -47,6 +47,37 @@ python -m pytest tests/ -q
 SRT_FAULT="oom:materialize:1" SRT_METRICS=1 \
 python -m pytest tests/test_resilience.py -m faulted -q
 
+# Timeline lane: record a faulted query on the span timeline, export
+# Chrome-trace JSON, and validate it against the golden-pinned schema
+# (tests/golden/chrome_trace_schema.json) — the artifact a reviewer can
+# drop into Perfetto to see the recovery ladder engage.
+mkdir -p artifacts
+SRT_FAULT="oom:materialize:1" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+python - <<'EOF'
+import json
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import timeline
+
+r = np.random.default_rng(0)
+t = Table({"k": Column.from_numpy(r.integers(0, 4, 512).astype(np.int64)),
+           "v": Column.from_numpy(r.integers(0, 100, 512).astype(np.float64))})
+p = (plan().filter(col("v") > 10)
+     .groupby_agg(["k"], [("v", "sum", "s"), ("v", "count", "c")],
+                  domains={"k": (0, 3)}))
+out = p.run(t, trace_timeline="artifacts/premerge-timeline.json")
+assert out.num_rows > 0
+payload = json.load(open("artifacts/premerge-timeline.json"))
+schema = json.load(open("tests/golden/chrome_trace_schema.json"))
+errors = timeline.validate_chrome_trace(payload, schema)
+assert not errors, errors
+names = {e["name"] for e in payload["traceEvents"]}
+assert "recovery.retry" in names, sorted(names)
+print("timeline lane ok:", len(payload["traceEvents"]), "events")
+EOF
+ls -l artifacts/premerge-timeline.json
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

@@ -23,6 +23,17 @@ Knobs (all optional):
                                measurements, the Spark SQL-metrics-UI
                                analog.  Off: all metric handles are shared
                                no-op singletons.
+  ``SRT_TRACE_TIMELINE``       ``1`` enables the structured span-timeline
+                               recorder (obs/timeline.py): begin/end and
+                               instant events on per-batch / per-shard
+                               lanes, exportable as Chrome-trace JSON for
+                               Perfetto.  Off: span handles are shared
+                               no-op singletons (one env read per span).
+  ``SRT_METRICS_HISTORY``      path of a JSONL sink: every finished
+                               ``QueryMetrics`` appends one record keyed
+                               by plan fingerprint (obs/history.py), read
+                               back via ``obs.history.load``.  Unset = no
+                               history is written.
   ``SRT_LEAK_DEBUG``           ``1`` records creation stacks for native blob
                                handles and reports leaks at exit — the
                                ``-Dai.rapids.refcount.debug`` analog.
@@ -340,6 +351,21 @@ def metrics_enabled() -> bool:
     return _flag("SRT_METRICS")
 
 
+def timeline_enabled() -> bool:
+    """Structured span-timeline recording on/off (obs/timeline.py).
+
+    Read live per span so tests can monkeypatch it; when off every
+    ``timeline.span(...)`` returns a shared null scope and instrumented
+    code pays one env lookup per *span region* (never per row)."""
+    return _flag("SRT_TRACE_TIMELINE")
+
+
+def metrics_history_path() -> str | None:
+    """JSONL metrics-history sink path (obs/history.py), or None when no
+    history should be written."""
+    return os.environ.get("SRT_METRICS_HISTORY") or None
+
+
 def leak_debug_enabled() -> bool:
     """Native-handle leak tracking on/off (refcount.debug analog)."""
     return _flag("SRT_LEAK_DEBUG")
@@ -365,6 +391,7 @@ def knob_table() -> dict[str, str]:
     """Current values of every knob (for diagnostics / bug reports)."""
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_METRICS",
+             "SRT_TRACE_TIMELINE", "SRT_METRICS_HISTORY",
              "SRT_LEAK_DEBUG", "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE",
              "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
              "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",

@@ -1458,12 +1458,15 @@ def _cache_lookup(key, build):
     counter."""
     from ..config import compile_cache_cap, ensure_compile_cache
     from ..obs.metrics import counter, gauge
+    from ..obs.timeline import instant, span
     ensure_compile_cache()
     fn = _COMPILED.get(key)
     hit = fn is not None
     if fn is None:
         counter("plan.compile_cache.miss").inc()
-        fn = build()
+        instant("compile_cache.miss", cat="compile")
+        with span("compile.build", cat="compile"):
+            fn = build()
         _COMPILED[key] = fn
         cap = compile_cache_cap()
         while len(_COMPILED) > cap:
@@ -1471,6 +1474,7 @@ def _cache_lookup(key, build):
             counter("plan.compile_cache.evictions").inc()
     else:
         counter("plan.compile_cache.hit").inc()
+        instant("compile_cache.hit", cat="compile")
         _COMPILED.move_to_end(key)
     gauge("plan.compile_cache.size").set(len(_COMPILED))
     return fn, hit
@@ -1697,6 +1701,8 @@ def _run_plan_metered(plan: Plan, table: Table):
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)
+    from ..obs.history import maybe_record
+    maybe_record(plan, qm)
     return t, qm
 
 
@@ -1713,6 +1719,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
     named fault sites (``bind``, ``dispatch``, ``materialize``) let
     ``SRT_FAULT`` provoke every path deterministically on CPU."""
     import time as _time
+    from ..obs.timeline import span as _tspan
     from ..resilience import fault_point
     from ..resilience.classify import ExecutionRecoveryError
     from ..resilience.recovery import SplitUnavailable, oom_ladder
@@ -1722,7 +1729,9 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
         return _bind(plan, table)
 
     t0 = _time.perf_counter()
-    bound = oom_ladder("bind", do_bind)
+    with _tspan("run.bind", cat="execute", rows=table.num_rows,
+                depth=depth):
+        bound = oom_ladder("bind", do_bind)
     if qm is not None:
         qm.bind_seconds += _time.perf_counter() - t0
         qm.compile_cache = ("hit" if bound.signature() in _COMPILED
@@ -1739,14 +1748,16 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
 
     try:
         t0 = _time.perf_counter()
-        out_cols, sel = oom_ladder("dispatch", do_dispatch)
+        with _tspan("run.dispatch", cat="execute", depth=depth):
+            out_cols, sel = oom_ladder("dispatch", do_dispatch)
         if qm is not None:
             qm.execute_seconds += _time.perf_counter() - t0
             if qm.compile_cache == "miss":
                 qm.compile_seconds = qm.execute_seconds
         t0 = _time.perf_counter()
-        t = oom_ladder("materialize",
-                       lambda: materialize(bound, out_cols, sel))
+        with _tspan("run.materialize", cat="execute", depth=depth):
+            t = oom_ladder("materialize",
+                           lambda: materialize(bound, out_cols, sel))
         if qm is not None:
             qm.materialize_seconds += _time.perf_counter() - t0
         return t
@@ -1803,7 +1814,10 @@ def _split_batch(plan: Plan, table: Table, qm, depth: int) -> Table:
     cut = min(bucket_capacity((n + 1) // 2), n - 1)
     recovery_stats().add_split()
     from ..obs.metrics import counter
+    from ..obs.timeline import instant
     counter("recovery.split_rows").inc(n)
+    instant("recovery.split", cat="resilience", rows=n, cut=cut,
+            depth=depth, mode=mode)
     pieces = (table.gather(jnp.arange(0, cut, dtype=jnp.int32)),
               table.gather(jnp.arange(cut, n, dtype=jnp.int32)))
     if mode == "concat":
@@ -2045,10 +2059,13 @@ def analyze_plan(plan: Plan, table: Table):
     from ..obs.metrics import counters_delta, registry
     from ..obs.query import QueryMetrics, StepMetrics, next_query_id, \
         set_last_query_metrics
+    from ..resilience import recovery_stats
+    from ..resilience.recovery import oom_ladder
     qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
+    r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
     bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
@@ -2056,8 +2073,14 @@ def analyze_plan(plan: Plan, table: Table):
                         else "miss")
     fn = _compiled_for(bound)
     t0 = _time.perf_counter()
-    out_cols, sel = jax.block_until_ready(
-        fn(bound.exec_cols, bound.side_inputs, bound.init_sel))
+    # The whole-plan dispatch and the final materialize run under the
+    # OOM recovery ladder (evict → backoff → retry), so a faulted/
+    # recovered explain_analyze still renders — with its recovery block —
+    # instead of aborting the report.  (No split rung here: the analyzer
+    # measures THE batch it was given; halving it would measure a
+    # different query.)
+    out_cols, sel = oom_ladder("dispatch", lambda: jax.block_until_ready(
+        fn(bound.exec_cols, bound.side_inputs, bound.init_sel)))
     qm.execute_seconds = _time.perf_counter() - t0
     if qm.compile_cache == "miss":
         qm.compile_seconds = qm.execute_seconds
@@ -2086,22 +2109,34 @@ def analyze_plan(plan: Plan, table: Table):
             density=(live / padded) if padded else 0.0))
         live_in = live
     t0 = _time.perf_counter()
-    t = materialize(bound, out_cols, sel)
+    t = oom_ladder("materialize",
+                   lambda: materialize(bound, out_cols, sel))
     qm.materialize_seconds = _time.perf_counter() - t0
     qm.total_seconds = _time.perf_counter() - t_all
     qm.output_rows = t.num_rows
     qm.finish_counters(counters_delta(before))
+    qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)
+    from ..obs.history import maybe_record
+    maybe_record(plan, qm)
     return t, qm
 
 
-def explain_analyze_plan(plan: Plan, table: Table) -> str:
+def explain_analyze_plan(plan: Plan, table: Table,
+                         timeline: bool = False) -> str:
     """The analyzed tree behind ``Plan.explain_analyze``.
 
     With ``SRT_METRICS=1`` runs :func:`analyze_plan` and renders measured
     per-step rows/timings; otherwise renders the same tree with metrics
     marked unavailable (still binds the plan, so the step text is real).
+    ``timeline=True`` records the run on the span timeline (regardless of
+    ``SRT_TRACE_TIMELINE``) and appends the lane summary to the report.
     """
+    if timeline:
+        from ..obs.timeline import recording
+        with recording() as rec:
+            text = explain_analyze_plan(plan, table)
+        return text + "\n" + rec.summary()
     from ..config import metrics_enabled
     from ..obs.query import UNMEASURED_FLOAT, QueryMetrics
     header = (f"Plan over {table.num_rows} rows x "

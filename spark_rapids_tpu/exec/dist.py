@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec
 
 from ..column import Column
 from ..dtypes import BOOL8
-from ..parallel.mesh import DistTable
+from ..parallel.mesh import DistTable, shard_map
 from ..table import Table
 from .compile import _Bound, _assemble, _final_order, materialize
 from .plan import GroupAggStep, JoinShuffledStep, Plan
@@ -168,9 +168,12 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     # just its shape.
     mesh_key = (axis, tuple(d.id for d in mesh.devices.flat))
     key = bound.signature() + (mesh_key, replicated_out)
+    from ..obs import timeline as _tl
     from ..obs.metrics import counter, gauge
     fn = _DIST_COMPILED.get(key)
     counter(f"dist.compile_cache.{'miss' if fn is None else 'hit'}").inc()
+    _tl.instant(f"dist.compile_cache.{'miss' if fn is None else 'hit'}",
+                cat="dist", shards=axis_size)
     gauge("dist.mesh_devices").set(axis_size)
     if fn is None:
         program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
@@ -184,7 +187,7 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
 
         out_spec = PartitionSpec() if replicated_out else PartitionSpec(axis)
         fn = jax.jit(partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(PartitionSpec(axis), PartitionSpec(axis),
                       PartitionSpec()),
@@ -193,7 +196,24 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
         )(sharded_program))
         _DIST_COMPILED[key] = fn
 
+    tl_on = _tl.enabled()
+    t0 = _tl.now_us() if tl_on else 0.0
     out_cols, sel = fn(bound.exec_cols, dist.row_mask, bound.side_inputs)
+    if tl_on:
+        # Block so the recorded interval covers device wall, then emit it
+        # once per shard lane: the host cannot observe per-core device
+        # timelines without the jax profiler, but the shard_map program is
+        # SPMD — every shard runs the same program over the same interval,
+        # and the replicated-out group-by merge is its ICI collective.
+        out_cols, sel = jax.block_until_ready((out_cols, sel))
+        dur = _tl.now_us() - t0
+        _tl.add_complete("dist.dispatch", "dist", t0, dur, lane="dist",
+                         shards=axis_size, replicated=replicated_out)
+        if replicated_out:
+            for s in range(axis_size):
+                _tl.add_complete("ici.psum", "ici", t0, dur,
+                                 lane=f"shard-{s}", shard=s,
+                                 collective="psum")
     if replicated_out:
         return materialize(bound, out_cols, sel)
     order = [nm for nm in _final_order(plan.steps, bound.input_names)

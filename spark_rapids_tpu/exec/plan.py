@@ -410,7 +410,7 @@ class Plan:
         return Plan(self.steps + (LimitStep(int(k)),))
 
     # -- execution ---------------------------------------------------------
-    def run(self, table: Table) -> Table:
+    def run(self, table: Table, trace_timeline=None) -> Table:
         """Execute against ``table``: one device program, then one host
         sync to slice data-dependent output sizes (zero syncs when every
         output size is static).
@@ -423,8 +423,20 @@ class Plan:
         the bucket schedule as a last resort, recombining pieces so the
         result is identical to the unsplit run (see
         :mod:`spark_rapids_tpu.resilience`).  Unrecoverable failures raise
-        ``ExecutionRecoveryError`` chained to the original error."""
+        ``ExecutionRecoveryError`` chained to the original error.
+
+        ``trace_timeline`` records the run on the span timeline
+        (obs/timeline.py) regardless of ``SRT_TRACE_TIMELINE``: ``True``
+        just records (read back via ``obs.timeline.events()``), a path
+        string also exports the run's slice as Chrome-trace JSON
+        (open at https://ui.perfetto.dev)."""
         from .compile import run_plan
+        if trace_timeline:
+            from ..obs.timeline import recording
+            path = trace_timeline if isinstance(trace_timeline, str) \
+                else None
+            with recording(path):
+                return run_plan(self, table)
         return run_plan(self, table)
 
     def run_padded(self, table: Table):
@@ -442,26 +454,31 @@ class Plan:
         from .compile import explain_plan
         return explain_plan(self, table)
 
-    def explain_analyze(self, table: Table) -> str:
+    def explain_analyze(self, table: Table, timeline: bool = False) -> str:
         """``explain`` annotated with MEASURED per-step metrics (Spark
         ``EXPLAIN ANALYZE`` analog): live rows in/out, selection density,
         per-step wall time, plus bind/compile/execute/materialize phase
         times and the compile-cache status of the fused program.  Runs
         the query (once fused for phase times, once step-by-step for the
         per-step numbers) when ``SRT_METRICS=1``; otherwise renders the
-        same tree with metrics marked unavailable."""
+        same tree with metrics marked unavailable.  ``timeline=True``
+        appends the span-timeline lane summary of the analyzed run."""
         from .compile import explain_analyze_plan
-        return explain_analyze_plan(self, table)
+        return explain_analyze_plan(self, table, timeline=timeline)
 
     def run_stream(self, batches, inflight=None, combine="auto",
-                   prefetch=False):
+                   prefetch=False, trace_timeline=None):
         """Execute over a batch iterator with up to ``inflight`` batches
         dispatched but unmaterialized (async pipelining + buffer
         donation; see :mod:`.stream`).  Yields one Table per batch, or a
-        single aggregated Table in streaming combine mode."""
+        single aggregated Table in streaming combine mode.
+        ``trace_timeline`` records the stream on the span timeline
+        (``True`` = record only, path string = export Chrome-trace JSON
+        when the stream finishes)."""
         from .stream import run_plan_stream
         return run_plan_stream(self, batches, inflight=inflight,
-                               combine=combine, prefetch=prefetch)
+                               combine=combine, prefetch=prefetch,
+                               trace_timeline=trace_timeline)
 
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`

@@ -199,7 +199,8 @@ def _combine_setup(bound):
 
 def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                     combine: Union[str, bool] = "auto",
-                    prefetch: Union[bool, int] = False) -> Iterator:
+                    prefetch: Union[bool, int] = False,
+                    trace_timeline: Union[None, bool, str] = None) -> Iterator:
     """Drive ``plan`` over ``batches`` with up to ``inflight`` batches
     dispatched but unmaterialized.  Yields one Table per batch (bit-equal
     to ``run_plan`` on that batch), or — in streaming combine mode — ONE
@@ -215,6 +216,12 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                    in a worker thread; ``True`` uses ``SRT_PREFETCH_DEPTH``,
                    an int sets the queue depth.  Leave False for sources
                    that already prefetch (``scan_parquet``).
+    ``trace_timeline``  record the stream on the span timeline
+                   (obs/timeline.py) regardless of ``SRT_TRACE_TIMELINE``:
+                   ``True`` records only; a path string additionally
+                   exports the stream's slice as Chrome-trace JSON —
+                   with per-batch lanes, so in-flight overlap is visible
+                   in Perfetto — when the stream finishes.
 
     Stream metrics (batch count, donation hits, peak in-flight depth,
     overlap ratio) land in ``obs.last_stream_metrics()`` after the
@@ -232,12 +239,29 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
             and (not isinstance(prefetch, int) or prefetch < 1):
         raise ValueError(f"prefetch must be a bool or an int >= 1, "
                          f"got {prefetch!r}")
+    if trace_timeline is not None and not isinstance(trace_timeline,
+                                                     (bool, str)):
+        raise ValueError(f"trace_timeline must be None, a bool, or an "
+                         f"export path, got {trace_timeline!r}")
     if combine is True:
         obstacles = combine_obstacles(plan)
         if obstacles:
             raise TypeError("plan cannot stream-combine: "
                             + "; ".join(obstacles))
-    return _stream(plan, batches, inflight, combine, prefetch)
+    gen = _stream(plan, batches, inflight, combine, prefetch)
+    if trace_timeline:
+        return _recorded_stream(gen, trace_timeline
+                                if isinstance(trace_timeline, str) else None)
+    return gen
+
+
+def _recorded_stream(gen, path):
+    """Wrap a stream driver in a forced timeline recording; the export
+    (when ``path`` is set) happens when the stream finishes or is
+    dropped."""
+    from ..obs.timeline import recording
+    with recording(path):
+        yield from gen
 
 
 def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
@@ -302,6 +326,8 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_stream_metrics(qm)
+    from ..obs.history import maybe_record
+    maybe_record(plan, qm)
 
 
 def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
@@ -319,26 +345,32 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
     the deque as a ready result, so output order — and therefore the
     yielded stream — is bit-identical to a no-fault run."""
     from ..obs.metrics import counter, gauge
+    from ..obs.timeline import instant as _tinstant, span as _tspan
     from ..resilience import fault_point
     from ..resilience.classify import ExecutionRecoveryError
     from ..resilience.recovery import SplitUnavailable, oom_ladder
     from .compile import (_bind, _compiled_for, _split_batch,
                           compiled_stream_for, materialize, run_plan_eager)
 
-    pending: deque = deque()    # ("exec", bound, out_cols, sel) | ("ready", t)
+    # ("exec", bound, out_cols, sel, batch_idx) | ("ready", t, batch_idx);
+    # the batch index names the entry's timeline lane, so the dispatch/
+    # materialize overlap across in-flight batches is visually checkable.
+    pending: deque = deque()
     inflight_gauge = gauge("stream.inflight_depth")
 
-    def materialize_entry(idx_or_entry):
-        _, bound, out_cols, sel = idx_or_entry
-        return oom_ladder("materialize",
-                          lambda: materialize(bound, out_cols, sel))
+    def materialize_entry(entry):
+        _, bound, out_cols, sel, bi = entry
+        with _tspan("stream.materialize", cat="stream",
+                    lane=f"batch-{bi}", batch=bi):
+            return oom_ladder("materialize",
+                              lambda: materialize(bound, out_cols, sel))
 
     def drain_inflight():
         """Recovery hook: turn every pending dispatch into a ready
         Table in place, releasing its device output buffers."""
         for i, entry in enumerate(pending):
             if entry[0] == "exec":
-                pending[i] = ("ready", materialize_entry(entry))
+                pending[i] = ("ready", materialize_entry(entry), entry[4])
 
     def drain_oldest():
         entry = pending.popleft()
@@ -349,14 +381,18 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
         acct.mat_s += _time.perf_counter() - t0
         return out
 
-    for batch in source:
+    for bi, batch in enumerate(source):
+        lane = f"batch-{bi}"
         if batch.num_rows == 0:
-            pending.append(("ready", run_plan_eager(plan, batch)))
+            pending.append(("ready", run_plan_eager(plan, batch), bi))
         else:
             t0 = _time.perf_counter()
-            bound_holder = [oom_ladder(
-                "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
-                drain=drain_inflight)]
+            with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
+                        rows=batch.num_rows):
+                bound_holder = [oom_ladder(
+                    "bind",
+                    lambda: (fault_point("bind"), _bind(plan, batch))[1],
+                    drain=drain_inflight)]
             acct.bind_s += _time.perf_counter() - t0
 
             def do_dispatch():
@@ -376,14 +412,19 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
 
             t0 = _time.perf_counter()
             try:
-                (out_cols, sel), reclaimed = oom_ladder(
-                    "dispatch", do_dispatch, drain=drain_inflight)
+                with _tspan("stream.dispatch", cat="stream", lane=lane,
+                            batch=bi):
+                    (out_cols, sel), reclaimed = oom_ladder(
+                        "dispatch", do_dispatch, drain=drain_inflight)
             except ExecutionRecoveryError as err:
                 if err.category != "oom":
                     raise
                 try:    # last rung: split the batch, ride as ready
-                    pending.append(
-                        ("ready", _split_batch(plan, batch, None, 0)))
+                    with _tspan("stream.split", cat="stream", lane=lane,
+                                batch=bi):
+                        pending.append(
+                            ("ready", _split_batch(plan, batch, None, 0),
+                             bi))
                 except SplitUnavailable as unavailable:
                     err.add_step(f"split-unavailable: {unavailable}")
                     raise err
@@ -392,11 +433,15 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
                 if reclaimed:
                     acct.donation_hits += 1
                     counter("stream.donation.hit").inc()
+                    _tinstant("stream.donation.hit", cat="stream",
+                              lane=lane, batch=bi)
                 else:
                     acct.donation_misses += 1
                     counter("stream.donation.miss").inc()
+                    _tinstant("stream.donation.miss", cat="stream",
+                              lane=lane, batch=bi)
                 acct.dispatch_s += _time.perf_counter() - t0
-                pending.append(("exec", bound_holder[0], out_cols, sel))
+                pending.append(("exec", bound_holder[0], out_cols, sel, bi))
         while len(pending) > k:
             yield drain_oldest()
         depth = sum(1 for e in pending if e[0] == "exec")
@@ -420,6 +465,7 @@ def _drive_combine(plan, source, k: int, acct: _Account,
     import jax
 
     from ..obs.metrics import counter, gauge
+    from ..obs.timeline import instant as _tinstant, span as _tspan
     from ..resilience import fault_point
     from ..resilience.classify import ExecutionRecoveryError
     from ..resilience.recovery import SplitUnavailable, oom_ladder
@@ -469,16 +515,19 @@ def _drive_combine(plan, source, k: int, acct: _Account,
                                    drain=drain_levels))
         return stream_combine()(accs[0], accs[1])
 
-    for batch in source:
+    for bi, batch in enumerate(source):
+        lane = f"batch-{bi}"
         if smeta is None:
             consumed.append(batch)
         if batch.num_rows == 0:
             last_empty = batch          # contributes no groups
             continue
         t0 = _time.perf_counter()
-        bound_holder = [oom_ladder(
-            "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
-            drain=drain_levels)]
+        with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
+                    rows=batch.num_rows):
+            bound_holder = [oom_ladder(
+                "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
+                drain=drain_levels)]
         acct.bind_s += _time.perf_counter() - t0
         if smeta is None:
             try:
@@ -511,13 +560,17 @@ def _drive_combine(plan, source, k: int, acct: _Account,
 
         t0 = _time.perf_counter()
         try:
-            acc, reclaimed = oom_ladder("dispatch", do_partial,
-                                        drain=drain_levels)
+            with _tspan("stream.partial", cat="stream", lane=lane,
+                        batch=bi):
+                acc, reclaimed = oom_ladder("dispatch", do_partial,
+                                            drain=drain_levels)
         except ExecutionRecoveryError as err:
             if err.category != "oom":
                 raise
             try:
-                acc = split_partial(batch)
+                with _tspan("stream.split", cat="stream", lane=lane,
+                            batch=bi):
+                    acc = split_partial(batch)
             except SplitUnavailable as unavailable:
                 err.add_step(f"split-unavailable: {unavailable}")
                 raise err
@@ -525,18 +578,24 @@ def _drive_combine(plan, source, k: int, acct: _Account,
         if reclaimed:
             acct.donation_hits += 1
             counter("stream.donation.hit").inc()
+            _tinstant("stream.donation.hit", cat="stream", lane=lane,
+                      batch=bi)
         else:
             acct.donation_misses += 1
             counter("stream.donation.miss").inc()
+            _tinstant("stream.donation.miss", cat="stream", lane=lane,
+                      batch=bi)
         merge = stream_combine()
         i = 0
         while i < len(levels) and levels[i] is not None:
             lv, acc_in = levels[i], acc
-            acc = oom_ladder(
-                "stream-combine",
-                lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
-                                         merge(lv, a))[1],
-                drain=drain_levels)
+            with _tspan("stream.combine", cat="stream", lane="combine",
+                        level=i, batch=bi):
+                acc = oom_ladder(
+                    "stream-combine",
+                    lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
+                                             merge(lv, a))[1],
+                    drain=drain_levels)
             levels[i] = None
             i += 1
         if i == len(levels):
@@ -549,7 +608,9 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             acct.peak_inflight = since_block
             inflight_gauge.set(since_block)
         if since_block >= k:
-            jax.block_until_ready(levels[i])
+            with _tspan("stream.backpressure", cat="stream",
+                        lane="combine", level=i):
+                jax.block_until_ready(levels[i])
             since_block = 0
 
     if smeta is None:
@@ -565,14 +626,16 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             total = lv
             continue
         t, l = total, lv
-        total = oom_ladder(
-            "stream-combine",
-            lambda t=t, l=l: (fault_point("stream-combine"),
-                              merge(t, l))[1])
+        with _tspan("stream.combine", cat="stream", lane="combine"):
+            total = oom_ladder(
+                "stream-combine",
+                lambda t=t, l=l: (fault_point("stream-combine"),
+                                  merge(t, l))[1])
     t0 = _time.perf_counter()
-    out = oom_ladder(
-        "materialize",
-        lambda: stream_finalize(bound0, smeta, total, dtypes))
+    with _tspan("stream.finalize", cat="stream", lane="combine"):
+        out = oom_ladder(
+            "materialize",
+            lambda: stream_finalize(bound0, smeta, total, dtypes))
     acct.mat_s += _time.perf_counter() - t0
     yield out
 

@@ -69,12 +69,23 @@ def prefetch(iterable: Iterable, depth: Optional[int] = None,
         return False
 
     def worker():
+        # Spans land on the "srt-prefetch" thread's own timeline lane, so
+        # the Perfetto view shows IO/decode overlapping device compute.
+        from ..obs.timeline import span as _tspan
         try:
-            for item in iterable:
+            it = iter(iterable)
+            while True:
+                with _tspan("io.prefetch.next", cat="io"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
                 if stop.is_set():
                     return
-                if not put(transform(item) if transform is not None
-                           else item):
+                if transform is not None:
+                    with _tspan("io.prefetch.transform", cat="io"):
+                        item = transform(item)
+                if not put(item):
                     return
             put(_SENTINEL)
         except BaseException as e:          # propagate to the consumer
@@ -148,12 +159,14 @@ def _read_retry(fn, site: str = "read"):
     exception (worker-side traceback and chain intact) with the
     attempted-recovery summary attached.  ``site`` is the fault-injection
     hook: ``SRT_FAULT=io:read:...`` flakes exactly here."""
+    from ..obs.timeline import span as _tspan
     from ..resilience import fault_point, with_retries
     from ..resilience.classify import CATEGORY_IO
 
     def attempt():
-        fault_point(site)
-        return fn()
+        with _tspan("io.read", cat="io", site=site):
+            fault_point(site)
+            return fn()
 
     return with_retries(attempt, retryable=(CATEGORY_IO,), site=site)
 

@@ -6,16 +6,22 @@ whole-plan XLA programs are opaque by construction, so :mod:`.metrics`
 provides the substrate (named counters/gauges/timers, no-op unless
 ``SRT_METRICS=1``) and :mod:`.query` the per-plan record populated by
 exec/compile.py and surfaced through ``Plan.explain_analyze`` and the
-benchmarks' JSON output.
+benchmarks' JSON output.  :mod:`.timeline` adds the fourth pillar —
+span events on per-batch/per-shard lanes exported as Chrome-trace JSON
+(``SRT_TRACE_TIMELINE=1``) — and :mod:`.history` persists finished
+``QueryMetrics`` as JSONL keyed by plan fingerprint
+(``SRT_METRICS_HISTORY=path``).
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
 in the XLA stack.
 """
 
+from . import history, timeline
+from .history import load as load_history, plan_fingerprint
 from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
                       counter, counters_delta, gauge, registry, timer)
-from .query import (QueryMetrics, StepMetrics, bench_cache_line,
+from .query import (QueryMetrics, StepMetrics, bench_cache_line, bench_line,
                     bench_metrics_line, bench_recovery_line,
                     bench_stream_line, last_query_metrics,
                     last_stream_metrics, set_last_query_metrics,
@@ -30,16 +36,21 @@ __all__ = [
     "StepMetrics",
     "Timer",
     "bench_cache_line",
+    "bench_line",
     "bench_metrics_line",
     "bench_recovery_line",
     "bench_stream_line",
     "counter",
     "counters_delta",
     "gauge",
+    "history",
     "last_query_metrics",
     "last_stream_metrics",
+    "load_history",
+    "plan_fingerprint",
     "registry",
     "set_last_query_metrics",
     "set_last_stream_metrics",
+    "timeline",
     "timer",
 ]

@@ -1,0 +1,112 @@
+"""Metrics-history sink — persisted per-plan QueryMetrics records.
+
+ROADMAP item 4 (adaptive plan optimizer) needs each recurring plan's own
+measured history to re-optimize from; regression tooling needs the same
+records the benchmarks write.  This module provides both ends of that
+file: when ``SRT_METRICS_HISTORY=path`` is set, every finished
+:class:`~.query.QueryMetrics` (run / analyze / stream) appends **one JSONL
+record** keyed by a stable plan fingerprint, and :func:`load` reads the
+records back.
+
+The fingerprint hashes the plan's step structure — frozen-dataclass reprs
+are deterministic, and embedded Tables (join build sides) contribute only
+their shape so fingerprinting never touches device data or memory
+addresses.  Identical logical plans fingerprint identically across
+processes; jax-free at import like the rest of ``obs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from typing import Any, List, Optional
+
+from ..config import metrics_history_path
+
+_LOCK = threading.Lock()
+
+
+def _describe(value: Any) -> str:
+    """Deterministic text for one plan-step field value.
+
+    Tables (anything row/column shaped) render as their shape only —
+    repr() of a device-backed Table would either sync or embed buffer
+    addresses, both of which break cross-process stability.
+    """
+    if hasattr(value, "num_rows") and hasattr(value, "names"):
+        names = tuple(value.names)
+        return f"<table {value.num_rows}x{len(names)} {names}>"
+    if hasattr(value, "steps"):                       # nested sub-plan
+        return f"<plan {_plan_text(value)}>"
+    if isinstance(value, (tuple, list)):
+        inner = ",".join(_describe(v) for v in value)
+        return f"[{inner}]" if isinstance(value, list) else f"({inner})"
+    if isinstance(value, dict):
+        items = ",".join(f"{k!r}:{_describe(v)}"
+                         for k, v in sorted(value.items(), key=repr))
+        return "{" + items + "}"
+    return repr(value)
+
+
+def _plan_text(plan: Any) -> str:
+    parts = []
+    for step in plan.steps:
+        if dataclasses.is_dataclass(step):
+            fields = ";".join(
+                f"{f.name}={_describe(getattr(step, f.name))}"
+                for f in dataclasses.fields(step))
+            parts.append(f"{type(step).__name__}({fields})")
+        else:
+            parts.append(repr(step))
+    return "|".join(parts)
+
+
+def plan_fingerprint(plan: Any) -> str:
+    """Stable 16-hex-digit fingerprint of a plan's logical structure."""
+    return hashlib.sha256(_plan_text(plan).encode()).hexdigest()[:16]
+
+
+def record(plan: Any, qm: Any, path: str) -> dict:
+    """Append one history record for ``qm`` to ``path``; returns it."""
+    rec = {"fingerprint": plan_fingerprint(plan), **qm.to_dict()}
+    line = json.dumps(rec, sort_keys=True)
+    with _LOCK:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    return rec
+
+
+def maybe_record(plan: Any, qm: Any) -> Optional[dict]:
+    """History hook called by the execution paths: one env read when the
+    sink is unset, one appended JSONL line when it is."""
+    path = metrics_history_path()
+    if path is None or qm is None:
+        return None
+    return record(plan, qm, path)
+
+
+def load(fingerprint: Optional[str] = None,
+         path: Optional[str] = None) -> List[dict]:
+    """Read history records (all, or just one plan's).
+
+    ``path`` defaults to ``SRT_METRICS_HISTORY``.  Returns ``[]`` when the
+    sink is unset or the file does not exist yet — the optimizer's
+    cold-start case, not an error.
+    """
+    if path is None:
+        path = metrics_history_path()
+    if path is None or not os.path.exists(path):
+        return []
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if fingerprint is None or rec.get("fingerprint") == fingerprint:
+                out.append(rec)
+    return out

@@ -264,25 +264,24 @@ def last_stream_metrics() -> Optional[QueryMetrics]:
         return _LAST_STREAM
 
 
-def bench_metrics_line() -> str:
-    """The benchmarks' second JSON line (behind ``SRT_METRICS=1``): the
-    last query's ``to_json()`` when a metered plan ran, else the global
-    registry snapshot (bench programs that never build a Plan still get
-    their cache/IO/host-sync counters captured)."""
+def _metrics_payload() -> dict:
+    """Payload for ``bench_line("metrics")``: the last query's
+    ``to_dict()`` when a metered plan ran, else the global registry
+    snapshot (bench programs that never build a Plan still get their
+    cache/IO/host-sync counters captured)."""
     qm = last_query_metrics()
     if qm is not None:
-        return qm.to_json()
+        return qm.to_dict()
     from .metrics import registry
-    return json.dumps({"metric": "srt_metrics",
-                       "counters": registry().snapshot()}, sort_keys=True)
+    return {"metric": "srt_metrics", "counters": registry().snapshot()}
 
 
-def bench_cache_line() -> str:
-    """The benchmarks' compile-cache/bucketing JSON line (one line, stable
-    key order): whole-plan cache hit rate, distinct shapes bound, and the
-    pad-waste fraction of the shape-bucketing layer — the bench-trajectory
-    view of the bucketing win.  Separate from ``bench_metrics_line`` so
-    the golden-pinned QueryMetrics schema stays untouched."""
+def _cache_payload() -> dict:
+    """Payload for ``bench_line("cache")``: whole-plan cache hit rate,
+    distinct shapes bound, and the pad-waste fraction of the
+    shape-bucketing layer — the bench-trajectory view of the bucketing
+    win.  Separate from the metrics payload so the golden-pinned
+    QueryMetrics schema stays untouched."""
     from .metrics import registry
     snap = registry().snapshot()
     hits = int(snap.get("plan.compile_cache.hit", 0))
@@ -291,7 +290,7 @@ def bench_cache_line() -> str:
     pad_rows = int(snap.get("plan.bucket.pad_rows", 0))
     rows_total = int(snap.get("plan.bucket.rows_total", 0))
     from ..exec.bucketing import bucket_stats   # lazy: exec pulls in jax
-    payload = {
+    return {
         "metric": "compile_cache",
         "hits": hits,
         "misses": misses,
@@ -304,21 +303,17 @@ def bench_cache_line() -> str:
                           pad_waste_frac=(round(pad_rows / rows_total, 6)
                                           if rows_total else 0.0)),
     }
-    return json.dumps(payload, sort_keys=True)
 
 
-def bench_stream_line() -> str:
-    """The benchmarks' streaming-pipeline JSON line (one line, stable key
-    order): wall vs. serial phase-sum time, the overlap ratio, and the
-    donation-reuse counters of the last ``run_plan_stream`` — the
-    bench-trajectory view of pipeline efficiency.  Separate from
-    ``bench_metrics_line`` so the golden-pinned QueryMetrics schema stays
-    untouched.  ``{"runs": 0}`` before any stream completes."""
+def _stream_payload() -> dict:
+    """Payload for ``bench_line("stream")``: wall vs. serial phase-sum
+    time, the overlap ratio, and the donation-reuse counters of the last
+    ``run_plan_stream`` — the bench-trajectory view of pipeline
+    efficiency.  ``{"runs": 0}`` before any stream completes."""
     qm = last_stream_metrics()
     if qm is None:
-        return json.dumps({"metric": "stream_exec", "runs": 0},
-                          sort_keys=True)
-    payload = {
+        return {"metric": "stream_exec", "runs": 0}
+    return {
         "metric": "stream_exec",
         "runs": 1,
         "batches": qm.stream_batches,
@@ -333,19 +328,16 @@ def bench_stream_line() -> str:
         "source_seconds": round(qm.stream_source_seconds, 6),
         "overlap_ratio": round(qm.stream_overlap_ratio, 6),
     }
-    return json.dumps(payload, sort_keys=True)
 
 
-def bench_recovery_line() -> str:
-    """The benchmarks' resilience JSON line (one line, stable key order):
-    the process-lifetime recovery totals — retries taken, batch splits,
-    cache evictions, backoff slept, faults injected — so a
-    ``--faults`` bench run shows recovery actually engaging.  Separate
-    from ``bench_metrics_line`` so the golden-pinned QueryMetrics schema
-    stays untouched."""
+def _recovery_payload() -> dict:
+    """Payload for ``bench_line("recovery")``: the process-lifetime
+    recovery totals — retries taken, batch splits, cache evictions,
+    backoff slept, faults injected — so a ``--faults`` bench run shows
+    recovery actually engaging."""
     from ..resilience import recovery_stats
     snap = recovery_stats().snapshot()
-    payload = {
+    return {
         "metric": "recovery",
         "retries": int(snap["retries"]),
         "splits": int(snap["splits"]),
@@ -353,4 +345,50 @@ def bench_recovery_line() -> str:
         "backoff_seconds": round(float(snap["backoff_seconds"]), 6),
         "faults_injected": int(snap["faults_injected"]),
     }
-    return json.dumps(payload, sort_keys=True)
+
+
+_BENCH_PAYLOADS = {
+    "metrics": _metrics_payload,
+    "cache": _cache_payload,
+    "stream": _stream_payload,
+    "recovery": _recovery_payload,
+}
+
+
+def bench_line(kind: str) -> str:
+    """One benchmark JSON line (single line, sorted keys) for ``kind``.
+
+    Kinds: ``"metrics"`` (last QueryMetrics or registry snapshot),
+    ``"cache"`` (compile cache + bucketing), ``"stream"`` (last streaming
+    run), ``"recovery"`` (process-lifetime resilience totals).  The four
+    legacy ``bench_*_line`` names are thin wrappers over this and emit
+    byte-identical output.
+    """
+    builder = _BENCH_PAYLOADS.get(kind)
+    if builder is None:
+        raise ValueError(f"unknown bench line kind {kind!r} "
+                         f"(have {sorted(_BENCH_PAYLOADS)})")
+    return json.dumps(builder(), sort_keys=True)
+
+
+def bench_metrics_line() -> str:
+    """Thin wrapper: ``bench_line("metrics")`` (the benchmarks' second
+    JSON line behind ``SRT_METRICS=1``)."""
+    return bench_line("metrics")
+
+
+def bench_cache_line() -> str:
+    """Thin wrapper: ``bench_line("cache")`` (compile-cache/bucketing
+    bench line)."""
+    return bench_line("cache")
+
+
+def bench_stream_line() -> str:
+    """Thin wrapper: ``bench_line("stream")`` (streaming-pipeline bench
+    line)."""
+    return bench_line("stream")
+
+
+def bench_recovery_line() -> str:
+    """Thin wrapper: ``bench_line("recovery")`` (resilience bench line)."""
+    return bench_line("recovery")

@@ -1,0 +1,322 @@
+"""Structured span timeline — Chrome-trace/Perfetto export for the engine.
+
+The counters/gauges/timers registry (obs/metrics.py) answers *how much*;
+this module answers *when and concurrently with what*.  It is the fourth
+observability pillar: an in-process event recorder whose spans carry a
+category, free-form args (query id, batch index, bucket, shard, ...) and a
+**lane** — a named horizontal track in the exported trace.  Per-batch
+lanes make the streaming executor's decode/dispatch/materialize overlap
+visually verifiable; per-shard lanes attribute dist-path time to ICI
+collectives vs compute vs host syncs (ROADMAP item 1).
+
+Contract (mirrors obs/metrics.py):
+
+  * no-op unless ``SRT_TRACE_TIMELINE=1`` or a :func:`recording` scope is
+    active — off, :func:`span` returns a shared null scope and callers pay
+    one env read per span region, never per row;
+  * jax-free at import (pinned by an import-hygiene test) so host-only
+    tooling can record and export without an accelerator stack;
+  * the export is standard Chrome Trace Event Format JSON — open it at
+    https://ui.perfetto.dev or ``chrome://tracing``.  Event key sets are
+    golden-pinned (tests/golden/chrome_trace_schema.json) and checked by
+    :func:`validate_chrome_trace` in both tests and the premerge lane.
+
+Event mapping: spans emit ``"X"`` (complete) events with microsecond
+``ts``/``dur``; :func:`instant` emits ``"i"`` events; each lane name is
+announced once via an ``"M"`` ``thread_name`` metadata event.  All events
+share ``pid`` 1; ``tid`` is a stable small integer per lane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..config import timeline_enabled as _env_enabled
+
+_PID = 1
+
+_LOCK = threading.RLock()
+_EVENTS: List[dict] = []
+_LANES: Dict[str, int] = {}      # lane name -> tid (stable per process)
+_FORCED = 0                      # nesting depth of recording() scopes
+
+
+def now_us() -> float:
+    """Current timestamp on the timeline clock (microseconds)."""
+    return time.perf_counter() * 1e6
+
+
+def enabled() -> bool:
+    """True when events are being recorded (env flag or active
+    :func:`recording` scope).  One env read; safe to call per region."""
+    return _FORCED > 0 or _env_enabled()
+
+
+def _coerce(value: Any) -> Any:
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    return repr(value)
+
+
+def _lane_tid(lane: Optional[str]) -> int:
+    """tid for ``lane``, announcing new lanes with an ``M`` event.
+
+    ``None`` means "the current thread" — the natural lane for code that
+    is not batch- or shard-attributed (compile, resilience, host syncs).
+    Must be called with ``_LOCK`` held.
+    """
+    if lane is None:
+        t = threading.current_thread()
+        lane = t.name or f"thread-{t.ident}"
+    tid = _LANES.get(lane)
+    if tid is None:
+        tid = len(_LANES) + 1
+        _LANES[lane] = tid
+        _EVENTS.append({"name": "thread_name", "ph": "M", "pid": _PID,
+                        "tid": tid, "args": {"name": lane}})
+    return tid
+
+
+def add_complete(name: str, cat: str, start_us: float, dur_us: float,
+                 lane: Optional[str] = None, **args: Any) -> None:
+    """Append one finished span (``X`` event) with explicit timestamps.
+
+    The low-level entry point for host-side *emulated* device lanes: the
+    dist path records one blocking interval and fans it out as one event
+    per ``shard-{i}`` lane, since per-core device timelines are not
+    observable from the host without the jax profiler.
+    """
+    if not enabled():
+        return
+    with _LOCK:
+        _EVENTS.append({
+            "name": name, "cat": cat, "ph": "X", "pid": _PID,
+            "tid": _lane_tid(lane), "ts": round(start_us, 3),
+            "dur": round(max(dur_us, 0.0), 3),
+            "args": {k: _coerce(v) for k, v in args.items()},
+        })
+
+
+def instant(name: str, cat: str = "engine", lane: Optional[str] = None,
+            **args: Any) -> None:
+    """Record a point-in-time event (``i``): cache hit/miss, recovery
+    rung, donation hit, host sync — anything without duration."""
+    if not enabled():
+        return
+    with _LOCK:
+        _EVENTS.append({
+            "name": name, "cat": cat, "ph": "i", "pid": _PID,
+            "tid": _lane_tid(lane), "ts": round(now_us(), 3), "s": "t",
+            "args": {k: _coerce(v) for k, v in args.items()},
+        })
+
+
+class _Span:
+    """An open span; closes via ``with`` or an explicit :meth:`end`."""
+
+    __slots__ = ("name", "cat", "lane", "args", "_t0", "_done")
+
+    def __init__(self, name: str, cat: str, lane: Optional[str],
+                 args: Dict[str, Any]):
+        self.name, self.cat, self.lane, self.args = name, cat, lane, args
+        self._t0 = now_us()
+        self._done = False
+
+    def __enter__(self) -> "_Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        add_complete(self.name, self.cat, self._t0, now_us() - self._t0,
+                     self.lane, **self.args)
+
+
+class _NullSpan:
+    """Shared do-nothing span handed out when recording is off."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def end(self) -> None:
+        return None
+
+
+NULL_SPAN = _NullSpan()
+
+
+def span(name: str, cat: str = "engine", lane: Optional[str] = None,
+         **args: Any):
+    """Open a span; use as a context manager (or call ``.end()``).
+
+    Off: returns the shared :data:`NULL_SPAN` (identity-comparable, zero
+    allocation).  ``lane`` names the horizontal track; ``None`` uses the
+    current thread's name.
+    """
+    if not enabled():
+        return NULL_SPAN
+    return _Span(name, cat, lane, args)
+
+
+def begin(name: str, cat: str = "engine", lane: Optional[str] = None,
+          **args: Any):
+    """Open a span without entering a ``with`` block; close via ``.end()``.
+    For spans whose begin and end live in different scopes (async drains)."""
+    return span(name, cat, lane, **args)
+
+
+def events() -> List[dict]:
+    """Snapshot of all recorded events (copies the list, not the dicts)."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def reset() -> None:
+    """Drop all recorded events and lane assignments (test isolation)."""
+    with _LOCK:
+        _EVENTS.clear()
+        _LANES.clear()
+
+
+def export_chrome_trace(path: Optional[str] = None,
+                        event_list: Optional[List[dict]] = None) -> dict:
+    """Build (and optionally write) the Chrome-trace JSON payload.
+
+    ``{"displayTimeUnit": "ms", "traceEvents": [...]}`` — the exact shape
+    Perfetto and ``chrome://tracing`` load.  Returns the payload dict.
+    """
+    evs = events() if event_list is None else event_list
+    payload = {"displayTimeUnit": "ms", "traceEvents": evs}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(payload, f, sort_keys=True)
+    return payload
+
+
+def summary_table(event_list: Optional[List[dict]] = None) -> str:
+    """Compact per-(category, name) rollup of spans and instants."""
+    evs = events() if event_list is None else event_list
+    spans: Dict[tuple, List[float]] = {}
+    instants: Dict[tuple, int] = {}
+    lanes = set()
+    for e in evs:
+        ph = e.get("ph")
+        if ph == "X":
+            spans.setdefault((e.get("cat", ""), e["name"]), []).append(
+                e.get("dur", 0.0))
+            lanes.add(e["tid"])
+        elif ph == "i":
+            key = (e.get("cat", ""), e["name"])
+            instants[key] = instants.get(key, 0) + 1
+            lanes.add(e["tid"])
+    lines = [f"== Timeline: {len(evs)} events, {len(lanes)} lanes =="]
+    if spans:
+        lines.append(f"  {'category':<12}{'span':<28}{'count':>6}"
+                     f"{'total':>12}")
+        for (cat, name), durs in sorted(
+                spans.items(), key=lambda kv: -sum(kv[1])):
+            lines.append(f"  {cat:<12}{name:<28}{len(durs):>6}"
+                         f"{sum(durs) / 1e3:>10.2f}ms")
+    if instants:
+        parts = [f"{name} x{n}" for (_, name), n in sorted(instants.items())]
+        lines.append("  instants: " + ", ".join(parts))
+    if not spans and not instants:
+        lines.append("  (no span or instant events recorded)")
+    return "\n".join(lines)
+
+
+class _Recording:
+    """Forces recording on for a region; exports its slice on exit."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._start_idx = 0
+
+    def __enter__(self) -> "_Recording":
+        global _FORCED
+        with _LOCK:
+            _FORCED += 1
+            self._start_idx = len(_EVENTS)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _FORCED
+        with _LOCK:
+            _FORCED -= 1
+        if self.path is not None:
+            export_chrome_trace(self.path, self.events())
+        return None
+
+    def events(self) -> List[dict]:
+        """Events recorded inside this scope, plus lane-name metadata
+        announced earlier (a lane first seen before the scope opened
+        would otherwise export as a bare integer tid)."""
+        with _LOCK:
+            meta = [e for e in _EVENTS[:self._start_idx]
+                    if e.get("ph") == "M"]
+            return meta + list(_EVENTS[self._start_idx:])
+
+    def summary(self) -> str:
+        return summary_table(self.events())
+
+
+def recording(path: Optional[str] = None) -> _Recording:
+    """Context manager: record events for the region regardless of
+    ``SRT_TRACE_TIMELINE`` and, if ``path`` is given, export the region's
+    slice as Chrome-trace JSON on exit.  Nests; powers the
+    ``Plan.run(trace_timeline=...)`` / ``run_plan_stream`` /
+    ``bench_queries --timeline`` surfaces."""
+    return _Recording(path)
+
+
+def validate_chrome_trace(payload: dict, schema: dict) -> List[str]:
+    """Check ``payload`` against the golden-pinned event schema.
+
+    ``schema`` is tests/golden/chrome_trace_schema.json: the exact
+    top-level key set plus, per event phase, the exact sorted key set.
+    Returns a list of human-readable problems (empty = valid).  Shared by
+    the test suite and the premerge timeline lane so both pin the same
+    contract.
+    """
+    errors: List[str] = []
+    top = sorted(payload) if isinstance(payload, dict) else None
+    if top != sorted(schema["top_level_keys"]):
+        errors.append(f"top-level keys {top} != {schema['top_level_keys']}")
+        return errors
+    phases = schema["phases"]
+    for i, ev in enumerate(payload["traceEvents"]):
+        label = f"event {i} ({ev.get('name')!r})" if isinstance(ev, dict) \
+            else f"event {i}"
+        if not isinstance(ev, dict):
+            errors.append(f"{label}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in phases:
+            errors.append(f"{label}: unknown phase {ph!r}")
+            continue
+        keys = sorted(ev)
+        if keys != phases[ph]:
+            errors.append(f"{label}: keys {keys} != pinned {phases[ph]}")
+            continue
+        if not isinstance(ev["pid"], int) or not isinstance(ev["tid"], int):
+            errors.append(f"{label}: pid/tid must be ints")
+        if ph in ("X", "i") and not isinstance(ev["ts"], (int, float)):
+            errors.append(f"{label}: ts must be a number")
+        if ph == "X" and (not isinstance(ev["dur"], (int, float))
+                          or ev["dur"] < 0):
+            errors.append(f"{label}: dur must be a non-negative number")
+        if not isinstance(ev.get("args"), dict):
+            errors.append(f"{label}: args must be an object")
+    return errors

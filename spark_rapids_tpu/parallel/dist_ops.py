@@ -27,7 +27,7 @@ from ..column import Column
 from ..dtypes import FLOAT64, INT64
 from ..ops.common import adjacent_differs, null_safe_equal_at
 from ..table import Table
-from .mesh import DistTable
+from .mesh import DistTable, shard_map
 from .shuffle import shuffle
 
 _DIST_AGGS = ("sum", "count", "min", "max", "mean")
@@ -58,7 +58,7 @@ def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
 
     n_in = 1 + 2 * len(key_cols) + 2 * len(val_cols)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * n_in,
              out_specs=(PartitionSpec(axis),) * (1 + 2 * len(key_cols)
                                                  + 2 * len(aggs)))
@@ -239,7 +239,7 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
     n_in = 2 + len(lk_flat) + len(rk_flat) + len(l_flat) + len(r_flat)
     n_out = 1 + len(l_flat) + len(r_flat) + 1
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * n_in,
              out_specs=((PartitionSpec(axis),) * (n_out - 1)
                         + (PartitionSpec(),)))

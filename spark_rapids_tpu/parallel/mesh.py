@@ -31,6 +31,19 @@ from ..table import Table
 
 AXIS = "x"    #: the partition axis name used throughout the engine
 
+# ``jax.shard_map`` graduated from jax.experimental in jax 0.6; accept
+# both so the distributed layer runs on every jax the engine supports.
+try:
+    shard_map = jax.shard_map                       # jax >= 0.6
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, **kwargs):
+        # check_vma is the jax >= 0.6 name for check_rep.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_exp(f, **kwargs)
+
 
 def make_mesh(devices: Optional[Sequence] = None, axis_name: str = AXIS) -> Mesh:
     """A 1-D mesh over all (or the given) devices.
@@ -74,7 +87,10 @@ class DistTable:
 
     def num_rows(self) -> int:
         """Live row count (host sync)."""
-        return int(jnp.sum(self.row_mask))
+        count = int(jnp.sum(self.row_mask))
+        from ..utils.memory import record_host_sync
+        record_host_sync("dist.live_count", 8)
+        return count
 
 
 def shard_table(table: Table, mesh: Mesh,
@@ -116,14 +132,24 @@ def shard_table(table: Table, mesh: Mesh,
 
 
 def collect(dist: DistTable) -> Table:
-    """Materialize a DistTable on host, dropping padding slots."""
+    """Materialize a DistTable on host, dropping padding slots.
+
+    Every ``np.asarray`` of a device array below is a blocking D2H round
+    trip; they are counted so sharded runs report the same host-sync
+    totals as the single-chip path (one sync per buffer pulled, plus the
+    mask)."""
+    from ..utils.memory import record_host_sync
     mask = np.asarray(dist.row_mask)
+    record_host_sync("dist.collect", mask.nbytes)
     cols = []
     for name, col in dist.table.items():
         data = np.asarray(col.data)[mask]
+        nbytes = data.nbytes
         validity = None
         if col.validity is not None:
             v = np.asarray(col.validity)[mask]
+            nbytes += v.nbytes
             validity = None if v.all() else v
+        record_host_sync("dist.collect", nbytes)
         cols.append((name, Column.from_numpy(data, validity, dtype=col.dtype)))
     return Table(cols)

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, PartitionSpec
 from ..column import Column
 from ..table import Table
 from .hashing import partition_ids
-from .mesh import AXIS, DistTable
+from .mesh import AXIS, DistTable, shard_map
 
 
 def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
@@ -91,10 +91,24 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         mask_bytes = slab_rows * (len(dist.table.columns) + 1)
         counter("shuffle.bytes_moved").inc(data_bytes + mask_bytes)
 
+        from ..obs import timeline as _tl
+        tl_on = _tl.enabled()
+        t0 = _tl.now_us() if tl_on else 0.0
         out, overflow, occupancy = _shuffle_arrays(
             dist, mesh, pids, P, capacity, bucket_size)
         ov = bool(overflow)   # host sync; rerun with more slack
         record_host_sync("shuffle.overflow_check", 1)
+        if tl_on:
+            # The overflow check above already blocked on the shuffled
+            # slabs, so the interval covers the collective's device wall;
+            # emit it on every shard lane — the all_to_all is the one
+            # all-shards ICI exchange of the shuffle.
+            dur = _tl.now_us() - t0
+            for s in range(P):
+                _tl.add_complete("ici.all_to_all", "ici", t0, dur,
+                                 lane=f"shard-{s}", shard=s,
+                                 collective="all_to_all",
+                                 bucket_size=bucket_size)
         if not ov:
             return out
         occ = int(occupancy)  # mesh-wide max rows any one bucket needed
@@ -121,7 +135,7 @@ def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
     datas = tuple(c.data for c in dist.table.columns)
     valids = tuple(c.valid_mask() for c in dist.table.columns)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * (2 + len(datas) + len(valids)),
              out_specs=((PartitionSpec(axis),) * (1 + len(datas) + len(valids))
                         + (PartitionSpec(), PartitionSpec())))

@@ -76,25 +76,33 @@ def oom_ladder(site: str, fn: Callable,
         if category not in (CATEGORY_OOM, CATEGORY_COMPILE):
             raise
         original = exc
+    from ..obs.timeline import instant, span
     if policy is None:
         policy = RetryPolicy.from_env()
     stats = recovery_stats()
     summary = RecoverySummary(site=site, category=category)
     if drain is not None:
-        drain()
+        with span("recovery.drain", cat="resilience", site=site):
+            drain()
         summary.steps.append("drain-inflight")
     for attempt in range(policy.max_retries):
         dropped = evict_device_caches()
         summary.cache_evictions += dropped
         summary.steps.append(f"evict-caches[{dropped}]")
+        instant("recovery.evict_caches", cat="resilience", site=site,
+                dropped=dropped, attempt=attempt)
         delay = policy.delay(attempt)
         if delay > 0:
-            time.sleep(delay)
+            with span("recovery.backoff", cat="resilience", site=site,
+                      seconds=delay):
+                time.sleep(delay)
         summary.backoff_seconds += delay
         stats.add_backoff(delay)
         stats.add_retry()
         summary.retries += 1
         summary.steps.append("retry")
+        instant("recovery.retry", cat="resilience", site=site,
+                category=category, attempt=attempt)
         try:
             return fn()
         except Exception as exc:

@@ -139,9 +139,14 @@ def with_retries(fn: Callable, policy: Optional[RetryPolicy] = None,
                 raise original
             if on_retry is not None:
                 on_retry(attempt, exc)
+            from ..obs.timeline import instant, span
+            instant("recovery.retry", cat="resilience", site=site,
+                    category=category, attempt=attempt)
             delay = policy.delay(attempt)
             if delay > 0:
-                time.sleep(delay)
+                with span("recovery.backoff", cat="resilience", site=site,
+                          seconds=delay):
+                    time.sleep(delay)
             backoff_total += delay
             stats.add_backoff(delay)
             stats.add_retry()

@@ -150,6 +150,12 @@ def record_host_sync(label: str = "", nbytes: int = 0) -> None:
             counter(f"host.sync.{label}").inc()
         if nbytes:
             counter("host.d2h_bytes").inc(int(nbytes))
+    # Every counted sync also lands on the span timeline, so blocking
+    # round trips show up *between* spans in the Perfetto view — the
+    # attribution gap ROADMAP item 1 names (ICI vs compute vs host sync).
+    from ..obs.timeline import instant
+    instant(f"host_sync.{label}" if label else "host_sync", cat="host",
+            nbytes=int(nbytes))
 
 
 def _tree_nbytes(tree: Any) -> int:

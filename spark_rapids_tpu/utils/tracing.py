@@ -10,6 +10,13 @@ Everything here is a no-op unless ``SRT_TRACE=1`` (config.trace_enabled), so
 instrumented code pays nothing in production — the same opt-in contract as
 the NVTX toggle.
 
+:func:`trace` has a second, jax-free backend: when the structured span
+timeline is recording (``SRT_TRACE_TIMELINE=1`` or an active
+``obs.timeline.recording()`` scope) every trace scope is also recorded as
+a timeline span under category ``"trace"`` — the same instrumentation
+points feed the profiler and the Chrome-trace export.  With only the
+timeline on, no jax import happens.
+
 Usage::
 
     with trace("convert_to_rows"):
@@ -47,17 +54,57 @@ class _NullScope:
 _NULL_SCOPE = _NullScope()
 
 
+class _ComboScope:
+    """Both backends at once: timeline span + jax profiler annotation."""
+    __slots__ = ("_scopes",)
+
+    def __init__(self, *scopes):
+        self._scopes = scopes
+
+    def __enter__(self):
+        for s in self._scopes:
+            s.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s in reversed(self._scopes):
+            s.__exit__(*exc)
+        return None
+
+
+def _timeline_span(name: str, attrs: dict):
+    """The timeline backend's span for this scope, or None when the
+    recorder is off.  Avoids importing ``obs`` unless the timeline module
+    is already loaded or the env flag asks for it — a cold
+    ``import spark_rapids_tpu`` must not pull in the obs subsystem."""
+    import sys
+    tl = sys.modules.get("spark_rapids_tpu.obs.timeline")
+    if tl is None:
+        from ..config import timeline_enabled
+        if not timeline_enabled():
+            return None
+        from ..obs import timeline as tl
+    if not tl.enabled():
+        return None
+    return tl.span(name, cat="trace", **attrs)
+
+
 def trace(name: str, **attrs):
-    """Named scope visible in jax profiler captures (NVTX push/pop analog).
+    """Named scope visible in jax profiler captures (NVTX push/pop analog)
+    and, when the span timeline is recording, in the Chrome-trace export.
 
     ``attrs`` pass through as annotation metadata (profiler-visible metric
-    labels, e.g. ``trace("shuffle", partitions=8)``).  When tracing is off
-    this returns a shared null context: no profiler import, no annotation
-    construction, no attr formatting."""
+    labels, e.g. ``trace("shuffle", partitions=8)``).  When both backends
+    are off this returns a shared null context: no profiler import, no
+    annotation construction, no attr formatting."""
+    tl_span = _timeline_span(name, attrs)
     if not trace_enabled():
-        return _NULL_SCOPE
+        return tl_span if tl_span is not None else _NULL_SCOPE
     import jax.profiler
-    return jax.profiler.TraceAnnotation(name, **attrs)
+    ann = jax.profiler.TraceAnnotation(name, **attrs)
+    if tl_span is None:
+        return ann
+    return _ComboScope(tl_span, ann)
 
 
 def traced(fn: _F) -> _F:
@@ -68,15 +115,35 @@ def traced(fn: _F) -> _F:
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        if not trace_enabled():
+        scope = trace(name)
+        if scope is _NULL_SCOPE:
             return fn(*args, **kwargs)
-        with trace(name):
+        with scope:
             return fn(*args, **kwargs)
 
     return wrapper  # type: ignore[return-value]
 
 
 def start_server(port: int = 9012):
-    """Start the on-demand jax profiler server (attach via TensorBoard)."""
-    import jax.profiler
+    """Start the on-demand jax profiler server (attach via TensorBoard).
+
+    Host-only tooling gets a clear failure instead of an opaque deep
+    ImportError when jax is absent, and an explicit ``SRT_TRACE=0`` is
+    honored — a process whose operator disabled tracing refuses to open a
+    profiling port rather than silently overriding the knob.
+    """
+    import os
+    raw = os.environ.get("SRT_TRACE")
+    if raw is not None and not trace_enabled():
+        raise RuntimeError(
+            f"start_server refused: SRT_TRACE={raw!r} disables tracing "
+            f"for this process (unset it or set SRT_TRACE=1 to profile)")
+    try:
+        import jax.profiler
+    except ImportError as e:
+        raise RuntimeError(
+            "start_server requires jax (jax.profiler provides the "
+            "profiling server); this host-only environment has no jax — "
+            "install the jax stack or capture a structured timeline "
+            "instead (SRT_TRACE_TIMELINE=1, obs/timeline.py)") from e
     return jax.profiler.start_server(port)

@@ -41,7 +41,8 @@ class TestHybridMesh:
     def test_hybrid_mesh_runs_collectives(self):
         # A psum over each axis of the hybrid mesh must compile + run.
         from jax.sharding import PartitionSpec
-        from jax import shard_map
+
+        from spark_rapids_tpu.parallel.mesh import shard_map
         mesh = make_hybrid_mesh(dcn_size=2)
 
         def body(x):

@@ -132,6 +132,45 @@ def test_stream_imports_without_jax():
     assert "jaxfree" in out.stdout
 
 
+def test_timeline_records_without_jax(tmp_path):
+    """``obs.timeline`` must record spans and export Chrome-trace JSON
+    without jax (the timeline-off/-on import contract of ISSUE 6): the
+    recorder is host-side bookkeeping and the export is plain JSON, so a
+    laptop can capture and inspect a timeline with no XLA stack."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    out_path = tmp_path / "trace.json"
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.timeline as tl\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.timeline pulled in jax'\n"
+        "assert tl.enabled()  # SRT_TRACE_TIMELINE=1 below\n"
+        "with tl.span('work', cat='test', lane='lane-0', batch=0):\n"
+        "    tl.instant('tick', cat='test', lane='lane-0')\n"
+        f"payload = tl.export_chrome_trace({str(out_path)!r})\n"
+        "phases = sorted(e['ph'] for e in payload['traceEvents'])\n"
+        "assert phases == ['M', 'X', 'i'], phases\n"
+        "assert 'jax' not in sys.modules, 'recording pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    import json
+    import os
+    env = dict(os.environ)
+    env["SRT_TRACE_TIMELINE"] = "1"
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+    # The exported file is loadable JSON in the pinned Chrome-trace shape.
+    payload = json.loads(out_path.read_text())
+    assert payload["displayTimeUnit"] == "ms"
+    assert len(payload["traceEvents"]) == 3
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

@@ -1,0 +1,396 @@
+"""Structured span-timeline contracts (spark_rapids_tpu/obs/timeline.py).
+
+Five contracts:
+
+1. **Opt-in no-op** — with ``SRT_TRACE_TIMELINE`` unset and no active
+   recording, ``span()`` hands back the shared null scope and nothing is
+   recorded; the env flag and ``recording()`` both switch it on live.
+2. **Chrome-trace export** — recorded runs export the exact golden-pinned
+   event shape (tests/golden/chrome_trace_schema.json), loadable in
+   Perfetto; :func:`validate_chrome_trace` is the shared checker.
+3. **Execution coverage** — a plan run emits bind/dispatch/materialize
+   spans and cache instants; a stream run emits per-batch lanes (the
+   in-flight overlap evidence); a faulted run emits recovery instants; a
+   dist run emits per-shard ICI spans; counted host syncs emit instants.
+4. **Metrics history** — with ``SRT_METRICS_HISTORY=path`` every finished
+   QueryMetrics appends one JSONL record keyed by a fingerprint that is
+   stable across processes and plan-identity, and ``history.load`` reads
+   it back.
+5. **Bench lines** — ``bench_line(kind)`` and the four legacy wrappers
+   emit byte-identical JSON.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, assert_tables_equal
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.obs import history, registry, timeline
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "chrome_trace_schema.json"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_timeline(monkeypatch):
+    """Timeline off and empty around every test; no fault leakage."""
+    monkeypatch.delenv("SRT_TRACE_TIMELINE", raising=False)
+    monkeypatch.delenv("SRT_METRICS_HISTORY", raising=False)
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    timeline.reset()
+    reset_faults()
+    yield
+    timeline.reset()
+    reset_faults()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+def _mk(n, seed=0, khi=5):
+    r = np.random.default_rng(seed)
+    return Table({
+        "k": Column.from_numpy(r.integers(0, khi, n).astype(np.int64)),
+        "v": Column.from_numpy(r.integers(0, 100, n).astype(np.float64)),
+    })
+
+
+def _grouped_plan(khi=5):
+    return plan().filter(col("v") > 10).groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "c")],
+        domains={"k": (0, khi - 1)})
+
+
+def _names(events):
+    return [e["name"] for e in events]
+
+
+# ---------------------------------------------------------------------------
+# 1. opt-in no-op contract
+# ---------------------------------------------------------------------------
+
+class TestOptIn:
+    def test_off_returns_shared_null_span(self):
+        assert timeline.span("x") is timeline.NULL_SPAN
+        assert timeline.begin("x") is timeline.NULL_SPAN
+        timeline.instant("x")
+        timeline.add_complete("x", "c", 0.0, 1.0)
+        assert timeline.events() == []
+
+    def test_env_flag_enables_live(self, monkeypatch):
+        monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+        with timeline.span("work", cat="test"):
+            pass
+        names = _names(timeline.events())
+        assert "work" in names
+
+    def test_off_run_records_nothing(self):
+        _grouped_plan().run(_mk(64))
+        assert timeline.events() == []
+
+    def test_recording_scope_forces_on_and_slices(self, tmp_path):
+        out = tmp_path / "t.json"
+        timeline.instant  # module stays loaded; nothing recorded yet
+        with timeline.recording(str(out)) as rec:
+            assert timeline.enabled()
+            with timeline.span("inside", cat="test"):
+                pass
+        assert not timeline.enabled()
+        timeline.instant("after", cat="test")     # off again: dropped
+        assert "inside" in _names(rec.events())
+        payload = json.loads(out.read_text())
+        assert "inside" in _names(payload["traceEvents"])
+        assert "after" not in _names(payload["traceEvents"])
+
+    def test_null_span_end_and_exit_are_noops(self):
+        s = timeline.span("x")
+        s.end()
+        with s:
+            pass
+        assert timeline.events() == []
+
+
+# ---------------------------------------------------------------------------
+# 2. Chrome-trace export vs the golden schema
+# ---------------------------------------------------------------------------
+
+class TestExportSchema:
+    def test_recorded_run_matches_golden_schema(self, tmp_path):
+        out = tmp_path / "trace.json"
+        _grouped_plan().run(_mk(128), trace_timeline=str(out))
+        payload = json.loads(out.read_text())
+        schema = json.loads(GOLDEN.read_text())
+        errors = timeline.validate_chrome_trace(payload, schema)
+        assert errors == []
+        # Spans carry microsecond complete events; lanes are announced.
+        phs = {e["ph"] for e in payload["traceEvents"]}
+        assert {"M", "X"} <= phs
+
+    def test_validator_rejects_malformed_events(self):
+        schema = json.loads(GOLDEN.read_text())
+        bad = {"displayTimeUnit": "ms",
+               "traceEvents": [{"name": "x", "ph": "X", "pid": 1, "tid": 1,
+                                "ts": 0.0, "args": {}}]}   # no cat/dur
+        assert timeline.validate_chrome_trace(bad, schema)
+        bad_ph = {"displayTimeUnit": "ms",
+                  "traceEvents": [{"name": "x", "ph": "Z"}]}
+        assert timeline.validate_chrome_trace(bad_ph, schema)
+        assert timeline.validate_chrome_trace({"traceEvents": []}, schema)
+
+    def test_summary_table_rolls_up(self, monkeypatch):
+        monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+        with timeline.span("work", cat="test"):
+            pass
+        timeline.instant("tick", cat="test")
+        text = timeline.summary_table()
+        assert "work" in text and "tick x1" in text
+
+    def test_lane_args_coerce_to_json_types(self, monkeypatch):
+        monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+        timeline.instant("x", cat="t", weird=object())
+        payload = timeline.export_chrome_trace()
+        ev = [e for e in payload["traceEvents"] if e["name"] == "x"][0]
+        assert isinstance(ev["args"]["weird"], str)
+        json.dumps(payload)     # fully serializable
+
+
+# ---------------------------------------------------------------------------
+# 3. execution coverage: run / stream / faulted / dist / host syncs
+# ---------------------------------------------------------------------------
+
+class TestExecutionSpans:
+    def test_run_emits_phase_spans_and_cache_instants(self):
+        t = Table({"u": Column.from_numpy(
+            np.arange(64, dtype=np.float64))})       # unique col: cache miss
+        p = plan().filter(col("u") > 3.0)
+        with timeline.recording() as rec:
+            p.run(t)
+        names = _names(rec.events())
+        for want in ("run.bind", "run.dispatch", "run.materialize",
+                     "compile_cache.miss"):
+            assert want in names, (want, names)
+
+    def test_stream_emits_per_batch_lanes(self):
+        p = plan().filter(col("v") > 10)
+        batches = [_mk(64, seed=i) for i in range(3)]
+        with timeline.recording() as rec:
+            outs = list(run_plan_stream(p, batches, inflight=2))
+        assert len(outs) == 3
+        evs = rec.events()
+        lanes = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {"batch-0", "batch-1", "batch-2"} <= lanes
+        spans = {(e["name"], e["args"].get("batch"))
+                 for e in evs if e["ph"] == "X"}
+        for bi in range(3):
+            assert ("stream.dispatch", bi) in spans
+            assert ("stream.materialize", bi) in spans
+
+    def test_stream_trace_timeline_param_exports(self, tmp_path):
+        out = tmp_path / "stream.json"
+        p = _grouped_plan()
+        batches = [_mk(64, seed=i) for i in range(4)]
+        res = list(run_plan_stream(p, batches, combine=True,
+                                   trace_timeline=str(out)))
+        assert len(res) == 1
+        payload = json.loads(out.read_text())
+        schema = json.loads(GOLDEN.read_text())
+        assert timeline.validate_chrome_trace(payload, schema) == []
+        names = _names(payload["traceEvents"])
+        assert "stream.partial" in names
+        assert "stream.combine" in names
+        assert "stream.finalize" in names
+
+    def test_stream_trace_timeline_rejects_bad_type(self):
+        with pytest.raises(ValueError, match="trace_timeline"):
+            run_plan_stream(plan(), [], trace_timeline=7)
+
+    def test_faulted_run_emits_recovery_instants(self, monkeypatch):
+        monkeypatch.setenv("SRT_FAULT", "oom:materialize:1")
+        reset_faults()
+        p = _grouped_plan()
+        t = _mk(128)
+        with timeline.recording() as rec:
+            out = p.run(t)
+        evs = rec.events()
+        names = _names(evs)
+        assert "recovery.retry" in names
+        assert "recovery.evict_caches" in names
+        retry = [e for e in evs if e["name"] == "recovery.retry"][0]
+        assert retry["ph"] == "i"
+        assert retry["args"]["site"] == "materialize"
+        # Recovered result is still correct.
+        reset_faults()
+        monkeypatch.delenv("SRT_FAULT")
+        reset_faults()
+        assert_tables_equal(out, p.run(t))
+
+    def test_split_rung_emits_instant(self, monkeypatch):
+        monkeypatch.setenv("SRT_RETRY_MAX", "1")
+        monkeypatch.setenv("SRT_FAULT", "oom:dispatch:2")
+        reset_faults()
+        p = plan().filter(col("v") > 10)
+        with timeline.recording() as rec:
+            p.run(_mk(128))
+        assert "recovery.split" in _names(rec.events())
+
+    def test_dist_run_emits_per_shard_ici_spans(self):
+        import jax
+        from spark_rapids_tpu.parallel.mesh import make_mesh, shard_table
+        mesh = make_mesh(jax.devices()[:8])
+        t = _mk(256, khi=4)
+        dist = shard_table(t, mesh)
+        p = _grouped_plan(khi=4)
+        with timeline.recording() as rec:
+            out = p.run_dist(dist, mesh)
+        evs = rec.events()
+        ici = [e for e in evs if e["name"] == "ici.psum"]
+        assert len(ici) == 8
+        assert sorted(e["args"]["shard"] for e in ici) == list(range(8))
+        lanes = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {f"shard-{i}" for i in range(8)} <= lanes
+        assert "dist.dispatch" in _names(evs)
+        # All shard spans share the dispatch interval (host-side emulation
+        # of the SPMD program: same ts, same dur).
+        assert len({(e["ts"], e["dur"]) for e in ici}) == 1
+        assert out.num_rows > 0
+
+    def test_counted_host_syncs_emit_instants(self):
+        with timeline.recording() as rec:
+            _grouped_plan().run(_mk(128))
+        host = [e for e in rec.events()
+                if e["ph"] == "i" and e["cat"] == "host"]
+        assert any(e["name"] == "host_sync.materialize.count" for e in host)
+
+    def test_trace_scope_mirrors_into_timeline(self):
+        from spark_rapids_tpu.utils.tracing import trace
+        with timeline.recording() as rec:
+            with trace("custom_region", step=3):
+                pass
+        ev = [e for e in rec.events() if e["name"] == "custom_region"]
+        assert len(ev) == 1
+        assert ev[0]["cat"] == "trace"
+        assert ev[0]["args"]["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# 4. metrics history
+# ---------------------------------------------------------------------------
+
+class TestHistory:
+    def test_fingerprint_stable_and_distinguishes_plans(self):
+        p1, p2 = _grouped_plan(), _grouped_plan()
+        assert history.plan_fingerprint(p1) == history.plan_fingerprint(p2)
+        p3 = plan().filter(col("v") > 11)
+        assert history.plan_fingerprint(p1) != history.plan_fingerprint(p3)
+        assert len(history.plan_fingerprint(p1)) == 16
+
+    def test_fingerprint_join_table_is_shape_only(self):
+        dim = Table({"k": Column.from_numpy(np.arange(5)),
+                     "w": Column.from_numpy(np.arange(5) * 2)})
+        dim2 = Table({"k": Column.from_numpy(np.arange(5)),
+                      "w": Column.from_numpy(np.arange(5) * 3)})
+        pa = plan().join_broadcast(dim, left_on="k", right_on="k")
+        pb = plan().join_broadcast(dim2, left_on="k", right_on="k")
+        # Same shape + names → same fingerprint (no device reads, no ids).
+        assert (history.plan_fingerprint(pa)
+                == history.plan_fingerprint(pb))
+
+    def test_run_appends_history_record(self, tmp_path, monkeypatch,
+                                        metrics_on):
+        sink = tmp_path / "hist.jsonl"
+        monkeypatch.setenv("SRT_METRICS_HISTORY", str(sink))
+        p = _grouped_plan()
+        p.run(_mk(64))
+        p.run(_mk(64, seed=1))
+        recs = history.load()
+        assert len(recs) == 2
+        fp = history.plan_fingerprint(p)
+        assert all(r["fingerprint"] == fp for r in recs)
+        assert all(r["metric"] == "query_metrics" for r in recs)
+        assert history.load(fingerprint="0" * 16) == []
+        assert history.load(fingerprint=fp, path=str(sink)) == recs
+
+    def test_stream_and_analyze_append_history(self, tmp_path, monkeypatch,
+                                               metrics_on):
+        sink = tmp_path / "hist.jsonl"
+        monkeypatch.setenv("SRT_METRICS_HISTORY", str(sink))
+        p = _grouped_plan()
+        list(run_plan_stream(p, [_mk(64), _mk(64, seed=1)], combine=True))
+        p.explain_analyze(_mk(64))
+        modes = [r["mode"] for r in history.load()]
+        assert "stream" in modes and "analyze" in modes
+
+    def test_no_sink_no_file(self, metrics_on):
+        _grouped_plan().run(_mk(64))
+        assert history.load() == []
+
+    def test_unmetered_run_writes_nothing(self, tmp_path, monkeypatch):
+        sink = tmp_path / "hist.jsonl"
+        monkeypatch.setenv("SRT_METRICS_HISTORY", str(sink))
+        _grouped_plan().run(_mk(64))      # SRT_METRICS unset: no QueryMetrics
+        assert not sink.exists()
+
+
+# ---------------------------------------------------------------------------
+# 5. bench-line unification + start_server gating
+# ---------------------------------------------------------------------------
+
+class TestBenchLines:
+    def test_wrappers_match_bench_line(self, metrics_on):
+        from spark_rapids_tpu.obs import (bench_cache_line, bench_line,
+                                          bench_metrics_line,
+                                          bench_recovery_line,
+                                          bench_stream_line)
+        _grouped_plan().run(_mk(64))
+        assert bench_metrics_line() == bench_line("metrics")
+        assert bench_cache_line() == bench_line("cache")
+        assert bench_stream_line() == bench_line("stream")
+        assert bench_recovery_line() == bench_line("recovery")
+        for kind in ("metrics", "cache", "stream", "recovery"):
+            line = bench_line(kind)
+            assert line == json.dumps(json.loads(line), sort_keys=True)
+
+    def test_unknown_kind_raises(self):
+        from spark_rapids_tpu.obs import bench_line
+        with pytest.raises(ValueError, match="unknown bench line kind"):
+            bench_line("bogus")
+
+    def test_start_server_refuses_when_trace_disabled(self, monkeypatch):
+        from spark_rapids_tpu.utils.tracing import start_server
+        monkeypatch.setenv("SRT_TRACE", "0")
+        with pytest.raises(RuntimeError, match="SRT_TRACE"):
+            start_server(port=0)
+
+
+class TestExplainAnalyzeTimeline:
+    def test_lane_summary_appended(self, metrics_on):
+        text = _grouped_plan().explain_analyze(_mk(64), timeline=True)
+        assert "== Timeline:" in text
+        assert "query_metrics" not in text    # still the rendered report
+        assert "rows" in text
+
+    def test_faulted_analyze_renders_recovery(self, monkeypatch,
+                                              metrics_on):
+        """Satellite: after a faulted-and-recovered analyzed run the
+        rendered tree carries the recovery line AND the per-step rows —
+        the analyzer's ladder pass must not lose step metering."""
+        monkeypatch.setenv("SRT_FAULT", "oom:materialize:1")
+        reset_faults()
+        text = _grouped_plan().explain_analyze(_mk(128))
+        assert "recovery: retries=1" in text
+        assert "cache_evictions=" in text
+        assert "Filter[" in text and "GroupBy[" in text
+        assert "rows: " in text              # per-step metering survived
+        from spark_rapids_tpu.obs import last_query_metrics
+        qm = last_query_metrics()
+        assert qm.mode == "analyze"
+        assert qm.recovery_retries == 1
+        assert qm.output_rows > 0
