@@ -9,10 +9,13 @@ host-read fencing, exact-composition warmup).
 Run: python benchmarks/bench_queries.py
 
 ``--faults`` additionally arms a deterministic HBM-OOM injection
-(``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec) and
-appends a ``recovery`` JSON line (retries / splits / evictions /
-backoff / faults injected) — the bench-trajectory proof that the
-resilience ladder engages and costs what it claims.
+(``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec),
+runs one mesh join+agg with a shard-targeted dist-dispatch OOM recovered
+by the mesh ladder (``dist_recovery`` JSON line: shards, recovered
+wall), and appends a ``recovery`` JSON line (retries / splits /
+evictions / backoff / faults injected, plus the ``dist`` block) — the
+bench-trajectory proof that the resilience ladder engages and costs
+what it claims.
 """
 
 from __future__ import annotations
@@ -117,6 +120,7 @@ def main():
         print(bench_line("cache"))
     if "--faults" in sys.argv:
         from spark_rapids_tpu.obs import bench_line
+        bench_dist_recovery(fact, dim)
         print(bench_line("recovery"))
     timeline_path = _timeline_arg()
     if timeline_path is not None:
@@ -125,6 +129,56 @@ def main():
         print(json.dumps({"metric": "timeline", "path": timeline_path,
                           "events": len(payload["traceEvents"])},
                          sort_keys=True))
+
+
+def bench_dist_recovery(fact, dim, n=200_000):
+    """``--faults`` only: one mesh join+agg with a shard-targeted HBM-OOM
+    armed at the dist dispatch, recovered by the mesh ladder — proves the
+    dist rungs engage (and what they cost) on whatever mesh the bench
+    runs on, and moves the ``dist`` block of the recovery JSON line."""
+    import os
+
+    from spark_rapids_tpu import Column, Table
+    from spark_rapids_tpu.exec import plan
+    from spark_rapids_tpu.parallel import make_mesh, shard_table
+    from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+
+    mesh = make_mesh()
+    P = mesh.devices.size
+    sub = Table([(nm, Column(data=c.data[:n],
+                             validity=None if c.validity is None
+                             else c.validity[:n], dtype=c.dtype))
+                 for nm, c in fact.items()])
+    p = (plan()
+         .join_broadcast(dim.rename({"k": "dk"}), left_on="k",
+                         right_on="dk")
+         .groupby_agg(["cat"], [("rev", "sum", "rev_sum"),
+                                ("rev", "count", "cnt")],
+                      domains={"cat": (0, 99)}))
+    d = shard_table(sub, mesh)
+    want = p.run_dist(d, mesh).to_pydict()       # no-fault golden (warm)
+
+    saved = os.environ.get("SRT_FAULT")
+    os.environ["SRT_FAULT"] = f"oom:dist-dispatch:1:shard={P - 1}"
+    reset_faults()
+    before = recovery_stats().snapshot()
+    t0 = time.perf_counter()
+    try:
+        got = p.run_dist(d, mesh).to_pydict()
+    finally:
+        if saved is None:
+            os.environ.pop("SRT_FAULT", None)
+        else:
+            os.environ["SRT_FAULT"] = saved
+        reset_faults()
+    elapsed = time.perf_counter() - t0
+    assert got == want, "faulted dist run diverged from the golden"
+    delta = recovery_stats().delta(before)
+    print(json.dumps({"metric": "dist_recovery", "rows": n, "shards": P,
+                      "recovered_seconds": round(elapsed, 6),
+                      "dist_retries": int(delta["dist_retries"]),
+                      "dist_evictions": int(delta["dist_evictions"])},
+                     sort_keys=True))
 
 
 def _timeline_arg():

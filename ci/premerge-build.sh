@@ -47,6 +47,13 @@ python -m pytest tests/ -q
 SRT_FAULT="oom:materialize:1" SRT_METRICS=1 \
 python -m pytest tests/test_resilience.py -m faulted -q
 
+# Faulted DIST smoke lane: same proof for the mesh recovery ladder — a
+# shard-targeted HBM-OOM armed process-wide, recovered by the dist rungs
+# on the 8-device mesh (recovery.dist counters asserted non-zero, results
+# asserted bit-identical to the no-fault goldens).
+SRT_FAULT="oom:dist-dispatch:1:shard=2" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+python -m pytest tests/test_exec_dist.py -m faulted_dist -q
+
 # Timeline lane: record a faulted query on the span timeline, export
 # Chrome-trace JSON, and validate it against the golden-pinned schema
 # (tests/golden/chrome_trace_schema.json) — the artifact a reviewer can

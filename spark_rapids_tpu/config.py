@@ -67,8 +67,22 @@ Knobs (all optional):
                                no watchdog).
   ``SRT_FAULT``                deterministic fault injection spec
                                (resilience/faults.py), e.g.
-                               ``oom:materialize:2`` or
-                               ``io:read:0.5:seed=7``; unset = no faults.
+                               ``oom:materialize:2``,
+                               ``io:read:0.5:seed=7`` or
+                               ``oom:dist-dispatch:1:shard=3``; unset =
+                               no faults.
+  ``SRT_DIST_FALLBACK``        ``collect`` enables the graceful-degradation
+                               rung of the mesh recovery ladder
+                               (exec/dist.py): an exhausted dist ladder
+                               collects the DistTable and finishes the
+                               plan single-chip.  Unset/``0``/``off`` =
+                               exhausted dist ladders fail honestly.
+  ``SRT_DIST_TIMEOUT``         mesh stall watchdog in seconds: dist
+                               dispatch / collectives / ``collect()``
+                               raise ``DistStallError`` instead of
+                               hanging the host when the device program
+                               makes no progress for this long (unset/0
+                               = no watchdog).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -326,6 +340,48 @@ def stream_timeout() -> float | None:
     return val
 
 
+def dist_fallback() -> str | None:
+    """Graceful-degradation mode for an exhausted mesh recovery ladder
+    (exec/dist.py), or None when disabled.
+
+    ``collect`` — the only mode — collects the ``DistTable`` to the host
+    and finishes the plan single-chip under the existing recovery ladder,
+    recording the degradation as a named rung.  Unset/``0``/``off``
+    disables: an exhausted dist ladder raises honestly."""
+    raw = os.environ.get("SRT_DIST_FALLBACK")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    if raw != "collect":
+        raise ValueError(
+            f"SRT_DIST_FALLBACK must be 'collect' (or 0/off), got {raw!r}")
+    return raw
+
+
+def dist_timeout() -> float | None:
+    """Mesh stall watchdog window in seconds, or None when disabled.
+
+    When set, dist dispatch, mesh collectives and ``collect()`` raise
+    ``DistStallError`` if the device program makes no progress for this
+    long — a wedged collective (one shard dead, the rest blocked in
+    psum/all_to_all) surfaces a named error instead of hanging the host
+    forever.  Tune with ``SRT_DIST_TIMEOUT`` (> 0 seconds;
+    unset/``0``/``off`` disables)."""
+    raw = os.environ.get("SRT_DIST_TIMEOUT")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    val = float(raw)
+    if val <= 0:
+        raise ValueError(
+            f"SRT_DIST_TIMEOUT must be > 0 seconds (or 0/off), got {val}")
+    return val
+
+
 def fault_spec() -> str | None:
     """The raw ``SRT_FAULT`` injection spec (resilience/faults.py parses
     and arms it), or None when no faults are configured."""
@@ -398,5 +454,6 @@ def knob_table() -> dict[str, str]:
              "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP",
              "SRT_PREFETCH_DEPTH", "SRT_STREAM_INFLIGHT",
              "SRT_RETRY_MAX", "SRT_RETRY_BACKOFF",
-             "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT")
+             "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT",
+             "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -1451,33 +1451,48 @@ def _assemble(steps: tuple, group_metas: tuple[_GroupMeta, ...],
     return jax.jit(program)
 
 
-def _cache_lookup(key, build):
-    """LRU lookup in the program table with hit/miss/eviction accounting;
-    ``build()`` runs on a miss.  Returns ``(program, was_hit)`` — the
-    streaming executor reports the hit flag as its donation-reuse
-    counter."""
+def _lru_lookup(cache, key, build, prefix, instant_name=None, **instant_kw):
+    """Generic bounded-LRU lookup with hit/miss/size/eviction accounting.
+
+    ``cache`` is an ``OrderedDict`` shared with :func:`evict_device_caches`
+    (resilience/recovery.py clears it wholesale on OOM); ``build()`` runs
+    on a miss; every cache shares ONE cap (``SRT_COMPILE_CACHE_CAP``).
+    ``prefix`` names the metric family (``plan.compile_cache``,
+    ``dist.compile_cache``, ``dist.programs``); ``instant_name`` keeps
+    the plan cache's historical timeline names while new caches default
+    to ``<prefix>.hit/miss``.  Returns ``(program, was_hit)``.
+    """
     from ..config import compile_cache_cap, ensure_compile_cache
     from ..obs.metrics import counter, gauge
     from ..obs.timeline import instant, span
     ensure_compile_cache()
-    fn = _COMPILED.get(key)
+    iname = instant_name or prefix
+    fn = cache.get(key)
     hit = fn is not None
     if fn is None:
-        counter("plan.compile_cache.miss").inc()
-        instant("compile_cache.miss", cat="compile")
+        counter(f"{prefix}.miss").inc()
+        instant(f"{iname}.miss", cat="compile", **instant_kw)
         with span("compile.build", cat="compile"):
             fn = build()
-        _COMPILED[key] = fn
+        cache[key] = fn
         cap = compile_cache_cap()
-        while len(_COMPILED) > cap:
-            _COMPILED.popitem(last=False)
-            counter("plan.compile_cache.evictions").inc()
+        while len(cache) > cap:
+            cache.popitem(last=False)
+            counter(f"{prefix}.evictions").inc()
     else:
-        counter("plan.compile_cache.hit").inc()
-        instant("compile_cache.hit", cat="compile")
-        _COMPILED.move_to_end(key)
-    gauge("plan.compile_cache.size").set(len(_COMPILED))
+        counter(f"{prefix}.hit").inc()
+        instant(f"{iname}.hit", cat="compile", **instant_kw)
+        cache.move_to_end(key)
+    gauge(f"{prefix}.size").set(len(cache))
     return fn, hit
+
+
+def _cache_lookup(key, build):
+    """LRU lookup in the whole-plan program table; ``build()`` runs on a
+    miss.  Returns ``(program, was_hit)`` — the streaming executor
+    reports the hit flag as its donation-reuse counter."""
+    return _lru_lookup(_COMPILED, key, build, "plan.compile_cache",
+                       instant_name="compile_cache")
 
 
 def _compiled_for(bound: _Bound):

@@ -25,10 +25,35 @@ Plan-shape contract (validated at trace time):
 Returns a materialized :class:`..table.Table` when the plan ends
 replicated (aggregation plans), or a padded :class:`..parallel.mesh.
 DistTable` when it ends row-sharded (pure filter/project pipelines).
+
+**Mesh recovery ladder.** Every device-touching phase runs under the
+same ``resilience.recovery.oom_ladder`` the single-chip path uses, with
+``dist=True`` so the mesh share of retries/evictions lands in the
+``recovery.dist`` block of QueryMetrics.  The rungs, in order:
+
+1. evict every device cache (whole-plan LRU, pad cache, the sharded
+   program LRU here, and the parallel-op program LRU in parallel/mesh),
+   back off, retry — bounded by ``SRT_RETRY_MAX``;
+2. per-shard split (:func:`_dist_split`): halve the *per-shard* slot
+   count, snapped to the shared bucket schedule, and re-run the sharded
+   program on both halves.  Row-local plans re-concatenate shard-wise
+   (slot order preserved, so results stay bit-identical); combinable
+   group-by plans merge per-shard partial accumulators through the
+   streaming combine machinery;
+3. graceful degradation (:func:`_dist_collect_fallback`): when
+   ``SRT_DIST_FALLBACK=collect`` is set, collect the DistTable to host
+   and finish single-chip under the ordinary ladder — slower, but the
+   query completes on one healthy chip.  Off by default: unset, the
+   ladder raises ``ExecutionRecoveryError`` naming every rung it tried.
+
+Mesh collectives and the dispatch itself run under the
+``SRT_DIST_TIMEOUT`` stall watchdog (resilience/watchdog.py): a wedged
+exchange raises ``DistStallError`` instead of hanging the host.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 
 import jax
@@ -37,12 +62,20 @@ from jax.sharding import Mesh, PartitionSpec
 
 from ..column import Column
 from ..dtypes import BOOL8
-from ..parallel.mesh import DistTable, shard_map
+from ..parallel.mesh import DistTable, mesh_cache_key, shard_map
 from ..table import Table
-from .compile import _Bound, _assemble, _final_order, materialize
+from .compile import (_Bound, _assemble, _final_order, _lru_lookup,
+                      materialize)
 from .plan import GroupAggStep, JoinShuffledStep, Plan
 
-_DIST_COMPILED: dict = {}
+#: Bounded LRU of compiled sharded whole-plan programs, keyed by
+#: (plan signature, mesh identity, output replication).  Shares the
+#: single-chip cap (``SRT_COMPILE_CACHE_CAP``) via
+#: :func:`..exec.compile._lru_lookup` and is cleared wholesale by
+#: ``resilience.recovery.evict_device_caches`` — sharded executables pin
+#: HBM on every device at once, so the mesh ladder must be able to drop
+#: them.
+_DIST_COMPILED: OrderedDict = OrderedDict()
 
 # live-count cache per row-mask buffer identity: the empty-input guard
 # needs one host sync, but steady-state repeat runs over the same
@@ -67,7 +100,367 @@ def _ends_replicated(bound: _Bound) -> bool:
     return any(isinstance(s, GroupAggStep) for s in bound.steps)
 
 
-def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
+def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
+    """Execute ``plan`` against a row-sharded table on ``mesh``.
+
+    Entry point only: metering (``SRT_METRICS=1``) wraps the shared
+    resilient core exactly as ``run_plan`` does, so dist queries get a
+    QueryMetrics record (mode ``"dist"``) with the ``recovery.dist``
+    block isolating mesh-ladder activity.
+    """
+    from ..config import metrics_enabled
+    if metrics_enabled():
+        return _run_plan_dist_metered(plan, dist, mesh)
+    return _execute_dist_resilient(plan, dist, mesh)
+
+
+def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
+    import time as _time
+    from ..obs.metrics import counters_delta, registry
+    from ..obs.query import QueryMetrics, next_query_id, \
+        set_last_query_metrics
+    from ..resilience import recovery_stats
+    qm = QueryMetrics(query_id=next_query_id(), mode="dist",
+                      input_rows=_live_count_cached(dist.row_mask),
+                      input_columns=dist.table.num_columns)
+    before = registry().counters_snapshot()
+    r_before = recovery_stats().snapshot()
+    t_all = _time.perf_counter()
+    result = _execute_dist_resilient(plan, dist, mesh)
+    qm.total_seconds = _time.perf_counter() - t_all
+    if isinstance(result, Table):
+        qm.output_rows = result.num_rows
+    qm.finish_counters(counters_delta(before))
+    qm.apply_recovery(recovery_stats().delta(r_before))
+    set_last_query_metrics(qm)
+    from ..obs.history import maybe_record
+    maybe_record(plan, qm)
+    return result
+
+
+def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
+                            depth: int = 0):
+    """Sharded bind → dispatch → materialize under the mesh recovery
+    ladder.  The named fault sites (``dist-dispatch`` per shard,
+    ``collective`` per shard on the merge) let ``SRT_FAULT`` provoke
+    every mesh failure path — including a single failing shard via the
+    ``shard=N`` selector — deterministically on a CPU host mesh."""
+    from ..resilience import dist_guard, fault_point
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+
+    if _live_count_cached(dist.row_mask) == 0:
+        # Degenerate shapes break trace-time assumptions (and the probe
+        # under an all-False mask); mirror run_plan's eager fallback.
+        # Checked before the shuffled-join dispatch so every lowering
+        # path sees live rows.  The return CONTRACT is preserved: a plan
+        # that ends row-sharded hands back a DistTable here too.
+        from ..parallel.mesh import collect, shard_table
+        from .compile import run_plan_eager
+        result = run_plan_eager(plan, collect(dist))
+        if any(isinstance(s, GroupAggStep) for s in plan.steps):
+            return result
+        return shard_table(result, mesh)
+    if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
+        return _lower_shuffled_join(plan, dist, mesh, depth)
+    axis = mesh.axis_names[0]
+    axis_size = int(mesh.shape[axis])
+    bound = _Bound(plan, dist.table, probe_mask=dist.row_mask)
+    if bound.string_cols or bound.dictionaries:
+        raise TypeError(
+            "distributed plans operate on fixed-width columns only "
+            "(dictionary-encode strings before sharding, as shard_table "
+            "requires)")
+    replicated_out = _ends_replicated(bound)
+
+    # The compiled function closes over the concrete mesh via shard_map,
+    # so the cache key must identify the mesh by its actual devices, not
+    # just its shape.
+    key = bound.signature() + (mesh_cache_key(mesh), replicated_out)
+    from ..obs import timeline as _tl
+    from ..obs.metrics import gauge
+
+    def do_dispatch():
+        # Looked up INSIDE the ladder closure: an evict rung clears the
+        # LRU, so the retry must rebuild rather than call a dropped fn.
+        fn, _ = _lru_lookup(
+            _DIST_COMPILED, key,
+            lambda: _build_dist_program(bound, mesh, axis, axis_size,
+                                        replicated_out),
+            "dist.compile_cache", shards=axis_size)
+        gauge("dist.mesh_devices").set(axis_size)
+        tl_on = _tl.enabled()
+        t0 = _tl.now_us() if tl_on else 0.0
+
+        def invoke():
+            for s in range(axis_size):
+                fault_point("dist-dispatch", shard=s)
+            if replicated_out:
+                # The accumulator merge is the program's one collective.
+                for s in range(axis_size):
+                    fault_point("collective", shard=s)
+            out = fn(bound.exec_cols, dist.row_mask, bound.side_inputs)
+            if tl_on:
+                out = jax.block_until_ready(out)
+            return out
+
+        out_cols, sel = dist_guard("dist.dispatch", invoke)
+        if tl_on:
+            # Block so the recorded interval covers device wall, then
+            # emit it once per shard lane: the host cannot observe
+            # per-core device timelines without the jax profiler, but
+            # the shard_map program is SPMD — every shard runs the same
+            # program over the same interval, and the replicated-out
+            # group-by merge is its ICI collective.
+            dur = _tl.now_us() - t0
+            _tl.add_complete("dist.dispatch", "dist", t0, dur, lane="dist",
+                             shards=axis_size, replicated=replicated_out)
+            if replicated_out:
+                for s in range(axis_size):
+                    _tl.add_complete("ici.psum", "ici", t0, dur,
+                                     lane=f"shard-{s}", shard=s,
+                                     collective="psum")
+        return out_cols, sel
+
+    try:
+        out_cols, sel = oom_ladder("dist-dispatch", do_dispatch, dist=True)
+        if replicated_out:
+            return oom_ladder("materialize",
+                              lambda: materialize(bound, out_cols, sel),
+                              dist=True)
+        order = [nm for nm in _final_order(plan.steps, bound.input_names)
+                 if nm in out_cols]
+        order += [nm for nm in out_cols if nm not in order]
+        return DistTable(table=Table([(nm, out_cols[nm]) for nm in order]),
+                         row_mask=sel.astype(jnp.bool_))
+    except ExecutionRecoveryError as err:
+        # Last rungs: per-shard split, then the collect fallback.
+        if err.category != "oom":
+            raise
+        try:
+            return _dist_split(plan, dist, mesh, depth)
+        except SplitUnavailable as unavailable:
+            err.add_step(f"split-unavailable: {unavailable}")
+        except ExecutionRecoveryError:
+            err.add_step("dist-split-failed")
+        return _dist_collect_fallback(plan, dist, mesh, err)
+
+
+def _build_dist_program(bound: _Bound, mesh: Mesh, axis: str,
+                        axis_size: int, replicated_out: bool):
+    program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
+                        tuple(bound.join_metas), axis=axis,
+                        axis_size=axis_size,
+                        union_metas=tuple(bound.union_metas))
+
+    def sharded_program(cols, row_mask, side):
+        # Padding slots enter as dead rows via the initial selection.
+        return program(cols, side, init_sel=row_mask)
+
+    out_spec = PartitionSpec() if replicated_out else PartitionSpec(axis)
+    return jax.jit(partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec(axis),
+                  PartitionSpec()),
+        out_specs=(out_spec, out_spec),
+        check_vma=False,
+    )(sharded_program))
+
+
+# ---------------------------------------------------------------------------
+# mesh recovery rungs: per-shard split + collect fallback
+# ---------------------------------------------------------------------------
+
+def _shard_slice(dist: DistTable, P: int, C: int, lo: int, hi: int
+                 ) -> DistTable:
+    """Slots ``[lo, hi)`` of every shard, as a smaller DistTable.  Each
+    shard's block stays on its device (the reshape/slice is shard-local
+    under the row sharding), so the split rung never gathers rows."""
+    w = hi - lo
+
+    def cut(arr):
+        return arr.reshape(P, C)[:, lo:hi].reshape(P * w)
+
+    cols = []
+    for name, c in dist.table.items():
+        validity = None if c.validity is None else cut(c.validity)
+        cols.append((name, Column(data=cut(c.data), validity=validity,
+                                  dtype=c.dtype)))
+    return DistTable(table=Table(cols), row_mask=cut(dist.row_mask))
+
+
+def _dist_split(plan: Plan, dist: DistTable, mesh: Mesh, depth: int):
+    """The mesh ladder's split rung: halve the PER-SHARD slot count —
+    snapped to the shared bucket schedule so both halves land on
+    capacities other stages already compiled — and re-run the sharded
+    program on each half.  Row-local plans re-concatenate shard-wise,
+    preserving slot order (bit-identical collect); combinable group-by
+    plans merge per-shard partial accumulators cell-wise.  Raises
+    ``SplitUnavailable`` when the plan or the shards cannot split."""
+    from ..obs.metrics import counter
+    from ..obs.timeline import instant
+    from ..resilience import recovery_stats
+    from ..resilience.recovery import MAX_SPLIT_DEPTH, SplitUnavailable
+    from .bucketing import bucket_capacity
+    from .compile import _split_mode
+    P = int(mesh.devices.size)
+    C = dist.capacity_total // P
+    if depth >= MAX_SPLIT_DEPTH:
+        raise SplitUnavailable(
+            f"split depth {depth} reached (MAX_SPLIT_DEPTH="
+            f"{MAX_SPLIT_DEPTH}); the OOM is not batch-size-driven")
+    if C < 2:
+        raise SplitUnavailable(
+            f"per-shard capacity of {C} slot(s) cannot split")
+    mode = _split_mode(plan)
+    if mode is None:
+        raise SplitUnavailable(
+            "plan is neither row-local nor stream-combinable (sort/"
+            "limit/window or a non-combinable aggregation blocks "
+            "piecewise re-execution)")
+    cut = min(bucket_capacity((C + 1) // 2, floor=8), C - 1)
+    stats = recovery_stats()
+    stats.add_split()
+    stats.add_dist_split()
+    counter("recovery.split_rows").inc(dist.capacity_total)
+    instant("recovery.dist.split", cat="resilience", capacity=C, cut=cut,
+            depth=depth, mode=mode, shards=P)
+    pieces = (_shard_slice(dist, P, C, 0, cut),
+              _shard_slice(dist, P, C, cut, C))
+    if mode == "concat":
+        a = _execute_dist_resilient(plan, pieces[0], mesh, depth + 1)
+        b = _execute_dist_resilient(plan, pieces[1], mesh, depth + 1)
+        return _concat_shards(a, b, P)
+    return _dist_split_combine(plan, pieces, mesh)
+
+
+def _concat_shards(a: DistTable, b: DistTable, P: int) -> DistTable:
+    """Merge two row-sharded piece results back into one DistTable with
+    each shard's slots in original order: shard i's output is piece a's
+    shard-i slots followed by piece b's — exactly the slot order of the
+    unsplit run, so ``collect`` of the merge is bit-identical."""
+    Ca = a.capacity_total // P
+    Cb = b.capacity_total // P
+
+    def merge(x, y):
+        return jnp.concatenate([x.reshape(P, Ca), y.reshape(P, Cb)],
+                               axis=1).reshape(P * (Ca + Cb))
+
+    cols = []
+    for (name, ca), (_, cb) in zip(a.table.items(), b.table.items()):
+        validity = None
+        if ca.validity is not None or cb.validity is not None:
+            validity = merge(ca.valid_mask(), cb.valid_mask())
+        cols.append((name, Column(data=merge(ca.data, cb.data),
+                                  validity=validity, dtype=ca.dtype)))
+    return DistTable(table=Table(cols),
+                     row_mask=merge(a.row_mask, b.row_mask))
+
+
+def _dist_partial_program(bound: _Bound, smeta, mesh: Mesh, axis: str):
+    """Sharded partial-aggregate program for the combine split path:
+    prefix steps then :func:`..exec.compile._dense_accumulate` per
+    shard under the batch-invariant ``smeta`` layout, with NO collective
+    — every shard's accumulator comes back to the driver (stacked on a
+    leading shard axis) and merges through ``stream_combine``, the same
+    cell-wise path the streaming executor uses."""
+    from .compile import _dense_accumulate, _step_closures
+    sig = bound.signature()
+    step = bound.steps[-1]
+    key = ("dist/partial", sig[0][:-1], sig[1], sig[2], sig[3], sig[5],
+           sig[6], sig[7], step, smeta, mesh_cache_key(mesh))
+
+    def build():
+        fns = _step_closures(sig[0][:-1], (), tuple(bound.join_metas),
+                             union_metas=tuple(bound.union_metas))
+
+        def partial_program(cols, row_mask, side):
+            sel = row_mask
+            for fn in fns:
+                cols, sel = fn(cols, sel, side)
+            acc = _dense_accumulate(cols, sel, step, smeta)
+            # Leading length-1 axis so the P shards stack to (P, cells).
+            return {k: v[None] for k, v in acc.items()}
+
+        return jax.jit(partial(
+            shard_map, mesh=mesh,
+            in_specs=(PartitionSpec(axis), PartitionSpec(axis),
+                      PartitionSpec()),
+            out_specs=PartitionSpec(axis),
+            check_vma=False)(partial_program))
+
+    return _lru_lookup(_DIST_COMPILED, key, build, "dist.compile_cache")[0]
+
+
+def _dist_split_combine(plan: Plan, pieces, mesh: Mesh) -> Table:
+    """Recombine split pieces of a replicated-ending (group-by) plan:
+    each piece's shards fold into dense per-shard accumulators, all of
+    them merge cell-wise, and ONE finalize materializes — integer
+    aggregates are exact regardless of merge order, so recovered results
+    match the unsplit psum merge."""
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+    from .compile import stream_combine, stream_finalize
+    from .stream import _combine_setup
+    axis = mesh.axis_names[0]
+    P = int(mesh.devices.size)
+    smeta = dtypes = bound0 = total = None
+    for piece in pieces:
+        bound = oom_ladder(
+            "bind",
+            lambda p=piece: _Bound(plan, p.table, probe_mask=p.row_mask),
+            dist=True)
+        if smeta is None:
+            try:
+                smeta, dtypes = _combine_setup(bound)
+            except TypeError as exc:
+                raise SplitUnavailable(
+                    f"no batch-invariant accumulator layout: {exc}"
+                ) from exc
+            bound0 = bound
+
+        def do_partial(b=bound, rm=piece.row_mask):
+            fn = _dist_partial_program(b, smeta, mesh, axis)
+            return fn(b.exec_cols, rm, b.side_inputs)
+
+        accs = oom_ladder("dist-dispatch", do_partial, dist=True)
+        for s in range(P):
+            acc_s = {k: v[s] for k, v in accs.items()}
+            total = acc_s if total is None else stream_combine()(total, acc_s)
+    return oom_ladder(
+        "materialize",
+        lambda: stream_finalize(bound0, smeta, total, dtypes),
+        dist=True)
+
+
+def _dist_collect_fallback(plan: Plan, dist: DistTable, mesh: Mesh, err):
+    """Graceful degradation, the mesh ladder's last rung: collect the
+    still-healthy DistTable to host and finish the plan single-chip
+    under the ordinary recovery ladder.  Opt-in via
+    ``SRT_DIST_FALLBACK=collect`` — unset, the exhausted mesh error
+    propagates with every attempted rung named in its summary."""
+    from ..config import dist_fallback
+    if dist_fallback() is None:
+        err.add_step("collect-fallback: disabled (SRT_DIST_FALLBACK unset)")
+        raise err
+    from ..obs.timeline import instant
+    from ..parallel.mesh import collect, shard_table
+    from ..resilience import recovery_stats
+    from .compile import run_plan
+    recovery_stats().add_dist_fallback()
+    err.add_step("collect-fallback")
+    instant("recovery.dist.fallback", cat="resilience", site=err.site,
+            category=err.category)
+    result = run_plan(plan, collect(dist))
+    instant("recovery.dist.fallback_done", cat="resilience",
+            rows=result.num_rows)
+    if any(isinstance(s, GroupAggStep) for s in plan.steps):
+        return result
+    return shard_table(result, mesh)
+
+
+def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh,
+                         depth: int = 0):
     """Execute a plan containing a shuffled join: per-shard prefix, then
     the mesh shuffle join (both sides ``all_to_all``-repartitioned by key
     hash and merge-joined per shard, parallel.dist_ops), then the suffix
@@ -75,12 +468,15 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
 
     This is the distributed big-big join of the TPC-DS q95 shape: the
     single-chip compiled form binds a probe over whole tables; across a
-    mesh the equivalent data movement is the shuffle itself.
-    """
+    mesh the equivalent data movement is the shuffle itself.  The
+    shuffle + join runs under the mesh ladder (``dist-join`` site); a
+    shuffled join cannot split per shard — repartitioning by key hash is
+    what it IS — so its exhaustion goes straight to the collect
+    fallback."""
     from ..parallel.dist_ops import dist_join
-    from ..parallel.mesh import shard_table
-
-    from ..parallel.mesh import collect
+    from ..parallel.mesh import collect, shard_table
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import oom_ladder
     from .compile import run_plan_eager
 
     i = next(idx for idx, s in enumerate(plan.steps)
@@ -112,7 +508,7 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
                 f"collides with right columns {sorted(clashes)}; rename "
                 f"them first")
         right = right.rename(dict(zip(step.right_on, step.left_on)))
-    pre = (run_plan_dist(Plan(plan.steps[:i]), dist, mesh)
+    pre = (_execute_dist_resilient(Plan(plan.steps[:i]), dist, mesh, depth)
            if i else dist)
     overlap = (set(right.names) - set(step.left_on)) & set(pre.table.names)
     if overlap:
@@ -130,94 +526,20 @@ def _lower_shuffled_join(plan: Plan, dist: DistTable, mesh: Mesh):
         if any(isinstance(s, GroupAggStep) for s in plan.steps[i:]):
             return result                     # replicated-ending: a Table
         return shard_table(result, mesh)
-    rdist = shard_table(right, mesh)
-    joined = dist_join(pre, rdist, mesh, on=list(step.left_on),
-                       how=step.how)
-    return run_plan_dist(Plan(plan.steps[i + 1:]), joined, mesh)
 
+    def do_join():
+        rdist = shard_table(right, mesh)
+        return dist_join(pre, rdist, mesh, on=list(step.left_on),
+                         how=step.how)
 
-def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
-    """Execute ``plan`` against a row-sharded table on ``mesh``."""
-    if _live_count_cached(dist.row_mask) == 0:
-        # Degenerate shapes break trace-time assumptions (and the probe
-        # under an all-False mask); mirror run_plan's eager fallback.
-        # Checked before the shuffled-join dispatch so every lowering
-        # path sees live rows.  The return CONTRACT is preserved: a plan
-        # that ends row-sharded hands back a DistTable here too.
-        from ..parallel.mesh import collect, shard_table
-        from .compile import run_plan_eager
-        result = run_plan_eager(plan, collect(dist))
-        if any(isinstance(s, GroupAggStep) for s in plan.steps):
-            return result
-        return shard_table(result, mesh)
-    if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
-        return _lower_shuffled_join(plan, dist, mesh)
-    axis = mesh.axis_names[0]
-    axis_size = int(mesh.shape[axis])
-    table = dist.table
-    bound = _Bound(plan, table, probe_mask=dist.row_mask)
-    if bound.string_cols or bound.dictionaries:
-        raise TypeError(
-            "distributed plans operate on fixed-width columns only "
-            "(dictionary-encode strings before sharding, as shard_table "
-            "requires)")
-    replicated_out = _ends_replicated(bound)
-
-    # The compiled function closes over the concrete mesh via shard_map,
-    # so the cache key must identify the mesh by its actual devices, not
-    # just its shape.
-    mesh_key = (axis, tuple(d.id for d in mesh.devices.flat))
-    key = bound.signature() + (mesh_key, replicated_out)
-    from ..obs import timeline as _tl
-    from ..obs.metrics import counter, gauge
-    fn = _DIST_COMPILED.get(key)
-    counter(f"dist.compile_cache.{'miss' if fn is None else 'hit'}").inc()
-    _tl.instant(f"dist.compile_cache.{'miss' if fn is None else 'hit'}",
-                cat="dist", shards=axis_size)
-    gauge("dist.mesh_devices").set(axis_size)
-    if fn is None:
-        program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
-                            tuple(bound.join_metas), axis=axis,
-                            axis_size=axis_size,
-                            union_metas=tuple(bound.union_metas))
-
-        def sharded_program(cols, row_mask, side):
-            # Padding slots enter as dead rows via the initial selection.
-            return program(cols, side, init_sel=row_mask)
-
-        out_spec = PartitionSpec() if replicated_out else PartitionSpec(axis)
-        fn = jax.jit(partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(PartitionSpec(axis), PartitionSpec(axis),
-                      PartitionSpec()),
-            out_specs=(out_spec, out_spec),
-            check_vma=False,
-        )(sharded_program))
-        _DIST_COMPILED[key] = fn
-
-    tl_on = _tl.enabled()
-    t0 = _tl.now_us() if tl_on else 0.0
-    out_cols, sel = fn(bound.exec_cols, dist.row_mask, bound.side_inputs)
-    if tl_on:
-        # Block so the recorded interval covers device wall, then emit it
-        # once per shard lane: the host cannot observe per-core device
-        # timelines without the jax profiler, but the shard_map program is
-        # SPMD — every shard runs the same program over the same interval,
-        # and the replicated-out group-by merge is its ICI collective.
-        out_cols, sel = jax.block_until_ready((out_cols, sel))
-        dur = _tl.now_us() - t0
-        _tl.add_complete("dist.dispatch", "dist", t0, dur, lane="dist",
-                         shards=axis_size, replicated=replicated_out)
-        if replicated_out:
-            for s in range(axis_size):
-                _tl.add_complete("ici.psum", "ici", t0, dur,
-                                 lane=f"shard-{s}", shard=s,
-                                 collective="psum")
-    if replicated_out:
-        return materialize(bound, out_cols, sel)
-    order = [nm for nm in _final_order(plan.steps, bound.input_names)
-             if nm in out_cols]
-    order += [nm for nm in out_cols if nm not in order]
-    return DistTable(table=Table([(nm, out_cols[nm]) for nm in order]),
-                     row_mask=sel.astype(jnp.bool_))
+    try:
+        joined = oom_ladder("dist-join", do_join, dist=True)
+    except ExecutionRecoveryError as err:
+        if err.category != "oom":
+            raise
+        err.add_step("split-unavailable: shuffled join repartitions by "
+                     "key hash; a per-shard split cannot preserve "
+                     "co-partitioning")
+        return _dist_collect_fallback(Plan(plan.steps[i:]), pre, mesh, err)
+    return _execute_dist_resilient(Plan(plan.steps[i + 1:]), joined, mesh,
+                                   depth)

@@ -116,6 +116,15 @@ class QueryMetrics:
     recovery_splits: int = 0            # batch halvings (the last rung)
     recovery_cache_evictions: int = 0   # device-cache entries dropped
     recovery_backoff_seconds: float = 0.0
+    # -- mesh-ladder share of the totals above (exec/dist.py; zero on
+    # single-chip runs).  A dist retry also counts in recovery_retries —
+    # these isolate how much of the recovery work happened on the mesh,
+    # and recovery_dist_fallbacks marks a degraded (collect-and-finish-
+    # single-chip) answer.
+    recovery_dist_retries: int = 0
+    recovery_dist_splits: int = 0       # per-shard capacity halvings
+    recovery_dist_fallbacks: int = 0    # SRT_DIST_FALLBACK=collect rungs
+    recovery_dist_evictions: int = 0
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -133,11 +142,16 @@ class QueryMetrics:
         self.recovery_cache_evictions = int(delta.get("cache_evictions", 0))
         self.recovery_backoff_seconds = float(
             delta.get("backoff_seconds", 0.0))
+        self.recovery_dist_retries = int(delta.get("dist_retries", 0))
+        self.recovery_dist_splits = int(delta.get("dist_splits", 0))
+        self.recovery_dist_fallbacks = int(delta.get("dist_fallbacks", 0))
+        self.recovery_dist_evictions = int(delta.get("dist_evictions", 0))
 
     def to_dict(self) -> dict:
         return {
             # v3: added the always-present "recovery" block.
-            "schema_version": 3,
+            # v4: added "recovery.dist" (the mesh-ladder share).
+            "schema_version": 4,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "mode": self.mode,
@@ -177,6 +191,15 @@ class QueryMetrics:
                 "splits": self.recovery_splits,
                 "cache_evictions": self.recovery_cache_evictions,
                 "backoff_seconds": round(self.recovery_backoff_seconds, 6),
+                # Mesh-ladder share (always present, zero single-chip):
+                # nonzero "fallbacks" marks a degraded-but-correct answer
+                # finished single-chip via SRT_DIST_FALLBACK=collect.
+                "dist": {
+                    "retries": self.recovery_dist_retries,
+                    "splits": self.recovery_dist_splits,
+                    "fallbacks": self.recovery_dist_fallbacks,
+                    "cache_evictions": self.recovery_dist_evictions,
+                },
             },
         }
 
@@ -210,6 +233,13 @@ class QueryMetrics:
                 f"splits={self.recovery_splits} "
                 f"cache_evictions={self.recovery_cache_evictions} "
                 f"backoff={_ms(self.recovery_backoff_seconds)}")
+        if (self.recovery_dist_retries or self.recovery_dist_splits
+                or self.recovery_dist_fallbacks):
+            lines.append(
+                f"  recovery.dist: retries={self.recovery_dist_retries} "
+                f"splits={self.recovery_dist_splits} "
+                f"fallbacks={self.recovery_dist_fallbacks} "
+                f"cache_evictions={self.recovery_dist_evictions}")
         n = len(self.steps)
         for i, s in enumerate(self.steps):
             branch = "└─" if i == n - 1 else "├─"
@@ -344,6 +374,12 @@ def _recovery_payload() -> dict:
         "cache_evictions": int(snap["cache_evictions"]),
         "backoff_seconds": round(float(snap["backoff_seconds"]), 6),
         "faults_injected": int(snap["faults_injected"]),
+        "dist": {
+            "retries": int(snap["dist_retries"]),
+            "splits": int(snap["dist_splits"]),
+            "fallbacks": int(snap["dist_fallbacks"]),
+            "cache_evictions": int(snap["dist_evictions"]),
+        },
     }
 
 

@@ -27,8 +27,19 @@ from ..column import Column
 from ..dtypes import FLOAT64, INT64
 from ..ops.common import adjacent_differs, null_safe_equal_at
 from ..table import Table
-from .mesh import DistTable, shard_map
+from .mesh import DistTable, _DIST_PROGRAMS, mesh_cache_key, shard_map
 from .shuffle import shuffle
+
+
+def _dist_program(key: tuple, build):
+    """Cached-compile lookup for the local shard_map kernels below:
+    bounded LRU shared with the shuffle program cache (mesh.
+    _DIST_PROGRAMS, ``SRT_COMPILE_CACHE_CAP``), cleared wholesale by the
+    recovery ladder's eviction rung.  The bodies close over arity/how/
+    capacity only — jit re-specializes per dtype — so one entry serves
+    every same-shape op on the mesh instead of retracing per call."""
+    from ..exec.compile import _lru_lookup
+    return _lru_lookup(_DIST_PROGRAMS, key, build, "dist.programs")[0]
 
 _DIST_AGGS = ("sum", "count", "min", "max", "mean")
 
@@ -55,15 +66,56 @@ def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
     table = dist.table
     key_cols = [table[k] for k in keys]
     val_cols = [table[v] for v, _, _ in aggs]
+    hows = tuple(how for _, how, _ in aggs)
 
-    n_in = 1 + 2 * len(key_cols) + 2 * len(val_cols)
+    body = _dist_program(
+        ("groupby", mesh_cache_key(mesh), len(key_cols), hows),
+        lambda: _build_groupby_body(mesh, axis, len(key_cols), hows))
+
+    flat_in = [dist.row_mask]
+    for kc in key_cols:
+        flat_in += [kc.data]
+    for kc in key_cols:
+        flat_in += [kc.valid_mask()]
+    for vc in val_cols:
+        flat_in += [vc.data]
+    for vc in val_cols:
+        flat_in += [vc.valid_mask()]
+
+    results = body(*flat_in)
+    new_mask = results[0]
+    pos = 1
+    cols = []
+    for k, kc in zip(keys, key_cols):
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        validity = None if kc.validity is None else valid
+        cols.append((k, Column(data=data, validity=validity, dtype=kc.dtype)))
+    for (vname, how, out_name), vc in zip(aggs, val_cols):
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        if how == "count":
+            dtype = INT64
+        elif how == "mean":
+            dtype = FLOAT64
+        elif how == "sum":
+            from ..ops.groupby import _sum_dtype
+            dtype = _sum_dtype(vc.dtype)
+        else:
+            dtype = vc.dtype
+        cols.append((out_name, Column(data=data.astype(dtype.jnp_dtype),
+                                      validity=valid, dtype=dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask)
+
+
+def _build_groupby_body(mesh: Mesh, axis: str, nk: int, hows: tuple):
+    nv = len(hows)
+    n_in = 1 + 2 * nk + 2 * nv
 
     @partial(shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * n_in,
-             out_specs=(PartitionSpec(axis),) * (1 + 2 * len(key_cols)
-                                                 + 2 * len(aggs)))
+             out_specs=(PartitionSpec(axis),) * (1 + 2 * nk + 2 * nv))
     def body(mask, *flat):
-        nk, nv = len(key_cols), len(val_cols)
         kdatas = flat[:nk]
         kvalids = flat[nk:2 * nk]
         vdatas = flat[2 * nk:2 * nk + nv]
@@ -104,7 +156,7 @@ def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
             outs.append(kd)                     # group key at start position
             outs.append(kv)
 
-        for (vname, how, _), vd, vv in zip(aggs, vdatas, vvalids):
+        for how, vd, vv in zip(hows, vdatas, vvalids):
             svd = jnp.take(vd, perm)
             svv = jnp.take(vv, perm) & smask
             counts = jax.ops.segment_sum(svv.astype(jnp.int64), gid,
@@ -141,40 +193,7 @@ def _local_groupby(dist: DistTable, mesh: Mesh, keys: list[str],
             outs.append(counts_at > 0)
         return tuple(outs)
 
-    flat_in = [dist.row_mask]
-    for kc in key_cols:
-        flat_in += [kc.data]
-    for kc in key_cols:
-        flat_in += [kc.valid_mask()]
-    for vc in val_cols:
-        flat_in += [vc.data]
-    for vc in val_cols:
-        flat_in += [vc.valid_mask()]
-
-    results = jax.jit(body)(*flat_in)
-    new_mask = results[0]
-    pos = 1
-    cols = []
-    for k, kc in zip(keys, key_cols):
-        data, valid = results[pos], results[pos + 1]
-        pos += 2
-        validity = None if kc.validity is None else valid
-        cols.append((k, Column(data=data, validity=validity, dtype=kc.dtype)))
-    for (vname, how, out_name), vc in zip(aggs, val_cols):
-        data, valid = results[pos], results[pos + 1]
-        pos += 2
-        if how == "count":
-            dtype = INT64
-        elif how == "mean":
-            dtype = FLOAT64
-        elif how == "sum":
-            from ..ops.groupby import _sum_dtype
-            dtype = _sum_dtype(vc.dtype)
-        else:
-            dtype = vc.dtype
-        cols.append((out_name, Column(data=data.astype(dtype.jnp_dtype),
-                                      validity=valid, dtype=dtype)))
-    return DistTable(table=Table(cols), row_mask=new_mask)
+    return jax.jit(body)
 
 
 def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
@@ -193,6 +212,7 @@ def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
     """
     if how not in ("inner", "left"):
         raise ValueError(f"unsupported distributed join type {how!r}")
+    from ..resilience import dist_guard, fault_point
     lsh = shuffle(left, mesh, on, bucket_size=bucket_size)
     rsh = shuffle(right, mesh, on, bucket_size=bucket_size)
     P = mesh.devices.size
@@ -200,11 +220,21 @@ def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
     if out_capacity_per_shard is None:
         out_capacity_per_shard = 2 * Cl
 
-    out, needed = _local_join(lsh, rsh, mesh, list(on), how,
-                              out_capacity_per_shard)
-    max_needed = int(needed)
+    def run_local(cap):
+        # Named fault site: the merge-join's pmax of the needed output
+        # capacity is this op's mesh collective, and the int() below
+        # blocks on the whole exchange — a shard-targeted "collective"
+        # SRT_FAULT spec fails here, and the stall watchdog around this
+        # closure turns a wedged mesh into DistStallError.
+        for s in range(P):
+            fault_point("collective", shard=s)
+        out, needed = _local_join(lsh, rsh, mesh, list(on), how, cap)
+        return out, int(needed)
+
+    out, max_needed = dist_guard(
+        "dist.join", lambda: run_local(out_capacity_per_shard))
     if max_needed > out_capacity_per_shard:
-        out, _ = _local_join(lsh, rsh, mesh, list(on), how, max_needed)
+        out, _ = dist_guard("dist.join", lambda: run_local(max_needed))
     return out
 
 
@@ -236,8 +266,33 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
     lk_flat = flatten_side(lkeys)
     rk_flat = flatten_side(rkeys)
 
-    n_in = 2 + len(lk_flat) + len(rk_flat) + len(l_flat) + len(r_flat)
-    n_out = 1 + len(l_flat) + len(r_flat) + 1
+    body = _dist_program(
+        ("join", mesh_cache_key(mesh), len(on), len(lothers), len(rothers),
+         how, Cout),
+        lambda: _build_join_body(mesh, axis, len(on), len(lothers),
+                                 len(rothers), how, Cout))
+
+    flat_in = [lsh.row_mask, rsh.row_mask] + lk_flat + rk_flat + l_flat + r_flat
+    results = body(*flat_in)
+    new_mask = results[0]
+    needed = results[-1]
+    pos = 1
+    cols = []
+    for (name, c) in lothers:
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
+    for (name, c) in rothers:
+        data, valid = results[pos], results[pos + 1]
+        pos += 2
+        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask), needed
+
+
+def _build_join_body(mesh: Mesh, axis: str, nk: int, nlo: int, nro: int,
+                     how: str, Cout: int):
+    n_in = 2 + 2 * (nk + nk + nlo + nro)
+    n_out = 1 + 2 * (nlo + nro) + 1
 
     @partial(shard_map, mesh=mesh,
              in_specs=(PartitionSpec(axis),) * n_in,
@@ -250,10 +305,10 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
             out = [(flat[i + 2 * j], flat[i + 2 * j + 1]) for j in range(count)]
             i += 2 * count
             return out
-        lk = take_pairs(len(lkeys))
-        rk = take_pairs(len(rkeys))
-        lo_cols = take_pairs(len(lothers))
-        ro_cols = take_pairs(len(rothers))
+        lk = take_pairs(nk)
+        rk = take_pairs(nk)
+        lo_cols = take_pairs(nlo)
+        ro_cols = take_pairs(nro)
         Cl = lmask.shape[0]
         Cr = rmask.shape[0]
 
@@ -337,18 +392,4 @@ def _local_join(lsh: DistTable, rsh: DistTable, mesh: Mesh, on: list[str],
         needed = jax.lax.pmax(total, axis)
         return tuple(outs) + (needed,)
 
-    flat_in = [lsh.row_mask, rsh.row_mask] + lk_flat + rk_flat + l_flat + r_flat
-    results = jax.jit(body)(*flat_in)
-    new_mask = results[0]
-    needed = results[-1]
-    pos = 1
-    cols = []
-    for (name, c) in lothers:
-        data, valid = results[pos], results[pos + 1]
-        pos += 2
-        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
-    for (name, c) in rothers:
-        data, valid = results[pos], results[pos + 1]
-        pos += 2
-        cols.append((name, Column(data=data, validity=valid, dtype=c.dtype)))
-    return DistTable(table=Table(cols), row_mask=new_mask), needed
+    return jax.jit(body)

@@ -18,6 +18,7 @@ with the compile-once discipline TPU wants.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -30,6 +31,22 @@ from ..column import Column
 from ..table import Table
 
 AXIS = "x"    #: the partition axis name used throughout the engine
+
+#: Bounded LRU of compiled parallel-op programs (shuffle bodies, local
+#: groupby/join kernels — dist_ops.py/shuffle.py), keyed by
+#: (op, mesh_cache_key, static shape/arity params).  Shared-cap LRU via
+#: exec/compile._lru_lookup (``SRT_COMPILE_CACHE_CAP``); cleared
+#: wholesale by resilience/recovery.evict_device_caches on OOM — live
+#: executables pin HBM, and the mesh ladder needs them droppable.
+_DIST_PROGRAMS: OrderedDict = OrderedDict()
+
+
+def mesh_cache_key(mesh: Mesh) -> tuple:
+    """Identify a mesh by its actual devices for program-cache keys:
+    compiled bodies close over the concrete mesh via ``shard_map``, so
+    same-shape meshes over different devices must not share entries."""
+    return (mesh.axis_names[0],
+            tuple(int(d.id) for d in mesh.devices.flat))
 
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; accept
 # both so the distributed layer runs on every jax the engine supports.
@@ -137,7 +154,19 @@ def collect(dist: DistTable) -> Table:
     Every ``np.asarray`` of a device array below is a blocking D2H round
     trip; they are counted so sharded runs report the same host-sync
     totals as the single-chip path (one sync per buffer pulled, plus the
-    mask)."""
+    mask).  The D2H drain blocks on every in-flight device computation
+    over these buffers, so it runs under the ``SRT_DIST_TIMEOUT`` stall
+    watchdog: a wedged mesh surfaces here as ``DistStallError`` instead
+    of an unbounded host hang."""
+    from ..resilience import dist_guard
+    return dist_guard("dist.collect", lambda: _collect_blocking(dist))
+
+
+def _collect_blocking(dist: DistTable) -> Table:
+    # Fault site INSIDE the guarded body: an injected stall parks this
+    # worker, and the watchdog surfaces it as DistStallError.
+    from ..resilience import fault_point
+    fault_point("collect")
     from ..utils.memory import record_host_sync
     mask = np.asarray(dist.row_mask)
     record_host_sync("dist.collect", mask.nbytes)

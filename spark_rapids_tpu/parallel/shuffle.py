@@ -42,7 +42,7 @@ from jax.sharding import Mesh, PartitionSpec
 from ..column import Column
 from ..table import Table
 from .hashing import partition_ids
-from .mesh import AXIS, DistTable, shard_map
+from .mesh import AXIS, DistTable, _DIST_PROGRAMS, mesh_cache_key, shard_map
 
 
 def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
@@ -58,7 +58,7 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     from ..config import shuffle_retry_max
     from ..exec.bucketing import bucket_capacity
     from ..obs.metrics import counter, gauge
-    from ..resilience import ShuffleOverflowError
+    from ..resilience import ShuffleOverflowError, dist_guard, fault_point
     from ..utils.memory import record_host_sync
     P = mesh.devices.size
     capacity = dist.capacity_total // P
@@ -94,9 +94,21 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         from ..obs import timeline as _tl
         tl_on = _tl.enabled()
         t0 = _tl.now_us() if tl_on else 0.0
-        out, overflow, occupancy = _shuffle_arrays(
-            dist, mesh, pids, P, capacity, bucket_size)
-        ov = bool(overflow)   # host sync; rerun with more slack
+
+        def exchange(bs=bucket_size):
+            # Named fault site INSIDE the guarded body: an armed
+            # SRT_FAULT "shuffle" spec (optionally shard-targeted) fails
+            # here — the mesh ladder of the caller (exec/dist.py
+            # dist-join rung) recovers OOMs, and an injected stall parks
+            # this worker so the watchdog fires.  The overflow bool is a
+            # host sync that blocks on the all_to_all itself, so a
+            # wedged exchange raises DistStallError instead of hanging.
+            for s in range(P):
+                fault_point("shuffle", shard=s)
+            o, overflow, occ = _shuffle_arrays(
+                dist, mesh, pids, P, capacity, bs)
+            return o, bool(overflow), occ
+        out, ov, occupancy = dist_guard("shuffle.exchange", exchange)
         record_host_sync("shuffle.overflow_check", 1)
         if tl_on:
             # The overflow check above already blocked on the shuffled
@@ -134,14 +146,46 @@ def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
     names = dist.table.names
     datas = tuple(c.data for c in dist.table.columns)
     valids = tuple(c.valid_mask() for c in dist.table.columns)
+    ncols = len(datas)
+    fn = _shuffle_program(mesh, axis, P, ncols, capacity, bucket_size)
 
+    results = fn(pids, dist.row_mask, *datas, *valids)
+    new_mask = results[0]
+    new_datas = results[1:1 + ncols]
+    new_valids = results[1 + ncols:-2]
+    overflow, occupancy = results[-2], results[-1]
+
+    cols = []
+    for name, old, data, valid in zip(names, dist.table.columns, new_datas,
+                                      new_valids):
+        validity = None if old.validity is None else valid
+        cols.append((name, Column(data=data, validity=validity, dtype=old.dtype)))
+    return DistTable(table=Table(cols), row_mask=new_mask), overflow, occupancy
+
+
+def _shuffle_program(mesh: Mesh, axis: str, P: int, ncols: int,
+                     capacity: int, bucket_size: int):
+    """The shard_map shuffle body, cached in the bounded parallel-program
+    LRU (mesh._DIST_PROGRAMS): the closure depends only on the mesh, the
+    column count, and the static capacities — jit re-specializes per
+    dtype, so one entry serves every same-arity shuffle on the mesh."""
+    from ..exec.compile import _lru_lookup
+    key = ("shuffle", mesh_cache_key(mesh), ncols, capacity, bucket_size)
+    return _lru_lookup(_DIST_PROGRAMS, key,
+                       lambda: _build_shuffle_body(mesh, axis, P, ncols,
+                                                   capacity, bucket_size),
+                       "dist.programs")[0]
+
+
+def _build_shuffle_body(mesh: Mesh, axis: str, P: int, ncols: int,
+                        capacity: int, bucket_size: int):
     @partial(shard_map, mesh=mesh,
-             in_specs=(PartitionSpec(axis),) * (2 + len(datas) + len(valids)),
-             out_specs=((PartitionSpec(axis),) * (1 + len(datas) + len(valids))
+             in_specs=(PartitionSpec(axis),) * (2 + 2 * ncols),
+             out_specs=((PartitionSpec(axis),) * (1 + 2 * ncols)
                         + (PartitionSpec(), PartitionSpec())))
     def body(pids_l, mask_l, *cols_l):
-        datas_l = cols_l[:len(datas)]
-        valids_l = cols_l[len(datas):]
+        datas_l = cols_l[:ncols]
+        valids_l = cols_l[ncols:]
         # Dead slots route to a virtual partition P (sorts last, never sent).
         eff_pid = jnp.where(mask_l, pids_l, P)
         order = jnp.argsort(eff_pid, stable=True)
@@ -178,15 +222,4 @@ def _shuffle_arrays(dist: DistTable, mesh: Mesh, pids: jax.Array, P: int,
         return (new_mask,) + new_datas + new_valids + (overflow_any,
                                                        occupancy)
 
-    results = jax.jit(body)(pids, dist.row_mask, *datas, *valids)
-    new_mask = results[0]
-    new_datas = results[1:1 + len(datas)]
-    new_valids = results[1 + len(datas):-2]
-    overflow, occupancy = results[-2], results[-1]
-
-    cols = []
-    for name, old, data, valid in zip(names, dist.table.columns, new_datas,
-                                      new_valids):
-        validity = None if old.validity is None else valid
-        cols.append((name, Column(data=data, validity=validity, dtype=old.dtype)))
-    return DistTable(table=Table(cols), row_mask=new_mask), overflow, occupancy
+    return jax.jit(body)

@@ -21,8 +21,16 @@ materialize (exec/compile.py) and the streaming executor (exec/stream.py):
     :class:`ExecutionRecoveryError` chained to the original error and
     naming every step attempted.
   * :func:`fault_point` — deterministic fault injection via ``SRT_FAULT``
-    (e.g. ``oom:materialize:2``, ``io:read:0.5:seed=7``) so every
-    recovery path above runs on CPU in tier-1 CI.
+    (e.g. ``oom:materialize:2``, ``io:read:0.5:seed=7``,
+    ``oom:dist-dispatch:1:shard=3``) so every recovery path above —
+    including shard-local mesh failures — runs on CPU in tier-1 CI.
+  * the MESH ladder (exec/dist.py, built on :func:`.recovery.oom_ladder`
+    with ``dist=True``): evict → retry → per-shard split → (opt-in via
+    ``SRT_DIST_FALLBACK=collect``) collect the DistTable and finish the
+    plan single-chip — a degraded-but-correct answer, recorded as a
+    named rung.  :func:`dist_guard` (``SRT_DIST_TIMEOUT``) bounds mesh
+    collectives/``collect()`` with a stall watchdog raising
+    :class:`DistStallError` instead of hanging the host.
 
 Recovery is observable: :func:`recovery_stats` accumulates retries /
 splits / cache evictions / backoff seconds, surfaced as the ``recovery``
@@ -37,15 +45,17 @@ which point the engine (and therefore jax) is necessarily live.
 """
 
 from .classify import (CATEGORY_COMPILE, CATEGORY_FATAL, CATEGORY_IO,
-                       CATEGORY_OOM, ExecutionRecoveryError, RecoverySummary,
-                       ShuffleOverflowError, StreamStallError, classify)
+                       CATEGORY_OOM, DistStallError, ExecutionRecoveryError,
+                       RecoverySummary, ShuffleOverflowError,
+                       StreamStallError, classify)
 from .faults import InjectedFault, fault_point, reset_faults
 from .retry import (RecoveryStats, RetryPolicy, recovery_stats, with_retries)
+from .watchdog import dist_guard
 
 __all__ = [
     "CATEGORY_COMPILE", "CATEGORY_FATAL", "CATEGORY_IO", "CATEGORY_OOM",
-    "ExecutionRecoveryError", "InjectedFault", "RecoveryStats",
-    "RecoverySummary", "RetryPolicy", "ShuffleOverflowError",
-    "StreamStallError", "classify", "fault_point", "recovery_stats",
-    "reset_faults", "with_retries",
+    "DistStallError", "ExecutionRecoveryError", "InjectedFault",
+    "RecoveryStats", "RecoverySummary", "RetryPolicy",
+    "ShuffleOverflowError", "StreamStallError", "classify", "dist_guard",
+    "fault_point", "recovery_stats", "reset_faults", "with_retries",
 ]

@@ -121,6 +121,15 @@ class StreamStallError(RuntimeError):
     consumer waited — surfaced instead of hanging forever."""
 
 
+class DistStallError(RuntimeError):
+    """The mesh stall watchdog (``SRT_DIST_TIMEOUT``): a dist dispatch,
+    mesh collective, or ``collect()`` made no progress for the configured
+    window — the usual cause is a wedged collective (one shard dead, the
+    rest blocked in psum/all_to_all), which would otherwise hang the host
+    forever.  Deliberately classified ``fatal``: a stalled mesh is not
+    fixed by evicting caches and retrying into the same wedge."""
+
+
 class ShuffleOverflowError(RuntimeError):
     """The mesh shuffle could not place every row within its retry
     budget (``SRT_SHUFFLE_RETRY_MAX``): the message names the observed

@@ -10,21 +10,34 @@ specs draw from a seeded PRNG, so a faulted run replays bit-identically.
 
 Spec grammar (comma-separated)::
 
-    SRT_FAULT=KIND:SITE:ARG[:seed=N][,...]
+    SRT_FAULT=KIND:SITE:ARG[:seed=N][:shard=N][,...]
 
     KIND   oom | compile | io        (the classify() category to inject)
-    SITE   bind | dispatch | materialize | stream-combine | read | ...
+           stall                     (block the caller instead of
+                                     raising — exercises the
+                                     SRT_DIST_TIMEOUT watchdog)
+    SITE   bind | dispatch | materialize | stream-combine | read |
+           dist-dispatch | shuffle | collective | collect | ...
     ARG    integer count  -> fire on the first ARG calls, then pass
            float in (0,1] -> fire with that probability (seeded PRNG,
                              seed=0 unless given)
+    shard=N  only fire when the engine passes a matching shard index to
+             the fault point — shard-local failure on a healthy mesh
+             (dist sites only; sites that pass no shard never match).
 
-Examples: ``oom:materialize:2``, ``oom:dispatch:1``,
-``io:read:0.5:seed=7``.
+Examples: ``oom:materialize:2``, ``oom:dist-dispatch:1:shard=3``,
+``io:read:0.5:seed=7``, ``stall:collective:1``.
 
 Injected errors are :class:`InjectedFault` instances whose message
 carries the real marker text (``RESOURCE_EXHAUSTED`` for oom), so both
 the isinstance fast path and the message-matching path of
-``classify`` exercise against them.  jax-free at import.
+``classify`` exercise against them.  A ``stall`` spec instead parks the
+calling thread on an event (released by :func:`reset_faults`, capped at
+``_STALL_CAP`` seconds) — the wedged-collective stand-in the
+``SRT_DIST_TIMEOUT`` watchdog is built to catch.  The decision of
+WHETHER to fire is made under the module lock; the stall wait itself
+happens outside it so ``reset_faults`` can always run.  jax-free at
+import.
 """
 
 from __future__ import annotations
@@ -52,12 +65,17 @@ class _FaultSpec:
     remaining: Optional[int]        # count mode: calls left to fail
     prob: Optional[float]           # probability mode
     rng: Optional[random.Random]
+    shard: Optional[int] = None     # only fire on this shard index
 
 
-_KINDS = ("oom", "compile", "io")
+_KINDS = ("oom", "compile", "io", "stall")
+
+#: Upper bound on a ``stall`` wait: a leaked watchdog-abandoned thread
+#: parked here wakes up on its own even if nobody calls reset_faults.
+_STALL_CAP = 30.0
 
 _LOCK = threading.Lock()
-_STATE: dict = {"raw": None, "specs": []}
+_STATE: dict = {"raw": None, "specs": [], "stall": threading.Event()}
 
 
 def _parse(raw: str) -> List[_FaultSpec]:
@@ -70,15 +88,21 @@ def _parse(raw: str) -> List[_FaultSpec]:
         if len(fields) < 3:
             raise ValueError(
                 f"SRT_FAULT spec {part!r} must be KIND:SITE:ARG"
-                f"[:seed=N] (e.g. 'oom:materialize:2')")
+                f"[:seed=N][:shard=N] (e.g. 'oom:materialize:2')")
         kind, site, arg = fields[0], fields[1], fields[2]
         if kind not in _KINDS:
             raise ValueError(
                 f"SRT_FAULT kind must be one of {_KINDS}, got {kind!r}")
         seed = 0
+        shard: Optional[int] = None
         for extra in fields[3:]:
             if extra.startswith("seed="):
                 seed = int(extra[len("seed="):])
+            elif extra.startswith("shard="):
+                shard = int(extra[len("shard="):])
+                if shard < 0:
+                    raise ValueError(
+                        f"SRT_FAULT shard index must be >= 0, got {shard}")
             else:
                 raise ValueError(
                     f"SRT_FAULT: unknown option {extra!r} in {part!r}")
@@ -88,46 +112,54 @@ def _parse(raw: str) -> List[_FaultSpec]:
                 raise ValueError(
                     f"SRT_FAULT probability must be in (0, 1], got {arg!r}")
             specs.append(_FaultSpec(kind, site, None, prob,
-                                    random.Random(seed)))
+                                    random.Random(seed), shard))
         else:
             count = int(arg)
             if count < 1:
                 raise ValueError(
                     f"SRT_FAULT count must be >= 1, got {arg!r}")
-            specs.append(_FaultSpec(kind, site, count, None, None))
+            specs.append(_FaultSpec(kind, site, count, None, None, shard))
     return specs
 
 
-def _make_error(kind: str, site: str, raw: str) -> InjectedFault:
+def _make_error(kind: str, site: str, raw: str,
+                shard: Optional[int] = None) -> InjectedFault:
+    where = f"site {site!r}" if shard is None else \
+        f"site {site!r} shard {shard}"
     if kind == "oom":
         return InjectedFault(
             "oom", site,
-            f"RESOURCE_EXHAUSTED: injected HBM OOM at site {site!r} "
+            f"RESOURCE_EXHAUSTED: injected HBM OOM at {where} "
             f"(SRT_FAULT={raw})")
     if kind == "compile":
         return InjectedFault(
             "compile", site,
-            f"injected XLA compilation failure at site {site!r} "
+            f"injected XLA compilation failure at {where} "
             f"(SRT_FAULT={raw})")
     return InjectedFault(
         "io", site,
-        f"injected transient IO error at site {site!r} (SRT_FAULT={raw})")
+        f"injected transient IO error at {where} (SRT_FAULT={raw})")
 
 
-def fault_point(site: str) -> None:
+def fault_point(site: str, shard: Optional[int] = None) -> None:
     """The engine's named failure sites call this; a matching armed
-    ``SRT_FAULT`` spec raises its classified error here.  One env read
-    when unset — cheap enough for per-batch paths, never per-row."""
+    ``SRT_FAULT`` spec raises its classified error here.  Dist sites
+    pass the shard index they are about to touch so ``shard=N`` specs
+    can fail one shard of a healthy mesh.  One env read when unset —
+    cheap enough for per-batch paths, never per-row."""
     from ..config import fault_spec
     raw = fault_spec()
     if not raw:
         return
+    stall_event: Optional[threading.Event] = None
     with _LOCK:
         if raw != _STATE["raw"]:
             _STATE["raw"] = raw
             _STATE["specs"] = _parse(raw)
         for spec in _STATE["specs"]:
             if spec.site != site:
+                continue
+            if spec.shard is not None and spec.shard != shard:
                 continue
             if spec.remaining is not None:
                 if spec.remaining <= 0:
@@ -137,13 +169,24 @@ def fault_point(site: str) -> None:
                 continue
             from .retry import recovery_stats
             recovery_stats().add_injection()
-            raise _make_error(spec.kind, site, raw)
+            if spec.kind == "stall":
+                # Park OUTSIDE the lock: reset_faults must stay callable
+                # while a stalled thread waits here.
+                stall_event = _STATE["stall"]
+                break
+            raise _make_error(spec.kind, site, raw, spec.shard)
+    if stall_event is not None:
+        stall_event.wait(timeout=_STALL_CAP)
 
 
 def reset_faults() -> None:
     """Forget injection state (remaining counts, PRNG position) so the
     next :func:`fault_point` reparses ``SRT_FAULT`` — tests call this
-    around every monkeypatched spec."""
+    around every monkeypatched spec.  Also releases any thread parked in
+    a ``stall`` injection (a watchdog-abandoned worker wakes and exits)
+    and arms a fresh event for the next spec."""
     with _LOCK:
         _STATE["raw"] = None
         _STATE["specs"] = []
+        _STATE["stall"].set()
+        _STATE["stall"] = threading.Event()

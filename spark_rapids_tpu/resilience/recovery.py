@@ -42,22 +42,40 @@ class SplitUnavailable(RuntimeError):
 
 def evict_device_caches() -> int:
     """Rung 1: drop every engine-owned device-buffer cache — the
-    whole-plan program LRU, the bucket pad cache, and the decoded
-    dictionary table.  Returns entries dropped (recorded in
-    ``recovery.cache_evictions``)."""
+    whole-plan program LRU, the bucket pad cache, the decoded dictionary
+    table, and (when the dist layer is loaded) the sharded-program LRU,
+    the live-count memo, and the parallel-op program cache.  Returns
+    entries dropped (recorded in ``recovery.cache_evictions``).
+
+    The dist caches are looked up via ``sys.modules`` instead of
+    imported: a single-chip process that never touched the mesh must not
+    pay the dist-layer import (and has nothing to evict there anyway).
+    """
+    import sys
     from ..exec import compile as _compile
     from ..exec.bucketing import clear_pad_cache
     dropped = len(_compile._COMPILED) + len(_compile._DECODED_DICTS)
     _compile._COMPILED.clear()
     _compile._DECODED_DICTS.clear()
     dropped += clear_pad_cache()
+    root = __package__.rsplit(".", 1)[0]
+    dist_mod = sys.modules.get(f"{root}.exec.dist")
+    if dist_mod is not None:
+        dropped += len(dist_mod._DIST_COMPILED) + len(dist_mod._LIVE_COUNT)
+        dist_mod._DIST_COMPILED.clear()
+        dist_mod._LIVE_COUNT.clear()
+    mesh_mod = sys.modules.get(f"{root}.parallel.mesh")
+    if mesh_mod is not None:
+        dropped += len(mesh_mod._DIST_PROGRAMS)
+        mesh_mod._DIST_PROGRAMS.clear()
     recovery_stats().add_evictions(dropped)
     return dropped
 
 
 def oom_ladder(site: str, fn: Callable,
                policy: Optional[RetryPolicy] = None,
-               drain: Optional[Callable] = None):
+               drain: Optional[Callable] = None,
+               dist: bool = False):
     """Run ``fn()`` under the evict-and-retry rungs of the recovery
     ladder for OOM/compile-classified failures.
 
@@ -68,6 +86,10 @@ def oom_ladder(site: str, fn: Callable,
     :class:`ExecutionRecoveryError` chained to the ORIGINAL error; the
     caller may catch it and attempt the split rung.  Non-OOM errors
     propagate untouched.
+
+    ``dist=True`` marks a mesh-ladder run (exec/dist.py): every rung
+    ALSO bumps the ``dist_*`` recovery stats so the ``recovery.dist``
+    block of QueryMetrics isolates the mesh share of the totals.
     """
     try:
         return fn()
@@ -87,6 +109,8 @@ def oom_ladder(site: str, fn: Callable,
         summary.steps.append("drain-inflight")
     for attempt in range(policy.max_retries):
         dropped = evict_device_caches()
+        if dist:
+            stats.add_dist_evictions(dropped)
         summary.cache_evictions += dropped
         summary.steps.append(f"evict-caches[{dropped}]")
         instant("recovery.evict_caches", cat="resilience", site=site,
@@ -99,6 +123,8 @@ def oom_ladder(site: str, fn: Callable,
         summary.backoff_seconds += delay
         stats.add_backoff(delay)
         stats.add_retry()
+        if dist:
+            stats.add_dist_retry()
         summary.retries += 1
         summary.steps.append("retry")
         instant("recovery.retry", cat="resilience", site=site,

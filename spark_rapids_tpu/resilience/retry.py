@@ -47,7 +47,8 @@ class RecoveryStats:
     """
 
     _FIELDS = ("retries", "splits", "cache_evictions", "backoff_seconds",
-               "faults_injected")
+               "faults_injected", "dist_retries", "dist_splits",
+               "dist_fallbacks", "dist_evictions")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -56,6 +57,13 @@ class RecoveryStats:
         self.cache_evictions = 0
         self.backoff_seconds = 0.0
         self.faults_injected = 0
+        # Mesh-ladder view: dist rungs ALSO bump the totals above (a dist
+        # retry is a retry); these isolate the mesh share for the
+        # ``recovery.dist`` block of QueryMetrics.
+        self.dist_retries = 0
+        self.dist_splits = 0
+        self.dist_fallbacks = 0
+        self.dist_evictions = 0
 
     def _bump(self, name: str, amount, counter_name: str) -> None:
         with self._lock:
@@ -79,6 +87,18 @@ class RecoveryStats:
 
     def add_injection(self) -> None:
         self._bump("faults_injected", 1, "resilience.faults_injected")
+
+    def add_dist_retry(self) -> None:
+        self._bump("dist_retries", 1, "recovery.dist.retries")
+
+    def add_dist_split(self) -> None:
+        self._bump("dist_splits", 1, "recovery.dist.splits")
+
+    def add_dist_fallback(self) -> None:
+        self._bump("dist_fallbacks", 1, "recovery.dist.fallbacks")
+
+    def add_dist_evictions(self, n: int) -> None:
+        self._bump("dist_evictions", n, "recovery.dist.cache_evictions")
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:

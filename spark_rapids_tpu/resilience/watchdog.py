@@ -1,0 +1,72 @@
+"""Mesh stall watchdog — ``SRT_DIST_TIMEOUT`` enforcement.
+
+A wedged mesh collective is the one failure the recovery ladder cannot
+see: when one shard dies mid-psum the surviving shards block forever
+inside the collective and the host blocks with them — no exception, no
+progress, no signal.  :func:`dist_guard` bounds that wait: the guarded
+call runs on a daemon worker thread and the host joins it for the
+configured window; silence past the deadline raises a named
+:class:`DistStallError` (deliberately ``fatal``-classified — retrying
+into the same wedge helps nobody) while the stalled worker is abandoned
+to its daemon fate.
+
+The guard is OFF unless ``SRT_DIST_TIMEOUT`` is set: the extra thread
+hop per guarded region is cheap but not free, and on a healthy mesh an
+unbounded wait is the correct default (XLA device computations are not
+cancellable from the host anyway — the watchdog buys a *named error*,
+not a cancellation).
+
+jax-free at import (the lazy-import rule): the guard is plain threading
+and the guarded callables bring their own engine imports.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, TypeVar
+
+from .classify import DistStallError
+
+T = TypeVar("T")
+
+
+def dist_guard(what: str, fn: Callable[[], T],
+               timeout: Optional[float] = None) -> T:
+    """Run ``fn()`` under the mesh stall watchdog.
+
+    With no timeout configured (``SRT_DIST_TIMEOUT`` unset and
+    ``timeout`` not given) this is a direct call — zero overhead.
+    Otherwise ``fn`` runs on a daemon thread; if it neither returns nor
+    raises within the window, :class:`DistStallError` names ``what``
+    and the window.  A worker exception re-raises in the caller
+    unchanged, so classification downstream is identical to the
+    unguarded call.
+    """
+    if timeout is None:
+        from ..config import dist_timeout
+        timeout = dist_timeout()
+    if timeout is None:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _run() -> None:
+        try:
+            box["result"] = fn()
+        except BaseException as exc:        # noqa: BLE001 — re-raised below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, daemon=True,
+                              name=f"dist-guard:{what}")
+    worker.start()
+    if not done.wait(timeout):
+        raise DistStallError(
+            f"{what} made no progress for {timeout:g}s (SRT_DIST_TIMEOUT): "
+            f"suspected wedged mesh collective or dead shard; the stalled "
+            f"worker thread was abandoned (daemon) — results from it are "
+            f"discarded")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]

@@ -245,3 +245,359 @@ class TestDistShuffledJoin:
         out = plan().filter(col("lv") > 0).run_dist(d0, mesh)
         assert isinstance(out, DistTable)
         assert out.num_rows() == 0
+
+
+# ---------------------------------------------------------------------------
+# Mesh recovery ladder: shard-targeted faults, per-shard split, degradation
+# ---------------------------------------------------------------------------
+
+import json
+import time
+
+from spark_rapids_tpu.obs import last_query_metrics, registry, timeline
+from spark_rapids_tpu.resilience import (DistStallError,
+                                         ExecutionRecoveryError,
+                                         recovery_stats, reset_faults)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    """No armed faults, zero backoff: mesh-fault tests never leak their
+    injection state (a parked stall worker is released by reset_faults)."""
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    reset_faults()
+    yield
+    reset_faults()
+
+
+def _res_table(n=4003, seed=0):
+    """Integer values (nullable) so every aggregate is exact regardless of
+    merge order — faulted runs must be bit-identical, not just close."""
+    r = np.random.default_rng(seed)
+    return Table([
+        ("k", Column.from_numpy(r.integers(0, 5, n).astype(np.int64))),
+        ("v", Column.from_numpy(r.integers(-100, 100, n).astype(np.int64),
+                                validity=r.random(n) > 0.2)),
+    ])
+
+
+def _rep_plan():
+    """Replicated-ending: filter + dense group-by (static domains so the
+    combine split rung has a batch-invariant accumulator layout)."""
+    return (plan().filter(col("v") > 0)
+            .groupby_agg(["k"], [("v", "sum", "s"), ("v", "count", "c"),
+                                 ("v", "max", "m")],
+                         domains={"k": (0, 4)}))
+
+
+def _sharded_plan():
+    """Row-sharded-ending: pure filter/project, returns a DistTable."""
+    return plan().filter(col("v") > 0).with_columns(w=col("v") * 2)
+
+
+def _join_right(m=3001, seed=1):
+    r = np.random.default_rng(seed)
+    return Table([
+        ("rk", Column.from_numpy(r.integers(0, 5, m).astype(np.int64))),
+        ("rv", Column.from_numpy(r.integers(0, 40, m).astype(np.int64))),
+    ])
+
+
+def _join_plan(right):
+    """Shuffled-join shape: all_to_all both sides, merge-join per shard,
+    then a distributed group-by on the joined rows."""
+    return (plan().join_shuffled(right, left_on="k", right_on="rk")
+            .groupby_agg(["rv"], [("v", "sum", "s"), ("v", "count", "c")])
+            .sort_by(["rv"]))
+
+
+class TestMeshRecoveryLadder:
+    """Every dist fault site recovers bit-identically through the mesh
+    ladder, for all three plan shapes the dist layer executes."""
+
+    @pytest.mark.parametrize("site", ("dist-dispatch", "collective"))
+    def test_replicated_plan_recovers(self, monkeypatch, mesh, site):
+        t = _res_table()
+        p = _rep_plan()
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT", f"oom:{site}:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == oracle
+        d = recovery_stats().delta(before)
+        assert d["dist_retries"] >= 1 and d["dist_evictions"] >= 1
+        # dist rungs also bump the totals (the dist block is a subset).
+        assert d["retries"] >= d["dist_retries"]
+
+    def test_row_sharded_plan_recovers(self, monkeypatch, mesh):
+        from spark_rapids_tpu.parallel import collect
+        t = _res_table()
+        p = _sharded_plan()
+        oracle = _row_multiset(collect(p.run_dist(shard_table(t, mesh),
+                                                  mesh)))
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = collect(p.run_dist(shard_table(t, mesh), mesh))
+        assert _row_multiset(got) == oracle
+        assert recovery_stats().delta(before)["dist_retries"] >= 1
+
+    @pytest.mark.parametrize("shard", (0, 3, 7))
+    def test_shard_targeted_fault_recovers(self, monkeypatch, mesh, shard):
+        # One shard of eight fails; the ladder recovers the whole program.
+        t = _res_table()
+        p = _rep_plan()
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT",
+                           f"oom:dist-dispatch:1:shard={shard}")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == oracle
+        assert recovery_stats().delta(before)["dist_retries"] >= 1
+
+    def test_shard_selector_misses_other_shards(self, monkeypatch, mesh):
+        # A spec pinned to a shard the mesh never reaches stays armed:
+        # no injection, no recovery, clean result.
+        t = _res_table()
+        p = _rep_plan()
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1:shard=64")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == oracle
+        d = recovery_stats().delta(before)
+        assert d["faults_injected"] == 0 and d["dist_retries"] == 0
+
+    @pytest.mark.parametrize("site",
+                             ("shuffle", "collective", "dist-dispatch"))
+    def test_shuffled_join_plan_recovers(self, monkeypatch, mesh, site):
+        t = _res_table()
+        right = _join_right()
+        p = _join_plan(right)
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT", f"oom:{site}:1:shard=3")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == oracle
+        assert recovery_stats().delta(before)["dist_retries"] >= 1
+
+
+class TestMeshSplitRung:
+    def test_concat_split_bit_identical(self, monkeypatch, mesh):
+        from spark_rapids_tpu.parallel import collect
+        t = _res_table()
+        p = _sharded_plan()
+        oracle = collect(p.run_dist(shard_table(t, mesh), mesh)).to_pydict()
+        monkeypatch.setenv("SRT_RETRY_MAX", "0")
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = collect(p.run_dist(shard_table(t, mesh), mesh))
+        # Slot order is preserved shard-wise, so direct equality applies.
+        assert got.to_pydict() == oracle
+        d = recovery_stats().delta(before)
+        assert d["dist_splits"] >= 1 and d["splits"] >= d["dist_splits"]
+
+    def test_combine_split_bit_identical(self, monkeypatch, mesh):
+        t = _res_table()
+        p = _rep_plan()
+        oracle = _row_multiset(p.run_dist(shard_table(t, mesh), mesh))
+        monkeypatch.setenv("SRT_RETRY_MAX", "0")
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = p.run_dist(shard_table(t, mesh), mesh)
+        assert _row_multiset(got) == oracle
+        assert recovery_stats().delta(before)["dist_splits"] >= 1
+
+    def test_recursive_split_shrinks_until_it_fits(self, monkeypatch, mesh):
+        from spark_rapids_tpu.parallel import collect
+        t = _res_table()
+        p = _sharded_plan()
+        oracle = collect(p.run_dist(shard_table(t, mesh), mesh)).to_pydict()
+        monkeypatch.setenv("SRT_RETRY_MAX", "0")
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:3")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = collect(p.run_dist(shard_table(t, mesh), mesh))
+        assert got.to_pydict() == oracle
+        assert recovery_stats().delta(before)["dist_splits"] >= 2
+
+
+class TestMeshDegradation:
+    def _unsplittable(self):
+        # sort after the group-by blocks both split modes.
+        return _rep_plan().sort_by(["k"])
+
+    def test_collect_fallback_completes_single_chip(self, monkeypatch,
+                                                    mesh):
+        t = _res_table()
+        p = self._unsplittable()
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_RETRY_MAX", "1")
+        monkeypatch.setenv("SRT_DIST_FALLBACK", "collect")
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:99")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        with timeline.recording() as rec:
+            got = p.run_dist(shard_table(t, mesh), mesh)
+        assert got.to_pydict() == oracle
+        assert recovery_stats().delta(before)["dist_fallbacks"] >= 1
+        names = [e["name"] for e in rec.events()]
+        assert "recovery.dist.fallback" in names
+        assert "recovery.dist.fallback_done" in names
+
+    def test_dist_join_fallback(self, monkeypatch, mesh):
+        # A shuffled join cannot split per shard: its exhaustion goes
+        # straight to the collect fallback.
+        t = _res_table()
+        p = _join_plan(_join_right())
+        oracle = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_RETRY_MAX", "1")
+        monkeypatch.setenv("SRT_DIST_FALLBACK", "collect")
+        monkeypatch.setenv("SRT_FAULT", "oom:shuffle:99")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == oracle
+        assert recovery_stats().delta(before)["dist_fallbacks"] >= 1
+
+    def test_exhausted_ladder_names_every_rung(self, monkeypatch, mesh):
+        t = _res_table()
+        p = self._unsplittable()
+        monkeypatch.delenv("SRT_DIST_FALLBACK", raising=False)
+        monkeypatch.setenv("SRT_RETRY_MAX", "1")
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:99")
+        reset_faults()
+        with pytest.raises(ExecutionRecoveryError) as ei:
+            p.run_dist(shard_table(t, mesh), mesh)
+        err = ei.value
+        assert err.site == "dist-dispatch"
+        assert "RESOURCE_EXHAUSTED" in str(err.__cause__)
+        msg = str(err)
+        assert "evict-caches" in msg and "retry" in msg
+        assert "split-unavailable" in msg
+        assert "collect-fallback" in msg and "SRT_DIST_FALLBACK" in msg
+
+    def test_stall_watchdog_on_collect(self, monkeypatch, mesh):
+        from spark_rapids_tpu.parallel import collect
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "0.3")
+        monkeypatch.setenv("SRT_FAULT", "stall:collect:1")
+        reset_faults()
+        t0 = time.monotonic()
+        with pytest.raises(DistStallError, match="SRT_DIST_TIMEOUT"):
+            collect(shard_table(_res_table(n=64), mesh))
+        assert time.monotonic() - t0 < 5.0
+
+    def test_stall_watchdog_on_dispatch(self, monkeypatch, mesh):
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "0.3")
+        monkeypatch.setenv("SRT_FAULT", "stall:dist-dispatch:1:shard=5")
+        reset_faults()
+        t = _res_table()
+        t0 = time.monotonic()
+        with pytest.raises(DistStallError):
+            _sharded_plan().run_dist(shard_table(t, mesh), mesh)
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestDistCompileCache:
+    def test_dist_cache_is_bounded_lru(self, monkeypatch, mesh):
+        from spark_rapids_tpu.exec import dist as dist_mod
+        monkeypatch.setenv("SRT_METRICS", "1")  # eviction counters live
+        monkeypatch.setenv("SRT_COMPILE_CACHE_CAP", "2")
+        dist_mod._DIST_COMPILED.clear()
+        t = _res_table(n=400)
+        d = shard_table(t, mesh)
+        before = registry().snapshot()
+        plans = [plan().filter(col("v") > i).with_columns(w=col("v") * 2)
+                 for i in (0, 10, 20)]
+        for p in plans:
+            p.run_dist(d, mesh)
+        assert len(dist_mod._DIST_COMPILED) <= 2
+        snap = registry().snapshot()
+        evicted = (snap.get("dist.compile_cache.evictions", 0)
+                   - before.get("dist.compile_cache.evictions", 0))
+        assert evicted >= 1
+        assert snap.get("dist.compile_cache.size") == \
+            len(dist_mod._DIST_COMPILED)
+        registry().reset()
+
+    def test_evict_clears_every_dist_cache(self, monkeypatch, mesh):
+        from spark_rapids_tpu.exec import dist as dist_mod
+        from spark_rapids_tpu.parallel import mesh as mesh_mod
+        from spark_rapids_tpu.resilience.recovery import evict_device_caches
+        # Metered run: the live-count cache (_LIVE_COUNT) fills on the
+        # metrics path, so the evict must drop it too.
+        monkeypatch.setenv("SRT_METRICS", "1")
+        registry().reset()
+        t = _res_table(n=400)
+        # Keep the DistTables alive: live-count entries are weakref-guarded
+        # on the row-mask buffer and self-evict when it is collected.
+        d1, d2 = shard_table(t, mesh), shard_table(t, mesh)
+        _rep_plan().run_dist(d1, mesh)
+        _join_plan(_join_right(m=300)).run_dist(d2, mesh)
+        assert dist_mod._DIST_COMPILED and dist_mod._LIVE_COUNT
+        assert mesh_mod._DIST_PROGRAMS     # shuffle/join local programs
+        expected = (len(dist_mod._DIST_COMPILED)
+                    + len(dist_mod._LIVE_COUNT)
+                    + len(mesh_mod._DIST_PROGRAMS))
+        dropped = evict_device_caches()
+        assert dropped >= expected
+        assert not dist_mod._DIST_COMPILED
+        assert not dist_mod._LIVE_COUNT
+        assert not mesh_mod._DIST_PROGRAMS
+        registry().reset()
+
+    def test_query_metrics_records_dist_block(self, monkeypatch, mesh):
+        monkeypatch.setenv("SRT_METRICS", "1")
+        registry().reset()
+        t = _res_table()
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1")
+        reset_faults()
+        _rep_plan().run_dist(shard_table(t, mesh), mesh)
+        payload = json.loads(last_query_metrics().to_json())
+        assert payload["mode"] == "dist"
+        assert payload["schema_version"] == 4
+        rec = payload["recovery"]["dist"]
+        assert rec["retries"] >= 1 and rec["cache_evictions"] >= 1
+        assert "recovery.dist:" in last_query_metrics().render()
+        registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# faulted-dist CI lane (ci/premerge-build.sh exports
+# SRT_FAULT=oom:dist-dispatch:1:shard=2 + SRT_METRICS=1; the tests pin
+# their own spec so they also pass standalone)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faulted_dist
+class TestFaultedDistSmoke:
+    def test_dist_dispatch_fault_golden(self, monkeypatch, mesh):
+        monkeypatch.setenv("SRT_METRICS", "1")
+        registry().reset()
+        t = _res_table()
+        p = _rep_plan()
+        monkeypatch.delenv("SRT_FAULT", raising=False)
+        reset_faults()
+        golden = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:1:shard=2")
+        reset_faults()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == golden
+        rec = json.loads(last_query_metrics().to_json())["recovery"]["dist"]
+        assert rec["retries"] >= 1 and rec["cache_evictions"] >= 1
+        snap = registry().snapshot()
+        assert snap.get("recovery.dist.retries", 0) >= 1
+        assert snap.get("resilience.faults_injected", 0) >= 1
+        registry().reset()
+
+    def test_shuffled_join_fault_golden(self, monkeypatch, mesh):
+        t = _res_table()
+        p = _join_plan(_join_right())
+        monkeypatch.delenv("SRT_FAULT", raising=False)
+        reset_faults()
+        golden = p.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        monkeypatch.setenv("SRT_FAULT", "oom:shuffle:1:shard=2")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run_dist(shard_table(t, mesh), mesh).to_pydict() == golden
+        assert recovery_stats().delta(before)["dist_retries"] >= 1

@@ -185,3 +185,39 @@ def test_cold_import_does_not_load_obs():
                          text=True, timeout=300)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "lazy" in out.stdout
+
+
+def test_watchdog_imports_without_jax():
+    """The mesh stall watchdog (resilience.watchdog) must stay jax-free
+    at import: the guard is plain threading, and the dist-resilience
+    surface (DistStallError, dist_guard, the fault grammar) is part of
+    the resilience package's jax-free contract."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.resilience.watchdog as wd\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing resilience.watchdog pulled in jax'\n"
+        "assert wd.dist_guard('x', lambda: 7, timeout=5.0) == 7\n"
+        "import threading\n"
+        "ev = threading.Event()\n"
+        "try:\n"
+        "    wd.dist_guard('x', lambda: ev.wait(30), timeout=0.1)\n"
+        "except wd.DistStallError:\n"
+        "    ev.set()\n"
+        "else:\n"
+        "    raise AssertionError('stalled guard did not raise')\n"
+        "assert 'jax' not in sys.modules, 'dist_guard pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    env.pop("SRT_DIST_TIMEOUT", None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout

@@ -271,7 +271,7 @@ class TestRunPlanRecovery:
         reset_faults()
         assert run_plan(p, t).to_pydict() == oracle
         payload = json.loads(last_query_metrics().to_json())
-        assert payload["schema_version"] == 3
+        assert payload["schema_version"] == 4
         rec = payload["recovery"]
         assert rec["retries"] >= 1
         assert rec["cache_evictions"] >= 1
@@ -282,7 +282,9 @@ class TestRunPlanRecovery:
         run_plan(_row_local_plan(), t)
         rec = json.loads(last_query_metrics().to_json())["recovery"]
         assert rec == {"retries": 0, "splits": 0, "cache_evictions": 0,
-                       "backoff_seconds": 0.0}
+                       "backoff_seconds": 0.0,
+                       "dist": {"retries": 0, "splits": 0, "fallbacks": 0,
+                                "cache_evictions": 0}}
 
     def test_concat_split_across_bucket_boundary(self, monkeypatch):
         # 150 rows straddles buckets (64/88/120/160): the snapped cut at
@@ -618,3 +620,115 @@ class TestFaultedSmoke:
                run_plan_stream(p, batches(), combine=False)]
         assert got == golden
         assert registry().snapshot().get("recovery.retries", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# 8. mesh fault grammar, stall watchdog, degradation knobs (jax-free units;
+#    the end-to-end mesh ladder lives in test_exec_dist.py)
+# ---------------------------------------------------------------------------
+
+class TestShardTargetedFaults:
+    def test_shard_selector_fires_only_on_matching_shard(self, monkeypatch):
+        monkeypatch.setenv("SRT_FAULT", "oom:dist-dispatch:2:shard=3")
+        reset_faults()
+        fault_point("dist-dispatch", shard=0)        # other shard: clean
+        fault_point("dist-dispatch", shard=2)
+        fault_point("dist-dispatch")                 # no shard: clean
+        with pytest.raises(InjectedFault) as ei:
+            fault_point("dist-dispatch", shard=3)
+        assert "shard 3" in str(ei.value)
+        assert classify(ei.value) == CATEGORY_OOM
+        with pytest.raises(InjectedFault):
+            fault_point("dist-dispatch", shard=3)    # count=2: twice
+        fault_point("dist-dispatch", shard=3)        # then exhausted
+
+    def test_shardless_spec_matches_any_shard(self, monkeypatch):
+        monkeypatch.setenv("SRT_FAULT", "oom:shuffle:1")
+        reset_faults()
+        with pytest.raises(InjectedFault):
+            fault_point("shuffle", shard=5)
+
+    def test_bad_shard_and_stall_specs_raise(self, monkeypatch):
+        for bad in ("oom:shuffle:1:shard=-1", "oom:shuffle:1:shard=x",
+                    "stall:collect"):
+            monkeypatch.setenv("SRT_FAULT", bad)
+            reset_faults()
+            with pytest.raises(ValueError):
+                fault_point("shuffle")
+
+    def test_stall_spec_parses_and_is_released_by_reset(self, monkeypatch):
+        # The stall parks the caller on an event (capped); reset_faults
+        # from another thread releases it well under the cap.
+        monkeypatch.setenv("SRT_FAULT", "stall:collect:1")
+        reset_faults()
+        t = threading.Timer(0.2, reset_faults)
+        t.start()
+        t0 = time.monotonic()
+        fault_point("collect")                       # parks, then released
+        t.join()
+        assert 0.1 < time.monotonic() - t0 < 5.0
+
+
+class TestDistGuard:
+    def test_no_timeout_is_a_direct_call(self, monkeypatch):
+        from spark_rapids_tpu.resilience import dist_guard
+        monkeypatch.delenv("SRT_DIST_TIMEOUT", raising=False)
+        before = threading.active_count()
+        assert dist_guard("x", lambda: 41 + 1) == 42
+        assert threading.active_count() == before    # no worker spawned
+
+    def test_result_and_exception_pass_through(self, monkeypatch):
+        from spark_rapids_tpu.resilience import dist_guard
+        assert dist_guard("x", lambda: {"a": 1}, timeout=5.0) == {"a": 1}
+
+        def boom():
+            raise InjectedFault("oom", "x", "RESOURCE_EXHAUSTED: unit")
+        with pytest.raises(InjectedFault) as ei:
+            dist_guard("x", boom, timeout=5.0)
+        assert classify(ei.value) == CATEGORY_OOM    # classification intact
+
+    def test_stall_raises_named_error_fast(self):
+        from spark_rapids_tpu.resilience import DistStallError, dist_guard
+        ev = threading.Event()
+        t0 = time.monotonic()
+        with pytest.raises(DistStallError, match="SRT_DIST_TIMEOUT"):
+            dist_guard("unit.wedge", lambda: ev.wait(30), timeout=0.2)
+        assert time.monotonic() - t0 < 3.0
+        ev.set()                                     # release the worker
+        # the watchdog's error must be terminal for the ladder
+        assert classify(DistStallError("x")) == CATEGORY_FATAL
+
+    def test_env_timeout_is_picked_up(self, monkeypatch):
+        from spark_rapids_tpu.resilience import DistStallError, dist_guard
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "0.2")
+        ev = threading.Event()
+        with pytest.raises(DistStallError):
+            dist_guard("unit.wedge", lambda: ev.wait(30))
+        ev.set()
+
+
+class TestDegradationKnobs:
+    def test_dist_fallback_parsing(self, monkeypatch):
+        from spark_rapids_tpu.config import dist_fallback
+        monkeypatch.delenv("SRT_DIST_FALLBACK", raising=False)
+        assert dist_fallback() is None
+        for off in ("0", "off", "false", ""):
+            monkeypatch.setenv("SRT_DIST_FALLBACK", off)
+            assert dist_fallback() is None
+        monkeypatch.setenv("SRT_DIST_FALLBACK", "collect")
+        assert dist_fallback() == "collect"
+        monkeypatch.setenv("SRT_DIST_FALLBACK", "replicate")
+        with pytest.raises(ValueError):
+            dist_fallback()
+
+    def test_dist_timeout_parsing(self, monkeypatch):
+        from spark_rapids_tpu.config import dist_timeout
+        monkeypatch.delenv("SRT_DIST_TIMEOUT", raising=False)
+        assert dist_timeout() is None
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "off")
+        assert dist_timeout() is None
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "2.5")
+        assert dist_timeout() == 2.5
+        monkeypatch.setenv("SRT_DIST_TIMEOUT", "-1")
+        with pytest.raises(ValueError):
+            dist_timeout()
