@@ -8,6 +8,14 @@ host-read fencing, exact-composition warmup).
 
 Run: python benchmarks/bench_queries.py
 
+``--metrics-out PATH`` tees every emitted JSON line (bench metrics,
+stream/recovery/dist_recovery records, the regress report) to ``PATH``
+as JSONL in addition to stdout — the machine-readable artifact a CI lane
+archives.  ``--regress`` appends a ``regress`` JSON line comparing the
+freshest ``SRT_METRICS_HISTORY`` record per plan fingerprint against the
+per-metric best of the earlier records (obs/regress.py) and exits
+nonzero on any breach beyond ``SRT_REGRESS_TOL``.
+
 ``--faults`` additionally arms a deterministic HBM-OOM injection
 (``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec),
 runs one mesh join+agg with a shard-targeted dist-dispatch OOM recovered
@@ -32,6 +40,24 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 N = 4_000_000
 N_DIM = 10_000
 REPS = 5
+
+#: ``--metrics-out`` sink (an open text file), or None for stdout-only.
+_METRICS_OUT = None
+
+
+def emit(line) -> None:
+    """Print one bench JSON line, teeing it to ``--metrics-out``.
+
+    Accepts a pre-serialized JSON string (the ``bench_line`` helpers) or
+    a dict (serialized here with sorted keys).  The tee is flushed per
+    line so a killed bench still leaves every completed record on disk.
+    """
+    if not isinstance(line, str):
+        line = json.dumps(line, sort_keys=True)
+    print(line)
+    if _METRICS_OUT is not None:
+        _METRICS_OUT.write(line + "\n")
+        _METRICS_OUT.flush()
 
 
 def main():
@@ -81,7 +107,7 @@ def main():
         out = q1(lineitem, bump)
         bump = int(np.asarray(out["n"].data)[0]) & 1
     dt_q1 = (time.perf_counter() - t0) / REPS
-    print(json.dumps({"metric": "tpch_q1_4M", "value": round(N / dt_q1, 1),
+    emit(json.dumps({"metric": "tpch_q1_4M", "value": round(N / dt_q1, 1),
                       "unit": "rows/sec"}))
 
     fact_key = rng.integers(0, N_DIM, N).astype(np.int64)
@@ -107,7 +133,7 @@ def main():
         out = join_agg(fact, bump)
         bump = int(np.asarray(out["n"].data)[0]) & 1
     dt_j = (time.perf_counter() - t0) / REPS
-    print(json.dumps({"metric": "fact_dim_join_agg_4M",
+    emit(json.dumps({"metric": "fact_dim_join_agg_4M",
                       "value": round(N / dt_j, 1), "unit": "rows/sec"}))
 
     bench_plans(lineitem, fact, dim)
@@ -116,17 +142,17 @@ def main():
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
         from spark_rapids_tpu.obs import bench_line
-        print(bench_line("metrics"))
-        print(bench_line("cache"))
+        emit(bench_line("metrics"))
+        emit(bench_line("cache"))
     if "--faults" in sys.argv:
         from spark_rapids_tpu.obs import bench_line
         bench_dist_recovery(fact, dim)
-        print(bench_line("recovery"))
+        emit(bench_line("recovery"))
     timeline_path = _timeline_arg()
     if timeline_path is not None:
         from spark_rapids_tpu.obs import timeline
         payload = timeline.export_chrome_trace(timeline_path)
-        print(json.dumps({"metric": "timeline", "path": timeline_path,
+        emit(json.dumps({"metric": "timeline", "path": timeline_path,
                           "events": len(payload["traceEvents"])},
                          sort_keys=True))
 
@@ -174,21 +200,41 @@ def bench_dist_recovery(fact, dim, n=200_000):
     elapsed = time.perf_counter() - t0
     assert got == want, "faulted dist run diverged from the golden"
     delta = recovery_stats().delta(before)
-    print(json.dumps({"metric": "dist_recovery", "rows": n, "shards": P,
+    emit(json.dumps({"metric": "dist_recovery", "rows": n, "shards": P,
                       "recovered_seconds": round(elapsed, 6),
                       "dist_retries": int(delta["dist_retries"]),
                       "dist_evictions": int(delta["dist_evictions"])},
                      sort_keys=True))
 
 
+def _path_arg(flag: str):
+    """``<flag> PATH``: the path following ``flag`` in argv, or None."""
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv):
+        raise SystemExit(f"{flag} requires an output path")
+    return sys.argv[i + 1]
+
+
 def _timeline_arg():
     """``--timeline out.json``: Chrome-trace export path, or None."""
-    if "--timeline" not in sys.argv:
-        return None
-    i = sys.argv.index("--timeline")
-    if i + 1 >= len(sys.argv):
-        raise SystemExit("--timeline requires an output path")
-    return sys.argv[i + 1]
+    return _path_arg("--timeline")
+
+
+def run_regress_gate():
+    """``--regress``: emit the regress JSON line and exit nonzero on any
+    tolerance breach (obs/regress.py over ``SRT_METRICS_HISTORY``)."""
+    from spark_rapids_tpu.obs import bench_line
+    line = bench_line("regress")
+    emit(line)
+    report = json.loads(line)
+    breaches = report.get("breaches") or []
+    if breaches:
+        raise SystemExit(
+            f"perf regression: {len(breaches)} breach(es) beyond "
+            f"tolerance {report.get('tolerance')} — see the regress "
+            f"JSON line above")
 
 
 def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
@@ -229,7 +275,7 @@ def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
         leaf = out_cols[leaf_col].data
     _ = np.asarray(leaf[-1:])
     dt = (time.perf_counter() - t0) / reps
-    print(json.dumps({"metric": f"{name}_plan_chained",
+    emit(json.dumps({"metric": f"{name}_plan_chained",
                       "value": round(n / dt, 1), "unit": "rows/sec"}))
 
     p.run(table)
@@ -237,7 +283,7 @@ def _bench_compiled(name, p, table, chain_col, leaf_col, reps=10):
     for _ in range(3):
         p.run(table)
     dt = (time.perf_counter() - t0) / 3
-    print(json.dumps({"metric": f"{name}_plan_run",
+    emit(json.dumps({"metric": f"{name}_plan_run",
                       "value": round(n / dt, 1), "unit": "rows/sec"}))
 
 
@@ -274,9 +320,9 @@ def bench_stream(lineitem, n_batches=8):
     for _ in run_plan_stream(p, feed(), prefetch=True):
         pass
     dt_s = time.perf_counter() - t0
-    print(json.dumps({"metric": "tpch_q1_etl_stream_4M",
+    emit(json.dumps({"metric": "tpch_q1_etl_stream_4M",
                       "value": round(rows / dt_s, 1), "unit": "rows/sec"}))
-    print(bench_stream_line())
+    emit(bench_stream_line())
 
 
 def bench_plans(lineitem, fact, dim):
@@ -318,4 +364,14 @@ if __name__ == "__main__":
         # stream lanes included — lands in the export.
         _timeline_arg()                       # validate the argument early
         os.environ["SRT_TRACE_TIMELINE"] = "1"
-    main()
+    metrics_out = _path_arg("--metrics-out")
+    if metrics_out is not None:
+        _METRICS_OUT = open(metrics_out, "a")
+    try:
+        main()
+        if "--regress" in sys.argv:
+            run_regress_gate()
+    finally:
+        if _METRICS_OUT is not None:
+            _METRICS_OUT.close()
+            _METRICS_OUT = None

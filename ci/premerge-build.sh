@@ -85,6 +85,58 @@ print("timeline lane ok:", len(payload["traceEvents"]), "events")
 EOF
 ls -l artifacts/premerge-timeline.json
 
+# Regression-gate lane: run a small query bank twice against a fresh
+# metrics history (run 1 seeds the per-fingerprint baseline, run 2 is
+# the gated fresh record), assert the gate passes on the unchanged
+# rerun, then re-run the bank with a deliberate HBM-OOM injection —
+# the retry backoff inflates wall time, and the gate must flag it.
+rm -f artifacts/regress-history.jsonl
+SRT_METRICS=1 SRT_METRICS_HISTORY=artifacts/regress-history.jsonl \
+SRT_REGRESS_TOL=0.5 SRT_RETRY_BACKOFF=0.5 \
+python - <<'EOF'
+import os
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import RegressionError, regress
+from spark_rapids_tpu.resilience import reset_faults
+
+r = np.random.default_rng(1)
+t = Table({"k": Column.from_numpy(r.integers(0, 8, 2048).astype(np.int64)),
+           "v": Column.from_numpy(r.uniform(0, 100, 2048))})
+BANK = [
+    plan().filter(col("v") > 25)
+          .groupby_agg(["k"], [("v", "sum", "s"), ("v", "count", "c")],
+                       domains={"k": (0, 7)}),
+    plan().with_columns(w=col("v") * 2.0).filter(col("w") <= 150)
+          .groupby_agg(["k"], [("w", "max", "m")], domains={"k": (0, 7)}),
+]
+
+def run_bank():
+    for p in BANK:
+        assert p.run(t).num_rows > 0
+
+run_bank()                      # run 1: cold compile, seeds the baseline
+run_bank()                      # run 2: steady state, the gated record
+report = regress.gate()         # raises RegressionError on a breach
+assert report["checked"] >= len(BANK), report
+print("regress lane clean:", report["checked"], "fingerprints gated")
+
+# Deliberate slowdown: an injected materialize OOM forces the retry
+# ladder (0.5 s backoff) into each query — the gate must flag it.
+os.environ["SRT_FAULT"] = "oom:materialize:2"
+reset_faults()
+run_bank()
+try:
+    regress.gate()
+except RegressionError as err:
+    print("regress lane flagged injected slowdown:", len(err.breaches),
+          "breach(es)")
+else:
+    raise AssertionError("regression gate missed the injected slowdown")
+EOF
+ls -l artifacts/regress-history.jsonl
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

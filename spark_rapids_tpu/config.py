@@ -34,6 +34,16 @@ Knobs (all optional):
                                by plan fingerprint (obs/history.py), read
                                back via ``obs.history.load``.  Unset = no
                                history is written.
+  ``SRT_METRICS_HISTORY_MAX_MB``  size cap in MiB for the history sink:
+                               after an append pushes the file past the
+                               cap, the oldest records are truncated
+                               away (newest kept).  Unset/``0``/``off``
+                               = unbounded.
+  ``SRT_REGRESS_TOL``          relative slowdown tolerance of the perf-
+                               regression gate (obs/regress.py): a fresh
+                               run breaches when a gated metric exceeds
+                               the best history baseline by more than
+                               this fraction (default 0.5 = 50%).
   ``SRT_LEAK_DEBUG``           ``1`` records creation stacks for native blob
                                handles and reports leaks at exit — the
                                ``-Dai.rapids.refcount.debug`` analog.
@@ -422,6 +432,42 @@ def metrics_history_path() -> str | None:
     return os.environ.get("SRT_METRICS_HISTORY") or None
 
 
+def metrics_history_max_mb() -> float | None:
+    """Size cap in MiB for the metrics-history sink, or None (unbounded).
+
+    When an append pushes the JSONL file past the cap, obs/history.py
+    truncates oldest-first so the newest records (the regression gate's
+    fresh runs and best baselines) survive.  Tune with
+    ``SRT_METRICS_HISTORY_MAX_MB`` (> 0; unset/``0``/``off`` disables)."""
+    raw = os.environ.get("SRT_METRICS_HISTORY_MAX_MB")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    val = float(raw)
+    if val <= 0:
+        raise ValueError(
+            f"SRT_METRICS_HISTORY_MAX_MB must be > 0 MiB (or 0/off), "
+            f"got {val}")
+    return val
+
+
+def regress_tolerance() -> float:
+    """Relative slowdown tolerance of the perf-regression gate
+    (obs/regress.py): fresh > baseline * (1 + tol) is a breach.  The
+    default is deliberately loose (0.5 — wall clocks are noisy on shared
+    CI hosts); CI lanes pin an explicit value.  Tune with
+    ``SRT_REGRESS_TOL`` (>= 0)."""
+    raw = os.environ.get("SRT_REGRESS_TOL")
+    if raw is None:
+        return 0.5
+    val = float(raw)
+    if val < 0:
+        raise ValueError(f"SRT_REGRESS_TOL must be >= 0, got {val}")
+    return val
+
+
 def leak_debug_enabled() -> bool:
     """Native-handle leak tracking on/off (refcount.debug analog)."""
     return _flag("SRT_LEAK_DEBUG")
@@ -448,6 +494,7 @@ def knob_table() -> dict[str, str]:
     names = ("SRT_ROWS_IMPL", "SPARK_RAPIDS_TPU_NATIVE_LIB",
              "SRT_TEST_PLATFORM", "SRT_TRACE", "SRT_METRICS",
              "SRT_TRACE_TIMELINE", "SRT_METRICS_HISTORY",
+             "SRT_METRICS_HISTORY_MAX_MB", "SRT_REGRESS_TOL",
              "SRT_LEAK_DEBUG", "SRT_LOG_LEVEL", "SRT_SKIP_NATIVE",
              "SRT_CPP_PARALLEL_LEVEL", "SRT_DENSE_MAX_CELLS",
              "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",

@@ -1503,6 +1503,53 @@ def _compiled_for(bound: _Bound):
     return _cache_lookup(bound.signature(), build)[0]
 
 
+def _program_cost_info(fn, bound: _Bound, deep: bool = False) -> dict:
+    """Best-effort XLA cost/memory analysis for one whole-plan program —
+    the compile-time half of the cost ledger (obs/profile.py).
+
+    ``fn.lower(...)`` is tracing only (no XLA optimization), so the
+    shallow path is cheap enough for the metered run; results are
+    memoized per program signature by ``profile.cached_analysis``.
+    ``deep=True`` (explain_analyze, where diagnostic cost is accepted)
+    additionally AOT-compiles the lowering for ``memory_analysis()`` —
+    the hot run path never pays that recompile.  Any failure (older jax,
+    backend without cost analysis) degrades to ``available: False``; the
+    ledger then reports compute-only attribution.
+    """
+    from ..utils.memory import _tree_nbytes
+    info = {"available": False, "deep": deep, "flops": 0.0,
+            "bytes_accessed": 0.0,
+            "static_bytes": int(_tree_nbytes((bound.exec_cols,
+                                              bound.side_inputs)))}
+    try:
+        lowered = fn.lower(bound.exec_cols, bound.side_inputs,
+                           bound.init_sel)
+    except Exception:
+        return info
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict) and ca:
+        info["available"] = True
+        info["flops"] = float(ca.get("flops", 0.0) or 0.0)
+        info["bytes_accessed"] = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if deep:
+        try:
+            ma = lowered.compile().memory_analysis()
+        except Exception:
+            ma = None
+        if ma is not None:
+            static = sum(int(getattr(ma, attr, 0) or 0) for attr in
+                         ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes"))
+            if static > 0:
+                info["static_bytes"] = static
+    return info
+
+
 # -- streaming-executor entry points (exec/stream.py) ------------------------
 
 def compiled_stream_for(bound: _Bound):
@@ -1704,15 +1751,21 @@ def _run_plan_metered(plan: Plan, table: Table):
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
     from ..resilience import recovery_stats
+    from ..obs import profile as _prof
     qm = QueryMetrics(query_id=next_query_id(), mode="run",
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
-    t = _execute_resilient(plan, table, qm=qm)
+    cc = _prof.push_collector()
+    try:
+        t = _execute_resilient(plan, table, qm=qm)
+    finally:
+        _prof.pop_collector(cc)
     qm.total_seconds = _time.perf_counter() - t_all
     qm.output_rows = t.num_rows
+    cc.apply(qm)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)
@@ -1769,12 +1822,27 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
             qm.execute_seconds += _time.perf_counter() - t0
             if qm.compile_cache == "miss":
                 qm.compile_seconds = qm.execute_seconds
+            from ..obs import profile as _prof
+            from ..utils.memory import sample_device_hbm
+            # Compile-time cost numbers (memoized per signature) + a
+            # live HBM sample at the dispatch boundary feed the ledger.
+            # Raw cache read, NOT _compiled_for: the dispatch above just
+            # populated it, and a counted lookup here would double the
+            # hit/miss accounting the cache tests pin.
+            sig = bound.signature()
+            _prof.cached_analysis(
+                ("plan", sig),
+                lambda: _program_cost_info(
+                    _COMPILED.get(sig) or _compiled_for(bound), bound))
+            sample_device_hbm("run.dispatch")
         t0 = _time.perf_counter()
         with _tspan("run.materialize", cat="execute", depth=depth):
             t = oom_ladder("materialize",
                            lambda: materialize(bound, out_cols, sel))
         if qm is not None:
             qm.materialize_seconds += _time.perf_counter() - t0
+            from ..utils.memory import sample_device_hbm
+            sample_device_hbm("run.materialize")
         return t
     except ExecutionRecoveryError as err:
         # Last rung: split the batch along rows and re-run the pieces.
@@ -1879,10 +1947,13 @@ def materialize(bound: _Bound, out_cols: dict[str, Column], sel) -> Table:
     fault_point("materialize")
     if sel is None:
         return _rebuild(bound, out_cols)
+    import time as _time
     from ..ops.common import pow2_bucket
     from ..utils.memory import record_host_sync
+    t0 = _time.perf_counter()
     count = int(jnp.sum(sel))                     # THE host sync
-    record_host_sync("materialize.count", 8)
+    record_host_sync("materialize.count", 8,
+                     seconds=_time.perf_counter() - t0)
     n = next(iter(out_cols.values())).size
     bucket = min(pow2_bucket(count), n)
     from ..ops.filter import _compact_kernel
@@ -2076,11 +2147,14 @@ def analyze_plan(plan: Plan, table: Table):
         set_last_query_metrics
     from ..resilience import recovery_stats
     from ..resilience.recovery import oom_ladder
+    from ..obs import profile as _prof
+    from ..utils.memory import sample_device_hbm
     qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
+    cc = _prof.push_collector()
     t_all = _time.perf_counter()
     bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
@@ -2099,6 +2173,12 @@ def analyze_plan(plan: Plan, table: Table):
     qm.execute_seconds = _time.perf_counter() - t0
     if qm.compile_cache == "miss":
         qm.compile_seconds = qm.execute_seconds
+    # deep=True: explain_analyze accepts the AOT recompile that XLA
+    # memory_analysis() costs; the memo upgrade benefits later runs too.
+    _prof.cached_analysis(("plan", bound.signature()),
+                          lambda: _program_cost_info(fn, bound, deep=True),
+                          deep=True)
+    sample_device_hbm("analyze.dispatch")
     # Per-step measured pass: fresh single-step jits over the same bound
     # inputs.  Diagnostic cost (re-traces every call) is acceptable —
     # explain_analyze is a debugging surface, not a hot path.
@@ -2127,8 +2207,11 @@ def analyze_plan(plan: Plan, table: Table):
     t = oom_ladder("materialize",
                    lambda: materialize(bound, out_cols, sel))
     qm.materialize_seconds = _time.perf_counter() - t0
+    sample_device_hbm("analyze.materialize")
     qm.total_seconds = _time.perf_counter() - t_all
     qm.output_rows = t.num_rows
+    _prof.pop_collector(cc)
+    cc.apply(qm)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)

@@ -84,14 +84,17 @@ _LIVE_COUNT: dict = {}
 
 
 def _live_count_cached(row_mask) -> int:
+    import time as _time
     from .stats import _guarded_cache_get, _guarded_cache_put
     key = (id(row_mask),)
     hit = _guarded_cache_get(_LIVE_COUNT, key, (row_mask,))
     if hit is not None:
         return hit
+    t0 = _time.perf_counter()
     count = int(jnp.sum(row_mask))
     from ..utils.memory import record_host_sync
-    record_host_sync("dist.live_count", 8)
+    record_host_sync("dist.live_count", 8,
+                     seconds=_time.perf_counter() - t0)
     _guarded_cache_put(_LIVE_COUNT, key, (row_mask,), count)
     return count
 
@@ -116,6 +119,7 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
 
 def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     import time as _time
+    from ..obs import profile as _prof
     from ..obs.metrics import counters_delta, registry
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
@@ -126,11 +130,29 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
-    result = _execute_dist_resilient(plan, dist, mesh)
+    cc = _prof.push_collector()
+    try:
+        result = _execute_dist_resilient(plan, dist, mesh)
+    finally:
+        _prof.pop_collector(cc)
     qm.total_seconds = _time.perf_counter() - t_all
     if isinstance(result, Table):
         qm.output_rows = result.num_rows
+    cc.apply(qm)
     qm.finish_counters(counters_delta(before))
+    # The dist path has no single bind/dispatch/materialize bracket the
+    # driver can time (the ladder may run several attempts), so the phase
+    # walls come from the microsecond counters the resilient core
+    # increments — summed across attempts, which is what the cost
+    # ledger's saturating attribution wants.
+    qm.bind_seconds = qm.counters.get("dist.bind.us", 0) / 1e6
+    qm.execute_seconds = qm.counters.get("dist.dispatch.us", 0) / 1e6
+    qm.materialize_seconds = qm.counters.get("dist.materialize.us", 0) / 1e6
+    if qm.counters.get("dist.compile_cache.miss"):
+        qm.compile_cache = "miss"
+        qm.compile_seconds = qm.execute_seconds
+    elif qm.counters.get("dist.compile_cache.hit"):
+        qm.compile_cache = "hit"
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
@@ -163,9 +185,18 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
         return shard_table(result, mesh)
     if any(isinstance(s, JoinShuffledStep) for s in plan.steps):
         return _lower_shuffled_join(plan, dist, mesh, depth)
+    import time as _time
+    from ..config import metrics_enabled
+    from ..obs.metrics import counter
+    meter = metrics_enabled()
+
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
+    t_bind = _time.perf_counter()
     bound = _Bound(plan, dist.table, probe_mask=dist.row_mask)
+    if meter:
+        counter("dist.bind.us").inc(
+            max(1, int((_time.perf_counter() - t_bind) * 1e6)))
     if bound.string_cols or bound.dictionaries:
         raise TypeError(
             "distributed plans operate on fixed-width columns only "
@@ -191,6 +222,7 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
         gauge("dist.mesh_devices").set(axis_size)
         tl_on = _tl.enabled()
         t0 = _tl.now_us() if tl_on else 0.0
+        t_wall = _time.perf_counter() if (tl_on or meter) else 0.0
 
         def invoke():
             for s in range(axis_size):
@@ -200,11 +232,35 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
                 for s in range(axis_size):
                     fault_point("collective", shard=s)
             out = fn(bound.exec_cols, dist.row_mask, bound.side_inputs)
-            if tl_on:
+            if tl_on or meter:
                 out = jax.block_until_ready(out)
             return out
 
         out_cols, sel = dist_guard("dist.dispatch", invoke)
+        if meter:
+            from ..utils.memory import _tree_nbytes, sample_device_hbm
+            dur_s = _time.perf_counter() - t_wall
+            counter("dist.dispatch.us").inc(max(1, int(dur_s * 1e6)))
+            if replicated_out:
+                # ICI share of the dispatch wall, estimated from the
+                # collective's ring-all-reduce traffic: each device moves
+                # ~2*(P-1) copies of its accumulator payload over the
+                # interconnect, while compute streams over its input
+                # shard.  Byte-weighted split of the measured wall; the
+                # floor keeps a ran-collective visible in ``ici.us``.
+                payload = _tree_nbytes(out_cols)
+                ici_bytes = 2 * (axis_size - 1) * payload
+                input_bytes = max(
+                    _tree_nbytes(bound.exec_cols) // max(axis_size, 1), 1)
+                frac = ici_bytes / max(input_bytes + ici_bytes, ici_bytes, 1)
+                counter("ici.us").inc(max(1, int(dur_s * 1e6 * frac)))
+                counter("ici.bytes").inc(int(ici_bytes))
+                counter("ici.collectives").inc(1)
+            from ..obs import profile as _prof
+            _prof.cached_analysis(
+                ("dist", key),
+                lambda: _dist_program_cost(fn, bound, dist.row_mask))
+            sample_device_hbm("dist.dispatch")
         if tl_on:
             # Block so the recorded interval covers device wall, then
             # emit it once per shard lane: the host cannot observe
@@ -225,9 +281,16 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
     try:
         out_cols, sel = oom_ladder("dist-dispatch", do_dispatch, dist=True)
         if replicated_out:
-            return oom_ladder("materialize",
-                              lambda: materialize(bound, out_cols, sel),
-                              dist=True)
+            t_mat = _time.perf_counter()
+            result = oom_ladder("materialize",
+                                lambda: materialize(bound, out_cols, sel),
+                                dist=True)
+            if meter:
+                counter("dist.materialize.us").inc(
+                    max(1, int((_time.perf_counter() - t_mat) * 1e6)))
+                from ..utils.memory import sample_device_hbm
+                sample_device_hbm("dist.materialize")
+            return result
         order = [nm for nm in _final_order(plan.steps, bound.input_names)
                  if nm in out_cols]
         order += [nm for nm in out_cols if nm not in order]
@@ -244,6 +307,31 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
         except ExecutionRecoveryError:
             err.add_step("dist-split-failed")
         return _dist_collect_fallback(plan, dist, mesh, err)
+
+
+def _dist_program_cost(fn, bound: _Bound, row_mask) -> dict:
+    """XLA cost analysis for a compiled sharded program (argument order
+    differs from the single-chip programs, hence the dist-specific
+    lowering).  Mirrors ``compile._program_cost_info`` minus the deep
+    AOT pass — never recompile on the dist dispatch path."""
+    from ..utils.memory import _tree_nbytes
+    info = {"available": False, "deep": False, "flops": 0.0,
+            "bytes_accessed": 0.0,
+            "static_bytes": int(_tree_nbytes(
+                (bound.exec_cols, row_mask, bound.side_inputs)))}
+    try:
+        lowered = fn.lower(bound.exec_cols, row_mask, bound.side_inputs)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            info["available"] = True
+            info["flops"] = float(ca.get("flops", 0.0) or 0.0)
+            info["bytes_accessed"] = float(
+                ca.get("bytes accessed", 0.0) or 0.0)
+    except Exception:
+        pass
+    return info
 
 
 def _build_dist_program(bound: _Bound, mesh: Mesh, axis: str,

@@ -323,6 +323,16 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
     qm.stream_source_seconds = acct.source_s
     qm.stream_serial_seconds = serial
     qm.stream_overlap_ratio = overlap
+    if before is not None:
+        # End-of-stream HBM occupancy for the cost ledger; per-batch
+        # program analysis stays unavailable here by design (the stream
+        # driver never re-lowers its cached per-bucket programs).
+        from ..utils.memory import sample_device_hbm
+        samples = sample_device_hbm("stream.end")
+        qm.hbm_per_device = samples
+        qm.hbm_peak_bytes = max(
+            [max(s["peak_bytes"], s["bytes_in_use"]) for s in samples],
+            default=0)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
     set_last_stream_metrics(qm)

@@ -10,17 +10,23 @@ benchmarks' JSON output.  :mod:`.timeline` adds the fourth pillar —
 span events on per-batch/per-shard lanes exported as Chrome-trace JSON
 (``SRT_TRACE_TIMELINE=1``) — and :mod:`.history` persists finished
 ``QueryMetrics`` as JSONL keyed by plan fingerprint
-(``SRT_METRICS_HISTORY=path``).
+(``SRT_METRICS_HISTORY=path``).  :mod:`.profile` turns all of the above
+into the per-plan **cost ledger** (compute/ici/host_sync/
+dispatch_overhead buckets + HBM footprint — the ``cost`` block of every
+QueryMetrics), and :mod:`.regress` gates fresh ledgers against the
+history baseline (``SRT_REGRESS_TOL``).
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
 in the XLA stack.
 """
 
-from . import history, timeline
+from . import history, profile, regress, timeline
 from .history import load as load_history, plan_fingerprint
 from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
                       counter, counters_delta, gauge, registry, timer)
+from .profile import cost_block
+from .regress import RegressionError
 from .query import (QueryMetrics, StepMetrics, bench_cache_line, bench_line,
                     bench_metrics_line, bench_recovery_line,
                     bench_stream_line, last_query_metrics,
@@ -40,6 +46,8 @@ __all__ = [
     "bench_metrics_line",
     "bench_recovery_line",
     "bench_stream_line",
+    "RegressionError",
+    "cost_block",
     "counter",
     "counters_delta",
     "gauge",
@@ -48,6 +56,8 @@ __all__ = [
     "last_stream_metrics",
     "load_history",
     "plan_fingerprint",
+    "profile",
+    "regress",
     "registry",
     "set_last_query_metrics",
     "set_last_stream_metrics",

@@ -24,9 +24,13 @@ import os
 import threading
 from typing import Any, List, Optional
 
-from ..config import metrics_history_path
+from ..config import metrics_history_max_mb, metrics_history_path
 
 _LOCK = threading.Lock()
+#: Corrupt lines skipped by the most recent :func:`load` (a torn write
+#: from a crashed process, a partial line from a truncation race) — the
+#: regression report surfaces this so silent data loss is visible.
+_LOAD_SKIPPED = 0
 
 
 def _describe(value: Any) -> str:
@@ -70,13 +74,63 @@ def plan_fingerprint(plan: Any) -> str:
 
 
 def record(plan: Any, qm: Any, path: str) -> dict:
-    """Append one history record for ``qm`` to ``path``; returns it."""
+    """Append one history record for ``qm`` to ``path``; returns it.
+
+    Concurrent-writer safe: the record goes out as ONE ``os.write`` on an
+    ``O_APPEND`` descriptor, so records from multiple processes sharing a
+    history file interleave whole-line (POSIX appends are atomic for one
+    write), never torn mid-record.  The in-process lock only serializes
+    threads of this process."""
     rec = {"fingerprint": plan_fingerprint(plan), **qm.to_dict()}
-    line = json.dumps(rec, sort_keys=True)
+    data = (json.dumps(rec, sort_keys=True) + "\n").encode()
     with _LOCK:
-        with open(path, "a") as f:
-            f.write(line + "\n")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        _maybe_truncate(path)
     return rec
+
+
+def _maybe_truncate(path: str) -> None:
+    """Enforce ``SRT_METRICS_HISTORY_MAX_MB`` oldest-first (called under
+    ``_LOCK`` after every append).
+
+    Keeps the newest suffix of whole records that fits the cap (at least
+    one record survives even if oversized) and swaps it in atomically via
+    ``os.replace``.  Best-effort across processes: another writer's
+    append between the read and the replace can be lost, which the cap
+    semantics tolerate (the file is a bounded ring, not a ledger of
+    record)."""
+    cap_mb = metrics_history_max_mb()
+    if cap_mb is None:
+        return
+    cap_bytes = int(cap_mb * 1024 * 1024)
+    try:
+        if os.path.getsize(path) <= cap_bytes:
+            return
+        with open(path, "rb") as f:
+            lines = [ln for ln in f.read().split(b"\n") if ln]
+    except OSError:
+        return
+    keep: List[bytes] = []
+    size = 0
+    for line in reversed(lines):
+        if size + len(line) + 1 > cap_bytes and keep:
+            break
+        keep.append(line)
+        size += len(line) + 1
+    keep.reverse()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(b"\n".join(keep) + b"\n")
+        os.replace(tmp, path)
+    except OSError:
+        return
+    from .metrics import counter
+    counter("history.truncated_records").inc(len(lines) - len(keep))
 
 
 def maybe_record(plan: Any, qm: Any) -> Optional[dict]:
@@ -95,18 +149,42 @@ def load(fingerprint: Optional[str] = None,
     ``path`` defaults to ``SRT_METRICS_HISTORY``.  Returns ``[]`` when the
     sink is unset or the file does not exist yet — the optimizer's
     cold-start case, not an error.
+
+    Corrupt lines (torn writes from a crashed process) are skipped, not
+    fatal: their count is kept in :func:`last_load_skipped` and on the
+    ``history.corrupt_lines`` counter, so one bad record can't take the
+    whole baseline down with it.
     """
+    global _LOAD_SKIPPED
     if path is None:
         path = metrics_history_path()
     if path is None or not os.path.exists(path):
+        _LOAD_SKIPPED = 0
         return []
     out: List[dict] = []
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
             if fingerprint is None or rec.get("fingerprint") == fingerprint:
                 out.append(rec)
+    _LOAD_SKIPPED = skipped
+    if skipped:
+        from .metrics import counter
+        counter("history.corrupt_lines").inc(skipped)
     return out
+
+
+def last_load_skipped() -> int:
+    """Corrupt lines skipped by the most recent :func:`load` call."""
+    return _LOAD_SKIPPED

@@ -1,0 +1,271 @@
+"""Cost-attribution profiler — the per-plan cost ledger.
+
+ROADMAP item 1's exit criterion is "QueryMetrics attributing time to ICI
+vs compute vs host syncs"; item 2 needs per-query HBM budgets.  This
+module is the jax-free half of both: it takes what the execution paths
+measured (phase walls, the microsecond counters below, XLA cost-analysis
+numbers, HBM allocator samples) and splits a query's wall time into four
+**buckets** that always sum to at most the wall:
+
+``compute``
+    device execution attributed to the compiled program(s) themselves
+    (includes trace+XLA compile on a program-cache miss; the separate
+    ``timings.compile_seconds`` field isolates that share).
+``ici``
+    emulated-interconnect time: dist psum collectives and shuffle
+    all-to-all exchanges, estimated from measured dispatch wall times
+    weighted by cost-analysis byte estimates.
+``host_sync``
+    blocking device→host synchronizations (materialize row counts,
+    stats probes, shuffle sizing, dist live counts).
+``dispatch_overhead``
+    bind + materialize bookkeeping that is neither device compute nor a
+    measured sync (padding, dtype coercion, cache lookups).
+
+Anything left is ``unattributed`` — the residual the acceptance bar
+bounds at 10% of wall on a real dist run.
+
+The execution paths feed this module two ways, both requiring zero new
+plumbing through the four QueryMetrics producers:
+
+* **Counters** ride the existing per-query ``counters_delta`` into
+  ``qm.counters``: ``host.sync.us``, ``ici.us``, ``ici.bytes``, and the
+  dist phase meters ``dist.bind.us`` / ``dist.dispatch.us`` /
+  ``dist.materialize.us``.
+* **Collector notes**: a metered run opens a :class:`CostCollector`
+  (``push_collector``/``pop_collector``); deeper layers call
+  :func:`note_analysis` (XLA ``cost_analysis()`` results, captured once
+  per program signature via :func:`cached_analysis`) and
+  :func:`note_hbm` (per-device allocator samples from
+  ``utils.memory.sample_device_hbm``) without knowing whether anyone is
+  listening — both are no-ops with no active collector.
+
+``cost_block(qm)`` renders the ledger dict that ``QueryMetrics.to_dict``
+embeds as the always-present ``cost`` block (schema_version 5), and that
+``obs/regress.py`` gates on.
+
+No jax at module load (lazy-import rule, see obs/metrics.py) — reading a
+ledger back on a laptop must not drag in the XLA stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+_TLS = threading.local()
+
+#: Memoized per-program analysis results (keyed by program signature):
+#: ``fn.lower(...)`` traces the whole plan, so even the "cheap" path is
+#: worth doing once per compiled program, not once per run.
+_ANALYSIS_LOCK = threading.Lock()
+_ANALYSIS_MEMO: "OrderedDict[Any, dict]" = OrderedDict()
+_ANALYSIS_CAP = 256
+
+
+class CostCollector:
+    """Accumulates cost notes over one query execution.
+
+    One collector spans one QueryMetrics producer scope; nested metered
+    runs (a dist fallback re-entering ``run_plan``) each push their own,
+    and notes fan out to every collector on the thread's stack so the
+    outer dist ledger still sees the fallback's programs."""
+
+    __slots__ = ("analysis_available", "flops", "bytes_accessed",
+                 "static_bytes", "hbm_last", "hbm_peak")
+
+    def __init__(self) -> None:
+        self.analysis_available = False
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.static_bytes = 0
+        self.hbm_last: List[dict] = []
+        self.hbm_peak = 0
+
+    def note_analysis(self, info: Dict[str, Any]) -> None:
+        self.analysis_available = (self.analysis_available
+                                   or bool(info.get("available")))
+        self.flops += float(info.get("flops", 0.0) or 0.0)
+        self.bytes_accessed += float(info.get("bytes_accessed", 0.0) or 0.0)
+        self.static_bytes += int(info.get("static_bytes", 0) or 0)
+
+    def note_hbm(self, samples: Iterable[dict]) -> None:
+        samples = list(samples)
+        if samples:
+            self.hbm_last = samples
+        for s in samples:
+            self.hbm_peak = max(self.hbm_peak,
+                                int(s.get("peak_bytes", 0) or 0),
+                                int(s.get("bytes_in_use", 0) or 0))
+
+    def apply(self, qm: Any) -> None:
+        """Fold the collected notes into a QueryMetrics."""
+        qm.cost_analysis_available = self.analysis_available
+        qm.cost_flops = self.flops
+        qm.cost_bytes_accessed = self.bytes_accessed
+        qm.hbm_static_bytes = self.static_bytes
+        qm.hbm_peak_bytes = self.hbm_peak
+        qm.hbm_per_device = list(self.hbm_last)
+
+
+def _stack() -> List[CostCollector]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def push_collector() -> CostCollector:
+    st = _stack()
+    # A producer that raised before its pop leaves a collector behind;
+    # stray entries are harmless (their apply() never runs) but must
+    # not accumulate without bound on a thread that keeps failing.
+    if len(st) >= 8:
+        del st[0]
+    cc = CostCollector()
+    st.append(cc)
+    return cc
+
+
+def pop_collector(cc: CostCollector) -> None:
+    st = _stack()
+    if cc in st:
+        st.remove(cc)
+
+
+@contextmanager
+def collect():
+    cc = push_collector()
+    try:
+        yield cc
+    finally:
+        pop_collector(cc)
+
+
+def note_analysis(info: Dict[str, Any]) -> None:
+    """Report one program's cost-analysis result to every active
+    collector (no-op when nothing is collecting)."""
+    for cc in _stack():
+        cc.note_analysis(info)
+
+
+def note_hbm(samples: Iterable[dict]) -> None:
+    """Report a per-device HBM occupancy sample to every active
+    collector (no-op when nothing is collecting)."""
+    samples = list(samples)
+    for cc in _stack():
+        cc.note_hbm(samples)
+
+
+def cached_analysis(key: Any, build: Callable[[], dict],
+                    deep: bool = False) -> dict:
+    """Memoized program cost analysis: ``build()`` at most once per
+    ``key`` (a program signature), result noted to active collectors on
+    every call.  ``deep=True`` results (which include AOT
+    ``memory_analysis``) upgrade a cached shallow entry.  ``build``
+    failures degrade to ``{"available": False}`` — the
+    cost-analysis-unavailable fallback, never an error on the run path.
+    """
+    with _ANALYSIS_LOCK:
+        hit = _ANALYSIS_MEMO.get(key)
+        if hit is not None and (hit.get("deep") or not deep):
+            _ANALYSIS_MEMO.move_to_end(key)
+        else:
+            hit = None
+    if hit is None:
+        try:
+            info = build()
+        except Exception:
+            info = None
+        if not isinstance(info, dict):
+            info = {"available": False, "deep": deep}
+        info.setdefault("deep", deep)
+        with _ANALYSIS_LOCK:
+            _ANALYSIS_MEMO[key] = info
+            while len(_ANALYSIS_MEMO) > _ANALYSIS_CAP:
+                _ANALYSIS_MEMO.popitem(last=False)
+        hit = info
+    note_analysis(hit)
+    return hit
+
+
+def reset_analysis_cache() -> None:
+    with _ANALYSIS_LOCK:
+        _ANALYSIS_MEMO.clear()
+
+
+def attribute(wall: float, bind: float, execute: float, materialize: float,
+              ici_seconds: float = 0.0,
+              host_sync_seconds: float = 0.0) -> Dict[str, float]:
+    """Split ``wall`` into the four cost buckets plus the residual.
+
+    Saturating by construction: each bucket is clamped to what is left
+    of the wall, so ``compute + ici + host_sync + dispatch_overhead +
+    unattributed == wall`` (up to rounding) and every bucket is >= 0.
+    ICI is carved out of the execute phase first (collectives run inside
+    dispatch), measured syncs come off the top, and bind + materialize
+    minus their sync share becomes dispatch overhead.  For stream mode,
+    whose per-phase sums are taken across overlapping batches and can
+    exceed the pipelined wall, the clamps make this "attributed wall,
+    saturating" rather than a phase identity.
+    """
+    wall = max(float(wall), 0.0)
+    bind = max(float(bind), 0.0)
+    execute = max(float(execute), 0.0)
+    materialize = max(float(materialize), 0.0)
+    sync_raw = max(float(host_sync_seconds), 0.0)
+
+    ici = min(max(float(ici_seconds), 0.0), wall)
+    remaining = wall - ici
+    host_sync = min(sync_raw, remaining)
+    remaining -= host_sync
+    compute = min(max(execute - ici, 0.0), remaining)
+    remaining -= compute
+    overhead = min(max(bind + materialize - sync_raw, 0.0), remaining)
+    remaining -= overhead
+    attributed = wall - remaining
+    return {
+        "compute_seconds": round(compute, 6),
+        "ici_seconds": round(ici, 6),
+        "host_sync_seconds": round(host_sync, 6),
+        "dispatch_overhead_seconds": round(overhead, 6),
+        "unattributed_seconds": round(max(remaining, 0.0), 6),
+        "attributed_fraction": (round(attributed / wall, 4)
+                                if wall > 0 else 0.0),
+    }
+
+
+def cost_block(qm: Any) -> dict:
+    """The ledger dict for one QueryMetrics — the ``cost`` block of
+    ``to_dict()`` (always present; zeroed for unmetered records where
+    ``total_seconds`` is the UNMEASURED sentinel)."""
+    counters = getattr(qm, "counters", None) or {}
+    wall = max(float(getattr(qm, "total_seconds", 0.0)), 0.0)
+    buckets = attribute(
+        wall,
+        getattr(qm, "bind_seconds", 0.0),
+        getattr(qm, "execute_seconds", 0.0),
+        getattr(qm, "materialize_seconds", 0.0),
+        ici_seconds=counters.get("ici.us", 0) / 1e6,
+        host_sync_seconds=counters.get("host.sync.us", 0) / 1e6)
+    per_device = list(getattr(qm, "hbm_per_device", ()) or ())
+    return {
+        **buckets,
+        "analysis": {
+            "available": bool(getattr(qm, "cost_analysis_available", False)),
+            "flops": round(float(getattr(qm, "cost_flops", 0.0)), 3),
+            "bytes_accessed": round(
+                float(getattr(qm, "cost_bytes_accessed", 0.0)), 3),
+            "ici_bytes": int(counters.get("ici.bytes", 0)),
+        },
+        "hbm": {
+            "static_bytes": int(getattr(qm, "hbm_static_bytes", 0)),
+            "peak_bytes": int(getattr(qm, "hbm_peak_bytes", 0)),
+            "devices": len(per_device),
+            # Schema note: treated as an opaque value by the golden key-
+            # path test (like "counters") — device count varies by mesh.
+            "per_device": per_device,
+        },
+    }

@@ -125,6 +125,14 @@ class QueryMetrics:
     recovery_dist_splits: int = 0       # per-shard capacity halvings
     recovery_dist_fallbacks: int = 0    # SRT_DIST_FALLBACK=collect rungs
     recovery_dist_evictions: int = 0
+    # -- cost ledger inputs (obs/profile.py; filled by a CostCollector
+    # over the metered run, zero/empty when nothing was collected) ------
+    cost_analysis_available: bool = False   # XLA cost_analysis() worked
+    cost_flops: float = 0.0                 # summed over programs run
+    cost_bytes_accessed: float = 0.0
+    hbm_static_bytes: int = 0               # program argument footprint
+    hbm_peak_bytes: int = 0                 # max allocator peak sampled
+    hbm_per_device: List[dict] = field(default_factory=list)
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -148,10 +156,12 @@ class QueryMetrics:
         self.recovery_dist_evictions = int(delta.get("dist_evictions", 0))
 
     def to_dict(self) -> dict:
+        from .profile import cost_block
         return {
             # v3: added the always-present "recovery" block.
             # v4: added "recovery.dist" (the mesh-ladder share).
-            "schema_version": 4,
+            # v5: added the always-present "cost" ledger block.
+            "schema_version": 5,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "mode": self.mode,
@@ -201,6 +211,10 @@ class QueryMetrics:
                     "cache_evictions": self.recovery_dist_evictions,
                 },
             },
+            # Always present (zeroed when unmetered): wall split into
+            # compute/ici/host_sync/dispatch_overhead plus the HBM
+            # footprint — the regression gate's input (obs/regress.py).
+            "cost": cost_block(self),
         }
 
     def to_json(self) -> str:
@@ -227,6 +241,21 @@ class QueryMetrics:
             f"  host_syncs={self.host_syncs} d2h_bytes={self.d2h_bytes} "
             f"dict_encode={self.dict_encode_hits} hit"
             f"/{self.dict_encode_misses} miss")
+        if self.total_seconds >= 0:
+            from .profile import cost_block
+            cb = cost_block(self)
+            lines.append(
+                f"  cost: compute={_ms(cb['compute_seconds'])} "
+                f"ici={_ms(cb['ici_seconds'])} "
+                f"host_sync={_ms(cb['host_sync_seconds'])} "
+                f"overhead={_ms(cb['dispatch_overhead_seconds'])} "
+                f"unattributed={_ms(cb['unattributed_seconds'])} "
+                f"(attributed {cb['attributed_fraction']:.0%})")
+            if cb["hbm"]["devices"]:
+                lines.append(
+                    f"  hbm: static={cb['hbm']['static_bytes']} "
+                    f"peak={cb['hbm']['peak_bytes']} "
+                    f"devices={cb['hbm']['devices']}")
         if self.recovery_retries or self.recovery_splits:
             lines.append(
                 f"  recovery: retries={self.recovery_retries} "
@@ -383,11 +412,22 @@ def _recovery_payload() -> dict:
     }
 
 
+def _regress_payload() -> dict:
+    """Payload for ``bench_line("regress")``: the perf-regression report
+    of obs/regress.py over the ``SRT_METRICS_HISTORY`` file — per-plan
+    fresh-vs-baseline breaches at ``SRT_REGRESS_TOL``.  Never raises;
+    the caller (``bench_queries.py --regress``) decides the exit code
+    from the ``breaches`` list."""
+    from . import regress
+    return regress.check_history()
+
+
 _BENCH_PAYLOADS = {
     "metrics": _metrics_payload,
     "cache": _cache_payload,
     "stream": _stream_payload,
     "recovery": _recovery_payload,
+    "regress": _regress_payload,
 }
 
 
@@ -396,9 +436,10 @@ def bench_line(kind: str) -> str:
 
     Kinds: ``"metrics"`` (last QueryMetrics or registry snapshot),
     ``"cache"`` (compile cache + bucketing), ``"stream"`` (last streaming
-    run), ``"recovery"`` (process-lifetime resilience totals).  The four
-    legacy ``bench_*_line`` names are thin wrappers over this and emit
-    byte-identical output.
+    run), ``"recovery"`` (process-lifetime resilience totals),
+    ``"regress"`` (perf-regression report vs the metrics history).  The
+    four legacy ``bench_*_line`` names are thin wrappers over this and
+    emit byte-identical output.
     """
     builder = _BENCH_PAYLOADS.get(kind)
     if builder is None:

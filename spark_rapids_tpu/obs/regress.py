@@ -1,0 +1,137 @@
+"""Perf-regression harness over the metrics history.
+
+The history sink (obs/history.py, ``SRT_METRICS_HISTORY=path``) appends
+one JSONL QueryMetrics record per finished plan, keyed by plan
+fingerprint.  This module turns that file into a gate: for every
+fingerprint with at least two records, the LAST record is "the fresh
+run" and every earlier record is baseline.  The baseline value for a
+metric is the **minimum** over the earlier records — the best prior run
+— which makes the gate robust to a slow outlier in history (a cold
+compile, a faulted run) while still catching a fresh run that got
+slower than the plan has ever been, beyond tolerance.
+
+A breach means ``fresh > best_baseline * (1 + SRT_REGRESS_TOL)``.  The
+default gated metrics are wall time, the host-sync count (deterministic
+— a new sync is a code regression, not noise), and peak HBM; zero or
+missing baselines are skipped, so CPU runs (no allocator stats) gate on
+time and syncs only.
+
+Consumers: ``bench_queries.py --regress`` (emits the report as a bench
+line and exits nonzero on breaches) and the ci/premerge-build.sh
+regression-gate lane (calls :func:`gate`, which raises
+:class:`RegressionError`).
+
+No jax at module load (lazy-import rule, see obs/metrics.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ..config import regress_tolerance
+from . import history
+
+#: Dotted key paths into a history record gated by default.
+DEFAULT_METRICS: Sequence[str] = (
+    "timings.total_seconds",
+    "host.syncs",
+    "cost.hbm.peak_bytes",
+)
+
+
+class RegressionError(RuntimeError):
+    """A fresh run's ledger breached the history baseline."""
+
+    def __init__(self, breaches: List[dict], report: dict) -> None:
+        self.breaches = breaches
+        self.report = report
+        parts = ", ".join(
+            f"{b['metric']}[{b.get('fingerprint', '?')}] "
+            f"{b['baseline']:g} -> {b['fresh']:g} (x{b['ratio']:g})"
+            for b in breaches)
+        super().__init__(
+            f"{len(breaches)} perf regression(s) vs history baseline "
+            f"(tol={report.get('tolerance')}): {parts}")
+
+
+def _lookup(rec: dict, path: str) -> Optional[float]:
+    cur: object = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def compare(fresh: dict, baseline: Iterable[dict], tolerance: float,
+            metrics: Sequence[str] = DEFAULT_METRICS) -> List[dict]:
+    """Breaches of ``fresh`` against the per-metric min over ``baseline``
+    records.  Metrics missing from the fresh record or with no positive
+    baseline are skipped (absence is a schema drift, not a perf fact)."""
+    baseline = list(baseline)
+    breaches: List[dict] = []
+    for metric in metrics:
+        base_vals = [v for v in (_lookup(r, metric) for r in baseline)
+                     if v is not None and v > 0]
+        if not base_vals:
+            continue
+        base = min(base_vals)
+        got = _lookup(fresh, metric)
+        if got is None:
+            continue
+        if got > base * (1.0 + tolerance):
+            breaches.append({
+                "metric": metric,
+                "baseline": round(base, 6),
+                "fresh": round(got, 6),
+                "ratio": round(got / base, 4),
+            })
+    return breaches
+
+
+def check_history(path: Optional[str] = None,
+                  tolerance: Optional[float] = None,
+                  metrics: Sequence[str] = DEFAULT_METRICS) -> dict:
+    """The regression report over the history file (default:
+    ``SRT_METRICS_HISTORY``): every fingerprint with >= 2 records is
+    checked, last record vs the rest.  Never raises on breaches — that
+    is :func:`gate`'s job — so ``--regress`` can emit the report line
+    before deciding the exit code."""
+    if tolerance is None:
+        tolerance = regress_tolerance()
+    records = history.load(path=path)
+    by_fp: Dict[str, List[dict]] = {}
+    for rec in records:
+        fp = rec.get("fingerprint")
+        if isinstance(fp, str) and fp:
+            by_fp.setdefault(fp, []).append(rec)
+    breaches: List[dict] = []
+    checked = 0
+    for fp, recs in sorted(by_fp.items()):
+        if len(recs) < 2:
+            continue
+        checked += 1
+        for b in compare(recs[-1], recs[:-1], tolerance, metrics):
+            breaches.append(dict(b, fingerprint=fp))
+    return {
+        "metric": "regress",
+        "tolerance": tolerance,
+        "fingerprints": len(by_fp),
+        "checked": checked,
+        "breaches": breaches,
+        "corrupt_lines": history.last_load_skipped(),
+    }
+
+
+def gate(path: Optional[str] = None,
+         tolerance: Optional[float] = None,
+         metrics: Sequence[str] = DEFAULT_METRICS) -> dict:
+    """``check_history`` that raises :class:`RegressionError` on any
+    breach; returns the clean report otherwise (the CI lane's entry
+    point)."""
+    report = check_history(path=path, tolerance=tolerance, metrics=metrics)
+    if report["breaches"]:
+        raise RegressionError(report["breaches"], report)
+    return report

@@ -42,6 +42,8 @@ _LOCK = threading.RLock()
 _EVENTS: List[dict] = []
 _LANES: Dict[str, int] = {}      # lane name -> tid (stable per process)
 _FORCED = 0                      # nesting depth of recording() scopes
+_OPEN: Dict[int, "_Span"] = {}   # id(span) -> still-open spans, in
+                                 # creation order (export-time flush)
 
 
 def now_us() -> float:
@@ -124,6 +126,8 @@ class _Span:
         self.name, self.cat, self.lane, self.args = name, cat, lane, args
         self._t0 = now_us()
         self._done = False
+        with _LOCK:
+            _OPEN[id(self)] = self
 
     def __enter__(self) -> "_Span":
         return self
@@ -135,6 +139,8 @@ class _Span:
         if self._done:
             return
         self._done = True
+        with _LOCK:
+            _OPEN.pop(id(self), None)
         add_complete(self.name, self.cat, self._t0, now_us() - self._t0,
                      self.lane, **self.args)
 
@@ -188,6 +194,36 @@ def reset() -> None:
     with _LOCK:
         _EVENTS.clear()
         _LANES.clear()
+        _OPEN.clear()
+
+
+def flush_open_spans() -> int:
+    """Auto-close every still-open span, recording it with an
+    ``incomplete: true`` arg and a duration up to now.
+
+    A span left open at export (an exception unwound past a ``begin()``,
+    an async drain that never finished) used to be silently dropped —
+    the one interval a trace reader most needs to see.  Writes events
+    directly (not via :func:`add_complete`) so the flush works even when
+    the enabling scope is already winding down.  Returns the number of
+    spans flushed.
+    """
+    now = now_us()
+    with _LOCK:
+        open_spans = [s for s in _OPEN.values() if not s._done]
+        _OPEN.clear()
+        n = 0
+        for s in open_spans:
+            s._done = True
+            args = {k: _coerce(v) for k, v in s.args.items()}
+            args["incomplete"] = True
+            _EVENTS.append({
+                "name": s.name, "cat": s.cat, "ph": "X", "pid": _PID,
+                "tid": _lane_tid(s.lane), "ts": round(s._t0, 3),
+                "dur": round(max(now - s._t0, 0.0), 3), "args": args,
+            })
+            n += 1
+    return n
 
 
 def export_chrome_trace(path: Optional[str] = None,
@@ -196,7 +232,11 @@ def export_chrome_trace(path: Optional[str] = None,
 
     ``{"displayTimeUnit": "ms", "traceEvents": [...]}`` — the exact shape
     Perfetto and ``chrome://tracing`` load.  Returns the payload dict.
+    Exporting the live recording (no ``event_list``) first flushes
+    still-open spans so they land in the trace marked ``incomplete``.
     """
+    if event_list is None:
+        flush_open_spans()
     evs = events() if event_list is None else event_list
     payload = {"displayTimeUnit": "ms", "traceEvents": evs}
     if path is not None:
@@ -222,11 +262,20 @@ def summary_table(event_list: Optional[List[dict]] = None) -> str:
             instants[key] = instants.get(key, 0) + 1
             lanes.add(e["tid"])
     lines = [f"== Timeline: {len(evs)} events, {len(lanes)} lanes =="]
+    if lanes:
+        # Deterministic lane listing: announcement (tid) order, names
+        # from the M metadata events.
+        names = {e["tid"]: e["args"].get("name", "")
+                 for e in evs if e.get("ph") == "M"}
+        lines.append("  lanes: " + ", ".join(
+            names.get(t) or f"tid-{t}" for t in sorted(lanes)))
     if spans:
         lines.append(f"  {'category':<12}{'span':<28}{'count':>6}"
                      f"{'total':>12}")
+        # Total-time descending with a (cat, name) tiebreak so equal
+        # totals render in one stable order.
         for (cat, name), durs in sorted(
-                spans.items(), key=lambda kv: -sum(kv[1])):
+                spans.items(), key=lambda kv: (-sum(kv[1]), kv[0])):
             lines.append(f"  {cat:<12}{name:<28}{len(durs):>6}"
                          f"{sum(durs) / 1e3:>10.2f}ms")
     if instants:
@@ -253,7 +302,8 @@ class _Recording:
 
     def __exit__(self, *exc) -> None:
         global _FORCED
-        with _LOCK:
+        flush_open_spans()          # before disarming: the flushed events
+        with _LOCK:                 # belong to this scope's slice
             _FORCED -= 1
         if self.path is not None:
             export_chrome_trace(self.path, self.events())

@@ -104,9 +104,12 @@ class DistTable:
 
     def num_rows(self) -> int:
         """Live row count (host sync)."""
+        import time as _time
+        t0 = _time.perf_counter()
         count = int(jnp.sum(self.row_mask))
         from ..utils.memory import record_host_sync
-        record_host_sync("dist.live_count", 8)
+        record_host_sync("dist.live_count", 8,
+                         seconds=_time.perf_counter() - t0)
         return count
 
 
@@ -167,11 +170,15 @@ def _collect_blocking(dist: DistTable) -> Table:
     # worker, and the watchdog surfaces it as DistStallError.
     from ..resilience import fault_point
     fault_point("collect")
+    import time as _time
     from ..utils.memory import record_host_sync
+    t0 = _time.perf_counter()
     mask = np.asarray(dist.row_mask)
-    record_host_sync("dist.collect", mask.nbytes)
+    record_host_sync("dist.collect", mask.nbytes,
+                     seconds=_time.perf_counter() - t0)
     cols = []
     for name, col in dist.table.items():
+        t0 = _time.perf_counter()
         data = np.asarray(col.data)[mask]
         nbytes = data.nbytes
         validity = None
@@ -179,6 +186,7 @@ def _collect_blocking(dist: DistTable) -> Table:
             v = np.asarray(col.validity)[mask]
             nbytes += v.nbytes
             validity = None if v.all() else v
-        record_host_sync("dist.collect", nbytes)
+        record_host_sync("dist.collect", nbytes,
+                         seconds=_time.perf_counter() - t0)
         cols.append((name, Column.from_numpy(data, validity, dtype=col.dtype)))
     return Table(cols)

@@ -65,9 +65,12 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
     if bucket_size is None:
         # Worst sender must fit its rows in P buckets; 2x slack for hash
         # skew, floor of 8 so tiny shards don't thrash the overflow retry.
+        import time as _time
+        t_sz = _time.perf_counter()
         per_shard_live = jnp.sum(dist.row_mask.reshape(P, capacity), axis=1)
         max_live = int(jnp.max(per_shard_live))   # host sync (P scalars)
-        record_host_sync("shuffle.sizing", 8)
+        record_host_sync("shuffle.sizing", 8,
+                         seconds=_time.perf_counter() - t_sz)
         # Snap to the shared geometric bucket schedule (exec/bucketing.py)
         # so the shard_map's static shapes — and every downstream kernel
         # keyed off capacity_total — recompile once per bucket instead of
@@ -91,9 +94,13 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         mask_bytes = slab_rows * (len(dist.table.columns) + 1)
         counter("shuffle.bytes_moved").inc(data_bytes + mask_bytes)
 
+        from ..config import metrics_enabled
         from ..obs import timeline as _tl
+        import time as _time
         tl_on = _tl.enabled()
+        meter = metrics_enabled()
         t0 = _tl.now_us() if tl_on else 0.0
+        t_wall = _time.perf_counter()
 
         def exchange(bs=bucket_size):
             # Named fault site INSIDE the guarded body: an armed
@@ -110,6 +117,13 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
             return o, bool(overflow), occ
         out, ov, occupancy = dist_guard("shuffle.exchange", exchange)
         record_host_sync("shuffle.overflow_check", 1)
+        if meter:
+            # The overflow check blocked on the all_to_all, so the wall
+            # here covers the exchange — the shuffle's whole ICI story.
+            counter("ici.us").inc(
+                max(1, int((_time.perf_counter() - t_wall) * 1e6)))
+            counter("ici.bytes").inc(data_bytes + mask_bytes)
+            counter("ici.collectives").inc(1)
         if tl_on:
             # The overflow check above already blocked on the shuffled
             # slabs, so the interval covers the collective's device wall;

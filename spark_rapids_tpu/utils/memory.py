@@ -133,14 +133,17 @@ class MemoryScope:
         return None
 
 
-def record_host_sync(label: str = "", nbytes: int = 0) -> None:
+def record_host_sync(label: str = "", nbytes: int = 0,
+                     seconds: float = 0.0) -> None:
     """Account one blocking device→host round trip.
 
     Call at the point the host actually blocks (``int(...)``,
     ``jax.device_get``, ``np.asarray`` of a device array).  ``label``
     names the sync site (``materialize.count``, ``stats.probe``, ...);
-    ``nbytes`` is the device→host payload.  No-op (one env read) unless
-    ``SRT_METRICS=1``.
+    ``nbytes`` is the device→host payload; ``seconds``, when the caller
+    measured the blocking wait, feeds the ``host.sync.us`` counter the
+    cost ledger's ``host_sync`` bucket is built from (obs/profile.py).
+    No-op (one env read) unless ``SRT_METRICS=1``.
     """
     from ..obs.metrics import counter
     c = counter("host.sync")
@@ -150,6 +153,10 @@ def record_host_sync(label: str = "", nbytes: int = 0) -> None:
             counter(f"host.sync.{label}").inc()
         if nbytes:
             counter("host.d2h_bytes").inc(int(nbytes))
+        if seconds > 0:
+            # Microsecond int so it rides the counters-delta transport;
+            # floor of 1 keeps a measured-but-fast sync visible.
+            counter("host.sync.us").inc(max(1, int(seconds * 1e6)))
     # Every counted sync also lands on the span timeline, so blocking
     # round trips show up *between* spans in the Perfetto view — the
     # attribution gap ROADMAP item 1 names (ICI vs compute vs host sync).
@@ -167,11 +174,47 @@ def _tree_nbytes(tree: Any) -> int:
 
 
 def device_get_counted(tree: Any, label: str = "") -> Any:
-    """``jax.device_get`` with transfer accounting: records one host sync
-    and the transferred byte count against ``label``."""
+    """``jax.device_get`` with transfer accounting: records one host sync,
+    the transferred byte count, and the blocking wall against ``label``."""
+    import time
+    t0 = time.perf_counter()
     out = jax.device_get(tree)
-    record_host_sync(label, _tree_nbytes(out))
+    record_host_sync(label, _tree_nbytes(out),
+                     seconds=time.perf_counter() - t0)
     return out
+
+
+def sample_device_hbm(tag: str = "") -> list:
+    """Sample live HBM occupancy on every local device.
+
+    Publishes the ``hbm.bytes_in_use`` / ``hbm.peak`` gauges (mesh max)
+    plus per-device ``hbm.bytes_in_use.devN`` / ``hbm.peak.devN``, notes
+    the sample to any active cost collector (obs/profile.py — it becomes
+    the ledger's ``cost.hbm`` block), and returns the per-device list.
+    Execution paths call this at dispatch/materialize boundaries.  All
+    zeros on backends whose PJRT client reports no allocator stats (CPU).
+    """
+    from ..obs.metrics import gauge
+    samples = []
+    in_use_max = peak_max = 0
+    for i, dev in enumerate(jax.local_devices()):
+        stats = device_memory_stats(dev)
+        entry = {"device": i,
+                 "bytes_in_use": int(stats.get("bytes_in_use", 0) or 0),
+                 "peak_bytes": int(stats.get("peak_bytes_in_use", 0) or 0)}
+        samples.append(entry)
+        gauge(f"hbm.bytes_in_use.dev{i}").set(entry["bytes_in_use"])
+        gauge(f"hbm.peak.dev{i}").set(entry["peak_bytes"])
+        in_use_max = max(in_use_max, entry["bytes_in_use"])
+        peak_max = max(peak_max, entry["peak_bytes"])
+    gauge("hbm.bytes_in_use").set(in_use_max)
+    gauge("hbm.peak").set(peak_max)
+    from ..obs import profile
+    profile.note_hbm(samples)
+    from ..obs.timeline import instant
+    instant("hbm.sample", cat="memory", tag=tag,
+            bytes_in_use=in_use_max, peak=peak_max)
+    return samples
 
 
 @contextlib.contextmanager

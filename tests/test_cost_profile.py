@@ -1,0 +1,376 @@
+"""Cost-attribution ledger + regression harness contracts (obs/profile,
+obs/regress, and the hardened history/timeline satellites).
+
+The load-bearing invariants:
+
+1. **Bucket math** — ``attribute()`` splits wall into compute / ici /
+   host_sync / dispatch_overhead + unattributed, all >= 0 and summing to
+   wall even when phase sums oversubscribe it (the stream case).
+2. **Measured runs** — a metered single-chip run carries a ``cost``
+   block with bounded unattributed residual and NO ici (no collectives
+   ran); a dist groupby (one psum merge) reports nonzero ici, while a
+   dist filter-only plan (row-sharded end to end) reports none.
+3. **Graceful degradation** — XLA cost analysis failing must not fail
+   the query: the ledger degrades to ``analysis.available: false``.
+4. **Regression gate** — an unchanged rerun passes; a doctored slow
+   record breaches; corrupt history lines are skipped and counted; the
+   MB cap keeps the newest records.
+5. **Timeline flush** — spans still open at export are emitted with
+   ``"incomplete": true`` instead of being dropped, and the summary
+   table is deterministically ordered.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import last_query_metrics, registry
+from spark_rapids_tpu.obs import history, profile, regress
+from spark_rapids_tpu.obs.regress import RegressionError
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+def _table(prefix, n=2048):
+    # Unique column names -> fresh plan signature -> compile-cache miss.
+    rng = np.random.default_rng(3)
+    return Table.from_pydict({
+        f"{prefix}_k": (np.arange(n) % 8).astype(np.int64),
+        f"{prefix}_v": rng.uniform(0, 100, n),
+    })
+
+
+def _query(prefix):
+    return (plan()
+            .filter(col(f"{prefix}_v") > 10.0)
+            .groupby_agg([f"{prefix}_k"],
+                         [(f"{prefix}_v", "sum", f"{prefix}_s"),
+                          (f"{prefix}_v", "count", f"{prefix}_c")],
+                         domains={f"{prefix}_k": (0, 7)}))
+
+
+# ---------------------------------------------------------------------------
+# 1. bucket math
+# ---------------------------------------------------------------------------
+
+_BUCKETS = ("compute_seconds", "ici_seconds", "host_sync_seconds",
+            "dispatch_overhead_seconds", "unattributed_seconds")
+
+
+@pytest.mark.parametrize("wall,bind,execute,mat,ici,sync", [
+    (1.0, 0.1, 0.6, 0.2, 0.1, 0.05),     # well-formed phases
+    (1.0, 0.0, 0.0, 0.0, 0.0, 0.0),      # nothing measured
+    (0.5, 0.4, 0.9, 0.4, 0.2, 0.3),      # oversubscribed (stream-like)
+    (1.0, 0.0, 0.3, 0.0, 2.0, 5.0),      # ici/sync beyond wall
+    (0.0, 0.1, 0.1, 0.1, 0.1, 0.1),      # zero wall
+])
+def test_attribute_sums_to_wall_and_saturates(wall, bind, execute, mat,
+                                              ici, sync):
+    b = profile.attribute(wall, bind, execute, mat,
+                          ici_seconds=ici, host_sync_seconds=sync)
+    assert all(b[k] >= 0 for k in _BUCKETS), b
+    assert sum(b[k] for k in _BUCKETS) == pytest.approx(wall, abs=1e-5)
+    assert 0.0 <= b["attributed_fraction"] <= 1.0
+
+
+def test_attribute_known_split():
+    b = profile.attribute(1.0, 0.1, 0.6, 0.2,
+                          ici_seconds=0.1, host_sync_seconds=0.05)
+    assert b["compute_seconds"] == pytest.approx(0.5)
+    assert b["ici_seconds"] == pytest.approx(0.1)
+    assert b["host_sync_seconds"] == pytest.approx(0.05)
+    assert b["dispatch_overhead_seconds"] == pytest.approx(0.25)
+    assert b["unattributed_seconds"] == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# 2. measured runs
+# ---------------------------------------------------------------------------
+
+def test_single_chip_ledger_bounded_residual(metrics_on):
+    t = _table("cp1")
+    p = _query("cp1")
+    p.run(t)                                  # cold: compile dominates
+    p.run(t)                                  # steady state: the claim
+    qm = last_query_metrics()
+    cost = qm.to_dict()["cost"]
+    wall = qm.total_seconds
+    assert wall > 0
+    # single chip: no collectives, so no ici bucket
+    assert cost["ici_seconds"] == 0
+    assert cost["analysis"]["ici_bytes"] == 0
+    # the acceptance residual bound (slack floor for sub-ms CPU walls)
+    assert cost["unattributed_seconds"] <= 0.10 * wall + 0.05, cost
+    assert sum(cost[k] for k in _BUCKETS) == pytest.approx(wall, abs=1e-5)
+    # XLA cost analysis captured for the whole-plan program
+    assert cost["analysis"]["available"] is True
+    assert cost["analysis"]["flops"] > 0
+    # host syncs were measured, not just counted
+    assert cost["host_sync_seconds"] > 0
+    assert qm.counters.get("host.sync.us", 0) >= 1
+
+
+def test_cost_block_always_present_and_zeroed_when_unmeasured():
+    from spark_rapids_tpu.obs import QueryMetrics
+    cost = QueryMetrics(query_id=1).to_dict()["cost"]
+    assert set(_BUCKETS) <= set(cost)
+    assert all(cost[k] == 0 for k in _BUCKETS)
+    assert cost["analysis"]["available"] is False
+    assert cost["hbm"]["devices"] == 0
+
+
+def test_explain_analyze_renders_cost_line(metrics_on):
+    t = _table("cp2")
+    text = _query("cp2").explain_analyze(t)
+    assert "cost:" in text
+    assert "ici=" in text and "host_sync=" in text
+    assert "attributed" in text
+
+
+class TestDistIci:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from spark_rapids_tpu.parallel import make_flat_mesh
+        return make_flat_mesh()
+
+    def test_dist_groupby_attributes_ici(self, metrics_on, mesh):
+        from spark_rapids_tpu.parallel import shard_table
+        t = _table("cpd")
+        p = _query("cpd")
+        d = shard_table(t, mesh)
+        p.run_dist(d, mesh)
+        qm = last_query_metrics()
+        assert qm.mode == "dist"
+        cost = qm.to_dict()["cost"]
+        # the accumulator psum ran -> nonzero ici, estimated bytes, and
+        # the collective counted
+        assert cost["ici_seconds"] > 0
+        assert cost["analysis"]["ici_bytes"] > 0
+        assert qm.counters.get("ici.collectives", 0) >= 1
+        # per-device HBM sampled across the whole mesh (zeros on CPU,
+        # but one entry per device regardless)
+        assert cost["hbm"]["devices"] == mesh.devices.size
+        assert sum(cost[k] for k in _BUCKETS) == \
+            pytest.approx(qm.total_seconds, abs=1e-5)
+        # phase walls backfilled from the dist counters
+        assert qm.execute_seconds > 0
+
+    def test_dist_filter_only_has_no_ici(self, metrics_on, mesh):
+        from spark_rapids_tpu.parallel import shard_table
+        t = _table("cpf")
+        p = plan().filter(col("cpf_v") > 10.0)
+        p.run_dist(shard_table(t, mesh), mesh)
+        qm = last_query_metrics()
+        cost = qm.to_dict()["cost"]
+        # row-sharded end to end: no collective ran, so no ici at all
+        assert cost["ici_seconds"] == 0
+        assert qm.counters.get("ici.collectives", 0) == 0
+        assert qm.counters.get("dist.dispatch.us", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# 3. cost-analysis-unavailable fallback
+# ---------------------------------------------------------------------------
+
+def test_analysis_failure_degrades_to_compute_only(metrics_on, monkeypatch):
+    from spark_rapids_tpu.exec import compile as c
+
+    def boom(*a, **k):
+        raise RuntimeError("no cost analysis on this backend")
+
+    monkeypatch.setattr(c, "_program_cost_info", boom)
+    profile.reset_analysis_cache()
+    t = _table("cpu1")
+    out = _query("cpu1").run(t)               # must not raise
+    assert out.num_rows == 8
+    qm = last_query_metrics()
+    cost = qm.to_dict()["cost"]
+    assert cost["analysis"]["available"] is False
+    assert cost["analysis"]["flops"] == 0
+    # the ledger still attributes the wall it measured
+    assert sum(cost[k] for k in _BUCKETS) == \
+        pytest.approx(qm.total_seconds, abs=1e-5)
+    profile.reset_analysis_cache()
+
+
+def test_cached_analysis_memoizes_and_upgrades():
+    profile.reset_analysis_cache()
+    calls = []
+
+    def build():
+        calls.append(1)
+        return {"available": True, "flops": 5.0}
+
+    with profile.collect() as cc:
+        profile.cached_analysis("k1", build)
+        profile.cached_analysis("k1", build)      # memo hit, still noted
+    assert len(calls) == 1
+    assert cc.flops == 10.0
+
+    def deep_build():
+        calls.append(2)
+        return {"available": True, "flops": 7.0, "static_bytes": 64}
+
+    # a deep request upgrades the shallow entry exactly once
+    profile.cached_analysis("k1", deep_build, deep=True)
+    profile.cached_analysis("k1", deep_build, deep=True)
+    assert calls == [1, 2]
+    profile.reset_analysis_cache()
+
+
+# ---------------------------------------------------------------------------
+# 4. regression gate + history hardening
+# ---------------------------------------------------------------------------
+
+def test_regress_unchanged_rerun_passes(metrics_on, monkeypatch, tmp_path):
+    hist = tmp_path / "h.jsonl"
+    monkeypatch.setenv("SRT_METRICS_HISTORY", str(hist))
+    t = _table("rg1")
+    p = _query("rg1")
+    p.run(t)                                  # cold baseline
+    p.run(t)                                  # fresh (faster or equal-ish)
+    report = regress.gate()                   # min-baseline -> no breach
+    assert report["checked"] == 1
+    assert report["breaches"] == []
+
+
+def test_regress_flags_doctored_slowdown(metrics_on, monkeypatch, tmp_path):
+    hist = tmp_path / "h.jsonl"
+    monkeypatch.setenv("SRT_METRICS_HISTORY", str(hist))
+    t = _table("rg2")
+    p = _query("rg2")
+    p.run(t)
+    p.run(t)
+    # doctor a fresh record: same fingerprint, 100x the wall
+    recs = history.load(path=str(hist))
+    slow = json.loads(json.dumps(recs[-1]))
+    slow["timings"]["total_seconds"] = \
+        100.0 * max(r["timings"]["total_seconds"] for r in recs)
+    with open(hist, "a") as f:
+        f.write(json.dumps(slow) + "\n")
+    with pytest.raises(RegressionError) as exc:
+        regress.gate()
+    assert any(b["metric"] == "timings.total_seconds"
+               for b in exc.value.breaches)
+    # check_history reports without raising (the --regress emit path)
+    report = regress.check_history()
+    assert report["breaches"]
+
+
+def test_compare_skips_zero_and_missing_baselines():
+    fresh = {"timings": {"total_seconds": 10.0},
+             "cost": {"hbm": {"peak_bytes": 0}}}
+    base = [{"timings": {"total_seconds": 0.0},
+             "cost": {"hbm": {"peak_bytes": 0}}}]
+    # zero baseline (CPU hbm, zero wall) is not a gateable fact
+    assert regress.compare(fresh, base, tolerance=0.5) == []
+
+
+def test_history_corrupt_lines_skipped(metrics_on, tmp_path):
+    hist = tmp_path / "c.jsonl"
+    good = {"fingerprint": "f", "timings": {"total_seconds": 1.0}}
+    hist.write_text(json.dumps(good) + "\n"
+                    "{torn json\n"
+                    "[1, 2, 3]\n"
+                    + json.dumps(good) + "\n")
+    recs = history.load(path=str(hist))
+    assert len(recs) == 2
+    assert history.last_load_skipped() == 2
+    assert registry().counters_snapshot().get("history.corrupt_lines") == 2
+    report = regress.check_history(path=str(hist))   # loads again (+2)
+    assert report["corrupt_lines"] == 2
+
+
+def test_history_max_mb_truncates_oldest_first(monkeypatch, tmp_path):
+    hist = tmp_path / "t.jsonl"
+    # ~1 KB cap; each record ~100 bytes -> only the newest survive
+    monkeypatch.setenv("SRT_METRICS_HISTORY_MAX_MB", "0.001")
+
+    class _QM:
+        def __init__(self, i):
+            self.i = i
+
+        def to_dict(self):
+            return {"seq": self.i, "pad": "x" * 64}
+
+    p = _query("tr")
+    for i in range(50):
+        history.record(p, _QM(i), str(hist))
+    assert hist.stat().st_size <= 1024 + 256   # cap plus one record slack
+    recs = history.load(path=str(hist))
+    assert recs, "cap must keep at least one record"
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 49                      # newest survives
+    assert 0 not in seqs                       # oldest dropped
+
+
+def test_history_single_write_appends_whole_lines(metrics_on, monkeypatch,
+                                                  tmp_path):
+    hist = tmp_path / "w.jsonl"
+    monkeypatch.delenv("SRT_METRICS_HISTORY_MAX_MB", raising=False)
+    p = _query("wl")
+
+    class _QM:
+        def to_dict(self):
+            return {"a": 1}
+
+    for _ in range(5):
+        history.record(p, _QM(), str(hist))
+    lines = hist.read_text().splitlines()
+    assert len(lines) == 5
+    assert all(json.loads(ln)["fingerprint"] for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# 5. timeline flush of still-open spans + deterministic summary
+# ---------------------------------------------------------------------------
+
+def test_export_flushes_open_spans(monkeypatch, tmp_path):
+    from spark_rapids_tpu.obs import timeline as tl
+    monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+    tl.reset()
+    with tl.span("closed.work", cat="test", lane="lane-a"):
+        pass
+    cm = tl.span("open.work", cat="test", lane="lane-b", batch=3)
+    cm.__enter__()                            # never exited: crashy caller
+    payload = tl.export_chrome_trace(str(tmp_path / "t.json"))
+    tl.reset()
+    by_name = {e["name"]: e for e in payload["traceEvents"]
+               if e["ph"] == "X"}
+    assert "open.work" in by_name, "open span was dropped at export"
+    open_ev = by_name["open.work"]
+    assert open_ev["args"]["incomplete"] is True
+    assert open_ev["args"]["batch"] == 3
+    assert open_ev["dur"] >= 0
+    assert "incomplete" not in by_name["closed.work"]["args"]
+
+
+def test_summary_table_is_deterministic(monkeypatch):
+    from spark_rapids_tpu.obs import timeline as tl
+    monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+
+    def build():
+        tl.reset()
+        # announce lanes in scrambled order; equal-duration spans tie
+        for lane in ("lane-z", "lane-a", "lane-m"):
+            tl.add_complete("work." + lane, "test", 100.0, 5.0, lane=lane)
+        out = tl.summary_table()
+        tl.reset()
+        return out
+
+    first = build()
+    assert first == build()                   # stable across rebuilds
+    assert "lanes:" in first
+    # span rows: duration-sorted, name-tiebroken -> alphabetical here
+    rows = [ln for ln in first.splitlines() if "work." in ln]
+    assert rows == sorted(rows)

@@ -171,6 +171,51 @@ def test_timeline_records_without_jax(tmp_path):
     assert len(payload["traceEvents"]) == 3
 
 
+def test_profile_and_regress_import_without_jax(tmp_path):
+    """``obs.profile`` and ``obs.regress`` must work without jax: the
+    cost ledger's bucket math and the regression gate are exactly the
+    post-processing a laptop runs over benchmark JSONL artifacts."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    hist = tmp_path / "hist.jsonl"
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.profile as pf\n"
+        "import spark_rapids_tpu.obs.regress as rg\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.profile/regress pulled in jax'\n"
+        "b = pf.attribute(1.0, 0.1, 0.6, 0.2, ici_seconds=0.1,\n"
+        "                 host_sync_seconds=0.05)\n"
+        "total = sum(v for k, v in b.items() if k.endswith('_seconds'))\n"
+        "assert abs(total - 1.0) < 1e-6, b\n"
+        "assert b['compute_seconds'] == 0.5, b\n"
+        "import json\n"
+        "rec = {'fingerprint': 'f1', 'timings': {'total_seconds': 1.0},\n"
+        "       'host': {'syncs': 2}}\n"
+        f"with open({str(hist)!r}, 'w') as f:\n"
+        "    f.write(json.dumps(rec) + '\\n')\n"
+        "    rec2 = dict(rec, timings={'total_seconds': 9.0})\n"
+        "    f.write(json.dumps(rec2) + '\\n')\n"
+        f"report = rg.check_history(path={str(hist)!r}, tolerance=0.5)\n"
+        "assert report['breaches'], report\n"
+        "try:\n"
+        f"    rg.gate(path={str(hist)!r}, tolerance=0.5)\n"
+        "except rg.RegressionError as err:\n"
+        "    assert err.breaches\n"
+        "else:\n"
+        "    raise AssertionError('9x slowdown did not trip the gate')\n"
+        "assert 'jax' not in sys.modules, 'the gate pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

@@ -216,12 +216,13 @@ def test_dict_encode_cache_counters(metrics_on):
 def _key_paths(obj, prefix=""):
     """Flattened key paths; list values descend into the first element
     (steps all share StepMetrics' shape), dict leaves under ``counters``
-    stay opaque (free-form counter names)."""
+    stay opaque (free-form counter names), as does the per-device HBM
+    list (device count varies by mesh)."""
     paths = []
     if isinstance(obj, dict):
         for k in sorted(obj):
             p = f"{prefix}.{k}" if prefix else k
-            if p == "counters":
+            if p in ("counters", "cost.hbm.per_device"):
                 paths.append(p)
             else:
                 paths.extend(_key_paths(obj[k], p))
@@ -259,7 +260,7 @@ def test_query_metrics_json_round_trips(metrics_on):
     t = _table("js")
     _query("js").explain_analyze(t)
     payload = json.loads(last_query_metrics().to_json())
-    assert payload["schema_version"] == 4
+    assert payload["schema_version"] == 5
     assert payload["metric"] == "query_metrics"
     assert payload["output"]["rows"] == 7
     # bind-time stats probe + materialize count (first run of this table)
