@@ -9,7 +9,7 @@ host-read fencing, exact-composition warmup).
 Run: python benchmarks/bench_queries.py
 
 ``--metrics-out PATH`` tees every emitted JSON line (bench metrics,
-stream/recovery/dist_recovery records, the regress report) to ``PATH``
+stream/dist_stream/recovery/dist_recovery records, the regress report) to ``PATH``
 as JSONL in addition to stdout — the machine-readable artifact a CI lane
 archives.  ``--regress`` appends a ``regress`` JSON line comparing the
 freshest ``SRT_METRICS_HISTORY`` record per plan fingerprint against the
@@ -138,6 +138,7 @@ def main():
 
     bench_plans(lineitem, fact, dim)
     bench_stream(lineitem)
+    bench_dist_stream(lineitem)
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
@@ -323,6 +324,82 @@ def bench_stream(lineitem, n_batches=8):
     emit(json.dumps({"metric": "tpch_q1_etl_stream_4M",
                       "value": round(rows / dt_s, 1), "unit": "rows/sec"}))
     emit(bench_stream_line())
+
+
+def bench_dist_stream(lineitem, n_batches=8, batch_rows=200_000):
+    """Sharded streaming executor: the q1 group-by prefix driven over the
+    mesh with one in-flight window per shard, per-shard partial
+    accumulators, and ONE merge collective at stream end.  Emits the
+    ``dist_stream`` JSON line (shards, merge collectives, ICI bytes,
+    syncs avoided) plus a wall/host-sync comparison against the per-batch
+    ``run_plan_dist`` loop over the same batches — the record that pins
+    the executor's ICI-O(1), sync-once economics for future PRs to diff."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.config import metrics_enabled
+    from spark_rapids_tpu.exec import col, plan, run_plan_dist_stream
+    from spark_rapids_tpu.exec.dist import run_plan_dist
+    from spark_rapids_tpu.obs import bench_line, registry
+    from spark_rapids_tpu.parallel import make_mesh, shard_table
+
+    mesh = make_mesh()
+    P = mesh.devices.size
+    rows = n_batches * batch_rows
+    host = {n: np.asarray(c.data)[:rows] for n, c in lineitem.items()}
+
+    def batch(i):
+        lo = i * batch_rows
+        return srt.Table([(n, Column.from_numpy(v[lo:lo + batch_rows]))
+                          for n, v in host.items()])
+
+    p = (plan()
+         .filter(col("shipdate") <= 10_500)
+         .with_columns(disc_price=col("price") * (1 - col("disc")))
+         .groupby_agg(["flag", "status"],
+                      [("qty", "sum", "sum_qty"),
+                       ("disc_price", "sum", "revenue"),
+                       ("qty", "count", "n")],
+                      domains={"flag": (0, 2), "status": (0, 1)}))
+
+    def per_batch_loop():
+        for i in range(n_batches):
+            run_plan_dist(p, shard_table(batch(i), mesh), mesh)
+
+    def stream():
+        return list(run_plan_dist_stream(
+            p, (batch(i) for i in range(n_batches)), mesh, combine=True))
+
+    meter = metrics_enabled()
+
+    def syncs():
+        # Snapshot delta, not reset(): the metrics/cache lines emitted at
+        # the end of main() must keep the whole bench's counters.
+        return registry().snapshot().get("host.sync", 0) if meter else 0
+
+    per_batch_loop()                  # warm: per-batch dist programs
+    stream()                          # warm: stream partial + merge programs
+
+    base = syncs()
+    t0 = time.perf_counter()
+    per_batch_loop()
+    dt_loop = time.perf_counter() - t0
+    loop_syncs = syncs() - base
+
+    base = syncs()
+    t0 = time.perf_counter()
+    out = stream()
+    dt_stream = time.perf_counter() - t0
+    stream_syncs = syncs() - base
+    assert len(out) == 1 and out[0].num_rows > 0
+
+    emit(json.dumps({"metric": "dist_stream_vs_loop", "rows": rows,
+                      "shards": P, "batches": n_batches,
+                      "loop_seconds": round(dt_loop, 6),
+                      "stream_seconds": round(dt_stream, 6),
+                      "loop_host_syncs": loop_syncs,
+                      "stream_host_syncs": stream_syncs},
+                     sort_keys=True))
+    emit(bench_line("dist_stream"))
 
 
 def bench_plans(lineitem, fact, dim):

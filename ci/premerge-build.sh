@@ -54,6 +54,14 @@ python -m pytest tests/test_resilience.py -m faulted -q
 SRT_FAULT="oom:dist-dispatch:1:shard=2" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
 python -m pytest tests/test_exec_dist.py -m faulted_dist -q
 
+# Faulted DIST-STREAM lane: the sharded streaming executor under a
+# shard-targeted HBM-OOM armed mid-stream — the per-shard in-flight
+# window drains, the ladder recovers the faulted shard, and the stream's
+# output (including the one-collective combine merge) stays bit-identical
+# to the no-fault goldens.
+SRT_FAULT="oom:dist-dispatch:2:shard=3" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+python -m pytest tests/test_dist_stream.py -m faulted_dist_stream -q
+
 # Timeline lane: record a faulted query on the span timeline, export
 # Chrome-trace JSON, and validate it against the golden-pinned schema
 # (tests/golden/chrome_trace_schema.json) — the artifact a reviewer can

@@ -62,6 +62,11 @@ Knobs (all optional):
   ``SRT_STREAM_INFLIGHT``      max batches dispatched-but-unmaterialized in
                                the streaming executor (exec/stream.py,
                                default 2).
+  ``SRT_DIST_STREAM_INFLIGHT`` max batches dispatched-but-unmaterialized
+                               PER SHARD in the sharded streaming executor
+                               (exec/dist_stream.py); unset, the
+                               single-chip ``SRT_STREAM_INFLIGHT`` value
+                               applies.
   ``SRT_CPP_PARALLEL_LEVEL``   native build parallelism (``CPP_PARALLEL_LEVEL``).
   ``SRT_RETRY_MAX``            retry budget for the resilience layer
                                (resilience/): re-attempts after a
@@ -286,6 +291,25 @@ def stream_inflight() -> int:
     return val
 
 
+def dist_stream_inflight() -> int:
+    """Max in-flight batches for the SHARDED streaming executor
+    (exec/dist_stream.py).
+
+    Each in-flight batch pins one bucket's worth of output buffers on
+    EVERY shard at once, so the sharded window may want to sit below the
+    single-chip one on memory-tight meshes.  Tune with
+    ``SRT_DIST_STREAM_INFLIGHT`` (>= 1); unset, the single-chip
+    ``SRT_STREAM_INFLIGHT`` value applies."""
+    raw = os.environ.get("SRT_DIST_STREAM_INFLIGHT")
+    if raw is None:
+        return stream_inflight()
+    val = int(raw)
+    if val < 1:
+        raise ValueError(
+            f"SRT_DIST_STREAM_INFLIGHT must be >= 1, got {val}")
+    return val
+
+
 def retry_max() -> int:
     """Retry budget for the resilience layer (resilience/retry.py): how
     many RE-attempts follow a retryable failure (OOM after a cache evict,
@@ -500,6 +524,7 @@ def knob_table() -> dict[str, str]:
              "SRT_COMPILE_CACHE", "SRT_CPU_COMPILE_CACHE",
              "SRT_SHAPE_BUCKETS", "SRT_COMPILE_CACHE_CAP",
              "SRT_PREFETCH_DEPTH", "SRT_STREAM_INFLIGHT",
+             "SRT_DIST_STREAM_INFLIGHT",
              "SRT_RETRY_MAX", "SRT_RETRY_BACKOFF",
              "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT",
              "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT")

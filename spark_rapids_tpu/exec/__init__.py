@@ -23,8 +23,8 @@ from .expr import CaseWhen, Col, Expr, Lit, col, lit, when
 from .lazy import LazyTable, lazy
 from .plan import Plan, plan
 from .setops import except_keys, intersect_keys
-from .stream import run_plan_stream
+from .stream import run_plan_dist_stream, run_plan_stream
 
 __all__ = ["CaseWhen", "Col", "Expr", "LazyTable", "Lit", "Plan", "col",
            "except_keys", "intersect_keys", "lazy", "lit", "plan",
-           "run_plan_stream", "when"]
+           "run_plan_dist_stream", "run_plan_stream", "when"]

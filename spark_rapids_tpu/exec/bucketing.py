@@ -109,6 +109,20 @@ def _round8(n: int) -> int:
     return max(8, -(-n // 8) * 8)
 
 
+def shard_capacity(n: int, shards: int) -> int:
+    """Per-shard slot capacity for an ``n``-row batch dealt over
+    ``shards`` devices, snapped to the shared geometric schedule with
+    the dist layer's smaller floor (8 slots — per-shard blocks are a
+    fraction of the batch, and the mesh split rung already snaps to
+    ``floor=8``, so recovered halves land on capacities the stream
+    compiled).  Every batch size within one bucket shares one
+    ``shards * capacity`` sharded program shape, which is what makes
+    the sharded stream compile exactly once per (bucket, mesh)."""
+    if shards < 1:
+        raise ValueError(f"shards must be >= 1, got {shards}")
+    return bucket_capacity(max(1, -(-n // shards)), floor=8)
+
+
 def plan_bucketable(plan) -> bool:
     """False for plans that bind row-aligned side tables: a
     ``JoinShuffledStep`` probe must stay 1:1 with the input's physical

@@ -1651,6 +1651,29 @@ def stream_combine():
     return _STREAM_COMBINE
 
 
+def stream_merge_cells(acc: dict, axis: str, axis_size: int) -> dict:
+    """Cross-shard merge body for the sharded streaming executor's ONE
+    end-of-stream collective (exec/dist_stream.py wraps this in
+    ``shard_map``).  Each shard enters holding its ``(1, cells)`` block
+    of the stacked per-shard accumulators; additive accumulators
+    (count/sum/sumsq) merge with a single psum, and extrema ride the
+    psum-gather trick — the target TPU stack lowers only SUM all-reduces
+    (:func:`_psum_gather`) — then reduce shard-locally.  Output is the
+    replicated ``(cells,)`` accumulator dict :func:`stream_finalize`
+    materializes, so a whole sharded stream pays collective traffic
+    once, not once per batch."""
+    out = {}
+    for k, v in acc.items():
+        v = v[0]                 # this shard's (1, cells) block
+        if k.startswith("min:"):
+            out[k] = jnp.min(_psum_gather(v, axis, axis_size), axis=0)
+        elif k.startswith("max:"):
+            out[k] = jnp.max(_psum_gather(v, axis, axis_size), axis=0)
+        else:                    # count_all / count: / sum: / sumsq:
+            out[k] = jax.lax.psum(v, axis)
+    return out
+
+
 def stream_finalize(bound: _Bound, smeta: _GroupMeta, acc,
                     col_dtypes: dict[str, DType]) -> Table:
     """Output columns + materialization from a combined streaming

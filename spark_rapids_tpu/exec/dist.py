@@ -161,17 +161,27 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
 
 
 def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
-                            depth: int = 0):
+                            depth: int = 0, live_rows=None):
     """Sharded bind → dispatch → materialize under the mesh recovery
     ladder.  The named fault sites (``dist-dispatch`` per shard,
     ``collective`` per shard on the merge) let ``SRT_FAULT`` provoke
     every mesh failure path — including a single failing shard via the
-    ``shard=N`` selector — deterministically on a CPU host mesh."""
+    ``shard=N`` selector — deterministically on a CPU host mesh.
+
+    ``live_rows`` lets a caller who already knows the live count (the
+    sharded streaming executor sharded the batch itself, so the count is
+    host-side for free) skip the per-dispatch ``dist.live_count`` host
+    sync of the empty-input guard; the avoided sync is accounted via
+    ``utils.memory.record_avoided_sync``."""
     from ..resilience import dist_guard, fault_point
     from ..resilience.classify import ExecutionRecoveryError
     from ..resilience.recovery import SplitUnavailable, oom_ladder
 
-    if _live_count_cached(dist.row_mask) == 0:
+    if live_rows is not None:
+        from ..utils.memory import record_avoided_sync
+        record_avoided_sync("dist.live_count")
+    if (live_rows if live_rows is not None
+            else _live_count_cached(dist.row_mask)) == 0:
         # Degenerate shapes break trace-time assumptions (and the probe
         # under an all-False mask); mirror run_plan's eager fallback.
         # Checked before the shuffled-join dispatch so every lowering
@@ -335,7 +345,8 @@ def _dist_program_cost(fn, bound: _Bound, row_mask) -> dict:
 
 
 def _build_dist_program(bound: _Bound, mesh: Mesh, axis: str,
-                        axis_size: int, replicated_out: bool):
+                        axis_size: int, replicated_out: bool,
+                        donate: bool = False):
     program = _assemble(bound.assembly_steps(), tuple(bound.group_metas),
                         tuple(bound.join_metas), axis=axis,
                         axis_size=axis_size,
@@ -346,6 +357,10 @@ def _build_dist_program(bound: _Bound, mesh: Mesh, axis: str,
         return program(cols, side, init_sel=row_mask)
 
     out_spec = PartitionSpec() if replicated_out else PartitionSpec(axis)
+    # ``donate`` is the sharded stream's HBM-recycling hook: the input
+    # columns are engine-owned per-shard bucket-pad copies (shard_table
+    # output, never the user's table), so row-shaped outputs may alias
+    # them shard-wise and same-bucket batches cycle one buffer set.
     return jax.jit(partial(
         shard_map,
         mesh=mesh,
@@ -353,7 +368,7 @@ def _build_dist_program(bound: _Bound, mesh: Mesh, axis: str,
                   PartitionSpec()),
         out_specs=(out_spec, out_spec),
         check_vma=False,
-    )(sharded_program))
+    )(sharded_program), donate_argnums=(0,) if donate else ())
 
 
 # ---------------------------------------------------------------------------
@@ -446,18 +461,22 @@ def _concat_shards(a: DistTable, b: DistTable, P: int) -> DistTable:
                      row_mask=merge(a.row_mask, b.row_mask))
 
 
-def _dist_partial_program(bound: _Bound, smeta, mesh: Mesh, axis: str):
-    """Sharded partial-aggregate program for the combine split path:
-    prefix steps then :func:`..exec.compile._dense_accumulate` per
-    shard under the batch-invariant ``smeta`` layout, with NO collective
-    — every shard's accumulator comes back to the driver (stacked on a
-    leading shard axis) and merges through ``stream_combine``, the same
-    cell-wise path the streaming executor uses."""
+def _dist_partial_program(bound: _Bound, smeta, mesh: Mesh, axis: str,
+                          donate: bool = False):
+    """Sharded partial-aggregate program for the combine split path AND
+    the sharded stream's per-batch dispatch: prefix steps then
+    :func:`..exec.compile._dense_accumulate` per shard under the
+    batch-invariant ``smeta`` layout, with NO collective — every shard's
+    accumulator comes back to the driver (stacked on a leading shard
+    axis) and merges through ``stream_combine``, the same cell-wise path
+    the streaming executor uses.  ``donate`` consumes the engine-owned
+    sharded input copies (exec/dist_stream.py only; the split path keeps
+    its pieces alive for the sibling half)."""
     from .compile import _dense_accumulate, _step_closures
     sig = bound.signature()
     step = bound.steps[-1]
-    key = ("dist/partial", sig[0][:-1], sig[1], sig[2], sig[3], sig[5],
-           sig[6], sig[7], step, smeta, mesh_cache_key(mesh))
+    key = ("dist/partial", donate, sig[0][:-1], sig[1], sig[2], sig[3],
+           sig[5], sig[6], sig[7], step, smeta, mesh_cache_key(mesh))
 
     def build():
         fns = _step_closures(sig[0][:-1], (), tuple(bound.join_metas),
@@ -476,7 +495,8 @@ def _dist_partial_program(bound: _Bound, smeta, mesh: Mesh, axis: str):
             in_specs=(PartitionSpec(axis), PartitionSpec(axis),
                       PartitionSpec()),
             out_specs=PartitionSpec(axis),
-            check_vma=False)(partial_program))
+            check_vma=False)(partial_program),
+            donate_argnums=(0,) if donate else ())
 
     return _lru_lookup(_DIST_COMPILED, key, build, "dist.compile_cache")[0]
 

@@ -1,0 +1,576 @@
+"""Sharded streaming executor: one in-flight window per shard,
+distributed stream-combine over ICI.
+
+The mesh counterpart of :mod:`.stream`, driven by the same
+``run_plan_stream`` entry point via ``mesh=`` (or ``run_plan_dist_stream``
+directly).  Each host batch is dealt row-wise over the mesh with a
+per-shard slot capacity snapped to the shared bucket schedule
+(:func:`.bucketing.shard_capacity`), so every batch size in one bucket
+shares one ``(shards * capacity)`` sharded program shape and every
+(bucket, mesh) pair compiles exactly one program in the shared
+``_DIST_COMPILED`` LRU.  Up to K batches sit dispatched but
+unmaterialized per shard (``SRT_DIST_STREAM_INFLIGHT``, defaulting to
+the single-chip ``SRT_STREAM_INFLIGHT``), and the sharded padded copies
+are engine-owned by construction (``shard_table`` always builds fresh
+buffers), so every dispatch donates them — same-bucket batches recycle
+HBM shard-wise.
+
+Two modes, matching the single-chip driver:
+
+* **per-batch** — yields one Table per input batch, bit-identical to the
+  single-chip ``run_plan_stream``: row-local plans collect each batch's
+  row-sharded result (the contiguous deal-out preserves row order),
+  group-by plans materialize the replicated per-batch merge.
+* **streaming combine** — per-shard dense partial accumulators
+  (``exec.dist._dist_partial_program``, stacked ``(shards, cells)`` and
+  row-sharded) fold across batches in the existing binomial tree with
+  zero per-batch ICI, then ONE psum/psum-gather merge collective
+  (``compile.stream_merge_cells`` under ``shard_map``) and ONE
+  materialize close the stream — ICI traffic is O(1) per stream instead
+  of O(batches).
+
+Live-row counts ride on device across batches (``DistTable.
+live_count_device``) and sync once at stream end; the per-dispatch
+``dist.live_count`` syncs the batch-at-a-time dist path pays are
+recorded as avoided (``utils.memory.record_avoided_sync``), so
+``host_syncs`` visibly drops in QueryMetrics.
+
+Every phase runs under ``oom_ladder(dist=True)`` with a drain hook that
+materializes the per-shard in-flight window first; the split rung reuses
+the mesh ladder's per-shard halving (``exec.dist._dist_split`` /
+``_shard_slice``), preserving output order and the combine carry, so
+faulted sharded streams stay bit-identical to fault-free runs.
+"""
+
+from __future__ import annotations
+
+import time as _time
+import warnings
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from functools import partial
+
+from ..parallel.mesh import (DistTable, collect, mesh_cache_key, record_ici,
+                             shard_map, shard_table)
+from ..table import Table
+from .bucketing import bucket_capacity, shard_capacity
+from .compile import (_Bound, _final_order, _lru_lookup, materialize,
+                      run_plan_eager, stream_combine, stream_finalize,
+                      stream_merge_cells)
+from .dist import (_DIST_COMPILED, _build_dist_program, _dist_partial_program,
+                   _dist_split, _execute_dist_resilient, _shard_slice)
+from .plan import GroupAggStep, JoinShuffledStep
+from .stream import _chain_batches, _combine_setup
+
+
+def _shard_batch(batch: Table, mesh) -> DistTable:
+    """Deal one host batch over the mesh at the shared bucket schedule's
+    per-shard capacity.  The returned DistTable's buffers are fresh
+    engine-owned copies — never the caller's — so they are always safe
+    to donate."""
+    P = int(mesh.devices.size)
+    return shard_table(batch, mesh,
+                       capacity=shard_capacity(batch.num_rows, P))
+
+
+def _check_fixed_width(bound: _Bound) -> None:
+    if bound.string_cols or bound.dictionaries:
+        raise TypeError(
+            "distributed plans operate on fixed-width columns only "
+            "(dictionary-encode strings before sharding, as shard_table "
+            "requires)")
+
+
+def _dispatch_donating(fn, bound, row_mask):
+    """Invoke a donating sharded program; report whether the per-shard
+    input buffers were actually reclaimed (see stream._dispatch_donated
+    — aggregation-terminated programs emit cells-shaped outputs, so
+    their inputs survive and the backend warns; keep the stream quiet
+    and let the ``is_deleted`` probe tell the truth)."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message=".*[Dd]onat.*", category=UserWarning)
+        out = fn(bound.exec_cols, row_mask, bound.side_inputs)
+    consumed = any(c.is_deleted() for c in bound.exec_cols.values())
+    return out, consumed
+
+
+def _account_donation(acct, reclaimed: bool, lane: str, bi: int) -> None:
+    from ..obs.metrics import counter
+    from ..obs.timeline import instant as _tinstant
+    if reclaimed:
+        acct.donation_hits += 1
+        counter("stream.donation.hit").inc()
+        _tinstant("stream.donation.hit", cat="stream", lane=lane, batch=bi)
+    else:
+        acct.donation_misses += 1
+        counter("stream.donation.miss").inc()
+        _tinstant("stream.donation.miss", cat="stream", lane=lane, batch=bi)
+
+
+def _finish_live_count(acct, live_dev) -> None:
+    """The stream's ONE live-count sync: fold the device-carried per-batch
+    counts the batch-at-a-time dist path would have synced eagerly."""
+    if live_dev is None:
+        return
+    from ..utils.memory import record_host_sync
+    t0 = _time.perf_counter()
+    acct.live_rows = int(live_dev)
+    record_host_sync("dist.stream.live_count", 8,
+                     seconds=_time.perf_counter() - t0)
+
+
+def _drive_batches_dist(plan, source, k: int, acct, mesh):
+    """Per-batch sharded pipeline: shard → donating sharded dispatch →
+    deferred materialize/collect, with up to ``k`` batches in flight per
+    shard.  Yields one Table per batch, bit-identical to the single-chip
+    per-batch driver (contiguous deal-out + collect preserve row order
+    for row-local plans; group-by plans materialize the replicated
+    merge).  Recovery drains the in-flight window, then evicts and
+    retries; a still-OOMing batch takes the mesh ladder's per-shard
+    split rung and rides the deque as a ready result — output order is
+    preserved."""
+    from ..config import metrics_enabled
+    from ..obs.metrics import gauge
+    from ..obs.timeline import span as _tspan
+    from ..resilience import dist_guard, fault_point
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+    from ..utils.memory import _tree_nbytes, record_avoided_sync
+
+    axis = mesh.axis_names[0]
+    P = int(mesh.devices.size)
+    acct.shards = P
+    meter = metrics_enabled()
+    replicated_out = any(isinstance(s, GroupAggStep) for s in plan.steps)
+    shuffled = any(isinstance(s, JoinShuffledStep) for s in plan.steps)
+    # ("exec", bound, out_cols, sel, bi) | ("res", result, bi) |
+    # ("ready", table, bi) — "res" holds a resilient-core result (split
+    # rung or shuffled-join batch) whose collect is deferred like any
+    # other in-flight entry.
+    pending: deque = deque()
+    inflight_gauge = gauge("stream.inflight_depth")
+    live_dev = None
+
+    def finish_entry(entry):
+        if entry[0] == "ready":
+            return entry[1]
+        if entry[0] == "res":
+            result = entry[1]
+            if isinstance(result, DistTable):
+                return oom_ladder("materialize",
+                                  lambda: collect(result), dist=True)
+            return result
+        _, bound, out_cols, sel, bi = entry
+        with _tspan("stream.materialize", cat="stream",
+                    lane=f"batch-{bi}", batch=bi, shards=P):
+            if replicated_out:
+                return oom_ladder(
+                    "materialize",
+                    lambda: materialize(bound, out_cols, sel), dist=True)
+            order = [nm for nm in _final_order(plan.steps,
+                                               bound.input_names)
+                     if nm in out_cols]
+            order += [nm for nm in out_cols if nm not in order]
+            dtable = DistTable(
+                table=Table([(nm, out_cols[nm]) for nm in order]),
+                row_mask=sel.astype(jnp.bool_))
+            return oom_ladder("materialize",
+                              lambda: collect(dtable), dist=True)
+
+    def drain_inflight():
+        """Recovery hook: turn every pending dispatch into a ready host
+        Table in place, releasing its per-shard output buffers before
+        the ladder retries."""
+        for i, entry in enumerate(pending):
+            if entry[0] != "ready":
+                pending[i] = ("ready", finish_entry(entry), entry[-1])
+
+    def drain_oldest():
+        entry = pending.popleft()
+        if entry[0] == "ready":
+            return entry[1]
+        t0 = _time.perf_counter()
+        out = finish_entry(entry)
+        acct.mat_s += _time.perf_counter() - t0
+        return out
+
+    for bi, batch in enumerate(source):
+        lane = f"batch-{bi}"
+        if batch.num_rows == 0:
+            pending.append(("ready", run_plan_eager(plan, batch), bi))
+        elif shuffled:
+            # Shuffled-join plans route per batch through the resilient
+            # dist core (the all_to_all repartition is the work); the
+            # known batch size skips its per-dispatch live-count sync.
+            t0 = _time.perf_counter()
+            with _tspan("stream.dispatch", cat="stream", lane=lane,
+                        batch=bi, shards=P):
+                dist_b = _shard_batch(batch, mesh)
+                live = dist_b.live_count_device()
+                live_dev = live if live_dev is None else live_dev + live
+                result = _execute_dist_resilient(
+                    plan, dist_b, mesh, live_rows=batch.num_rows)
+            acct.syncs_avoided += 1
+            acct.dispatch_s += _time.perf_counter() - t0
+            pending.append(("res", result, bi))
+        else:
+            t0 = _time.perf_counter()
+            with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
+                        rows=batch.num_rows, shards=P):
+                dist_b = _shard_batch(batch, mesh)
+                record_avoided_sync("dist.live_count")
+                acct.syncs_avoided += 1
+                live = dist_b.live_count_device()
+                live_dev = live if live_dev is None else live_dev + live
+                state = [dist_b, None]      # [DistTable, _Bound]
+
+                def do_bind():
+                    fault_point("bind")
+                    bound = _Bound(plan, state[0].table,
+                                   probe_mask=state[0].row_mask)
+                    _check_fixed_width(bound)
+                    return bound
+                state[1] = oom_ladder("bind", do_bind,
+                                      drain=drain_inflight, dist=True)
+            acct.bind_s += _time.perf_counter() - t0
+
+            key = (("dist/stream", replicated_out)
+                   + state[1].signature() + (mesh_cache_key(mesh),))
+
+            def do_dispatch():
+                # A prior attempt may have donated (and lost) this
+                # batch's sharded copies — re-shard from the user's
+                # batch, which is never donated.
+                if any(c.is_deleted()
+                       for c in state[1].exec_cols.values()):
+                    state[0] = _shard_batch(batch, mesh)
+                    state[1] = _Bound(plan, state[0].table,
+                                      probe_mask=state[0].row_mask)
+                # Looked up INSIDE the ladder closure: an evict rung
+                # clears the LRU, so a retry rebuilds.
+                fn, _ = _lru_lookup(
+                    _DIST_COMPILED, key,
+                    lambda: _build_dist_program(
+                        state[1], mesh, axis, P, replicated_out,
+                        donate=True),
+                    "dist.compile_cache", shards=P)
+
+                def invoke():
+                    for s in range(P):
+                        fault_point("dist-dispatch", shard=s)
+                    if replicated_out:
+                        for s in range(P):
+                            fault_point("collective", shard=s)
+                    return _dispatch_donating(fn, state[1],
+                                              state[0].row_mask)
+                return dist_guard("dist.dispatch", invoke)
+
+            t0 = _time.perf_counter()
+            try:
+                with _tspan("stream.dispatch", cat="stream", lane=lane,
+                            batch=bi, shards=P):
+                    (out_cols, sel), reclaimed = oom_ladder(
+                        "dist-dispatch", do_dispatch,
+                        drain=drain_inflight, dist=True)
+            except ExecutionRecoveryError as err:
+                if err.category != "oom":
+                    raise
+                try:    # last rung: per-shard split, ride as a result
+                    with _tspan("stream.split", cat="stream", lane=lane,
+                                batch=bi, shards=P):
+                        pending.append(
+                            ("res", _dist_split(plan, state[0], mesh, 0),
+                             bi))
+                except SplitUnavailable as unavailable:
+                    err.add_step(f"split-unavailable: {unavailable}")
+                    # Graceful degradation, mirroring the core dist
+                    # ladder: finish this batch single-chip when
+                    # SRT_DIST_FALLBACK=collect opts in.
+                    from ..config import dist_fallback
+                    if dist_fallback() is None:
+                        err.add_step("collect-fallback: disabled "
+                                     "(SRT_DIST_FALLBACK unset)")
+                        raise err
+                    from ..resilience import recovery_stats
+                    from .compile import run_plan
+                    recovery_stats().add_dist_fallback()
+                    err.add_step("collect-fallback")
+                    pending.append(("ready", run_plan(plan, batch), bi))
+                acct.dispatch_s += _time.perf_counter() - t0
+            else:
+                _account_donation(acct, reclaimed, lane, bi)
+                if replicated_out:
+                    acct.merge_collectives += 1
+                    if meter:
+                        ici_bytes = 2 * (P - 1) * _tree_nbytes(out_cols)
+                        record_ici(ici_bytes)
+                        acct.ici_bytes += ici_bytes
+                acct.dispatch_s += _time.perf_counter() - t0
+                pending.append(("exec", state[1], out_cols, sel, bi))
+        while len(pending) > k:
+            yield drain_oldest()
+        depth = sum(1 for e in pending if e[0] != "ready")
+        if depth > acct.peak_inflight:
+            acct.peak_inflight = depth
+            inflight_gauge.set(depth)
+    while pending:
+        yield drain_oldest()
+    _finish_live_count(acct, live_dev)
+
+
+def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
+    """Sharded streaming combine: per batch, a donating sharded
+    partial-aggregate program folds the shard-local rows into stacked
+    ``(shards, cells)`` accumulators (NO collective); batches merge in
+    the binomial tree shard-locally; at stream end ONE psum/psum-gather
+    merge collective replicates the totals and ONE materialize closes
+    the stream.  Falls back to the per-batch sharded driver when the
+    first bind shows the layout cannot be batch-invariant — unless
+    ``strict``."""
+    from ..config import metrics_enabled
+    from ..obs import timeline as _tl
+    from ..obs.metrics import gauge
+    from ..obs.timeline import span as _tspan
+    from ..resilience import dist_guard, fault_point, recovery_stats
+    from ..resilience.classify import ExecutionRecoveryError
+    from ..resilience.recovery import SplitUnavailable, oom_ladder
+    from ..utils.memory import _tree_nbytes, record_avoided_sync
+
+    axis = mesh.axis_names[0]
+    P = int(mesh.devices.size)
+    acct.shards = P
+    meter = metrics_enabled()
+    levels: list = []           # levels[i]: acc of 2^i batches, or None
+    bound0 = smeta = dtypes = None
+    last_empty = None
+    consumed: list = []         # batches seen before viability is decided
+    since_block = 0
+    live_dev = None
+    inflight_gauge = gauge("stream.inflight_depth")
+
+    def drain_levels():
+        """Recovery hook: force the whole per-shard accumulator tree to
+        finish so its transient dispatch scratch frees before a retry.
+        Skips buffers the donating cell-merge already consumed."""
+        live = [a for lv in levels if lv is not None
+                for a in lv.values() if not a.is_deleted()]
+        if live:
+            jax.block_until_ready(live)
+
+    def split_partial(dist_b):
+        """Last recovery rung for a combine-mode batch: halve the
+        per-shard slot count (snapped to the bucket schedule),
+        partial-aggregate each half without donation, and merge into the
+        ONE stacked accumulator the batch would have produced — the
+        binomial-tree carry downstream is identical to a no-fault run."""
+        C = dist_b.capacity_total // P
+        if C < 2:
+            raise SplitUnavailable(
+                f"per-shard capacity of {C} slot(s) cannot split")
+        cut = min(bucket_capacity((C + 1) // 2, floor=8), C - 1)
+        stats = recovery_stats()
+        stats.add_split()
+        stats.add_dist_split()
+        accs = []
+        for lo, hi in ((0, cut), (cut, C)):
+            piece = _shard_slice(dist_b, P, C, lo, hi)
+            b = oom_ladder(
+                "bind",
+                lambda p=piece: _Bound(plan, p.table,
+                                       probe_mask=p.row_mask),
+                drain=drain_levels, dist=True)
+
+            def do_piece(b=b, rm=piece.row_mask):
+                fn = _dist_partial_program(b, smeta, mesh, axis)
+                return fn(b.exec_cols, rm, b.side_inputs)
+
+            accs.append(oom_ladder("dist-dispatch", do_piece,
+                                   drain=drain_levels, dist=True))
+        return stream_combine()(accs[0], accs[1])
+
+    for bi, batch in enumerate(source):
+        lane = f"batch-{bi}"
+        if smeta is None:
+            consumed.append(batch)
+        if batch.num_rows == 0:
+            last_empty = batch          # contributes no groups
+            continue
+        t0 = _time.perf_counter()
+        with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
+                    rows=batch.num_rows, shards=P):
+            dist_b = _shard_batch(batch, mesh)
+            state = [dist_b, None]
+
+            def do_bind():
+                fault_point("bind")
+                bound = _Bound(plan, state[0].table,
+                               probe_mask=state[0].row_mask)
+                _check_fixed_width(bound)
+                return bound
+            state[1] = oom_ladder("bind", do_bind, drain=drain_levels,
+                                  dist=True)
+        acct.bind_s += _time.perf_counter() - t0
+        if smeta is None:
+            try:
+                smeta, dtypes = _combine_setup(state[1])
+            except TypeError:
+                if strict:
+                    raise
+                # The layout is not batch-invariant: replay everything
+                # consumed so far (leading empties included, in order)
+                # through the per-batch sharded driver instead.
+                yield from _drive_batches_dist(
+                    plan, _chain_batches(consumed, source), k, acct, mesh)
+                return
+            bound0 = state[1]
+            consumed.clear()
+        # Accounted only once viability is settled, so a combine->
+        # per-batch fallback never double-counts the replayed batch.
+        record_avoided_sync("dist.live_count")
+        acct.syncs_avoided += 1
+        live = state[0].live_count_device()
+        live_dev = live if live_dev is None else live_dev + live
+
+        def do_partial():
+            # A prior attempt may have donated (and lost) this batch's
+            # sharded copies — re-shard from the user's batch.
+            if any(c.is_deleted() for c in state[1].exec_cols.values()):
+                state[0] = _shard_batch(batch, mesh)
+                state[1] = _Bound(plan, state[0].table,
+                                  probe_mask=state[0].row_mask)
+            fn = _dist_partial_program(state[1], smeta, mesh, axis,
+                                       donate=True)
+
+            def invoke():
+                for s in range(P):
+                    fault_point("dist-dispatch", shard=s)
+                return _dispatch_donating(fn, state[1],
+                                          state[0].row_mask)
+            return dist_guard("dist.dispatch", invoke)
+
+        t0 = _time.perf_counter()
+        try:
+            with _tspan("stream.partial", cat="stream", lane=lane,
+                        batch=bi, shards=P):
+                acc, reclaimed = oom_ladder(
+                    "dist-dispatch", do_partial, drain=drain_levels,
+                    dist=True)
+        except ExecutionRecoveryError as err:
+            if err.category != "oom":
+                raise
+            try:
+                with _tspan("stream.split", cat="stream", lane=lane,
+                            batch=bi, shards=P):
+                    acc = split_partial(state[0])
+            except SplitUnavailable as unavailable:
+                err.add_step(f"split-unavailable: {unavailable}")
+                raise err
+            reclaimed = False
+        _account_donation(acct, reclaimed, lane, bi)
+        merge = stream_combine()
+        i = 0
+        while i < len(levels) and levels[i] is not None:
+            lv, acc_in = levels[i], acc
+            with _tspan("stream.combine", cat="stream", lane="combine",
+                        level=i, batch=bi):
+                acc = oom_ladder(
+                    "stream-combine",
+                    lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
+                                             merge(lv, a))[1],
+                    drain=drain_levels, dist=True)
+            levels[i] = None
+            i += 1
+        if i == len(levels):
+            levels.append(acc)
+        else:
+            levels[i] = acc
+        acct.dispatch_s += _time.perf_counter() - t0
+        since_block += 1
+        if since_block > acct.peak_inflight:
+            acct.peak_inflight = since_block
+            inflight_gauge.set(since_block)
+        if since_block >= k:
+            with _tspan("stream.backpressure", cat="stream",
+                        lane="combine", level=i):
+                jax.block_until_ready(levels[i])
+            since_block = 0
+
+    if smeta is None:
+        if last_empty is not None:      # schema known, zero groups
+            yield run_plan_eager(plan, last_empty)
+        return
+    total = None
+    merge = stream_combine()
+    for li, lv in enumerate(levels):
+        if lv is None:
+            continue
+        levels[li] = None   # consumed below (merge donates its first arg)
+        if total is None:
+            total = lv
+            continue
+        t, l = total, lv
+        with _tspan("stream.combine", cat="stream", lane="combine"):
+            total = oom_ladder(
+                "stream-combine",
+                lambda t=t, l=l: (fault_point("stream-combine"),
+                                  merge(t, l))[1],
+                drain=drain_levels, dist=True)
+
+    # The stream's ONE merge collective: replicate the per-shard totals.
+    shapes = tuple(sorted((name, tuple(v.shape), str(v.dtype))
+                          for name, v in total.items()))
+    mkey = ("dist/stream-merge", shapes, mesh_cache_key(mesh))
+    total_holder = [total]
+
+    def do_merge():
+        fn, _ = _lru_lookup(
+            _DIST_COMPILED, mkey,
+            lambda: jax.jit(partial(
+                shard_map, mesh=mesh, in_specs=(PartitionSpec(axis),),
+                out_specs=PartitionSpec(), check_vma=False,
+            )(lambda acc: stream_merge_cells(acc, axis, P))),
+            "dist.compile_cache", shards=P)
+
+        def invoke():
+            for s in range(P):
+                fault_point("collective", shard=s)
+            return jax.block_until_ready(fn(total_holder[0]))
+        return dist_guard("dist.merge", invoke)
+
+    t0 = _time.perf_counter()
+    tl_on = _tl.enabled()
+    t_us = _tl.now_us() if tl_on else 0.0
+    with _tspan("stream.merge_collective", cat="stream", lane="combine",
+                shards=P):
+        merged = oom_ladder("collective", do_merge, drain=drain_levels,
+                            dist=True)
+    dur_s = _time.perf_counter() - t0
+    acct.dispatch_s += dur_s
+    acct.merge_collectives += 1
+    ici_bytes = 2 * (P - 1) * _tree_nbytes(merged)
+    acct.ici_bytes += ici_bytes
+    if meter:
+        record_ici(ici_bytes, seconds=dur_s)
+    if tl_on:
+        # SPMD: every shard runs the merge over the same interval — one
+        # ici.psum event per shard lane, the stream's whole ICI story.
+        dur = _tl.now_us() - t_us
+        for s in range(P):
+            _tl.add_complete("ici.psum", "ici", t_us, dur,
+                             lane=f"shard-{s}", shard=s,
+                             collective="psum")
+
+    t0 = _time.perf_counter()
+    with _tspan("stream.finalize", cat="stream", lane="combine"):
+        out = oom_ladder(
+            "materialize",
+            lambda: stream_finalize(bound0, smeta, merged, dtypes),
+            dist=True)
+    acct.mat_s += _time.perf_counter() - t0
+    _finish_live_count(acct, live_dev)
+    yield out

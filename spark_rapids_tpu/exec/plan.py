@@ -467,18 +467,32 @@ class Plan:
         return explain_analyze_plan(self, table, timeline=timeline)
 
     def run_stream(self, batches, inflight=None, combine="auto",
-                   prefetch=False, trace_timeline=None):
+                   prefetch=False, trace_timeline=None, mesh=None):
         """Execute over a batch iterator with up to ``inflight`` batches
         dispatched but unmaterialized (async pipelining + buffer
         donation; see :mod:`.stream`).  Yields one Table per batch, or a
         single aggregated Table in streaming combine mode.
         ``trace_timeline`` records the stream on the span timeline
         (``True`` = record only, path string = export Chrome-trace JSON
-        when the stream finishes)."""
+        when the stream finishes).  ``mesh`` drives the stream sharded
+        over the device mesh (see :mod:`.dist_stream`)."""
         from .stream import run_plan_stream
         return run_plan_stream(self, batches, inflight=inflight,
                                combine=combine, prefetch=prefetch,
-                               trace_timeline=trace_timeline)
+                               trace_timeline=trace_timeline, mesh=mesh)
+
+    def run_dist_stream(self, batches, mesh, inflight=None,
+                        combine="auto", prefetch=False,
+                        trace_timeline=None):
+        """Sharded streaming execution: each batch dealt over ``mesh``
+        with per-shard in-flight windows, donation on the engine-owned
+        shard copies, and — for group-by plans — ONE end-of-stream merge
+        collective (see :mod:`.dist_stream`)."""
+        from .stream import run_plan_dist_stream
+        return run_plan_dist_stream(self, batches, mesh,
+                                    inflight=inflight, combine=combine,
+                                    prefetch=prefetch,
+                                    trace_timeline=trace_timeline)
 
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`

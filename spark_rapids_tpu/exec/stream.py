@@ -83,7 +83,9 @@ class _Account:
     feed's worker thread (single writer) and is read once at the end."""
     __slots__ = ("batches", "rows", "columns", "out_rows", "source_s",
                  "bind_s", "dispatch_s", "mat_s", "idle_s",
-                 "donation_hits", "donation_misses", "peak_inflight")
+                 "donation_hits", "donation_misses", "peak_inflight",
+                 "shards", "merge_collectives", "ici_bytes",
+                 "syncs_avoided", "live_rows")
 
     def __init__(self):
         self.batches = self.rows = self.columns = self.out_rows = 0
@@ -91,6 +93,9 @@ class _Account:
         self.mat_s = self.idle_s = 0.0
         self.donation_hits = self.donation_misses = 0
         self.peak_inflight = 0
+        # sharded-stream extras (exec/dist_stream.py); zero single-chip
+        self.shards = self.merge_collectives = self.ici_bytes = 0
+        self.syncs_avoided = self.live_rows = 0
 
 
 def _counted_source(source: Iterator, acct: _Account, batch_counter
@@ -200,15 +205,18 @@ def _combine_setup(bound):
 def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                     combine: Union[str, bool] = "auto",
                     prefetch: Union[bool, int] = False,
-                    trace_timeline: Union[None, bool, str] = None) -> Iterator:
+                    trace_timeline: Union[None, bool, str] = None,
+                    mesh=None) -> Iterator:
     """Drive ``plan`` over ``batches`` with up to ``inflight`` batches
     dispatched but unmaterialized.  Yields one Table per batch (bit-equal
     to ``run_plan`` on that batch), or — in streaming combine mode — ONE
     Table aggregating the whole stream.
 
     ``inflight``   max dispatched-but-unmaterialized batches (default
-                   ``SRT_STREAM_INFLIGHT``); each in-flight batch pins a
-                   bucket's worth of output buffers in device memory.
+                   ``SRT_STREAM_INFLIGHT``; with ``mesh``,
+                   ``SRT_DIST_STREAM_INFLIGHT``); each in-flight batch
+                   pins a bucket's worth of output buffers in device
+                   memory — on every shard at once when sharded.
     ``combine``    ``"auto"`` (combine when the plan allows, else
                    per-batch), ``True`` (combine or raise TypeError),
                    ``False`` (always per-batch).
@@ -222,14 +230,31 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                    exports the stream's slice as Chrome-trace JSON —
                    with per-batch lanes, so in-flight overlap is visible
                    in Perfetto — when the stream finishes.
+    ``mesh``       drive the stream SHARDED: each batch is dealt over the
+                   mesh (exec/dist_stream.py), per-shard bucket programs
+                   compile once per (bucket, mesh), donation recycles the
+                   engine-owned shard copies, and group-by streams merge
+                   with ONE end-of-stream collective — ICI traffic is
+                   O(1) per stream instead of O(batches).  Output stays
+                   bit-identical to the single-chip stream for exact
+                   (integer) aggregations.
 
     Stream metrics (batch count, donation hits, peak in-flight depth,
     overlap ratio) land in ``obs.last_stream_metrics()`` after the
     final yield; registry counters additionally fire under SRT_METRICS.
     """
+    if mesh is not None and not (hasattr(mesh, "axis_names")
+                                 and hasattr(mesh, "devices")):
+        raise ValueError(
+            f"mesh must be a jax Mesh (parallel.make_flat_mesh), got "
+            f"{mesh!r}")
     if inflight is None:
-        from ..config import stream_inflight
-        inflight = stream_inflight()
+        if mesh is not None:
+            from ..config import dist_stream_inflight
+            inflight = dist_stream_inflight()
+        else:
+            from ..config import stream_inflight
+            inflight = stream_inflight()
     if not isinstance(inflight, int) or inflight < 1:
         raise ValueError(f"inflight must be an int >= 1, got {inflight!r}")
     if combine not in ("auto", True, False):
@@ -248,11 +273,30 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
         if obstacles:
             raise TypeError("plan cannot stream-combine: "
                             + "; ".join(obstacles))
-    gen = _stream(plan, batches, inflight, combine, prefetch)
+    gen = _stream(plan, batches, inflight, combine, prefetch, mesh)
     if trace_timeline:
         return _recorded_stream(gen, trace_timeline
                                 if isinstance(trace_timeline, str) else None)
     return gen
+
+
+def run_plan_dist_stream(plan, batches: Iterable, mesh,
+                         inflight: Optional[int] = None,
+                         combine: Union[str, bool] = "auto",
+                         prefetch: Union[bool, int] = False,
+                         trace_timeline: Union[None, bool, str] = None
+                         ) -> Iterator:
+    """Sharded streaming executor: :func:`run_plan_stream` with a
+    required ``mesh``.  See the ``mesh=`` parameter there; this spelling
+    exists so call sites that are distributed by construction fail fast
+    when the mesh is missing."""
+    if mesh is None:
+        raise ValueError("run_plan_dist_stream requires a mesh "
+                         "(parallel.make_flat_mesh); for single-chip "
+                         "streaming call run_plan_stream")
+    return run_plan_stream(plan, batches, inflight=inflight,
+                           combine=combine, prefetch=prefetch,
+                           trace_timeline=trace_timeline, mesh=mesh)
 
 
 def _recorded_stream(gen, path):
@@ -264,7 +308,7 @@ def _recorded_stream(gen, path):
         yield from gen
 
 
-def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
+def _stream(plan, batches, k: int, combine, prefetch, mesh=None) -> Iterator:
     from ..config import metrics_enabled
     from ..obs.metrics import counter, counters_delta, gauge, registry
     from ..resilience import recovery_stats
@@ -281,7 +325,16 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
                                        and not combine_obstacles(plan))
     before = registry().counters_snapshot() if metrics_enabled() else None
     t_all = _time.perf_counter()
-    if want_combine:
+    if mesh is not None:
+        # Sharded drivers live in dist_stream.py (imports jax at top);
+        # loaded here at first call per the lazy-import rule.
+        from .dist_stream import _drive_batches_dist, _drive_combine_dist
+        if want_combine:
+            driver = _drive_combine_dist(plan, source, k, acct, mesh,
+                                         strict=combine is True)
+        else:
+            driver = _drive_batches_dist(plan, source, k, acct, mesh)
+    elif want_combine:
         driver = _drive_combine(plan, source, k, acct,
                                 strict=combine is True)
     else:
@@ -308,7 +361,8 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
 
     from ..obs.query import (QueryMetrics, next_query_id,
                              set_last_stream_metrics)
-    qm = QueryMetrics(query_id=next_query_id(), mode="stream",
+    qm = QueryMetrics(query_id=next_query_id(),
+                      mode="dist_stream" if mesh is not None else "stream",
                       input_rows=acct.rows, input_columns=acct.columns)
     qm.output_rows = acct.out_rows
     qm.bind_seconds = acct.bind_s
@@ -323,6 +377,10 @@ def _stream(plan, batches, k: int, combine, prefetch) -> Iterator:
     qm.stream_source_seconds = acct.source_s
     qm.stream_serial_seconds = serial
     qm.stream_overlap_ratio = overlap
+    qm.stream_shards = acct.shards
+    qm.stream_merge_collectives = acct.merge_collectives
+    qm.stream_ici_bytes = acct.ici_bytes
+    qm.stream_syncs_avoided = acct.syncs_avoided
     if before is not None:
         # End-of-stream HBM occupancy for the cost ledger; per-batch
         # program analysis stays unavailable here by design (the stream

@@ -111,6 +111,11 @@ class QueryMetrics:
     #: overlap ratio is (serial - wall) / serial, > 0 when pipelining won.
     stream_serial_seconds: float = 0.0
     stream_overlap_ratio: float = 0.0
+    # -- sharded streaming (exec/dist_stream.py; zero when single-chip) --
+    stream_shards: int = 0              # mesh devices driving the stream
+    stream_merge_collectives: int = 0   # ICI merges paid (combine: ONE)
+    stream_ici_bytes: int = 0           # estimated collective traffic
+    stream_syncs_avoided: int = 0       # per-batch live-count syncs saved
     # -- execution resilience (resilience/; zero on a fault-free run) ----
     recovery_retries: int = 0           # evict-and-retry rounds taken
     recovery_splits: int = 0            # batch halvings (the last rung)
@@ -161,7 +166,9 @@ class QueryMetrics:
             # v3: added the always-present "recovery" block.
             # v4: added "recovery.dist" (the mesh-ladder share).
             # v5: added the always-present "cost" ledger block.
-            "schema_version": 5,
+            # v6: "stream" gained the sharded-stream fields (shards,
+            #     merge_collectives, ici_bytes, syncs_avoided).
+            "schema_version": 6,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "mode": self.mode,
@@ -193,6 +200,13 @@ class QueryMetrics:
                 "source_seconds": round(self.stream_source_seconds, 6),
                 "serial_seconds": round(self.stream_serial_seconds, 6),
                 "overlap_ratio": round(self.stream_overlap_ratio, 6),
+                # Sharded-stream share (zero when single-chip): one
+                # merge collective per group-by stream is the design
+                # invariant the bench line watches.
+                "shards": self.stream_shards,
+                "merge_collectives": self.stream_merge_collectives,
+                "ici_bytes": self.stream_ici_bytes,
+                "syncs_avoided": self.stream_syncs_avoided,
             },
             # Always present (zeroed on a fault-free run) for the same
             # one-key-set-across-modes reason as "stream".
@@ -389,6 +403,34 @@ def _stream_payload() -> dict:
     }
 
 
+def _dist_stream_payload() -> dict:
+    """Payload for ``bench_line("dist_stream")``: the sharded-stream view
+    of the last streaming run — shard count, the one-merge-collective
+    invariant, estimated ICI bytes, donation reuse, and the host syncs
+    the device-carried live counts avoided versus per-batch
+    ``run_plan_dist`` dispatch.  ``{"runs": 0}`` until a sharded stream
+    (``run_plan_stream(mesh=...)``) completes."""
+    qm = last_stream_metrics()
+    if qm is None or qm.stream_shards == 0:
+        return {"metric": "dist_stream", "runs": 0}
+    return {
+        "metric": "dist_stream",
+        "runs": 1,
+        "batches": qm.stream_batches,
+        "shards": qm.stream_shards,
+        "input_rows": qm.input_rows,
+        "output_rows": qm.output_rows,
+        "overlap_ratio": round(qm.stream_overlap_ratio, 6),
+        "donation_hits": qm.stream_donation_hits,
+        "donation_misses": qm.stream_donation_misses,
+        "merge_collectives": qm.stream_merge_collectives,
+        "ici_bytes": qm.stream_ici_bytes,
+        "host_syncs": qm.host_syncs,
+        "syncs_avoided": qm.stream_syncs_avoided,
+        "wall_seconds": round(qm.total_seconds, 6),
+    }
+
+
 def _recovery_payload() -> dict:
     """Payload for ``bench_line("recovery")``: the process-lifetime
     recovery totals — retries taken, batch splits, cache evictions,
@@ -426,6 +468,7 @@ _BENCH_PAYLOADS = {
     "metrics": _metrics_payload,
     "cache": _cache_payload,
     "stream": _stream_payload,
+    "dist_stream": _dist_stream_payload,
     "recovery": _recovery_payload,
     "regress": _regress_payload,
 }
@@ -436,6 +479,7 @@ def bench_line(kind: str) -> str:
 
     Kinds: ``"metrics"`` (last QueryMetrics or registry snapshot),
     ``"cache"`` (compile cache + bucketing), ``"stream"`` (last streaming
+    run), ``"dist_stream"`` (sharded-stream view of the last streaming
     run), ``"recovery"`` (process-lifetime resilience totals),
     ``"regress"`` (perf-regression report vs the metrics history).  The
     four legacy ``bench_*_line`` names are thin wrappers over this and

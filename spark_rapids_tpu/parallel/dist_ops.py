@@ -229,8 +229,8 @@ def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
         for s in range(P):
             fault_point("collective", shard=s)
         import time as _time
-        from ..obs.metrics import counter
         from ..utils.memory import record_host_sync
+        from .mesh import record_ici
         t0 = _time.perf_counter()
         out, needed = _local_join(lsh, rsh, mesh, list(on), how, cap)
         needed = int(needed)         # blocks on the whole joined exchange
@@ -238,10 +238,9 @@ def dist_join(left: DistTable, right: DistTable, mesh: Mesh,
         record_host_sync("dist.join.needed", 8, seconds=dur_s)
         # The capacity pmax is this op's own collective (the shuffles
         # above account their all_to_alls separately): a P-scalar
-        # all-reduce, so bytes are ~8*P and the floor keeps it visible.
-        counter("ici.us").inc(1)
-        counter("ici.bytes").inc(8 * P)
-        counter("ici.collectives").inc(1)
+        # all-reduce, so bytes are ~8*P and record_ici's floor keeps it
+        # visible in ``ici.us``.
+        record_ici(8 * P)
         return out, needed
 
     out, max_needed = dist_guard(

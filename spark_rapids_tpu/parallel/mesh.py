@@ -48,6 +48,21 @@ def mesh_cache_key(mesh: Mesh) -> tuple:
     return (mesh.axis_names[0],
             tuple(int(d.id) for d in mesh.devices.flat))
 
+
+def record_ici(nbytes: int, seconds: float = 0.0,
+               collectives: int = 1) -> None:
+    """Shared ICI-counter accounting for one mesh collective: the
+    ``ici.us`` / ``ici.bytes`` / ``ici.collectives`` triple every
+    distributed layer (shuffle all_to_all, dist_ops pmax, the sharded
+    stream merge) increments identically.  ``seconds`` is the measured
+    wall the caller attributes to the exchange; the 1-microsecond floor
+    keeps a ran-collective visible in the cost ledger even when the
+    caller could not isolate its wall."""
+    from ..obs.metrics import counter
+    counter("ici.us").inc(max(1, int(seconds * 1e6)))
+    counter("ici.bytes").inc(int(nbytes))
+    counter("ici.collectives").inc(int(collectives))
+
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; accept
 # both so the distributed layer runs on every jax the engine supports.
 try:
@@ -111,6 +126,12 @@ class DistTable:
         record_host_sync("dist.live_count", 8,
                          seconds=_time.perf_counter() - t0)
         return count
+
+    def live_count_device(self) -> jax.Array:
+        """Live row count as a device scalar — NO host sync.  The sharded
+        streaming executor sums these across batches on device and pays
+        one blocking read at stream end instead of one per dispatch."""
+        return jnp.sum(self.row_mask, dtype=jnp.int32)
 
 
 def shard_table(table: Table, mesh: Mesh,

@@ -120,10 +120,9 @@ def shuffle(dist: DistTable, mesh: Mesh, keys: Sequence[str],
         if meter:
             # The overflow check blocked on the all_to_all, so the wall
             # here covers the exchange — the shuffle's whole ICI story.
-            counter("ici.us").inc(
-                max(1, int((_time.perf_counter() - t_wall) * 1e6)))
-            counter("ici.bytes").inc(data_bytes + mask_bytes)
-            counter("ici.collectives").inc(1)
+            from .mesh import record_ici
+            record_ici(data_bytes + mask_bytes,
+                       seconds=_time.perf_counter() - t_wall)
         if tl_on:
             # The overflow check above already blocked on the shuffled
             # slabs, so the interval covers the collective's device wall;

@@ -165,6 +165,25 @@ def record_host_sync(label: str = "", nbytes: int = 0,
             nbytes=int(nbytes))
 
 
+def record_avoided_sync(label: str = "", count: int = 1) -> None:
+    """Account host syncs the engine designed AWAY — the other half of
+    :func:`record_host_sync`'s ledger.
+
+    Call at the point a blocking round trip WOULD have happened on the
+    unoptimized path (e.g. the sharded streaming executor carrying
+    live-row counts on device across batches instead of paying the
+    per-dispatch ``dist.live_count`` sync).  The counters make the win
+    visible in QueryMetrics: ``host.sync.avoided`` rising while
+    ``host.sync`` stays flat is the receipt.  No-op (one env read)
+    unless ``SRT_METRICS=1``.
+    """
+    from ..obs.metrics import counter
+    c = counter("host.sync.avoided")
+    c.inc(int(count))
+    if c.name and label:                 # real registry, not the null object
+        counter(f"host.sync.avoided.{label}").inc(int(count))
+
+
 def _tree_nbytes(tree: Any) -> int:
     import jax
     total = 0

@@ -1,0 +1,416 @@
+"""Sharded streaming executor contracts (exec/dist_stream.py, driven on
+the 8-virtual-device CPU mesh from conftest).
+
+Oracle: a sharded stream must yield EXACTLY what the single-chip
+``run_plan_stream`` yields over the same batches — per batch in
+per-batch mode, as one table in combine mode — including with faults
+injected at every dist site.  All aggregates here are integer-exact (or
+derived from exact integer sums at finalize), so bit-identity holds
+regardless of the psum merge order.
+
+Design invariants under test beyond identity:
+
+* one compiled program per (bucket, mesh) across the whole stream
+  (``dist.compile_cache.miss`` == bucket count);
+* ONE merge collective per group-by stream (``ici.collectives`` == 1);
+* per-batch live-count host syncs are designed away (``host.sync.avoided``
+  == batch count, total syncs below the per-batch ``run_plan_dist`` loop);
+* overlap ratio > 0 on a feed with real decode latency.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import (col, plan, run_plan_dist_stream,
+                                   run_plan_stream)
+from spark_rapids_tpu.obs import last_stream_metrics, registry
+from spark_rapids_tpu.obs.query import bench_line
+from spark_rapids_tpu.parallel import make_flat_mesh, shard_table
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+
+#: 60/65/89 pad to a bucket; 64/88 sit exactly on per-shard capacity
+#: boundaries at P=8 (caps 8,8,16,16,16,8 -> TWO distinct buckets).
+SIZES = [60, 64, 65, 88, 89, 1]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_flat_mesh()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+@pytest.fixture
+def faults(monkeypatch):
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+
+
+def _mk(n, seed, hi=3):
+    """Nullable int key + bool key + nullable int values: every agg below
+    is exact, so sharded results must be bit-identical, not just close."""
+    r = np.random.default_rng(seed)
+    return Table([
+        ("k", Column.from_numpy(r.integers(0, hi, n).astype(np.int64),
+                                validity=r.random(n) > 0.15)),
+        ("b", Column.from_numpy(r.integers(0, 2, n).astype(np.bool_))),
+        ("v", Column.from_numpy(r.integers(-100, 100, n).astype(np.int64),
+                                validity=r.random(n) > 0.2)),
+        ("w", Column.from_numpy(r.integers(0, 100, n).astype(np.int64))),
+    ])
+
+
+def _batches(sizes=SIZES):
+    return [_mk(n, seed) for seed, n in enumerate(sizes)]
+
+
+def _row_plan():
+    return plan().filter(col("v") > 0).with_columns(d=col("v") * 2)
+
+
+def _agg_plan():
+    # mean over ints is exact too: finalize divides the exact sums.
+    return (plan().filter(col("w") < 90)
+            .groupby_agg(["k", "b"],
+                         [("v", "sum", "sv"), ("v", "count", "cv"),
+                          ("v", "min", "mn"), ("v", "max", "mx"),
+                          ("v", "mean", "mv"), ("w", "count_all", "ca")],
+                         domains={"k": (0, 2)}))
+
+
+def _dicts(stream):
+    return [t.to_pydict() for t in stream]
+
+
+def _rowset(t: Table):
+    cols = [t[n].to_pylist() for n in t.names]
+    return sorted(zip(*cols), key=repr)
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity vs the single-chip stream
+# ---------------------------------------------------------------------------
+
+class TestShardedStreamIdentity:
+    def test_per_batch_bit_identical(self, mesh):
+        p = _row_plan()
+        want = _dicts(run_plan_stream(p, iter(_batches())))
+        got = _dicts(run_plan_stream(p, iter(_batches()), mesh=mesh))
+        assert got == want
+
+    def test_per_batch_groupby_bit_identical(self, mesh):
+        g = _agg_plan()
+        want = _dicts(run_plan_stream(g, iter(_batches()), combine=False))
+        got = _dicts(run_plan_stream(g, iter(_batches()), combine=False,
+                                     mesh=mesh))
+        assert got == want
+        assert len(got) == len(SIZES)
+
+    def test_combine_bit_identical(self, mesh):
+        g = _agg_plan()
+        want = _dicts(run_plan_stream(g, iter(_batches()), combine=True))
+        got = _dicts(run_plan_dist_stream(g, iter(_batches()), mesh,
+                                          combine=True))
+        assert got == want
+        assert len(got) == 1
+
+    def test_empty_batches_mid_stream(self, mesh):
+        batches = (_batches([60, 64])
+                   + [_mk(0, 97)] + _batches([65]) + [_mk(0, 98)])
+        for p, kw in ((_row_plan(), {}), (_agg_plan(), {"combine": True})):
+            want = _dicts(run_plan_stream(
+                p, iter(batches), **kw))
+            got = _dicts(run_plan_stream(p, iter(batches), mesh=mesh, **kw))
+            assert got == want
+
+    def test_all_empty_stream(self, mesh):
+        batches = [_mk(0, 1), _mk(0, 2)]
+        for kw in ({}, {"combine": True}):
+            want = _dicts(run_plan_stream(_agg_plan(), iter(batches), **kw))
+            got = _dicts(run_plan_stream(_agg_plan(), iter(batches),
+                                         mesh=mesh, **kw))
+            assert got == want
+
+    def test_combine_auto_falls_back_per_batch(self, mesh):
+        # No domains hint and an int key -> no batch-invariant layout;
+        # "auto" must replay every consumed batch through per-batch mode.
+        g = plan().groupby_agg(["k"], [("v", "sum", "sv")])
+        want = _dicts(run_plan_stream(g, iter(_batches()), combine=False,
+                                      mesh=mesh))
+        got = _dicts(run_plan_stream(g, iter(_batches()), combine="auto",
+                                     mesh=mesh))
+        assert got == want
+        assert len(got) == len(SIZES)
+
+    def test_combine_strict_raises_without_domains(self, mesh):
+        g = plan().groupby_agg(["k"], [("v", "sum", "sv")])
+        with pytest.raises(TypeError, match="static domain"):
+            list(run_plan_stream(g, iter(_batches([60])), combine=True,
+                                 mesh=mesh))
+
+    def test_shuffled_join_streams_per_batch(self, mesh):
+        r = np.random.default_rng(7)
+        right = Table([
+            ("rk", Column.from_numpy(
+                r.integers(0, 3, 200).astype(np.int64))),
+            ("rv", Column.from_numpy(
+                r.integers(0, 40, 200).astype(np.int64))),
+        ])
+        p = plan().join_shuffled(right, left_on="k", right_on="rk")
+        batches = _batches([60, 65])
+        want = list(run_plan_stream(p, iter(batches)))
+        got = list(run_plan_stream(p, iter(batches), mesh=mesh))
+        assert len(got) == len(want)
+        for w, g in zip(want, got):
+            # The shuffle repartitions rows; compare as multisets.
+            assert _rowset(g) == _rowset(w)
+
+    def test_plan_run_dist_stream_method(self, mesh):
+        g = _agg_plan()
+        want = _dicts(run_plan_stream(g, iter(_batches([60, 65])),
+                                      combine=True))
+        got = _dicts(g.run_dist_stream(iter(_batches([60, 65])), mesh,
+                                       combine=True))
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# 2. compile-once-per-(bucket, mesh) and the single merge collective
+# ---------------------------------------------------------------------------
+
+class TestShardedStreamCompile:
+    def test_one_compile_per_bucket_per_batch(self, mesh, metrics_on):
+        from spark_rapids_tpu.resilience.recovery import evict_device_caches
+        evict_device_caches()
+        registry().reset()
+        list(run_plan_stream(_row_plan(), iter(_batches()), mesh=mesh))
+        snap = registry().snapshot()
+        # SIZES deal to per-shard caps {8, 16}: exactly two programs.
+        assert snap.get("dist.compile_cache.miss", 0) == 2
+        before_miss = snap["dist.compile_cache.miss"]
+        list(run_plan_stream(_row_plan(), iter(_batches()), mesh=mesh))
+        snap = registry().snapshot()
+        assert snap["dist.compile_cache.miss"] == before_miss
+        assert snap.get("dist.compile_cache.hit", 0) >= len(SIZES) - 2
+
+    def test_one_merge_collective_per_combine_stream(self, mesh,
+                                                     metrics_on):
+        from spark_rapids_tpu.resilience.recovery import evict_device_caches
+        evict_device_caches()
+        registry().reset()
+        out = _dicts(run_plan_dist_stream(_agg_plan(), iter(_batches()),
+                                          mesh, combine=True))
+        assert len(out) == 1
+        qm = last_stream_metrics()
+        assert qm.stream_merge_collectives == 1
+        assert qm.stream_ici_bytes > 0
+        snap = registry().snapshot()
+        assert snap.get("ici.collectives", 0) == 1
+        # two partial-aggregate buckets + the one merge program
+        assert snap.get("dist.compile_cache.miss", 0) == 3
+
+    def test_donation_recycles_shard_buffers(self, mesh, metrics_on):
+        list(run_plan_stream(_row_plan(), iter(_batches()), mesh=mesh))
+        qm = last_stream_metrics()
+        # Row-shaped outputs alias the engine-owned shard copies: every
+        # non-empty batch's dispatch reclaims its input HBM.
+        assert qm.stream_donation_hits == len(SIZES)
+        assert qm.stream_donation_misses == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. host syncs: carried on device, paid once at stream end
+# ---------------------------------------------------------------------------
+
+class TestShardedStreamHostSyncs:
+    def test_fewer_syncs_than_per_batch_dist_loop(self, mesh, metrics_on):
+        from spark_rapids_tpu.exec.dist import run_plan_dist
+        g = _agg_plan()
+        registry().reset()
+        for b in _batches():
+            run_plan_dist(g, shard_table(b, mesh), mesh)
+        loop_syncs = registry().snapshot().get("host.sync", 0)
+
+        registry().reset()
+        _dicts(run_plan_dist_stream(g, iter(_batches()), mesh,
+                                    combine=True))
+        snap = registry().snapshot()
+        stream_syncs = snap.get("host.sync", 0)
+        assert snap.get("host.sync.avoided", 0) == len(SIZES)
+        assert stream_syncs < loop_syncs
+        qm = last_stream_metrics()
+        assert qm.stream_syncs_avoided == len(SIZES)
+        assert qm.host_syncs == stream_syncs
+
+    def test_per_batch_mode_also_avoids_live_count_syncs(self, mesh,
+                                                         metrics_on):
+        list(run_plan_stream(_row_plan(), iter(_batches()), mesh=mesh))
+        snap = registry().snapshot()
+        assert snap.get("host.sync.avoided", 0) == len(SIZES)
+        assert snap.get("host.sync.avoided.dist.live_count", 0) \
+            == len(SIZES)
+
+
+# ---------------------------------------------------------------------------
+# 4. overlap: the sharded pipeline still beats the serial phase sum
+# ---------------------------------------------------------------------------
+
+class TestShardedStreamOverlap:
+    def test_overlap_ratio_positive_with_slow_feed(self, mesh):
+        def slow_feed():
+            for seed, n in enumerate([80] * 6):
+                time.sleep(0.02)        # simulated decode latency
+                yield _mk(n, seed)
+
+        outs = list(run_plan_stream(_row_plan(), slow_feed(), mesh=mesh,
+                                    inflight=3, prefetch=4))
+        assert len(outs) == 6
+        qm = last_stream_metrics()
+        assert qm.stream_overlap_ratio > 0
+        assert qm.total_seconds < qm.stream_serial_seconds
+        assert qm.stream_shards == mesh.devices.size
+
+
+# ---------------------------------------------------------------------------
+# 5. observability and knobs
+# ---------------------------------------------------------------------------
+
+class TestShardedStreamObservability:
+    def test_query_metrics_dist_stream_block(self, mesh, metrics_on):
+        _dicts(run_plan_dist_stream(_agg_plan(), iter(_batches()), mesh,
+                                    combine=True))
+        payload = json.loads(last_stream_metrics().to_json())
+        assert payload["mode"] == "dist_stream"
+        assert payload["schema_version"] == 6
+        s = payload["stream"]
+        assert s["shards"] == 8
+        assert s["merge_collectives"] == 1
+        assert s["ici_bytes"] > 0
+        assert s["syncs_avoided"] == len(SIZES)
+        assert s["batches"] == len(SIZES)
+        # cost ledger composes: the merge collective's wall shows as ici
+        assert payload["cost"]["ici_seconds"] > 0
+
+    def test_bench_dist_stream_line(self, mesh, metrics_on):
+        _dicts(run_plan_dist_stream(_agg_plan(), iter(_batches()), mesh,
+                                    combine=True))
+        payload = json.loads(bench_line("dist_stream"))
+        assert payload["metric"] == "dist_stream"
+        assert payload["runs"] == 1
+        assert payload["shards"] == 8
+        assert payload["batches"] == len(SIZES)
+        assert payload["merge_collectives"] == 1
+        assert payload["ici_bytes"] > 0
+        assert payload["syncs_avoided"] == len(SIZES)
+
+    def test_mesh_arg_validated_jax_free(self):
+        with pytest.raises(ValueError, match="mesh must be a jax Mesh"):
+            run_plan_stream(_row_plan(), iter([]), mesh=object())
+        with pytest.raises(ValueError, match="requires a mesh"):
+            run_plan_dist_stream(_row_plan(), iter([]), None)
+
+    def test_dist_stream_inflight_knob(self, monkeypatch):
+        from spark_rapids_tpu.config import (dist_stream_inflight,
+                                             stream_inflight)
+        monkeypatch.delenv("SRT_DIST_STREAM_INFLIGHT", raising=False)
+        assert dist_stream_inflight() == stream_inflight()
+        monkeypatch.setenv("SRT_DIST_STREAM_INFLIGHT", "5")
+        assert dist_stream_inflight() == 5
+        monkeypatch.setenv("SRT_DIST_STREAM_INFLIGHT", "0")
+        with pytest.raises(ValueError, match="SRT_DIST_STREAM_INFLIGHT"):
+            dist_stream_inflight()
+
+    def test_shard_capacity_schedule(self):
+        # jax-free schedule math: snapped to the shared geometric ladder
+        # with the dist floor of 8, shared across same-bucket sizes.
+        from spark_rapids_tpu.exec.bucketing import shard_capacity
+        caps = [shard_capacity(n, 8) for n in SIZES]
+        assert caps == [8, 8, 16, 16, 16, 8]
+        assert len(set(caps)) == 2
+        with pytest.raises(ValueError, match="shards"):
+            shard_capacity(64, 0)
+
+
+# ---------------------------------------------------------------------------
+# faulted-dist-stream CI lane (ci/premerge-build.sh arms a shard-targeted
+# mid-stream OOM; the tests pin their own specs so they pass standalone)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faulted_dist_stream
+class TestFaultedShardedStream:
+    def _golden_then_faulted(self, faults, p, spec, mesh, **kw):
+        reset_faults()
+        want = _dicts(run_plan_stream(p, iter(_batches()), mesh=mesh, **kw))
+        faults.setenv("SRT_FAULT", spec)
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = _dicts(run_plan_stream(p, iter(_batches()), mesh=mesh, **kw))
+        assert got == want, spec
+        assert recovery_stats().delta(before)["dist_retries"] >= 1, spec
+
+    def test_per_batch_dist_dispatch_fault(self, faults, mesh):
+        self._golden_then_faulted(
+            faults, _row_plan(), "oom:dist-dispatch:2:shard=3", mesh)
+
+    def test_per_batch_collective_fault(self, faults, mesh):
+        self._golden_then_faulted(
+            faults, _agg_plan(), "oom:collective:2:shard=5", mesh,
+            combine=False)
+
+    def test_combine_dist_dispatch_fault(self, faults, mesh):
+        self._golden_then_faulted(
+            faults, _agg_plan(), "oom:dist-dispatch:2:shard=2", mesh,
+            combine=True)
+        assert last_stream_metrics().stream_merge_collectives == 1
+
+    def test_combine_merge_collective_fault(self, faults, mesh):
+        self._golden_then_faulted(
+            faults, _agg_plan(), "oom:collective:2", mesh, combine=True)
+
+    def test_collect_fault_mid_drain(self, faults, mesh):
+        self._golden_then_faulted(
+            faults, _row_plan(), "oom:collect:1", mesh)
+
+    def test_shuffle_fault_in_streamed_join(self, faults, mesh):
+        r = np.random.default_rng(11)
+        right = Table([
+            ("rk", Column.from_numpy(
+                r.integers(0, 3, 150).astype(np.int64))),
+            ("rv", Column.from_numpy(
+                r.integers(0, 9, 150).astype(np.int64))),
+        ])
+        p = plan().join_shuffled(right, left_on="k", right_on="rk")
+        batches = _batches([60, 65])
+        reset_faults()
+        want = [_rowset(t) for t in
+                run_plan_stream(p, iter(batches), mesh=mesh)]
+        faults.setenv("SRT_FAULT", "oom:shuffle:1:shard=2")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        got = [_rowset(t) for t in
+               run_plan_stream(p, iter(batches), mesh=mesh)]
+        assert got == want
+        assert recovery_stats().delta(before)["dist_retries"] >= 1
+
+    def test_dist_stall_raises_not_hangs(self, faults, mesh):
+        from spark_rapids_tpu.resilience import DistStallError
+        faults.setenv("SRT_DIST_TIMEOUT", "0.2")
+        faults.setenv("SRT_FAULT", "stall:dist-dispatch:1:shard=4")
+        reset_faults()
+        with pytest.raises(DistStallError):
+            _dicts(run_plan_stream(_row_plan(), iter(_batches([60])),
+                                   mesh=mesh))
