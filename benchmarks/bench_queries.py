@@ -16,6 +16,12 @@ freshest ``SRT_METRICS_HISTORY`` record per plan fingerprint against the
 per-metric best of the earlier records (obs/regress.py) and exits
 nonzero on any breach beyond ``SRT_REGRESS_TOL``.
 
+``--live`` additionally times the ETL stream shape with the live
+telemetry stack fully on (``SRT_METRICS=1``, exporter scraped at 20 Hz)
+against the same stream with telemetry off and appends a
+``live_overhead`` JSON line (base/live wall seconds, overhead fraction)
+— the record pinning the registry's near-zero hot-path cost.
+
 ``--faults`` additionally arms a deterministic HBM-OOM injection
 (``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec),
 runs one mesh join+agg with a shard-targeted dist-dispatch OOM recovered
@@ -139,6 +145,8 @@ def main():
     bench_plans(lineitem, fact, dim)
     bench_stream(lineitem)
     bench_dist_stream(lineitem)
+    if "--live" in sys.argv:
+        bench_live(lineitem)
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
@@ -324,6 +332,97 @@ def bench_stream(lineitem, n_batches=8):
     emit(json.dumps({"metric": "tpch_q1_etl_stream_4M",
                       "value": round(rows / dt_s, 1), "unit": "rows/sec"}))
     emit(bench_stream_line())
+
+
+def bench_live(lineitem, n_batches=8):
+    """``--live``: wall-clock cost of the live-telemetry stack on the ETL
+    stream shape — registry counters + live-query heartbeats + an
+    exporter being scraped, against the same stream with everything off.
+    Emits the ``live_overhead`` JSON line the acceptance gate reads
+    (overhead_frac stays within a few percent); the stricter
+    zero-extra-work-when-off contract is structural (NULL_LIVE identity)
+    and pinned by tests/test_live.py rather than timed here."""
+    import os
+    import threading
+    import urllib.request
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import col, plan, run_plan_stream
+
+    host = {n: np.asarray(c.data) for n, c in lineitem.items()}
+    rows = lineitem.num_rows
+    step = rows // n_batches
+
+    def feed():
+        for i in range(n_batches):
+            lo, hi = i * step, min((i + 1) * step, rows)
+            yield srt.Table([
+                (n, Column.from_numpy(v[lo:hi])) for n, v in host.items()])
+
+    p = (plan()
+         .filter(col("shipdate") <= 10_500)
+         .with_columns(disc_price=col("price") * (1 - col("disc")))
+         .with_columns(charge=col("disc_price") * (1 + col("tax"))))
+
+    def run():
+        for _ in run_plan_stream(p, feed(), prefetch=True):
+            pass
+
+    def timed(reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    had = os.environ.pop("SRT_METRICS", None)
+    try:
+        run()                        # warm compile, telemetry off
+        base_s = timed()
+    finally:
+        if had is not None:
+            os.environ["SRT_METRICS"] = had
+
+    from spark_rapids_tpu.obs import server
+    os.environ["SRT_METRICS"] = "1"
+    srv = server.start(port=0)
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(srv.url + "/metrics",
+                                            timeout=5) as r:
+                    r.read()
+                with urllib.request.urlopen(srv.url + "/queries",
+                                            timeout=5) as r:
+                    r.read()
+            except Exception:
+                pass
+            stop.wait(0.05)
+
+    th = threading.Thread(target=scraper, daemon=True)
+    th.start()
+    try:
+        run()                        # warm the metered path
+        live_s = timed()
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        if had is None:
+            os.environ.pop("SRT_METRICS", None)
+        else:
+            os.environ["SRT_METRICS"] = had
+
+    emit(json.dumps({
+        "metric": "live_overhead",
+        "base_seconds": round(base_s, 6),
+        "live_seconds": round(live_s, 6),
+        "overhead_frac": round(max(live_s - base_s, 0.0) / base_s, 6)},
+        sort_keys=True))
 
 
 def bench_dist_stream(lineitem, n_batches=8, batch_rows=200_000):

@@ -62,6 +62,75 @@ python -m pytest tests/test_exec_dist.py -m faulted_dist -q
 SRT_FAULT="oom:dist-dispatch:2:shard=3" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
 python -m pytest tests/test_dist_stream.py -m faulted_dist_stream -q
 
+# Live-telemetry lane: a faulted 8-shard dist-stream with the exporter
+# up; scrape /metrics and /queries MID-RUN (from a progress heartbeat)
+# and assert the live snapshot shows per-shard batch progress and the
+# recovery rung the mesh ladder took, and that /metrics parses as
+# Prometheus text exposition.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_FAULT="oom:dist-dispatch:2:shard=3" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+SRT_LIVE_SERVER=1 SRT_LIVE_PORT=0 \
+python - <<'EOF'
+import json
+import re
+import urllib.request
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import plan
+from spark_rapids_tpu.exec.stream import run_plan_dist_stream
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.parallel import make_flat_mesh
+
+r = np.random.default_rng(3)
+def batches(n=8, rows=512):
+    for i in range(n):
+        yield Table({
+            "k": Column.from_numpy(r.integers(0, 4, rows).astype(np.int64)),
+            "v": Column.from_numpy(r.integers(0, 100, rows).astype(np.int64)),
+        })
+
+mesh = make_flat_mesh()
+P = int(mesh.devices.size)
+assert P == 8, P
+p = plan().groupby_agg(["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+mid = {}
+
+def scrape(snap):
+    if mid or snap["status"] != "running" or snap["batches_done"] < 3:
+        return
+    base = server.get().url
+    with urllib.request.urlopen(base + "/queries", timeout=5) as resp:
+        mid["queries"] = json.loads(resp.read().decode())
+    with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+        mid["metrics"] = resp.read().decode()
+
+outs = list(run_plan_dist_stream(p, batches(), mesh, combine=False,
+                                 on_progress=scrape))
+assert len(outs) == 8, len(outs)
+assert mid, "no mid-run scrape happened"
+
+[q] = mid["queries"]["in_flight"]
+assert q["mode"] == "dist_stream" and q["status"] == "running", q
+assert q["shards"] == P, q
+assert len(q["shard_batches"]) == P, q["shard_batches"]
+assert all(done >= 1 for done in q["shard_batches"].values()), \
+    q["shard_batches"]
+assert q["recovery"]["count"] >= 1, q["recovery"]
+assert any("dist-dispatch" in rung for rung in q["recovery"]["rungs"]), \
+    q["recovery"]
+
+sample = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|\+Inf|-Inf)$')
+lines = [l for l in mid["metrics"].strip().split("\n")
+         if not l.startswith("#")]
+bad = [l for l in lines if not sample.match(l)]
+assert not bad, bad[:5]
+assert any(l.startswith("srt_live_query_shard_batches{") for l in lines)
+print("live telemetry lane ok:", len(lines), "metric samples,",
+      "rung:", q["recovery"]["last_rung"])
+EOF
+
 # Timeline lane: record a faulted query on the span timeline, export
 # Chrome-trace JSON, and validate it against the golden-pinned schema
 # (tests/golden/chrome_trace_schema.json) — the artifact a reviewer can

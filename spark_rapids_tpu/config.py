@@ -98,6 +98,19 @@ Knobs (all optional):
                                hanging the host when the device program
                                makes no progress for this long (unset/0
                                = no watchdog).
+  ``SRT_LIVE_SERVER``          ``1`` starts the live-telemetry HTTP
+                               exporter (obs/server.py) on the first
+                               metered query: ``/metrics`` (Prometheus
+                               text exposition), ``/queries`` (JSON
+                               snapshots of in-flight + recent queries),
+                               ``/queries/<id>/timeline`` (Chrome trace
+                               of a still-running query).  Requires
+                               ``SRT_METRICS=1`` to have anything to
+                               serve.
+  ``SRT_LIVE_PORT``            port of the live-telemetry exporter
+                               (default 9465; ``0`` binds an ephemeral
+                               port — read it back via
+                               ``obs.server.get().port``).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -450,6 +463,31 @@ def timeline_enabled() -> bool:
     return _flag("SRT_TRACE_TIMELINE")
 
 
+def live_server_enabled() -> bool:
+    """Live-telemetry HTTP exporter on/off (obs/server.py).
+
+    Read live at query start (one env read per query, never per batch):
+    when on, the first metered execution spins up the daemon-thread
+    ``http.server`` exporter; when off nothing listens and the live
+    registry stays a process-local structure."""
+    return _flag("SRT_LIVE_SERVER")
+
+
+def live_server_port() -> int:
+    """Port for the live-telemetry exporter (``SRT_LIVE_PORT``).
+
+    Default 9465.  ``0`` asks the OS for an ephemeral port (tests and CI
+    lanes do this to avoid collisions; the bound port is available as
+    ``obs.server.get().port``)."""
+    raw = os.environ.get("SRT_LIVE_PORT")
+    if raw is None or not raw.strip():
+        return 9465
+    val = int(raw)
+    if val < 0 or val > 65535:
+        raise ValueError(f"SRT_LIVE_PORT must be 0..65535, got {val}")
+    return val
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -527,5 +565,6 @@ def knob_table() -> dict[str, str]:
              "SRT_DIST_STREAM_INFLIGHT",
              "SRT_RETRY_MAX", "SRT_RETRY_BACKOFF",
              "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT",
-             "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT")
+             "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT",
+             "SRT_LIVE_SERVER", "SRT_LIVE_PORT")
     return {n: os.environ.get(n, "<default>") for n in names}

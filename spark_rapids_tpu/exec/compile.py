@@ -1751,16 +1751,27 @@ def run_plan_padded(plan: Plan, table: Table):
     return t, sel_col
 
 
-def run_plan(plan: Plan, table: Table) -> Table:
+def run_plan(plan: Plan, table: Table, progress=None) -> Table:
+    """``progress`` opts this one query into live-telemetry heartbeats
+    (obs/live.py) even without ``SRT_METRICS``: ``True`` renders a
+    stderr progress line, a callable receives live snapshots at phase
+    transitions.  None (default) pays nothing extra."""
     if table.num_rows == 0:
         return run_plan_eager(plan, table)
     from ..config import metrics_enabled
-    if metrics_enabled():
-        return _run_plan_metered(plan, table)[0]
+    if metrics_enabled() or progress is not None:
+        return _run_plan_metered(plan, table, progress=progress)[0]
+    from ..obs import timeline as _tl
+    if _tl.enabled():
+        # Correlation id for the recorded spans even on the unmetered
+        # path (the metered path scopes with its QueryMetrics id).
+        from ..obs.query import next_query_id
+        with _tl.query_scope(next_query_id()):
+            return _execute_resilient(plan, table)
     return _execute_resilient(plan, table)
 
 
-def _run_plan_metered(plan: Plan, table: Table):
+def _run_plan_metered(plan: Plan, table: Table, progress=None):
     """run_plan with QueryMetrics accounting (``SRT_METRICS=1``): phase
     wall times, compile-cache status, registry counter deltas, and the
     recovery block (retries / splits / cache evictions — resilience/).
@@ -1770,20 +1781,32 @@ def _run_plan_metered(plan: Plan, table: Table):
     pay, which is why metering is a flag into the shared resilient core
     and not inline ifs at every call site."""
     import time as _time
+    from ..obs import live as _live
+    from ..obs import timeline as _tl
+    from ..obs.history import plan_fingerprint
     from ..obs.metrics import counters_delta, registry
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
     from ..resilience import recovery_stats
     from ..obs import profile as _prof
     qm = QueryMetrics(query_id=next_query_id(), mode="run",
+                      fingerprint=plan_fingerprint(plan),
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
+    lq = _live.start("run", query_id=qm.query_id,
+                     fingerprint=qm.fingerprint,
+                     input_rows=table.num_rows,
+                     observer=_live.as_observer(progress))
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
     cc = _prof.push_collector()
     try:
-        t = _execute_resilient(plan, table, qm=qm)
+        with _tl.query_scope(qm.query_id):
+            t = _execute_resilient(plan, table, qm=qm)
+    except BaseException as err:
+        lq.finish(status="error", error=repr(err))
+        raise
     finally:
         _prof.pop_collector(cc)
     qm.total_seconds = _time.perf_counter() - t_all
@@ -1791,6 +1814,8 @@ def _run_plan_metered(plan: Plan, table: Table):
     cc.apply(qm)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
+    lq.note_hbm(qm.hbm_peak_bytes)
+    lq.finish(output_rows=t.num_rows)
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
     maybe_record(plan, qm)
@@ -1810,6 +1835,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
     named fault sites (``bind``, ``dispatch``, ``materialize``) let
     ``SRT_FAULT`` provoke every path deterministically on CPU."""
     import time as _time
+    from ..obs import live as _live
     from ..obs.timeline import span as _tspan
     from ..resilience import fault_point
     from ..resilience.classify import ExecutionRecoveryError
@@ -1820,6 +1846,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
         return _bind(plan, table)
 
     t0 = _time.perf_counter()
+    _live.phase("bind")
     with _tspan("run.bind", cat="execute", rows=table.num_rows,
                 depth=depth):
         bound = oom_ladder("bind", do_bind)
@@ -1839,6 +1866,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
 
     try:
         t0 = _time.perf_counter()
+        _live.phase("dispatch")
         with _tspan("run.dispatch", cat="execute", depth=depth):
             out_cols, sel = oom_ladder("dispatch", do_dispatch)
         if qm is not None:
@@ -1859,6 +1887,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
                     _COMPILED.get(sig) or _compiled_for(bound), bound))
             sample_device_hbm("run.dispatch")
         t0 = _time.perf_counter()
+        _live.phase("materialize")
         with _tspan("run.materialize", cat="execute", depth=depth):
             t = oom_ladder("materialize",
                            lambda: materialize(bound, out_cols, sel))
@@ -2164,21 +2193,46 @@ def analyze_plan(plan: Plan, table: Table):
     describe the production fused program.  Returns
     ``(materialized Table, QueryMetrics)``.
     """
+    from ..obs import live as _live
+    from ..obs import timeline as _tl
+    from ..obs.history import plan_fingerprint
+    from ..obs.query import QueryMetrics, next_query_id, \
+        set_last_query_metrics
+    qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
+                      fingerprint=plan_fingerprint(plan),
+                      input_rows=table.num_rows,
+                      input_columns=table.num_columns)
+    lq = _live.start("analyze", query_id=qm.query_id,
+                     fingerprint=qm.fingerprint,
+                     input_rows=table.num_rows)
+    try:
+        with _tl.query_scope(qm.query_id):
+            t = _analyze_measured(plan, table, qm, lq)
+    except BaseException as err:
+        lq.finish(status="error", error=repr(err))
+        raise
+    lq.finish(output_rows=qm.output_rows)
+    set_last_query_metrics(qm)
+    from ..obs.history import maybe_record
+    maybe_record(plan, qm)
+    return t, qm
+
+
+def _analyze_measured(plan: Plan, table: Table, qm, lq) -> Table:
+    """The measured body of :func:`analyze_plan` (runs inside its
+    timeline query scope; ``lq`` is the live heartbeat record)."""
     import time as _time
     from ..obs.metrics import counters_delta, registry
-    from ..obs.query import QueryMetrics, StepMetrics, next_query_id, \
-        set_last_query_metrics
+    from ..obs.query import StepMetrics
     from ..resilience import recovery_stats
     from ..resilience.recovery import oom_ladder
     from ..obs import profile as _prof
     from ..utils.memory import sample_device_hbm
-    qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
-                      input_rows=table.num_rows,
-                      input_columns=table.num_columns)
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
     cc = _prof.push_collector()
     t_all = _time.perf_counter()
+    lq.set_phase("bind")
     bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
     qm.compile_cache = ("hit" if bound.signature() in _COMPILED
@@ -2191,6 +2245,7 @@ def analyze_plan(plan: Plan, table: Table):
     # instead of aborting the report.  (No split rung here: the analyzer
     # measures THE batch it was given; halving it would measure a
     # different query.)
+    lq.set_phase("dispatch")
     out_cols, sel = oom_ladder("dispatch", lambda: jax.block_until_ready(
         fn(bound.exec_cols, bound.side_inputs, bound.init_sel)))
     qm.execute_seconds = _time.perf_counter() - t0
@@ -2213,6 +2268,7 @@ def analyze_plan(plan: Plan, table: Table):
     # LIVE counts, so the report reads the same at any bucket capacity.
     cols, step_sel = bound.exec_cols, bound.init_sel
     live_in = bound.logical_rows
+    lq.set_phase("measure-steps")
     for i, (step_fn, (kind, text)) in enumerate(zip(fns, descs)):
         t0 = _time.perf_counter()
         cols, step_sel = jax.block_until_ready(
@@ -2226,7 +2282,9 @@ def analyze_plan(plan: Plan, table: Table):
             rows_out=live, padded_out=padded, seconds=dt,
             density=(live / padded) if padded else 0.0))
         live_in = live
+        lq.batch_out(live)
     t0 = _time.perf_counter()
+    lq.set_phase("materialize")
     t = oom_ladder("materialize",
                    lambda: materialize(bound, out_cols, sel))
     qm.materialize_seconds = _time.perf_counter() - t0
@@ -2237,10 +2295,8 @@ def analyze_plan(plan: Plan, table: Table):
     cc.apply(qm)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
-    set_last_query_metrics(qm)
-    from ..obs.history import maybe_record
-    maybe_record(plan, qm)
-    return t, qm
+    lq.note_hbm(qm.hbm_peak_bytes)
+    return t
 
 
 def explain_analyze_plan(plan: Plan, table: Table,

@@ -114,27 +114,45 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     from ..config import metrics_enabled
     if metrics_enabled():
         return _run_plan_dist_metered(plan, dist, mesh)
+    from ..obs import timeline as _tl
+    if _tl.enabled():
+        # Unmetered but tracing: still claim a query id so the timeline's
+        # span args carry one for correlation.
+        from ..obs.query import next_query_id
+        with _tl.query_scope(next_query_id()):
+            return _execute_dist_resilient(plan, dist, mesh)
     return _execute_dist_resilient(plan, dist, mesh)
 
 
 def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     import time as _time
+    from ..obs import live as _live
     from ..obs import profile as _prof
+    from ..obs import timeline as _tl
+    from ..obs.history import plan_fingerprint
     from ..obs.metrics import counters_delta, registry
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
     from ..resilience import recovery_stats
     qm = QueryMetrics(query_id=next_query_id(), mode="dist",
+                      fingerprint=plan_fingerprint(plan),
                       input_rows=_live_count_cached(dist.row_mask),
                       input_columns=dist.table.num_columns)
+    lq = _live.start("dist", query_id=qm.query_id,
+                     fingerprint=qm.fingerprint, input_rows=qm.input_rows)
     before = registry().counters_snapshot()
     r_before = recovery_stats().snapshot()
     t_all = _time.perf_counter()
-    cc = _prof.push_collector()
     try:
-        result = _execute_dist_resilient(plan, dist, mesh)
-    finally:
-        _prof.pop_collector(cc)
+        with _tl.query_scope(qm.query_id):
+            cc = _prof.push_collector()
+            try:
+                result = _execute_dist_resilient(plan, dist, mesh)
+            finally:
+                _prof.pop_collector(cc)
+    except BaseException as err:
+        lq.finish(status="error", error=repr(err))
+        raise
     qm.total_seconds = _time.perf_counter() - t_all
     if isinstance(result, Table):
         qm.output_rows = result.num_rows
@@ -154,6 +172,8 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     elif qm.counters.get("dist.compile_cache.hit"):
         qm.compile_cache = "hit"
     qm.apply_recovery(recovery_stats().delta(r_before))
+    lq.note_hbm(qm.hbm_peak_bytes)
+    lq.finish(output_rows=qm.output_rows or None)
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
     maybe_record(plan, qm)
@@ -197,11 +217,13 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
         return _lower_shuffled_join(plan, dist, mesh, depth)
     import time as _time
     from ..config import metrics_enabled
+    from ..obs import live as _live
     from ..obs.metrics import counter
     meter = metrics_enabled()
 
     axis = mesh.axis_names[0]
     axis_size = int(mesh.shape[axis])
+    _live.phase("bind")
     t_bind = _time.perf_counter()
     bound = _Bound(plan, dist.table, probe_mask=dist.row_mask)
     if meter:
@@ -266,6 +288,7 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
                 counter("ici.us").inc(max(1, int(dur_s * 1e6 * frac)))
                 counter("ici.bytes").inc(int(ici_bytes))
                 counter("ici.collectives").inc(1)
+                _live.add_ici(int(ici_bytes))
             from ..obs import profile as _prof
             _prof.cached_analysis(
                 ("dist", key),
@@ -289,8 +312,10 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
         return out_cols, sel
 
     try:
+        _live.phase("dispatch")
         out_cols, sel = oom_ladder("dist-dispatch", do_dispatch, dist=True)
         if replicated_out:
+            _live.phase("materialize")
             t_mat = _time.perf_counter()
             result = oom_ladder("materialize",
                                 lambda: materialize(bound, out_cols, sel),

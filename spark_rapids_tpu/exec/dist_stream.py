@@ -110,6 +110,7 @@ def _account_donation(acct, reclaimed: bool, lane: str, bi: int) -> None:
         acct.donation_misses += 1
         counter("stream.donation.miss").inc()
         _tinstant("stream.donation.miss", cat="stream", lane=lane, batch=bi)
+    acct.live.donation(reclaimed)
 
 
 def _finish_live_count(acct, live_dev) -> None:
@@ -122,6 +123,7 @@ def _finish_live_count(acct, live_dev) -> None:
     acct.live_rows = int(live_dev)
     record_host_sync("dist.stream.live_count", 8,
                      seconds=_time.perf_counter() - t0)
+    acct.live.set_live_rows(acct.live_rows)
 
 
 def _drive_batches_dist(plan, source, k: int, acct, mesh):
@@ -145,6 +147,7 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
     axis = mesh.axis_names[0]
     P = int(mesh.devices.size)
     acct.shards = P
+    acct.live.set_shards(P)
     meter = metrics_enabled()
     replicated_out = any(isinstance(s, GroupAggStep) for s in plan.steps)
     shuffled = any(isinstance(s, JoinShuffledStep) for s in plan.steps)
@@ -312,9 +315,12 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
                         acct.ici_bytes += ici_bytes
                 acct.dispatch_s += _time.perf_counter() - t0
                 pending.append(("exec", state[1], out_cols, sel, bi))
+        if batch.num_rows:
+            acct.live.shard_batches_done(P)
         while len(pending) > k:
             yield drain_oldest()
         depth = sum(1 for e in pending if e[0] != "ready")
+        acct.live.set_inflight(depth)
         if depth > acct.peak_inflight:
             acct.peak_inflight = depth
             inflight_gauge.set(depth)
@@ -344,6 +350,7 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
     axis = mesh.axis_names[0]
     P = int(mesh.devices.size)
     acct.shards = P
+    acct.live.set_shards(P)
     meter = metrics_enabled()
     levels: list = []           # levels[i]: acc of 2^i batches, or None
     bound0 = smeta = dtypes = None
@@ -490,7 +497,9 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
         else:
             levels[i] = acc
         acct.dispatch_s += _time.perf_counter() - t0
+        acct.live.shard_batches_done(P)
         since_block += 1
+        acct.live.set_inflight(since_block)
         if since_block > acct.peak_inflight:
             acct.peak_inflight = since_block
             inflight_gauge.set(since_block)
@@ -542,6 +551,7 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
             return jax.block_until_ready(fn(total_holder[0]))
         return dist_guard("dist.merge", invoke)
 
+    acct.live.set_phase("merge-collective")
     t0 = _time.perf_counter()
     tl_on = _tl.enabled()
     t_us = _tl.now_us() if tl_on else 0.0

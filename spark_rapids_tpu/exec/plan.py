@@ -410,7 +410,8 @@ class Plan:
         return Plan(self.steps + (LimitStep(int(k)),))
 
     # -- execution ---------------------------------------------------------
-    def run(self, table: Table, trace_timeline=None) -> Table:
+    def run(self, table: Table, trace_timeline=None,
+            progress=None) -> Table:
         """Execute against ``table``: one device program, then one host
         sync to slice data-dependent output sizes (zero syncs when every
         output size is static).
@@ -429,15 +430,20 @@ class Plan:
         (obs/timeline.py) regardless of ``SRT_TRACE_TIMELINE``: ``True``
         just records (read back via ``obs.timeline.events()``), a path
         string also exports the run's slice as Chrome-trace JSON
-        (open at https://ui.perfetto.dev)."""
+        (open at https://ui.perfetto.dev).
+
+        ``progress`` opts this query into live-telemetry heartbeats
+        (obs/live.py) even without ``SRT_METRICS``: ``True`` renders an
+        overwriting stderr progress line, a callable receives live
+        snapshot dicts at phase transitions and completion."""
         from .compile import run_plan
         if trace_timeline:
             from ..obs.timeline import recording
             path = trace_timeline if isinstance(trace_timeline, str) \
                 else None
             with recording(path):
-                return run_plan(self, table)
-        return run_plan(self, table)
+                return run_plan(self, table, progress=progress)
+        return run_plan(self, table, progress=progress)
 
     def run_padded(self, table: Table):
         """Execute fully sync-free: returns ``(padded Table, selection)``
@@ -467,7 +473,8 @@ class Plan:
         return explain_analyze_plan(self, table, timeline=timeline)
 
     def run_stream(self, batches, inflight=None, combine="auto",
-                   prefetch=False, trace_timeline=None, mesh=None):
+                   prefetch=False, trace_timeline=None, mesh=None,
+                   on_progress=None):
         """Execute over a batch iterator with up to ``inflight`` batches
         dispatched but unmaterialized (async pipelining + buffer
         donation; see :mod:`.stream`).  Yields one Table per batch, or a
@@ -475,15 +482,18 @@ class Plan:
         ``trace_timeline`` records the stream on the span timeline
         (``True`` = record only, path string = export Chrome-trace JSON
         when the stream finishes).  ``mesh`` drives the stream sharded
-        over the device mesh (see :mod:`.dist_stream`)."""
+        over the device mesh (see :mod:`.dist_stream`).  ``on_progress``
+        receives live snapshot dicts (obs/live.py) per completed batch,
+        with or without ``SRT_METRICS``."""
         from .stream import run_plan_stream
         return run_plan_stream(self, batches, inflight=inflight,
                                combine=combine, prefetch=prefetch,
-                               trace_timeline=trace_timeline, mesh=mesh)
+                               trace_timeline=trace_timeline, mesh=mesh,
+                               on_progress=on_progress)
 
     def run_dist_stream(self, batches, mesh, inflight=None,
                         combine="auto", prefetch=False,
-                        trace_timeline=None):
+                        trace_timeline=None, on_progress=None):
         """Sharded streaming execution: each batch dealt over ``mesh``
         with per-shard in-flight windows, donation on the engine-owned
         shard copies, and — for group-by plans — ONE end-of-stream merge
@@ -492,7 +502,8 @@ class Plan:
         return run_plan_dist_stream(self, batches, mesh,
                                     inflight=inflight, combine=combine,
                                     prefetch=prefetch,
-                                    trace_timeline=trace_timeline)
+                                    trace_timeline=trace_timeline,
+                                    on_progress=on_progress)
 
     def run_dist(self, dist, mesh):
         """Execute against a row-sharded :class:`..parallel.mesh.DistTable`

@@ -85,7 +85,7 @@ class _Account:
                  "bind_s", "dispatch_s", "mat_s", "idle_s",
                  "donation_hits", "donation_misses", "peak_inflight",
                  "shards", "merge_collectives", "ici_bytes",
-                 "syncs_avoided", "live_rows")
+                 "syncs_avoided", "live_rows", "live")
 
     def __init__(self):
         self.batches = self.rows = self.columns = self.out_rows = 0
@@ -96,6 +96,10 @@ class _Account:
         # sharded-stream extras (exec/dist_stream.py); zero single-chip
         self.shards = self.merge_collectives = self.ici_bytes = 0
         self.syncs_avoided = self.live_rows = 0
+        # live-query heartbeat (obs/live.py); the null record unless the
+        # stream is metered, so driver publishing is no-op method calls
+        from ..obs.live import NULL_LIVE
+        self.live = NULL_LIVE
 
 
 def _counted_source(source: Iterator, acct: _Account, batch_counter
@@ -109,6 +113,7 @@ def _counted_source(source: Iterator, acct: _Account, batch_counter
         if acct.columns == 0:
             acct.columns = batch.num_columns
         batch_counter.inc()
+        acct.live.batch_in(batch.num_rows)
         yield batch
 
 
@@ -206,7 +211,7 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                     combine: Union[str, bool] = "auto",
                     prefetch: Union[bool, int] = False,
                     trace_timeline: Union[None, bool, str] = None,
-                    mesh=None) -> Iterator:
+                    mesh=None, on_progress=None) -> Iterator:
     """Drive ``plan`` over ``batches`` with up to ``inflight`` batches
     dispatched but unmaterialized.  Yields one Table per batch (bit-equal
     to ``run_plan`` on that batch), or — in streaming combine mode — ONE
@@ -230,6 +235,12 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                    exports the stream's slice as Chrome-trace JSON —
                    with per-batch lanes, so in-flight overlap is visible
                    in Perfetto — when the stream finishes.
+    ``on_progress``  callable receiving the query's live snapshot dict
+                   (obs/live.py) after every yielded batch, on phase
+                   transitions, and at finish; ``True`` uses the
+                   built-in stderr one-liner.  Forces the live-query
+                   registry on for this stream even without
+                   ``SRT_METRICS``.
     ``mesh``       drive the stream SHARDED: each batch is dealt over the
                    mesh (exec/dist_stream.py), per-shard bucket programs
                    compile once per (bucket, mesh), donation recycles the
@@ -268,12 +279,17 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                                                      (bool, str)):
         raise ValueError(f"trace_timeline must be None, a bool, or an "
                          f"export path, got {trace_timeline!r}")
+    if on_progress is not None and on_progress is not True \
+            and not callable(on_progress):
+        raise ValueError(f"on_progress must be None, True, or a callable, "
+                         f"got {on_progress!r}")
     if combine is True:
         obstacles = combine_obstacles(plan)
         if obstacles:
             raise TypeError("plan cannot stream-combine: "
                             + "; ".join(obstacles))
-    gen = _stream(plan, batches, inflight, combine, prefetch, mesh)
+    gen = _stream(plan, batches, inflight, combine, prefetch, mesh,
+                  on_progress)
     if trace_timeline:
         return _recorded_stream(gen, trace_timeline
                                 if isinstance(trace_timeline, str) else None)
@@ -284,8 +300,8 @@ def run_plan_dist_stream(plan, batches: Iterable, mesh,
                          inflight: Optional[int] = None,
                          combine: Union[str, bool] = "auto",
                          prefetch: Union[bool, int] = False,
-                         trace_timeline: Union[None, bool, str] = None
-                         ) -> Iterator:
+                         trace_timeline: Union[None, bool, str] = None,
+                         on_progress=None) -> Iterator:
     """Sharded streaming executor: :func:`run_plan_stream` with a
     required ``mesh``.  See the ``mesh=`` parameter there; this spelling
     exists so call sites that are distributed by construction fail fast
@@ -296,7 +312,8 @@ def run_plan_dist_stream(plan, batches: Iterable, mesh,
                          "streaming call run_plan_stream")
     return run_plan_stream(plan, batches, inflight=inflight,
                            combine=combine, prefetch=prefetch,
-                           trace_timeline=trace_timeline, mesh=mesh)
+                           trace_timeline=trace_timeline, mesh=mesh,
+                           on_progress=on_progress)
 
 
 def _recorded_stream(gen, path):
@@ -308,12 +325,22 @@ def _recorded_stream(gen, path):
         yield from gen
 
 
-def _stream(plan, batches, k: int, combine, prefetch, mesh=None) -> Iterator:
+def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
+            on_progress=None) -> Iterator:
     from ..config import metrics_enabled
+    from ..obs import live as _live
+    from ..obs import timeline as _tl
     from ..obs.metrics import counter, counters_delta, gauge, registry
+    from ..obs.query import next_query_id
     from ..resilience import recovery_stats
 
+    mode = "dist_stream" if mesh is not None else "stream"
+    qid = next_query_id()
+    lq = _live.start(mode, plan=plan, query_id=qid,
+                     observer=_live.as_observer(on_progress))
+
     acct = _Account()
+    acct.live = lq
     r_before = recovery_stats().snapshot()
     feed = _timed_source(batches, acct)
     if prefetch is not False:
@@ -339,30 +366,40 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None) -> Iterator:
                                 strict=combine is True)
     else:
         driver = _drive_batches(plan, source, k, acct)
+    lq.set_phase("stream")
     try:
-        for out in driver:
-            acct.out_rows += out.num_rows
-            pause = _time.perf_counter()
-            yield out
-            acct.idle_s += _time.perf_counter() - pause
-    finally:
-        # Deterministic teardown (an abandoned stream must not leave the
-        # feed's prefetch worker running until GC); idempotent on normal
-        # exhaustion.
-        driver.close()
-        source.close()
-        feed.close()
+        with _tl.query_scope(qid):
+            try:
+                for out in driver:
+                    acct.out_rows += out.num_rows
+                    lq.batch_out(out.num_rows)
+                    pause = _time.perf_counter()
+                    yield out
+                    acct.idle_s += _time.perf_counter() - pause
+            finally:
+                # Deterministic teardown (an abandoned stream must not
+                # leave the feed's prefetch worker running until GC);
+                # idempotent on normal exhaustion.
+                driver.close()
+                source.close()
+                feed.close()
+    except GeneratorExit:
+        lq.finish(status="abandoned")
+        raise
+    except BaseException as err:
+        lq.finish(status="error", error=repr(err))
+        raise
 
+    lq.set_phase("finalize")
     wall = _time.perf_counter() - t_all - acct.idle_s
     serial = acct.source_s + acct.bind_s + acct.dispatch_s + acct.mat_s
     overlap = max(0.0, serial - wall) / serial if serial > 0 else 0.0
     gauge("stream.inflight_depth").set(acct.peak_inflight)
     gauge("stream.overlap_ratio").set(round(overlap, 6))
 
-    from ..obs.query import (QueryMetrics, next_query_id,
-                             set_last_stream_metrics)
-    qm = QueryMetrics(query_id=next_query_id(),
-                      mode="dist_stream" if mesh is not None else "stream",
+    from ..obs.query import QueryMetrics, set_last_stream_metrics
+    qm = QueryMetrics(query_id=qid, mode=mode,
+                      fingerprint=lq.fingerprint,
                       input_rows=acct.rows, input_columns=acct.columns)
     qm.output_rows = acct.out_rows
     qm.bind_seconds = acct.bind_s
@@ -393,6 +430,8 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None) -> Iterator:
             default=0)
     qm.finish_counters(counters_delta(before))
     qm.apply_recovery(recovery_stats().delta(r_before))
+    lq.note_hbm(qm.hbm_peak_bytes)
+    lq.finish(output_rows=acct.out_rows)
     set_last_stream_metrics(qm)
     from ..obs.history import maybe_record
     maybe_record(plan, qm)
@@ -508,11 +547,13 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
                     counter("stream.donation.miss").inc()
                     _tinstant("stream.donation.miss", cat="stream",
                               lane=lane, batch=bi)
+                acct.live.donation(reclaimed)
                 acct.dispatch_s += _time.perf_counter() - t0
                 pending.append(("exec", bound_holder[0], out_cols, sel, bi))
         while len(pending) > k:
             yield drain_oldest()
         depth = sum(1 for e in pending if e[0] == "exec")
+        acct.live.set_inflight(depth)
         if depth > acct.peak_inflight:
             acct.peak_inflight = depth
             inflight_gauge.set(depth)
@@ -653,6 +694,7 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             counter("stream.donation.miss").inc()
             _tinstant("stream.donation.miss", cat="stream", lane=lane,
                       batch=bi)
+        acct.live.donation(reclaimed)
         merge = stream_combine()
         i = 0
         while i < len(levels) and levels[i] is not None:
@@ -672,6 +714,7 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             levels[i] = acc
         acct.dispatch_s += _time.perf_counter() - t0
         since_block += 1
+        acct.live.set_inflight(since_block)
         if since_block > acct.peak_inflight:
             acct.peak_inflight = since_block
             inflight_gauge.set(since_block)

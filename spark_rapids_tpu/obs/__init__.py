@@ -14,53 +14,78 @@ span events on per-batch/per-shard lanes exported as Chrome-trace JSON
 into the per-plan **cost ledger** (compute/ici/host_sync/
 dispatch_overhead buckets + HBM footprint — the ``cost`` block of every
 QueryMetrics), and :mod:`.regress` gates fresh ledgers against the
-history baseline (``SRT_REGRESS_TOL``).
+history baseline (``SRT_REGRESS_TOL``).  :mod:`.live` is the in-flight
+side — a live-query registry every execution path heartbeats into —
+and :mod:`.server` exports it over HTTP (Prometheus ``/metrics``, JSON
+``/queries``, mid-run Chrome traces) behind ``SRT_LIVE_SERVER=1``;
+``python -m spark_rapids_tpu.obs top`` renders it as a console table.
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
-in the XLA stack.
+in the XLA stack.  This ``__init__`` resolves submodules and names
+LAZILY (PEP 562 ``__getattr__``): ``import spark_rapids_tpu.obs`` loads
+none of the pillars until one is touched, so the live server and the
+``top`` renderer stay out of processes that never observe anything.
 """
 
-from . import history, profile, regress, timeline
-from .history import load as load_history, plan_fingerprint
-from .metrics import (NULL_METRIC, Counter, Gauge, MetricsRegistry, Timer,
-                      counter, counters_delta, gauge, registry, timer)
-from .profile import cost_block
-from .regress import RegressionError
-from .query import (QueryMetrics, StepMetrics, bench_cache_line, bench_line,
-                    bench_metrics_line, bench_recovery_line,
-                    bench_stream_line, last_query_metrics,
-                    last_stream_metrics, set_last_query_metrics,
-                    set_last_stream_metrics)
+from __future__ import annotations
 
-__all__ = [
-    "NULL_METRIC",
-    "Counter",
-    "Gauge",
-    "MetricsRegistry",
-    "QueryMetrics",
-    "StepMetrics",
-    "Timer",
-    "bench_cache_line",
-    "bench_line",
-    "bench_metrics_line",
-    "bench_recovery_line",
-    "bench_stream_line",
-    "RegressionError",
-    "cost_block",
-    "counter",
-    "counters_delta",
-    "gauge",
-    "history",
-    "last_query_metrics",
-    "last_stream_metrics",
-    "load_history",
-    "plan_fingerprint",
-    "profile",
-    "regress",
-    "registry",
-    "set_last_query_metrics",
-    "set_last_stream_metrics",
-    "timeline",
-    "timer",
-]
+import importlib
+
+#: exported name -> (submodule, attribute | None).  None means the name
+#: IS the submodule.
+_LAZY = {
+    "history": ("history", None),
+    "live": ("live", None),
+    "metrics": ("metrics", None),
+    "profile": ("profile", None),
+    "query": ("query", None),
+    "regress": ("regress", None),
+    "server": ("server", None),
+    "timeline": ("timeline", None),
+    "load_history": ("history", "load"),
+    "plan_fingerprint": ("history", "plan_fingerprint"),
+    "NULL_METRIC": ("metrics", "NULL_METRIC"),
+    "Counter": ("metrics", "Counter"),
+    "Gauge": ("metrics", "Gauge"),
+    "MetricsRegistry": ("metrics", "MetricsRegistry"),
+    "Timer": ("metrics", "Timer"),
+    "counter": ("metrics", "counter"),
+    "counters_delta": ("metrics", "counters_delta"),
+    "gauge": ("metrics", "gauge"),
+    "registry": ("metrics", "registry"),
+    "timer": ("metrics", "timer"),
+    "cost_block": ("profile", "cost_block"),
+    "RegressionError": ("regress", "RegressionError"),
+    "NULL_LIVE": ("live", "NULL_LIVE"),
+    "LiveQuery": ("live", "LiveQuery"),
+    "QueryMetrics": ("query", "QueryMetrics"),
+    "StepMetrics": ("query", "StepMetrics"),
+    "bench_cache_line": ("query", "bench_cache_line"),
+    "bench_line": ("query", "bench_line"),
+    "bench_metrics_line": ("query", "bench_metrics_line"),
+    "bench_recovery_line": ("query", "bench_recovery_line"),
+    "bench_stream_line": ("query", "bench_stream_line"),
+    "last_query_metrics": ("query", "last_query_metrics"),
+    "last_stream_metrics": ("query", "last_stream_metrics"),
+    "set_last_query_metrics": ("query", "set_last_query_metrics"),
+    "set_last_stream_metrics": ("query", "set_last_stream_metrics"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    submodule, attr = entry
+    mod = importlib.import_module(f".{submodule}", __name__)
+    value = mod if attr is None else getattr(mod, attr)
+    globals()[name] = value        # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

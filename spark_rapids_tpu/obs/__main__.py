@@ -1,0 +1,140 @@
+"""``python -m spark_rapids_tpu.obs top`` — htop-style live query view.
+
+Polls the in-process live registry (obs/live.py) or, with ``--url``, a
+remote exporter's ``/queries`` endpoint (obs/server.py) and redraws a
+console table of in-flight queries: phase, batches done / in-flight,
+rows/sec, ICI bytes, last recovery rung, and one progress bar per shard.
+``--once`` prints a single frame (scripts, CI, docs); default is a 1 Hz
+refresh until Ctrl-C.
+
+Rendering is a pure function of the ``/queries`` JSON payload
+(:func:`render_top`), so tests drive it with synthetic snapshots and the
+remote and local paths share one code path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import List, Optional
+
+_BAR_WIDTH = 24
+
+
+def _human(n: float) -> str:
+    for unit in ("", "K", "M", "G", "T"):
+        if abs(n) < 1000:
+            return f"{n:.0f}{unit}" if unit else f"{n:.0f}"
+        n /= 1000.0
+    return f"{n:.0f}P"
+
+
+def _bar(done: int, total: int, width: int = _BAR_WIDTH) -> str:
+    if total <= 0:
+        return "[" + "·" * width + "]"
+    filled = min(width, int(round(width * done / total)))
+    return "[" + "#" * filled + "-" * (width - filled) + "]"
+
+
+def _fmt_query(q: dict) -> List[str]:
+    eta = q.get("eta_seconds")
+    lines = [
+        "  q{qid:<5} {mode:<12} {phase:<12} {elapsed:>8.1f}s "
+        "{done:>5}/{total:<5} inflight={inflight:<2} "
+        "{rps:>9} rows/s  ici={ici:>6}B  hbm={hbm:>6}B{eta}".format(
+            qid=q["query_id"], mode=q["mode"], phase=q["phase"],
+            elapsed=q["elapsed_seconds"], done=q["batches_done"],
+            total=q["total_batches"] or "?", inflight=q["inflight"],
+            rps=_human(q["rows_per_sec"]), ici=_human(q["ici_bytes"]),
+            hbm=_human(q["hbm_peak_bytes"]),
+            eta=f"  eta={eta:.0f}s" if eta else "")]
+    rung = q["recovery"]["last_rung"]
+    if rung:
+        lines.append(f"         recovery: {rung} "
+                     f"({q['recovery']['count']} rungs)")
+    shard_batches = q.get("shard_batches") or {}
+    if shard_batches:
+        total = max(q["batches_in"], max(shard_batches.values()), 1)
+        for shard, done in sorted(shard_batches.items(),
+                                  key=lambda kv: int(kv[0])):
+            lines.append(f"         shard {int(shard):>2} "
+                         f"{_bar(done, total)} {done}/{total}")
+    return lines
+
+
+def render_top(snap: dict, source: str = "local") -> str:
+    """One frame of the ``top`` view from a ``/queries`` payload."""
+    in_flight = snap.get("in_flight", [])
+    recent = snap.get("recent", [])
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(snap.get("unix_time", time.time())))
+    lines = [f"srt top — {source} pid={snap.get('pid', '?')} {ts}  "
+             f"running={len(in_flight)} recent={len(recent)}"]
+    if in_flight:
+        lines.append("in-flight:")
+        for q in in_flight:
+            lines.extend(_fmt_query(q))
+    else:
+        lines.append("in-flight: (none)")
+    if recent:
+        lines.append("recent:")
+        for q in recent[-8:]:
+            lines.append(
+                "  q{qid:<5} {mode:<12} {status:<8} {elapsed:>8.1f}s "
+                "{batches:>5} batches {rows:>10} rows out".format(
+                    qid=q["query_id"], mode=q["mode"], status=q["status"],
+                    elapsed=q["elapsed_seconds"],
+                    batches=q["batches_done"], rows=q["rows_out"]))
+    return "\n".join(lines)
+
+
+def _fetch(url: str) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/queries",
+                                timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _snapshot(url: Optional[str]) -> dict:
+    if url is not None:
+        return _fetch(url)
+    from . import live
+    return live.snapshot_all()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.obs",
+        description="Console views over the live-query registry.")
+    sub = parser.add_subparsers(dest="command")
+    top = sub.add_parser("top", help="htop-style live query table")
+    top.add_argument("--url", default=None,
+                     help="remote exporter base URL (e.g. "
+                          "http://127.0.0.1:9465); default: the local "
+                          "in-process registry")
+    top.add_argument("--interval", type=float, default=1.0,
+                     help="refresh period in seconds (default 1.0)")
+    top.add_argument("--once", action="store_true",
+                     help="print one frame and exit")
+    args = parser.parse_args(argv)
+    if args.command != "top":
+        parser.print_help()
+        return 2
+    source = args.url or "local"
+    try:
+        while True:
+            frame = render_top(_snapshot(args.url), source=source)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,7 +81,10 @@ def record(plan: Any, qm: Any, path: str) -> dict:
     history file interleave whole-line (POSIX appends are atomic for one
     write), never torn mid-record.  The in-process lock only serializes
     threads of this process."""
-    rec = {"fingerprint": plan_fingerprint(plan), **qm.to_dict()}
+    # The computed fingerprint is authoritative: it overwrites the
+    # to_dict() copy (qm.fingerprint may be "" when the producer never
+    # had the plan), so history records always key correctly.
+    rec = {**qm.to_dict(), "fingerprint": plan_fingerprint(plan)}
     data = (json.dumps(rec, sort_keys=True) + "\n").encode()
     with _LOCK:
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
@@ -143,8 +146,13 @@ def maybe_record(plan: Any, qm: Any) -> Optional[dict]:
 
 
 def load(fingerprint: Optional[str] = None,
-         path: Optional[str] = None) -> List[dict]:
-    """Read history records (all, or just one plan's).
+         path: Optional[str] = None,
+         query_id: Optional[int] = None) -> List[dict]:
+    """Read history records (all, one plan's, or one query's).
+
+    ``query_id`` filters on the same correlation id the live registry
+    snapshots and timeline span args carry, so a ``/queries`` scrape or
+    a Chrome trace joins to its persisted record with one call.
 
     ``path`` defaults to ``SRT_METRICS_HISTORY``.  Returns ``[]`` when the
     sink is unset or the file does not exist yet — the optimizer's
@@ -176,8 +184,12 @@ def load(fingerprint: Optional[str] = None,
             if not isinstance(rec, dict):
                 skipped += 1
                 continue
-            if fingerprint is None or rec.get("fingerprint") == fingerprint:
-                out.append(rec)
+            if fingerprint is not None \
+                    and rec.get("fingerprint") != fingerprint:
+                continue
+            if query_id is not None and rec.get("query_id") != query_id:
+                continue
+            out.append(rec)
     _LOAD_SKIPPED = skipped
     if skipped:
         from .metrics import counter

@@ -1,0 +1,451 @@
+"""Live-query registry — in-flight heartbeat state for running queries.
+
+Every observability layer before this one (QueryMetrics, the span
+timeline, the history sink, the cost ledger) is post-hoc: a long
+dist_stream sweep gives zero signal until it finishes.  This module is
+the live side — each execution path (``run_plan``, ``analyze_plan``,
+``run_plan_stream``, ``run_plan_dist``, ``run_plan_dist_stream``)
+registers a :class:`LiveQuery` at start and publishes heartbeat state as
+it executes: phase, batches completed / in-flight per shard, live rows,
+ICI bytes, donation hits, recovery rungs taken, HBM occupancy, and
+elapsed + rows/sec.  The serving layer's admission control (ROADMAP open
+item 2) and the ``/queries`` endpoint of obs/server.py both read the
+same snapshots.
+
+Contract (mirrors obs/metrics.py):
+
+* **off (default)** — with ``SRT_METRICS`` unset, :func:`start` hands
+  back the ONE shared :data:`NULL_LIVE` record whose methods do nothing;
+  executors pay one env read per *query*, never per batch or row.  An
+  explicit progress observer (``Plan.run(progress=...)``,
+  ``run_plan_stream(on_progress=...)``) opts a single query in without
+  the env flag.
+* **on** — updates are plain attribute writes on the record (GIL-atomic
+  increments, no lock on the hot path); the registry lock is taken only
+  at query start/finish and by snapshot readers.  Readers may observe a
+  heartbeat mid-update — snapshots are monitoring data, not a ledger.
+* jax-free at module load (tests/test_import_hygiene.py), like the rest
+  of ``obs``.
+
+The publishing helpers (:func:`phase`, :func:`rung`, :func:`add_ici`,
+:func:`note_hbm`) act on the *current* query of the calling thread — a
+thread-local stack maintained by :func:`start`/:meth:`LiveQuery.finish`
+— so deep layers (the recovery ladder, the mesh ICI accountant, the HBM
+sampler) publish without any record plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..config import metrics_enabled
+
+#: Finished queries kept for the ``/queries`` "recent" list.
+RECENT_KEEP = 32
+#: Recovery rungs kept per live record (newest last).
+RUNG_KEEP = 16
+
+_LOCK = threading.Lock()
+_ACTIVE: "OrderedDict[int, LiveQuery]" = OrderedDict()
+_RECENT: deque = deque(maxlen=RECENT_KEEP)
+_TLS = threading.local()
+
+
+class _NullLiveQuery:
+    """Shared do-nothing record handed out while ``SRT_METRICS`` is
+    unset (and no observer asked for progress).  Duck-types
+    :class:`LiveQuery`; all mutators discard, :meth:`snapshot` is ``{}``."""
+
+    __slots__ = ()
+
+    query_id = 0
+    fingerprint = ""
+
+    def set_phase(self, name: str) -> None:
+        pass
+
+    def batch_in(self, rows: int = 0) -> None:
+        pass
+
+    def batch_out(self, rows: int = 0) -> None:
+        pass
+
+    def set_inflight(self, depth: int) -> None:
+        pass
+
+    def set_shards(self, n: int) -> None:
+        pass
+
+    def shard_batches_done(self, shards: int = 1) -> None:
+        pass
+
+    def donation(self, hit: bool) -> None:
+        pass
+
+    def add_ici(self, nbytes: int) -> None:
+        pass
+
+    def set_live_rows(self, rows: int) -> None:
+        pass
+
+    def set_rows(self, rows_in: Optional[int] = None,
+                 rows_out: Optional[int] = None) -> None:
+        pass
+
+    def set_total_batches(self, n: int) -> None:
+        pass
+
+    def rung(self, step: str, site: str = "") -> None:
+        pass
+
+    def note_hbm(self, peak_bytes: int) -> None:
+        pass
+
+    def finish(self, status: str = "done", error: Optional[str] = None,
+               output_rows: Optional[int] = None) -> None:
+        pass
+
+    def snapshot(self) -> dict:
+        return {}
+
+
+#: THE null record — identity-comparable so tests can assert the no-op
+#: contract (``live.start(...) is NULL_LIVE`` when metrics are off).
+NULL_LIVE = _NullLiveQuery()
+
+
+class LiveQuery:
+    """One in-flight query's heartbeat state.
+
+    Mutators are single attribute writes / increments — no lock (the GIL
+    makes ``int`` increments atomic enough for monitoring; the registry
+    lock only guards start/finish membership).  ``snapshot()`` renders a
+    JSON-safe dict and is what the server and the ``top`` view consume.
+    """
+
+    __slots__ = ("query_id", "mode", "fingerprint", "phase", "status",
+                 "error", "started_unix", "_t0", "_t_end", "input_rows",
+                 "rows_in", "rows_out", "live_rows", "batches_in",
+                 "batches_done", "total_batches", "inflight",
+                 "peak_inflight", "shards", "shard_done", "ici_bytes",
+                 "donation_hits", "donation_misses", "rungs",
+                 "hbm_peak_bytes", "_observer")
+
+    def __init__(self, query_id: int, mode: str, fingerprint: str = "",
+                 input_rows: int = 0, shards: int = 0,
+                 observer: Optional[Callable[[dict], None]] = None):
+        self.query_id = query_id
+        self.mode = mode
+        self.fingerprint = fingerprint
+        self.phase = "start"
+        self.status = "running"
+        self.error: Optional[str] = None
+        self.started_unix = time.time()
+        self._t0 = time.perf_counter()
+        self._t_end: Optional[float] = None
+        self.input_rows = input_rows
+        self.rows_in = 0
+        self.rows_out = 0
+        self.live_rows = 0
+        self.batches_in = 0
+        self.batches_done = 0
+        self.total_batches = 0
+        self.inflight = 0
+        self.peak_inflight = 0
+        self.shards = shards
+        self.shard_done: Dict[int, int] = {}
+        self.ici_bytes = 0
+        self.donation_hits = 0
+        self.donation_misses = 0
+        self.rungs: deque = deque(maxlen=RUNG_KEEP)
+        self.hbm_peak_bytes = 0
+        self._observer = observer
+
+    # -- publishers (hot path: attribute writes only) --------------------
+
+    def set_phase(self, name: str) -> None:
+        self.phase = name
+        self._notify()
+
+    def batch_in(self, rows: int = 0) -> None:
+        self.batches_in += 1
+        self.rows_in += rows
+
+    def batch_out(self, rows: int = 0) -> None:
+        self.batches_done += 1
+        self.rows_out += rows
+        self._notify()
+
+    def set_inflight(self, depth: int) -> None:
+        self.inflight = depth
+        if depth > self.peak_inflight:
+            self.peak_inflight = depth
+
+    def set_shards(self, n: int) -> None:
+        self.shards = n
+        for s in range(n):
+            self.shard_done.setdefault(s, 0)
+
+    def shard_batches_done(self, shards: int = 1) -> None:
+        """One batch finished on each of the first ``shards`` shards
+        (SPMD dispatch runs every batch on every shard)."""
+        done = self.shard_done
+        for s in range(shards):
+            done[s] = done.get(s, 0) + 1
+
+    def donation(self, hit: bool) -> None:
+        if hit:
+            self.donation_hits += 1
+        else:
+            self.donation_misses += 1
+
+    def add_ici(self, nbytes: int) -> None:
+        self.ici_bytes += int(nbytes)
+
+    def set_live_rows(self, rows: int) -> None:
+        self.live_rows = int(rows)
+
+    def set_rows(self, rows_in: Optional[int] = None,
+                 rows_out: Optional[int] = None) -> None:
+        if rows_in is not None:
+            self.rows_in = int(rows_in)
+        if rows_out is not None:
+            self.rows_out = int(rows_out)
+
+    def set_total_batches(self, n: int) -> None:
+        """Expected batch count when the caller knows it (benchmarks and
+        bounded feeds) — enables the ETA in :meth:`snapshot`."""
+        self.total_batches = int(n)
+
+    def rung(self, step: str, site: str = "") -> None:
+        self.rungs.append(f"{site}:{step}" if site else step)
+        self._notify()
+
+    def note_hbm(self, peak_bytes: int) -> None:
+        if peak_bytes > self.hbm_peak_bytes:
+            self.hbm_peak_bytes = int(peak_bytes)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def finish(self, status: str = "done", error: Optional[str] = None,
+               output_rows: Optional[int] = None) -> None:
+        if self.status != "running":
+            return
+        self._t_end = time.perf_counter()
+        self.status = status
+        self.error = error
+        if output_rows is not None:
+            self.rows_out = int(output_rows)
+        self.phase = status
+        with _LOCK:
+            _ACTIVE.pop(self.query_id, None)
+            _RECENT.append(self)
+        stack = getattr(_TLS, "stack", None)
+        if stack and self in stack:
+            stack.remove(self)
+        self._notify()
+
+    # -- reading ---------------------------------------------------------
+
+    def elapsed(self) -> float:
+        end = self._t_end if self._t_end is not None else time.perf_counter()
+        return max(end - self._t0, 0.0)
+
+    def snapshot(self) -> dict:
+        elapsed = self.elapsed()
+        rows = self.rows_in or self.input_rows
+        rows_per_sec = rows / elapsed if elapsed > 0 and rows else 0.0
+        eta = None
+        if (self.status == "running" and self.total_batches
+                and self.batches_done):
+            remaining = max(self.total_batches - self.batches_done, 0)
+            eta = round(remaining * (elapsed / self.batches_done), 3)
+        rungs = list(self.rungs)
+        return {
+            "query_id": self.query_id,
+            "fingerprint": self.fingerprint,
+            "mode": self.mode,
+            "phase": self.phase,
+            "status": self.status,
+            "error": self.error,
+            "started_unix": round(self.started_unix, 3),
+            "elapsed_seconds": round(elapsed, 6),
+            "input_rows": self.input_rows,
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+            "live_rows": self.live_rows,
+            "rows_per_sec": round(rows_per_sec, 1),
+            "eta_seconds": eta,
+            "batches_in": self.batches_in,
+            "batches_done": self.batches_done,
+            "total_batches": self.total_batches,
+            "inflight": self.inflight,
+            "peak_inflight": self.peak_inflight,
+            "shards": self.shards,
+            "shard_batches": {str(s): n
+                              for s, n in sorted(self.shard_done.items())},
+            "ici_bytes": self.ici_bytes,
+            "donation_hits": self.donation_hits,
+            "donation_misses": self.donation_misses,
+            "recovery": {"rungs": rungs,
+                         "last_rung": rungs[-1] if rungs else "",
+                         "count": len(rungs)},
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+        }
+
+    def _notify(self) -> None:
+        if self._observer is None:
+            return
+        try:
+            self._observer(self.snapshot())
+        except Exception:        # an observer must never kill the query
+            pass
+
+
+def as_observer(progress: Any) -> Optional[Callable[[dict], None]]:
+    """Normalize a ``progress=`` argument: callables pass through,
+    truthy non-callables mean the stderr one-liner, falsy means None."""
+    if progress is None or progress is False:
+        return None
+    return progress if callable(progress) else print_progress
+
+
+def start(mode: str, plan: Any = None, query_id: Optional[int] = None,
+          input_rows: int = 0, shards: int = 0,
+          observer: Optional[Callable[[dict], None]] = None,
+          force: bool = False,
+          fingerprint: Optional[str] = None) -> Any:
+    """Register a query; returns its :class:`LiveQuery` (or
+    :data:`NULL_LIVE` when telemetry is off and nobody is observing).
+
+    The ONE gate of the zero-cost-off contract: everything downstream is
+    method calls on the returned record.  ``force`` (or a non-None
+    ``observer``) opts this query in regardless of ``SRT_METRICS`` —
+    the explicit-progress surfaces use it.  Pass ``fingerprint`` when the
+    caller already hashed the plan (QueryMetrics producers do) so the
+    plan is not hashed twice.
+    """
+    if not (metrics_enabled() or force or observer is not None):
+        return NULL_LIVE
+    if query_id is None:
+        from .query import next_query_id
+        query_id = next_query_id()
+    if fingerprint is None:
+        fingerprint = ""
+        if plan is not None:
+            from .history import plan_fingerprint
+            fingerprint = plan_fingerprint(plan)
+    lq = LiveQuery(query_id, mode, fingerprint=fingerprint,
+                   input_rows=input_rows, shards=shards, observer=observer)
+    with _LOCK:
+        _ACTIVE[query_id] = lq
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(lq)
+    from ..config import live_server_enabled
+    if live_server_enabled():
+        from . import server
+        server.maybe_start()
+    lq._notify()
+    return lq
+
+
+def current() -> Optional[LiveQuery]:
+    """The calling thread's innermost in-flight query, or None."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+# -- ambient publishers: deep layers (recovery ladder, ICI accountant,
+# HBM sampler) call these without holding a record ----------------------
+
+def phase(name: str) -> None:
+    lq = current()
+    if lq is not None:
+        lq.set_phase(name)
+
+
+def rung(step: str, site: str = "") -> None:
+    lq = current()
+    if lq is not None:
+        lq.rung(step, site)
+
+
+def add_ici(nbytes: int) -> None:
+    lq = current()
+    if lq is not None:
+        lq.add_ici(nbytes)
+
+
+def note_hbm(peak_bytes: int) -> None:
+    lq = current()
+    if lq is not None:
+        lq.note_hbm(peak_bytes)
+
+
+# -- registry reads ------------------------------------------------------
+
+def get(query_id: int) -> Optional[dict]:
+    """Snapshot of one query (in-flight or recent), or None."""
+    with _LOCK:
+        lq = _ACTIVE.get(query_id)
+        if lq is None:
+            for r in _RECENT:
+                if r.query_id == query_id:
+                    lq = r
+                    break
+    return lq.snapshot() if lq is not None else None
+
+
+def snapshot_all() -> dict:
+    """The ``/queries`` payload: in-flight and recently finished queries,
+    newest last, plus the publishing process's identity."""
+    with _LOCK:
+        active = list(_ACTIVE.values())
+        recent = list(_RECENT)
+    return {
+        "pid": os.getpid(),
+        "unix_time": round(time.time(), 3),
+        "in_flight": [lq.snapshot() for lq in active],
+        "recent": [lq.snapshot() for lq in recent],
+    }
+
+
+def reset() -> None:
+    """Drop all live/recent records (test isolation)."""
+    with _LOCK:
+        _ACTIVE.clear()
+        _RECENT.clear()
+    _TLS.stack = []
+
+
+def print_progress(snap: dict) -> None:
+    """The ``progress=True`` observer: one overwriting stderr line per
+    heartbeat."""
+    if not snap:
+        return
+    sys.stderr.write(
+        "\r[q{qid} {mode}] {phase:<12} {done}/{total} batches "
+        "{rows:,} rows {rps:,.0f} rows/s {elapsed:.1f}s {rung}".format(
+            qid=snap["query_id"], mode=snap["mode"], phase=snap["phase"],
+            done=snap["batches_done"],
+            total=snap["total_batches"] or "?",
+            rows=snap["rows_in"] or snap["input_rows"],
+            rps=snap["rows_per_sec"], elapsed=snap["elapsed_seconds"],
+            rung=snap["recovery"]["last_rung"]))
+    if snap["status"] != "running":
+        sys.stderr.write("\n")
+    sys.stderr.flush()
+
+
+__all__: List[str] = [
+    "LiveQuery", "NULL_LIVE", "RECENT_KEEP", "RUNG_KEEP", "add_ici",
+    "as_observer", "current", "get", "note_hbm", "phase",
+    "print_progress", "reset", "rung", "snapshot_all", "start",
+]

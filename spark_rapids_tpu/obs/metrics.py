@@ -239,6 +239,24 @@ class MetricsRegistry:
                 out[name] = m.value
         return out
 
+    def typed_snapshot(self) -> Dict[str, tuple]:
+        """``{name: (kind, value)}`` with the metric kind preserved —
+        ``("counter", int)``, ``("gauge", number)``, or ``("timer",
+        (total_seconds, count))``.  The Prometheus exposition layer
+        (obs/server.py) maps kinds onto ``# TYPE`` lines; the flat
+        :meth:`snapshot` stays the bench payload."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, tuple] = {}
+        for name, m in items:
+            if isinstance(m, Timer):
+                out[name] = ("timer", (m.total_seconds, m.count))
+            elif isinstance(m, Counter):
+                out[name] = ("counter", m.value)
+            else:
+                out[name] = ("gauge", m.value)
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()

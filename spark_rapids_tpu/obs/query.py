@@ -77,6 +77,12 @@ class StepMetrics:
 class QueryMetrics:
     """End-to-end accounting for one plan execution."""
     query_id: int = 0
+    #: stable plan fingerprint (obs/history.plan_fingerprint) — the same
+    #: correlation key the live registry, timeline span args, and the
+    #: history sink carry, so a scrape, a trace, and a history line all
+    #: join on (query_id, fingerprint).  "" when the producer had no
+    #: plan in hand.
+    fingerprint: str = ""
     mode: str = "run"                  # run | analyze | dist | stream
     input_rows: int = 0
     input_columns: int = 0
@@ -168,9 +174,12 @@ class QueryMetrics:
             # v5: added the always-present "cost" ledger block.
             # v6: "stream" gained the sharded-stream fields (shards,
             #     merge_collectives, ici_bytes, syncs_avoided).
-            "schema_version": 6,
+            # v7: added "fingerprint" (the live-telemetry correlation
+            #     key shared with obs/live.py and timeline span args).
+            "schema_version": 7,
             "metric": "query_metrics",
             "query_id": self.query_id,
+            "fingerprint": self.fingerprint,
             "mode": self.mode,
             "input": {"rows": self.input_rows,
                       "columns": self.input_columns},

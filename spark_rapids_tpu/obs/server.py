@@ -1,0 +1,260 @@
+"""Live-telemetry HTTP exporter — Prometheus `/metrics` + `/queries`.
+
+A stdlib :mod:`http.server` daemon thread (no third-party exporter
+dependency) that publishes the observability state of this process while
+queries are still running:
+
+``/metrics``
+    Prometheus text exposition (format 0.0.4) of the whole metrics
+    registry (counters, gauges, timers — obs/metrics.py) plus per-query
+    live gauges from the in-flight registry (obs/live.py), including
+    per-shard batch progress.
+``/queries``
+    JSON snapshots of in-flight and recently finished queries keyed by
+    ``query_id`` + plan fingerprint (``obs.live.snapshot_all()``).
+``/queries/<id>/timeline``
+    Chrome-trace JSON of a *still-running* query: recorded events whose
+    span args carry that ``query_id``, plus a non-destructive render of
+    still-open spans marked ``incomplete`` (obs/timeline.py) — load it
+    in Perfetto mid-run.
+
+Enable with ``SRT_LIVE_SERVER=1`` (port via ``SRT_LIVE_PORT``, default
+9465, ``0`` = ephemeral); the first metered query start spins the server
+up (obs/live.py), or call :func:`start` directly.  Binds 127.0.0.1 —
+front it with a real proxy before exposing it beyond the host.  jax-free
+at import like the rest of ``obs``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..config import live_server_port
+
+_NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]")
+_TIMELINE_RE = re.compile(r"^/queries/(\d+)/timeline$")
+
+
+def metric_name(name: str) -> str:
+    """Registry name → Prometheus metric name (``srt_`` prefixed;
+    anything outside ``[a-zA-Z0-9_:]`` becomes ``_``)."""
+    return "srt_" + _NAME_SUB.sub("_", name)
+
+
+def escape_label_value(value: object) -> str:
+    """Label-value escaping per the exposition format: backslash, double
+    quote, and newline must be escaped; everything else passes through."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_value(value: object) -> str:
+    """Sample-value rendering: ``NaN`` / ``+Inf`` / ``-Inf`` spelled the
+    way Prometheus parsers expect, ints without a decimal point."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "+Inf" if value > 0 else "-Inf"
+        return repr(value)
+    return str(value)
+
+
+def _render_labels(labels: Dict[str, object]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+#: family name -> (type, [(labels, value), ...]); insertion-ordered so
+#: every sample of a family stays under its one ``# TYPE`` line, as the
+#: exposition format requires.
+_Families = Dict[str, Tuple[str, List[Tuple[Dict[str, object], object]]]]
+
+
+def _add(fam: _Families, name: str, kind: str,
+         labels: Dict[str, object], value: object) -> None:
+    entry = fam.get(name)
+    if entry is None:
+        entry = fam[name] = (kind, [])
+    entry[1].append((labels, value))
+
+
+def prometheus_text() -> str:
+    """The ``/metrics`` body: registry metrics + live-query gauges."""
+    from . import live
+    from .metrics import registry
+
+    fam: _Families = {}
+    for name, (kind, value) in sorted(registry().typed_snapshot().items()):
+        base = metric_name(name)
+        if kind == "counter":
+            _add(fam, base + "_total", "counter", {}, value)
+        elif kind == "timer":
+            total_seconds, count = value
+            _add(fam, base + "_seconds_total", "counter", {}, total_seconds)
+            _add(fam, base + "_calls_total", "counter", {}, count)
+        else:
+            _add(fam, base, "gauge", {}, value)
+
+    snap = live.snapshot_all()
+    _add(fam, "srt_live_queries", "gauge", {}, len(snap["in_flight"]))
+    for q in snap["in_flight"]:
+        labels = {"query_id": q["query_id"], "mode": q["mode"],
+                  "fingerprint": q["fingerprint"]}
+        for suffix, key in (
+                ("elapsed_seconds", "elapsed_seconds"),
+                ("batches_done", "batches_done"),
+                ("batches_in", "batches_in"),
+                ("inflight", "inflight"),
+                ("rows_in", "rows_in"),
+                ("rows_out", "rows_out"),
+                ("live_rows", "live_rows"),
+                ("rows_per_sec", "rows_per_sec"),
+                ("ici_bytes", "ici_bytes"),
+                ("donation_hits", "donation_hits"),
+                ("recovery_rungs", None),
+                ("hbm_peak_bytes", "hbm_peak_bytes")):
+            value = (q["recovery"]["count"] if key is None else q[key])
+            _add(fam, f"srt_live_query_{suffix}", "gauge", labels, value)
+        for shard, done in q["shard_batches"].items():
+            _add(fam, "srt_live_query_shard_batches", "gauge",
+                 {"query_id": q["query_id"], "shard": shard}, done)
+
+    lines: List[str] = []
+    for name, (kind, samples) in fam.items():
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in samples:
+            lines.append(f"{name}{_render_labels(labels)} "
+                         f"{format_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def query_timeline(query_id: int) -> Optional[dict]:
+    """Chrome-trace payload for one (possibly still-running) query.
+
+    Recorded events filtered to span args carrying ``query_id`` (lane
+    metadata kept so tids render as names), plus a *non-destructive*
+    snapshot of still-open spans marked ``incomplete``.  None when the
+    query left no events and the live registry has never seen it.
+    """
+    from . import live, timeline
+    evs = timeline.events() + timeline.open_span_events()
+    picked = [e for e in evs
+              if e.get("ph") == "M"
+              or e.get("args", {}).get("query_id") == query_id]
+    if (all(e.get("ph") == "M" for e in picked)
+            and live.get(query_id) is None):
+        return None
+    return {"displayTimeUnit": "ms", "traceEvents": picked}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):        # no access-log noise
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        from . import live
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, prometheus_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+                return
+            if path == "/queries":
+                body = json.dumps(live.snapshot_all(), sort_keys=True)
+                self._send(200, body.encode(), "application/json")
+                return
+            m = _TIMELINE_RE.match(path)
+            if m:
+                payload = query_timeline(int(m.group(1)))
+                if payload is None:
+                    self._send(404, b'{"error": "unknown query_id"}',
+                               "application/json")
+                    return
+                self._send(200, json.dumps(payload, sort_keys=True).encode(),
+                           "application/json")
+                return
+            self._send(404, b'{"error": "not found"}', "application/json")
+        except BrokenPipeError:
+            pass
+
+
+class LiveTelemetryServer:
+    """The exporter: a ThreadingHTTPServer on a daemon thread."""
+
+    def __init__(self, port: Optional[int] = None, host: str = "127.0.0.1"):
+        if port is None:
+            port = live_server_port()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="srt-live-server",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_SERVER: Optional[LiveTelemetryServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def start(port: Optional[int] = None) -> LiveTelemetryServer:
+    """Start (or return) the process-global exporter."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            _SERVER = LiveTelemetryServer(port=port)
+        return _SERVER
+
+
+def maybe_start() -> Optional[LiveTelemetryServer]:
+    """Start the exporter iff ``SRT_LIVE_SERVER=1`` — the hook query
+    starts call (one flag read; idempotent once running)."""
+    from ..config import live_server_enabled
+    if not live_server_enabled():
+        return None
+    return start()
+
+
+def get() -> Optional[LiveTelemetryServer]:
+    """The running exporter, or None."""
+    return _SERVER
+
+
+def stop() -> None:
+    """Stop the process-global exporter (test isolation)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None

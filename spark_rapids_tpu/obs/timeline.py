@@ -44,6 +44,7 @@ _LANES: Dict[str, int] = {}      # lane name -> tid (stable per process)
 _FORCED = 0                      # nesting depth of recording() scopes
 _OPEN: Dict[int, "_Span"] = {}   # id(span) -> still-open spans, in
                                  # creation order (export-time flush)
+_TLS = threading.local()         # per-thread query_id scope stack
 
 
 def now_us() -> float:
@@ -61,6 +62,50 @@ def _coerce(value: Any) -> Any:
     if value is None or isinstance(value, (bool, int, float, str)):
         return value
     return repr(value)
+
+
+def current_query_id() -> Optional[int]:
+    """The innermost :func:`query_scope` id on this thread, or None."""
+    stack = getattr(_TLS, "qstack", None)
+    return stack[-1] if stack else None
+
+
+def _stamp_query(args: Dict[str, Any]) -> Dict[str, Any]:
+    """Attach the ambient query id so every span/instant correlates with
+    its QueryMetrics record, live snapshot, and history line.  Explicit
+    ``query_id`` args win."""
+    qid = current_query_id()
+    if qid is not None and "query_id" not in args:
+        args["query_id"] = qid
+    return args
+
+
+class _QueryScope:
+    __slots__ = ("_qid",)
+
+    def __init__(self, qid: int):
+        self._qid = qid
+
+    def __enter__(self) -> "_QueryScope":
+        stack = getattr(_TLS, "qstack", None)
+        if stack is None:
+            stack = _TLS.qstack = []
+        stack.append(self._qid)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        stack = getattr(_TLS, "qstack", None)
+        if stack:
+            stack.pop()
+        return None
+
+
+def query_scope(query_id: int) -> _QueryScope:
+    """Context manager: events recorded on this thread inside the scope
+    get ``query_id`` stamped into their args (the correlation key shared
+    with QueryMetrics, the live registry, and the history sink).  Nests;
+    the execution paths open one scope per query."""
+    return _QueryScope(query_id)
 
 
 def _lane_tid(lane: Optional[str]) -> int:
@@ -98,7 +143,8 @@ def add_complete(name: str, cat: str, start_us: float, dur_us: float,
             "name": name, "cat": cat, "ph": "X", "pid": _PID,
             "tid": _lane_tid(lane), "ts": round(start_us, 3),
             "dur": round(max(dur_us, 0.0), 3),
-            "args": {k: _coerce(v) for k, v in args.items()},
+            "args": _stamp_query(
+                {k: _coerce(v) for k, v in args.items()}),
         })
 
 
@@ -112,7 +158,8 @@ def instant(name: str, cat: str = "engine", lane: Optional[str] = None,
         _EVENTS.append({
             "name": name, "cat": cat, "ph": "i", "pid": _PID,
             "tid": _lane_tid(lane), "ts": round(now_us(), 3), "s": "t",
-            "args": {k: _coerce(v) for k, v in args.items()},
+            "args": _stamp_query(
+                {k: _coerce(v) for k, v in args.items()}),
         })
 
 
@@ -123,7 +170,11 @@ class _Span:
 
     def __init__(self, name: str, cat: str, lane: Optional[str],
                  args: Dict[str, Any]):
-        self.name, self.cat, self.lane, self.args = name, cat, lane, args
+        # Stamp at creation: a span may end on another thread or after
+        # its query scope popped (async drains), and the flush paths
+        # bypass add_complete.
+        self.name, self.cat, self.lane = name, cat, lane
+        self.args = _stamp_query(args)
         self._t0 = now_us()
         self._done = False
         with _LOCK:
@@ -224,6 +275,29 @@ def flush_open_spans() -> int:
             })
             n += 1
     return n
+
+
+def open_span_events(now: Optional[float] = None) -> List[dict]:
+    """Render still-open spans as ``incomplete`` ``X`` events WITHOUT
+    closing them — the live ``/queries/<id>/timeline`` endpoint's view
+    of a running query.  Unlike :func:`flush_open_spans` this mutates
+    nothing: the spans stay open and will still record their real end.
+    """
+    if now is None:
+        now = now_us()
+    out: List[dict] = []
+    with _LOCK:
+        for s in list(_OPEN.values()):
+            if s._done:
+                continue
+            args = {k: _coerce(v) for k, v in s.args.items()}
+            args["incomplete"] = True
+            out.append({
+                "name": s.name, "cat": s.cat, "ph": "X", "pid": _PID,
+                "tid": _lane_tid(s.lane), "ts": round(s._t0, 3),
+                "dur": round(max(now - s._t0, 0.0), 3), "args": args,
+            })
+    return out
 
 
 def export_chrome_trace(path: Optional[str] = None,
@@ -369,4 +443,10 @@ def validate_chrome_trace(payload: dict, schema: dict) -> List[str]:
             errors.append(f"{label}: dur must be a non-negative number")
         if not isinstance(ev.get("args"), dict):
             errors.append(f"{label}: args must be an object")
+            continue
+        corr = schema.get("correlation_arg")
+        if (corr and corr in ev["args"]
+                and not isinstance(ev["args"][corr], int)):
+            errors.append(f"{label}: args[{corr!r}] must be an int "
+                          f"query id, got {ev['args'][corr]!r}")
     return errors

@@ -58,10 +58,12 @@ def record_ici(nbytes: int, seconds: float = 0.0,
     wall the caller attributes to the exchange; the 1-microsecond floor
     keeps a ran-collective visible in the cost ledger even when the
     caller could not isolate its wall."""
+    from ..obs import live as _live
     from ..obs.metrics import counter
     counter("ici.us").inc(max(1, int(seconds * 1e6)))
     counter("ici.bytes").inc(int(nbytes))
     counter("ici.collectives").inc(int(collectives))
+    _live.add_ici(int(nbytes))
 
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; accept
 # both so the distributed layer runs on every jax the engine supports.

@@ -98,6 +98,7 @@ def oom_ladder(site: str, fn: Callable,
         if category not in (CATEGORY_OOM, CATEGORY_COMPILE):
             raise
         original = exc
+    from ..obs import live as _live
     from ..obs.timeline import instant, span
     if policy is None:
         policy = RetryPolicy.from_env()
@@ -107,6 +108,7 @@ def oom_ladder(site: str, fn: Callable,
         with span("recovery.drain", cat="resilience", site=site):
             drain()
         summary.steps.append("drain-inflight")
+        _live.rung("drain-inflight", site=site)
     for attempt in range(policy.max_retries):
         dropped = evict_device_caches()
         if dist:
@@ -115,6 +117,7 @@ def oom_ladder(site: str, fn: Callable,
         summary.steps.append(f"evict-caches[{dropped}]")
         instant("recovery.evict_caches", cat="resilience", site=site,
                 dropped=dropped, attempt=attempt)
+        _live.rung("evict-caches", site=site)
         delay = policy.delay(attempt)
         if delay > 0:
             with span("recovery.backoff", cat="resilience", site=site,
@@ -129,6 +132,7 @@ def oom_ladder(site: str, fn: Callable,
         summary.steps.append("retry")
         instant("recovery.retry", cat="resilience", site=site,
                 category=category, attempt=attempt)
+        _live.rung("retry", site=site)
         try:
             return fn()
         except Exception as exc:

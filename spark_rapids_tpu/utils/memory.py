@@ -228,7 +228,8 @@ def sample_device_hbm(tag: str = "") -> list:
         peak_max = max(peak_max, entry["peak_bytes"])
     gauge("hbm.bytes_in_use").set(in_use_max)
     gauge("hbm.peak").set(peak_max)
-    from ..obs import profile
+    from ..obs import live, profile
+    live.note_hbm(peak_max)
     profile.note_hbm(samples)
     from ..obs.timeline import instant
     instant("hbm.sample", cat="memory", tag=tag,

@@ -216,6 +216,46 @@ def test_profile_and_regress_import_without_jax(tmp_path):
     assert "jaxfree" in out.stdout
 
 
+def test_live_and_server_import_without_jax():
+    """``obs.live`` and ``obs.server`` must work without jax: the live
+    registry is host-side bookkeeping and the exporter renders text/JSON,
+    so a monitoring sidecar (or ``python -m spark_rapids_tpu.obs top``)
+    never pays for the XLA stack.  With ``SRT_METRICS`` unset and nobody
+    observing, ``live.start`` must hand back the shared null record."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.live as live\n"
+        "import spark_rapids_tpu.obs.server as server\n"
+        "import spark_rapids_tpu.obs.__main__ as top\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.live/server pulled in jax'\n"
+        "assert live.start('run') is live.NULL_LIVE  # SRT_METRICS unset\n"
+        "assert live.snapshot_all()['in_flight'] == []\n"
+        "lq = live.start('run', force=True)\n"
+        "lq.batch_out(10)\n"
+        "text = server.prometheus_text()\n"
+        "assert 'srt_live_queries 1' in text, text\n"
+        "frame = top.render_top(live.snapshot_all(), source='test')\n"
+        "assert 'running=1' in frame, frame\n"
+        "lq.finish()\n"
+        "assert 'jax' not in sys.modules, 'live telemetry pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    env.pop("SRT_METRICS", None)
+    env.pop("SRT_LIVE_SERVER", None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

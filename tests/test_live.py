@@ -1,0 +1,438 @@
+"""Live-query telemetry contracts (obs/live.py, obs/server.py).
+
+Four contracts:
+
+1. **Zero-cost when off** — with ``SRT_METRICS`` unset and nobody
+   observing, every execution path gets the shared ``NULL_LIVE`` record
+   (identity-checked) and the registry stays empty.
+2. **Heartbeats when on** — metered runs and streams appear in the
+   in-flight registry while executing and move to the recent ring at
+   finish; ``on_progress`` / ``progress=`` callbacks fire even without
+   ``SRT_METRICS``; recovery rungs and per-shard progress publish live.
+3. **Valid exposition** — ``/metrics`` is parseable Prometheus text
+   0.0.4 under label escaping, NaN/±Inf values, and concurrent scrapes
+   mid-stream; counters stay monotonic across device-cache evictions.
+4. **Correlation** — timeline span args and history JSONL rows carry the
+   same ``query_id`` the live snapshot uses.
+"""
+
+import json
+import math
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.exec.stream import run_plan_stream
+from spark_rapids_tpu.obs import live, server
+from spark_rapids_tpu.obs.metrics import counter, gauge, registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_live(monkeypatch):
+    monkeypatch.delenv("SRT_LIVE_SERVER", raising=False)
+    monkeypatch.delenv("SRT_LIVE_PORT", raising=False)
+    live.reset()
+    yield
+    server.stop()
+    live.reset()
+    registry().reset()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+@pytest.fixture
+def metrics_off(monkeypatch):
+    monkeypatch.delenv("SRT_METRICS", raising=False)
+
+
+def _table(prefix, n=400):
+    return Table.from_pydict({
+        f"{prefix}_k": (np.arange(n) % 5).astype(np.int32),
+        f"{prefix}_v": np.arange(n, dtype=np.float32),
+    })
+
+
+def _query(prefix):
+    return (plan()
+            .filter(col(f"{prefix}_v") > 10.0)
+            .with_columns(**{f"{prefix}_d": col(f"{prefix}_v") * 2.0}))
+
+
+def _batches(prefix, n=4, rows=128):
+    for i in range(n):
+        yield Table.from_pydict({
+            f"{prefix}_k": (np.arange(rows) % 5).astype(np.int32),
+            f"{prefix}_v": np.arange(rows, dtype=np.float32) + i,
+        })
+
+
+# ---------------------------------------------------------------------------
+# 1. zero-cost-off contract
+# ---------------------------------------------------------------------------
+
+def test_start_returns_null_record_when_off(metrics_off):
+    assert live.start("run") is live.NULL_LIVE
+    # the null record swallows the whole publishing API
+    live.NULL_LIVE.set_phase("x")
+    live.NULL_LIVE.batch_out(5)
+    live.NULL_LIVE.rung("retry", site="bind")
+    live.NULL_LIVE.finish()
+    assert live.NULL_LIVE.snapshot() == {}
+    assert live.snapshot_all()["in_flight"] == []
+
+
+def test_disabled_run_leaves_registry_empty(metrics_off):
+    t = _table("loff")
+    _query("loff").run(t)
+    snap = live.snapshot_all()
+    assert snap["in_flight"] == [] and snap["recent"] == []
+
+
+def test_ambient_publishers_noop_without_record(metrics_off):
+    # must not raise (the recovery ladder calls these unconditionally)
+    live.phase("bind")
+    live.rung("retry", site="dispatch")
+    live.add_ici(1024)
+    live.note_hbm(1 << 20)
+    assert live.current() is None
+
+
+# ---------------------------------------------------------------------------
+# 2. heartbeats when on
+# ---------------------------------------------------------------------------
+
+def test_metered_run_lands_in_recent_ring(metrics_on):
+    t = _table("lrec")
+    _query("lrec").run(t)
+    snap = live.snapshot_all()
+    assert snap["in_flight"] == []
+    assert len(snap["recent"]) == 1
+    q = snap["recent"][0]
+    assert q["status"] == "done" and q["mode"] == "run"
+    assert q["fingerprint"] and q["query_id"] > 0
+    assert q["rows_out"] > 0
+
+
+def test_stream_progress_callback_without_metrics(metrics_off):
+    snaps = []
+    outs = list(run_plan_stream(_query("lprog"), _batches("lprog"),
+                                on_progress=snaps.append))
+    assert len(outs) == 4
+    assert snaps, "observer must fire even when SRT_METRICS is unset"
+    last = snaps[-1]
+    assert last["status"] == "done"
+    assert last["batches_done"] == 4
+    assert last["rows_in"] == 4 * 128
+    # still zero-cost for everyone else: the registry stayed empty
+    assert registry().counters_snapshot() == {}
+
+
+def test_plan_run_progress_callback(metrics_off):
+    snaps = []
+    t = _table("lrun")
+    _query("lrun").run(t, progress=snaps.append)
+    assert snaps and snaps[-1]["status"] == "done"
+    assert {s["phase"] for s in snaps} >= {"bind", "dispatch", "done"}
+
+
+def test_in_flight_snapshot_mid_stream(metrics_on):
+    seen = []
+
+    def observe(snap):
+        if snap["status"] == "running" and not seen:
+            inflight = live.snapshot_all()["in_flight"]
+            seen.append((snap["query_id"], [q["query_id"]
+                                            for q in inflight]))
+
+    list(run_plan_stream(_query("lmid"), _batches("lmid"),
+                         on_progress=observe))
+    assert seen, "no running heartbeat observed"
+    qid, inflight_ids = seen[0]
+    assert qid in inflight_ids
+
+
+def test_recovery_rung_publishes_live(metrics_on, monkeypatch):
+    monkeypatch.setenv("SRT_FAULT", "oom:dispatch:1")
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    from spark_rapids_tpu.resilience import reset_faults
+    reset_faults()
+    try:
+        t = _table("lrung")
+        _query("lrung").run(t)
+    finally:
+        monkeypatch.delenv("SRT_FAULT")
+        reset_faults()
+    q = live.snapshot_all()["recent"][-1]
+    assert q["recovery"]["count"] >= 1
+    assert any(r.endswith(":retry") for r in q["recovery"]["rungs"])
+
+
+# ---------------------------------------------------------------------------
+# 3. Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|\+Inf|-Inf)$')
+
+
+def _assert_valid_exposition(text):
+    families = {}
+    current = None
+    for line in text.strip().split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = kind
+            current = name
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        assert name == current, (
+            f"sample {name} outside its TYPE block (current={current})")
+    return families
+
+
+def test_metrics_endpoint_is_valid_exposition(metrics_on):
+    t = _table("lexp")
+    _query("lexp").run(t)
+    families = _assert_valid_exposition(server.prometheus_text())
+    assert any(k == "counter" for k in families.values())
+    assert families.get("srt_live_queries") == "gauge"
+
+
+def test_counter_names_are_mangled_and_suffixed(metrics_on):
+    counter("weird.name-with/chars").inc(3)
+    text = server.prometheus_text()
+    assert "srt_weird_name_with_chars_total 3" in text
+
+
+def test_timers_become_two_counter_families(metrics_on):
+    from spark_rapids_tpu.obs.metrics import timer
+    with timer("lt.timer").time():
+        pass
+    text = server.prometheus_text()
+    assert "# TYPE srt_lt_timer_seconds_total counter" in text
+    assert "# TYPE srt_lt_timer_calls_total counter" in text
+
+
+def test_nan_and_inf_gauges_render(metrics_on):
+    gauge("lt.nan").set(float("nan"))
+    gauge("lt.posinf").set(float("inf"))
+    gauge("lt.neginf").set(float("-inf"))
+    text = server.prometheus_text()
+    assert "srt_lt_nan NaN" in text
+    assert "srt_lt_posinf +Inf" in text
+    assert "srt_lt_neginf -Inf" in text
+    _assert_valid_exposition(text)
+
+
+def test_label_escaping(metrics_on):
+    lq = live.start('we"ird\\mo\nde', force=True)
+    try:
+        text = server.prometheus_text()
+    finally:
+        lq.finish()
+    assert 'mode="we\\"ird\\\\mo\\nde"' in text
+
+
+def test_counters_monotonic_across_cache_eviction(metrics_on):
+    from spark_rapids_tpu.resilience.recovery import evict_device_caches
+    t = _table("lmono")
+    q = _query("lmono")
+    q.run(t)
+
+    def counters(text):
+        out = {}
+        for line in text.split("\n"):
+            if line.startswith("#") or not line:
+                continue
+            name, value = line.rsplit(" ", 1)
+            if name.endswith("_total") and "{" not in name:
+                out[name] = float(value)
+        return out
+
+    before = counters(server.prometheus_text())
+    evict_device_caches()
+    q.run(t)
+    after = counters(server.prometheus_text())
+    for name, value in before.items():
+        assert after.get(name, 0) >= value, (
+            f"{name} went backwards across eviction: "
+            f"{value} -> {after.get(name)}")
+
+
+def test_concurrent_scrape_during_stream(metrics_on):
+    srv = server.start(port=0)
+    stop = threading.Event()
+    errors = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(srv.url + "/metrics",
+                                            timeout=5) as resp:
+                    assert resp.status == 200
+                    _assert_valid_exposition(resp.read().decode())
+                with urllib.request.urlopen(srv.url + "/queries",
+                                            timeout=5) as resp:
+                    json.loads(resp.read().decode())
+            except Exception as exc:       # pragma: no cover
+                errors.append(exc)
+                return
+
+    th = threading.Thread(target=scraper, daemon=True)
+    th.start()
+    try:
+        outs = list(run_plan_stream(_query("lconc"), _batches("lconc", n=6)))
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert len(outs) == 6
+    assert not errors, f"scrape failed mid-stream: {errors[0]!r}"
+
+
+# ---------------------------------------------------------------------------
+# 3b. HTTP endpoints
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_queries_endpoint_round_trips(metrics_on):
+    srv = server.start(port=0)
+    t = _table("lhttp")
+    _query("lhttp").run(t)
+    status, body = _get(srv.url + "/queries")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["recent"][-1]["mode"] == "run"
+    assert snap["pid"] > 0
+
+
+def test_timeline_endpoint_404_for_unknown_query(metrics_on):
+    srv = server.start(port=0)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(srv.url + "/queries/999999/timeline")
+    assert exc.value.code == 404
+
+
+def test_timeline_endpoint_serves_mid_run_spans(metrics_on, monkeypatch):
+    monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+    from spark_rapids_tpu.obs import timeline
+    timeline.reset()
+    srv = server.start(port=0)
+    grabbed = []
+
+    def observe(snap):
+        if (snap["status"] == "running" and snap["batches_done"] >= 1
+                and not grabbed):
+            status, body = _get(
+                srv.url + f"/queries/{snap['query_id']}/timeline")
+            grabbed.append((status, json.loads(body)))
+
+    list(run_plan_stream(_query("ltl"), _batches("ltl"),
+                         on_progress=observe, trace_timeline=True))
+    timeline.reset()
+    assert grabbed, "no mid-run timeline scrape happened"
+    status, payload = grabbed[0]
+    assert status == 200
+    evs = payload["traceEvents"]
+    assert any(e.get("ph") == "X" for e in evs)
+    for e in evs:
+        if e.get("ph") != "M":
+            assert isinstance(e["args"]["query_id"], int)
+
+
+def test_server_start_is_idempotent_and_stoppable():
+    a = server.start(port=0)
+    b = server.start(port=0)
+    assert a is b
+    server.stop()
+    assert server.get() is None
+
+
+def test_maybe_start_respects_flag(monkeypatch):
+    monkeypatch.delenv("SRT_LIVE_SERVER", raising=False)
+    assert server.maybe_start() is None
+    monkeypatch.setenv("SRT_LIVE_SERVER", "1")
+    monkeypatch.setenv("SRT_LIVE_PORT", "0")
+    assert server.maybe_start() is not None
+
+
+def test_live_port_knob_validation(monkeypatch):
+    from spark_rapids_tpu.config import live_server_port
+    monkeypatch.delenv("SRT_LIVE_PORT", raising=False)
+    assert live_server_port() == 9465
+    monkeypatch.setenv("SRT_LIVE_PORT", "0")
+    assert live_server_port() == 0
+    monkeypatch.setenv("SRT_LIVE_PORT", "70000")
+    with pytest.raises(ValueError):
+        live_server_port()
+
+
+# ---------------------------------------------------------------------------
+# 4. correlation: one query_id across live / timeline / history
+# ---------------------------------------------------------------------------
+
+def test_query_id_threads_into_timeline_and_history(metrics_on,
+                                                    monkeypatch, tmp_path):
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("SRT_TRACE_TIMELINE", "1")
+    monkeypatch.setenv("SRT_METRICS_HISTORY", str(hist))
+    from spark_rapids_tpu.obs import history, timeline
+    timeline.reset()
+    t = _table("lcorr")
+    _query("lcorr").run(t)
+    q = live.snapshot_all()["recent"][-1]
+    qid = q["query_id"]
+    spans = [e for e in timeline.events()
+             if e.get("ph") == "X" and e.get("args", {}).get("query_id")]
+    timeline.reset()
+    assert spans and all(e["args"]["query_id"] == qid for e in spans)
+    rows = history.load(path=hist, query_id=qid)
+    assert len(rows) == 1
+    assert rows[0]["fingerprint"] == q["fingerprint"]
+
+
+def test_top_renderer_draws_shard_bars():
+    from spark_rapids_tpu.obs.__main__ import render_top
+    lq = live.start("dist_stream", force=True)
+    lq.set_shards(4)
+    lq.batch_in(100)
+    lq.batch_in(100)
+    lq.shard_batches_done(4)
+    lq.rung("retry", site="dist-dispatch")
+    try:
+        frame = render_top(live.snapshot_all(), source="test")
+    finally:
+        lq.finish()
+    assert "dist_stream" in frame
+    assert frame.count("shard ") == 4
+    assert "dist-dispatch:retry" in frame
+    done_frame = render_top(live.snapshot_all(), source="test")
+    assert "recent:" in done_frame
+
+
+def test_rows_per_sec_and_eta_are_finite():
+    lq = live.start("stream", force=True)
+    lq.set_total_batches(10)
+    lq.batch_in(500)
+    lq.batch_out(500)
+    snap = lq.snapshot()
+    lq.finish()
+    assert math.isfinite(snap["rows_per_sec"])
+    assert snap["eta_seconds"] is None or snap["eta_seconds"] >= 0
