@@ -17,7 +17,13 @@ IO noise is minimized by page-cache residency (a distinct file per rep —
 identical repeated device inputs can be served from a cache through the
 TPU tunnel, BASELINE.md measurement rule #2).
 
-Run: python benchmarks/bench_parquet.py
+A final selective-scan pass runs with ``SRT_ENCODED_EXEC=1`` and a
+pushdown predicate, asserts bit-equality against the unpruned oracle,
+and emits an ``encoded_scan`` JSON line (bytes moved vs skipped, pages
+skipped, decode/gather walls) for ``--metrics-out`` archives and the
+``--regress`` gate.
+
+Run: python benchmarks/bench_parquet.py [--metrics-out PATH] [--regress]
 """
 
 from __future__ import annotations
@@ -37,6 +43,20 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 N = 4_000_000
 REPS = 5
+
+#: ``--metrics-out`` sink (an open text file), or None for stdout-only.
+_METRICS_OUT = None
+
+
+def emit(line) -> None:
+    """Print one bench JSON line, teeing it to ``--metrics-out`` (same
+    contract as bench_queries.emit: flushed per line)."""
+    if not isinstance(line, str):
+        line = json.dumps(line, sort_keys=True)
+    print(line, flush=True)
+    if _METRICS_OUT is not None:
+        _METRICS_OUT.write(line + "\n")
+        _METRICS_OUT.flush()
 
 
 def _spin():
@@ -91,9 +111,8 @@ def main():
 
         quiet = _measure(paths, warm_path, read_parquet)
         for engine, v in quiet.items():
-            print(json.dumps({"metric": f"parquet_scan_{engine}_4M",
-                              "value": round(v, 1), "unit": "rows/sec"}),
-                  flush=True)
+            emit({"metric": f"parquet_scan_{engine}_4M",
+                  "value": round(v, 1), "unit": "rows/sec"})
 
         ncpu = os.cpu_count() or 8
         ctx = multiprocessing.get_context("spawn")  # fork + JAX threads is UB
@@ -107,11 +126,11 @@ def main():
             for s in spinners:
                 s.terminate()
         for engine, v in loaded.items():
-            print(json.dumps(
-                {"metric": f"parquet_scan_{engine}_4M_contended",
-                 "value": round(v, 1), "unit": "rows/sec"}), flush=True)
+            emit({"metric": f"parquet_scan_{engine}_4M_contended",
+                  "value": round(v, 1), "unit": "rows/sec"})
 
         bench_stream_scan(warm_path)
+        bench_encoded_scan(d)
 
 
 def bench_stream_scan(path):
@@ -137,11 +156,88 @@ def bench_stream_scan(path):
                                                             "f64"])):
         pass
     dt_s = time.perf_counter() - t0
-    print(json.dumps({"metric": "parquet_stream_combine_4M",
-                      "value": round(N / dt_s, 1), "unit": "rows/sec"}),
-          flush=True)
-    print(bench_stream_line(), flush=True)
+    emit({"metric": "parquet_stream_combine_4M",
+          "value": round(N / dt_s, 1), "unit": "rows/sec"})
+    emit(bench_stream_line())
+
+
+def bench_encoded_scan(tmpdir):
+    """Selective scan under ``SRT_ENCODED_EXEC=1``: a row-position-sorted
+    key column makes footer statistics prune most row groups before any
+    byte is read; the surviving strings stay dictionary-resident.  The
+    result is asserted equal to the unpruned decode-everything oracle,
+    then the ``encoded_scan`` JSON line (bytes moved vs skipped, pages
+    skipped, decode/gather walls) is emitted with the measured wall."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io import read_parquet
+    from spark_rapids_tpu.io.arrow import to_arrow
+    from spark_rapids_tpu.obs import bench_line, registry
+
+    os.environ.setdefault("SRT_METRICS", "1")
+    n = 2_000_000
+    rng = np.random.default_rng(23)
+    vocab = np.asarray([f"cat-{i:03d}" for i in range(200)])
+    at = pa.table({
+        "k": np.arange(n, dtype=np.int64),
+        "f64": rng.normal(size=n),
+        "s": pa.array(vocab[rng.integers(0, len(vocab), n)]),
+    })
+    p = Path(tmpdir) / "encoded.parquet"
+    pq.write_table(at, p, compression="snappy", row_group_size=1 << 18)
+    filt = [("k", ">", n - (1 << 18))]       # last row group survives
+
+    env_save = {k: os.environ.get(k)
+                for k in ("SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE")}
+    try:
+        os.environ["SRT_ENCODED_EXEC"] = "0"
+        os.environ["SRT_SCAN_PRUNE"] = "0"
+        oracle = read_parquet(p, filters=filt)
+
+        os.environ["SRT_ENCODED_EXEC"] = "1"
+        os.environ["SRT_SCAN_PRUNE"] = "1"
+        registry().reset()      # scope the JSON line to the pruned scan only
+        t0 = time.perf_counter()
+        table = read_parquet(p, filters=filt)
+        _ = np.asarray(table["f64"].data[-1:])   # fence
+        wall = time.perf_counter() - t0
+    finally:
+        for k, v in env_save.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+
+    assert to_arrow(table).equals(to_arrow(oracle)), \
+        "encoded/pruned scan diverged from the decode-everything oracle"
+    line = json.loads(bench_line("encoded_scan"))
+    line["wall_seconds"] = round(wall, 6)
+    emit(line)
+
+
+def _path_arg(flag):
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv):
+        raise SystemExit(f"{flag} requires an output path")
+    return sys.argv[i + 1]
 
 
 if __name__ == "__main__":
-    main()
+    _out = _path_arg("--metrics-out")
+    if _out is not None:
+        _METRICS_OUT = open(_out, "a")
+    try:
+        main()
+        if "--regress" in sys.argv:
+            from spark_rapids_tpu.obs import bench_line as _bl
+            _line = _bl("regress")
+            emit(_line)
+            _breaches = json.loads(_line).get("breaches") or []
+            if _breaches:
+                raise SystemExit(
+                    f"perf regression: {len(_breaches)} breach(es) — "
+                    f"see the regress JSON line above")
+    finally:
+        if _METRICS_OUT is not None:
+            _METRICS_OUT.close()

@@ -111,6 +111,23 @@ Knobs (all optional):
                                (default 9465; ``0`` binds an ephemeral
                                port — read it back via
                                ``obs.server.get().port``).
+  ``SRT_ENCODED_EXEC``         ``1`` keeps dictionary-encoded parquet
+                               string columns resident as (codes, vocab)
+                               pairs after scan (io/parquet_native.py →
+                               ops/strings.py registry), so the plan
+                               compiler's code-domain predicates and
+                               group-by keys reuse the scan's encoding
+                               instead of re-deriving it on the host.
+                               Off (default): decode-everything oracle
+                               path.
+  ``SRT_SCAN_PRUNE``           statistics-driven parquet scan pruning
+                               (row groups and pages skipped from
+                               footer/page-header min/max/null-count
+                               stats when a pushed-down predicate can
+                               never match).  Default ON; ``0``/``off``
+                               disables — every byte is read and the
+                               full predicate runs downstream (the
+                               bit-identity oracle).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -488,6 +505,34 @@ def live_server_port() -> int:
     return val
 
 
+def encoded_exec() -> bool:
+    """Encoded-execution path on/off (``SRT_ENCODED_EXEC``).
+
+    When on, the native parquet scanner registers dictionary-encoded
+    string columns with the encoded-residency registry
+    (ops/strings.py) so downstream code-domain execution — string
+    predicates via ``scalar_cut``, group-by/join keys as INT32 codes —
+    starts from the scan's encoding instead of a host-side
+    ``np.unique`` over materialized values.  Read live per scan; off
+    (the default) is the decode-everything oracle path."""
+    return _flag("SRT_ENCODED_EXEC")
+
+
+def scan_prune() -> bool:
+    """Statistics-driven parquet scan pruning on/off (``SRT_SCAN_PRUNE``).
+
+    When on (the default), predicates pushed into ``scan_parquet`` /
+    ``read_parquet_native`` skip row groups whose footer min/max/null
+    statistics prove no row can match, and skip page uploads the same
+    way.  ``0``/``off`` disables pruning — the oracle path for
+    bit-identity checks.  Pruning is conservative: missing or unusable
+    statistics always mean "read"."""
+    raw = os.environ.get("SRT_SCAN_PRUNE")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("", "0", "off", "false", "no")
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -566,5 +611,6 @@ def knob_table() -> dict[str, str]:
              "SRT_RETRY_MAX", "SRT_RETRY_BACKOFF",
              "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT",
              "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT",
-             "SRT_LIVE_SERVER", "SRT_LIVE_PORT")
+             "SRT_LIVE_SERVER", "SRT_LIVE_PORT",
+             "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -182,10 +182,32 @@ def prepare_input(plan, table) -> Optional[BucketedInput]:
         padded = table.pad_to(capacity)
         mask = jnp.arange(capacity, dtype=jnp.int32) < n
         _guarded_cache_put(_PAD_CACHE, key, buffers, (padded, mask))
+        _propagate_resident_encodings(table, padded, capacity)
 
     _record(capacity, n)
     return BucketedInput(table=padded, live_mask=mask,
                          logical_rows=n, capacity=capacity)
+
+
+def _propagate_resident_encodings(table, padded, capacity: int) -> None:
+    """Carry scan-registered dictionary encodings across bucket padding.
+
+    ``Column.pad_to`` pads with validity False, which is exactly the null
+    semantics ``dictionary_encode`` gives null rows — so padding the codes
+    the same way yields a valid encoding of the padded column, and the
+    binder's ``dictionary_encode_cached`` stays a cache hit instead of
+    re-factorizing the padded copy on the host."""
+    from ..config import encoded_exec
+    if not encoded_exec():
+        return
+    from ..ops.strings import register_resident_encoding, resident_encoding
+    for name, col in table.items():
+        hit = resident_encoding(col)
+        if hit is None:
+            continue
+        codes, uniq = hit
+        register_resident_encoding(padded[name], codes.pad_to(capacity),
+                                   uniq)
 
 
 # ---------------------------------------------------------------------------

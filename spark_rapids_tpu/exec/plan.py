@@ -409,6 +409,26 @@ class Plan:
             raise ValueError("limit must be >= 0")
         return Plan(self.steps + (LimitStep(int(k)),))
 
+    # -- scan pushdown -----------------------------------------------------
+    def scan_predicates(self) -> tuple:
+        """The plan's leading filter conjunction as pushdown leaves
+        (:class:`~..io.pushdown.LeafPred`) — hand this to
+        ``io.feed.scan_parquet(..., predicate=...)`` so footer/page
+        statistics prune row groups and pages before any byte is read.
+
+        Only the *leading* run of FilterSteps qualifies: past the first
+        non-filter step the predicate no longer ranges over scan columns.
+        Sound by construction — the FilterSteps stay in the plan and
+        re-run over whatever the scan yields, so pruning can only skip
+        data the filter would drop anyway."""
+        from ..io.pushdown import extract_scan_predicates
+        leaves: list = []
+        for step in self.steps:
+            if not isinstance(step, FilterStep):
+                break
+            leaves.extend(extract_scan_predicates(step.pred))
+        return tuple(leaves)
+
     # -- execution ---------------------------------------------------------
     def run(self, table: Table, trace_timeline=None,
             progress=None) -> Table:

@@ -171,7 +171,7 @@ def _read_retry(fn, site: str = "read"):
     return with_retries(attempt, retryable=(CATEGORY_IO,), site=site)
 
 
-def _row_group_reader(path, columns):
+def _row_group_reader(path, columns, preds=()):
     """Yield one decoded device Table per row group of one file.
 
     Fallback to the Arrow reader is **row-group granular**: a footer-level
@@ -179,9 +179,16 @@ def _row_group_reader(path, columns):
     (e.g. legacy BIT_PACKED levels the footer cannot reveal) switches just
     that row group — matching ``read_parquet(engine="auto")`` semantics
     without re-yielding rows already produced.
+
+    ``preds`` is a conjunction of :class:`~.pushdown.LeafPred`: row groups
+    whose footer statistics prove no row can match are skipped (never
+    read), and page statistics prune inside surviving groups.  The caller
+    MUST still apply the full predicate — surviving groups can contain
+    non-matching rows (and page-pruned rows read as null).
     """
-    from .parquet_native import (read_metadata, _decode_chunk,
+    from .parquet_native import (group_stats, read_metadata, _decode_chunk,
                                  _materialize_piece)
+    from .pushdown import group_may_match, predicates_for_column
 
     try:
         cols, row_groups = read_metadata(path)
@@ -196,8 +203,17 @@ def _row_group_reader(path, columns):
     missing = set(want) - {c.name for c in cols}
     if missing:
         raise KeyError(f"columns not in file: {sorted(missing)}")
+    col_preds = {name: predicates_for_column(preds, name) for name in want}
     with open(path, "rb") as f:
         for i, rg in enumerate(row_groups):
+            if preds and not group_may_match(group_stats(rg), preds):
+                from ..obs.metrics import counter
+                counter("scan.row_groups_skipped").inc()
+                counter("scan.bytes_skipped").inc(
+                    sum(c.total_compressed for c in rg
+                        if c.column.name in col_preds))
+                continue
+
             def decode_group(i=i, rg=rg):
                 by_name = {}
                 for chunk in rg:
@@ -208,7 +224,8 @@ def _row_group_reader(path, columns):
                         # whole-column dictionary fusion needs all chunks;
                         # a stream hands each group on as it decodes).
                         by_name[chunk.column.name] = _materialize_piece(
-                            _decode_chunk(raw, chunk))
+                            _decode_chunk(raw, chunk,
+                                          col_preds[chunk.column.name]))
                 return Table([(n, by_name[n]) for n in want])
             try:
                 # Seek + read restart inside the closure, so a transient
@@ -248,6 +265,7 @@ def coalesce_to_buckets(tables: Iterable[Table],
         out = pending[0] if len(pending) == 1 else concat_tables(pending)
         if len(pending) > 1:
             counter("io.feed.coalesced_batches").inc(len(pending))
+            _propagate_residency(pending, out)
         pending, pending_rows = [], 0
         return out
 
@@ -265,16 +283,49 @@ def coalesce_to_buckets(tables: Iterable[Table],
         yield merged
 
 
-def _bucket_coalesce_target(paths, columns) -> int:
+def _propagate_residency(pieces: list[Table], out: Table) -> None:
+    """Carry scan-registered dictionary encodings across a coalesce.
+
+    When every coalesced piece of a string column holds a resident
+    encoding over the same vocabulary (the common case: one file's row
+    groups share a dictionary), the concatenated codes are registered for
+    the merged column so downstream code-domain execution survives the
+    batch merge.  Vocabulary mismatches just fall back silently."""
+    from ..config import encoded_exec
+    if not encoded_exec():
+        return
+    from ..dtypes import STRING
+    from ..ops.strings import resident_concat
+    for name, col in out.items():
+        if col.dtype is STRING:
+            resident_concat([p[name] for p in pieces], col)
+
+
+def _bucket_coalesce_target(paths, columns, preds=()) -> int:
     """Footer-only pass over ``paths``: the bucket capacity of the largest
-    row group — coalescing to it lands every non-tail batch in one shape
-    bucket (exec/bucketing.py), so the scan runs under one program."""
+    *surviving* row group — coalescing to it lands every non-tail batch in
+    one shape bucket (exec/bucketing.py), so the scan runs under one
+    program.  With pushdown predicates the target is computed over the
+    groups that survive statistics pruning, not the raw file layout:
+    skipped groups never yield rows, so sizing buckets to them would only
+    inflate pad waste."""
     from ..exec.bucketing import bucket_capacity
     counts: list[int] = []
     for p in paths:
         try:
-            from .parquet_native import row_group_row_counts
-            counts.extend(row_group_row_counts(p))
+            if preds:
+                from .parquet_native import group_stats, read_metadata
+                from .pushdown import group_may_match
+                _, row_groups = read_metadata(p)
+                for rg in row_groups:
+                    if not rg or not group_may_match(group_stats(rg),
+                                                     preds):
+                        continue
+                    flat = [c for c in rg if c.column.max_rep == 0]
+                    counts.append((flat[0] if flat else rg[0]).num_values)
+            else:
+                from .parquet_native import row_group_row_counts
+                counts.extend(row_group_row_counts(p))
         except NotImplementedError:
             import pyarrow.parquet as pq
             md = pq.ParquetFile(p).metadata
@@ -285,7 +336,8 @@ def _bucket_coalesce_target(paths, columns) -> int:
 
 def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
                  depth: Optional[int] = None,
-                 coalesce_rows: Optional[object] = None) -> Iterator[Table]:
+                 coalesce_rows: Optional[object] = None,
+                 predicate: Optional[object] = None) -> Iterator[Table]:
     """Stream device Tables row-group by row-group across ``paths``.
 
     IO + host decode for the next row group overlap with the caller's
@@ -296,17 +348,30 @@ def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
     ``coalesce_rows`` merges consecutive row groups until each yielded
     batch holds at least that many rows (see :func:`coalesce_to_buckets`).
     Pass an int target, or ``"bucket"`` to derive one from the files'
-    footers (the bucket capacity of the largest row group,
+    footers (the bucket capacity of the largest *surviving* row group,
     ``exec.bucketing.bucket_capacity``) so a many-file scan executes as
     one compiled program instead of one per distinct row-group length.
+
+    ``predicate`` is a pushdown hint — an :class:`~..exec.expr.Expr`, a
+    list of ``(col, op, val)`` tuples, or LeafPreds (see
+    ``io.pushdown.extract_scan_predicates``).  Statistics-qualifying row
+    groups and pages are skipped before any byte is read or uploaded
+    (``scan.bytes_skipped`` / ``scan.pages_skipped``), and the
+    ``coalesce_rows="bucket"`` target is derived from surviving groups
+    only.  Pruning is a pure optimization: batches can still contain
+    non-matching rows (and pruned pages read as null), so the CALLER MUST
+    apply the full predicate to every yielded batch.  Honors
+    ``SRT_SCAN_PRUNE`` (off → no pruning).
     """
     if isinstance(paths, (str, bytes)) or hasattr(paths, "__fspath__"):
         paths = [paths]
+    from .parquet_native import scan_predicate_leaves
+    preds = scan_predicate_leaves(predicate)
 
     def all_groups():
         from ..obs.metrics import counter
         for p in paths:
-            for t in _row_group_reader(p, columns):
+            for t in _row_group_reader(p, columns, preds):
                 counter("io.feed.row_groups").inc()
                 counter("io.feed.rows").inc(t.num_rows)
                 yield t
@@ -314,7 +379,7 @@ def scan_parquet(paths, columns: Optional[Sequence[str]] = None,
     groups = all_groups()
     if coalesce_rows is not None:
         if coalesce_rows == "bucket":
-            coalesce_rows = _bucket_coalesce_target(paths, columns)
+            coalesce_rows = _bucket_coalesce_target(paths, columns, preds)
         if not isinstance(coalesce_rows, int) or coalesce_rows < 1:
             raise ValueError(
                 f"coalesce_rows must be a positive int or 'bucket', "

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import struct as _struct
+import time as _time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -52,6 +53,7 @@ from ..column import Column
 from ..dtypes import (BOOL8, DType, FLOAT32, FLOAT64, INT32, INT64, STRING,
                       TypeId, decimal32, decimal64)
 from ..table import Table
+from .pushdown import (ColumnStats, LeafPred, NULL_REJECTING_OPS, may_match)
 from .thriftc import ThriftReader
 
 MAGIC = b"PAR1"
@@ -125,6 +127,76 @@ class ChunkInfo:
     num_values: int
     start_offset: int       # min(data_page_offset, dictionary_page_offset)
     total_compressed: int
+    stats: Optional[ColumnStats] = None   # footer Statistics, decoded
+
+
+def _stat_bound(raw, info: ColumnInfo):
+    """Decode one Statistics min/max payload into a python comparable in
+    the column's logical domain, or None when undecodable.
+
+    BYTE_ARRAY bounds stay raw utf-8 bytes (byte order == code-point
+    order); INT32/INT64 lanes decode per the logical signedness (UINT
+    converted types order unsigned); decimal lanes hold unscaled ints —
+    the same domain the engine's Column data uses, so comparisons against
+    pushed-down literals stay consistent.
+    """
+    if raw is None:
+        return None
+    phys = info.physical
+    if phys == T_BYTE_ARRAY:
+        return bytes(raw) if info.dtype == STRING else None
+    if phys == T_BOOLEAN:
+        return bool(raw[0]) if len(raw) >= 1 else None
+    try:
+        kind = np.dtype(info.dtype.jnp_dtype).kind
+    except Exception:
+        return None
+    fmts = {T_INT32: ("<u4" if kind == "u" else "<i4", 4),
+            T_INT64: ("<u8" if kind == "u" else "<i8", 8),
+            T_FLOAT: ("<f4", 4), T_DOUBLE: ("<f8", 8)}
+    if phys not in fmts:
+        return None
+    fmt, width = fmts[phys]
+    if len(raw) < width:
+        return None
+    val = np.frombuffer(raw[:width], dtype=fmt)[0]
+    return float(val) if fmt[1] == "f" else int(val)
+
+
+def _decode_stats(sd, info: ColumnInfo, num_values: int,
+                  exact_nulls: Optional[int] = None
+                  ) -> Optional[ColumnStats]:
+    """Parquet ``Statistics`` thrift struct → :class:`ColumnStats`, or
+    None when nothing usable was written.  min/max are only used as a
+    PAIR (a lone bound can't drive the two-sided truth table safely
+    against buggy writers)."""
+    if not isinstance(sd, dict):
+        sd = {}
+    null_count = sd.get(3)
+    if exact_nulls is not None:
+        null_count = exact_nulls
+    mn_raw, mx_raw = sd.get(6), sd.get(5)
+    if mn_raw is None and mx_raw is None:
+        # Legacy min/max (fields 2/1) were written under SIGNED comparison;
+        # trust them only where the logical order IS the signed physical
+        # order — plain signed ints and floats, never BYTE_ARRAY
+        # (PARQUET-251) and never UINT converted types.
+        legacy_ok = info.physical in (T_INT32, T_INT64, T_FLOAT, T_DOUBLE)
+        if legacy_ok:
+            try:
+                legacy_ok = np.dtype(info.dtype.jnp_dtype).kind != "u"
+            except Exception:
+                legacy_ok = False
+        if legacy_ok:
+            mn_raw, mx_raw = sd.get(2), sd.get(1)
+    mn = _stat_bound(mn_raw, info)
+    mx = _stat_bound(mx_raw, info)
+    if mn is None or mx is None:
+        mn = mx = None
+    if mn is None and null_count is None:
+        return None
+    return ColumnStats(min=mn, max=mx, null_count=null_count,
+                       num_values=num_values)
 
 
 def _logical_dtype(phys: int, elem: Dict[int, Any], name: str) -> DType:
@@ -292,10 +364,14 @@ def read_metadata(path) -> Tuple[List[ColumnInfo], List[List[ChunkInfo]]]:
             # erroneously; the chunk always starts at the smallest offset.
             if dict_off is not None and 0 < dict_off < start:
                 start = dict_off
+            try:
+                stats = _decode_stats(md.get(12), col, md[5])
+            except Exception:
+                stats = None            # malformed stats never fail a read
             chunks.append(ChunkInfo(
                 column=col, codec=_CODEC_NAMES[codec_id],
                 num_values=md[5], start_offset=start,
-                total_compressed=md[7]))
+                total_compressed=md[7], stats=stats))
         row_groups.append(chunks)
     return columns, row_groups
 
@@ -735,6 +811,19 @@ class _PageSlice:
     def_runs: Optional[Dict[str, np.ndarray]] = None   # parsed def levels
     rep_levels: Optional[np.ndarray] = None   # LIST: expanded rep levels
     def_levels: Optional[np.ndarray] = None   # LIST: expanded def levels
+    pruned: bool = False    # stats-skipped page: rows present, all null
+
+
+def _all_null_runs(num_values: int) -> Dict[str, np.ndarray]:
+    """Synthetic definition-level run table — one RLE run of value 0
+    covering the whole page — so a stats-pruned page contributes all-null
+    rows to the chunk's fused validity expansion without ever being
+    decompressed."""
+    return {"out_start": np.zeros(1, np.int32),
+            "count": np.asarray([num_values], np.int64),
+            "rle_value": np.zeros(1, np.int32),
+            "bp_bit_base": np.zeros(1, np.int64),
+            "is_rle": np.ones(1, np.bool_)}
 
 
 def _page_kind(p: _PageSlice) -> str:
@@ -748,15 +837,31 @@ def _page_kind(p: _PageSlice) -> str:
         f"value encoding {p.encoding} (DELTA_* need the Arrow reader)")
 
 
-def _walk_pages(blob: bytes, chunk: ChunkInfo
+def _walk_pages(blob: bytes, chunk: ChunkInfo,
+                preds: Sequence[LeafPred] = ()
                 ) -> Tuple[Optional[_Dict], List[_PageSlice], int]:
     """Host pass over a chunk: headers, decompression, defined counts.
 
     Returns (dictionary, pages, total_rows).  The only value-scale work
     here is decompression and the width-1 popcount — both O(bytes) host
     passes with no device involvement.
+
+    ``preds`` are the pushed-down leaf predicates constraining THIS
+    column.  A page whose header statistics prove no row can match is
+    never decompressed or uploaded — it enters the page list as an
+    all-null placeholder (pruning one column's page cannot drop rows,
+    because sibling columns' page boundaries don't align).  That is only
+    sound for null-rejecting predicates on nullable flat columns: the
+    placeholder nulls fail the full predicate when it re-runs downstream,
+    so survivors are bit-identical to an unpruned read.
     """
     info = chunk.column
+    # Page pruning requires: the column is optional (nulls are
+    # representable) and flat, and every predicate on it is
+    # null-rejecting (an ``is_null`` pushdown could newly match the
+    # placeholder rows).  Required columns still get row-group pruning.
+    prune_pages = bool(preds) and info.optional and not info.max_rep \
+        and all(p.op in NULL_REJECTING_OPS for p in preds)
     pos = 0                     # blob is the chunk's own byte range
     remaining = chunk.num_values
     dictionary: Optional[_Dict] = None
@@ -778,6 +883,27 @@ def _walk_pages(blob: bytes, chunk: ChunkInfo
             continue
         if ptype == P_INDEX:
             continue
+        if prune_pages and ptype in (P_DATA, P_DATA_V2):
+            dph = header[5] if ptype == P_DATA else header[8]
+            num_values = dph[1]
+            try:
+                st = _decode_stats(
+                    dph.get(5 if ptype == P_DATA else 8), info, num_values,
+                    exact_nulls=dph.get(2) if ptype == P_DATA_V2 else None)
+            except Exception:
+                st = None               # malformed stats: read the page
+            if st is not None and not all(may_match(p, st) for p in preds):
+                from ..obs.metrics import counter
+                counter("scan.pages_skipped").inc()
+                counter("scan.bytes_skipped").inc(comp_size)
+                pages.append(_PageSlice(
+                    row_base=row_base, num_values=num_values,
+                    def_base=def_base, n_defined=0, def_buf=b"",
+                    encoding=E_RLE_DICTIONARY, values=b"",
+                    def_runs=_all_null_runs(num_values), pruned=True))
+                row_base += num_values
+                remaining -= num_values
+                continue
         rep_buf = None
         if ptype == P_DATA:
             dph = header[5]
@@ -935,22 +1061,31 @@ class _DictStrChunk:
     dict_: _Dict
 
 
-def _decode_chunk(blob: bytes, chunk: ChunkInfo):
+def _decode_chunk(blob: bytes, chunk: ChunkInfo,
+                  preds: Sequence[LeafPred] = ()):
     """One column chunk → one device Column (or a deferred
-    :class:`_DictStrChunk` for single-dictionary string chunks)."""
+    :class:`_DictStrChunk` for single-dictionary string chunks).
+
+    ``preds`` (this column's pushed-down predicates) drive page-level
+    stats pruning in the page walk: pruned pages surface as all-null
+    rows, never as dropped rows — see :func:`_walk_pages`."""
     info = chunk.column
-    dictionary, pages, total_rows = _walk_pages(blob, chunk)
+    dictionary, pages, total_rows = _walk_pages(blob, chunk, preds)
     if not pages:
         return _empty_column(info.dtype)
+    # Pruned placeholders contribute rows (all null) to validity/offsets
+    # but no dense values — only real pages feed the value decode.
+    real = [p for p in pages if not p.pruned]
 
     if info.max_rep:
         return _decode_list_chunk(info, dictionary, pages)
 
     if (info.dtype == STRING and dictionary is not None
-            and all(_page_kind(p) == "dict" for p in pages)):
+            and all(_page_kind(p) == "dict" for p in real)):
         n_dense = sum(p.n_defined for p in pages)
-        codes = Column(data=_expand_dict_codes(pages).astype(jnp.int32),
-                       dtype=INT32)
+        dense_codes = _expand_dict_codes(real).astype(jnp.int32) if real \
+            else jnp.zeros(0, jnp.int32)
+        codes = Column(data=dense_codes, dtype=INT32)
         if info.optional and n_dense != total_rows:
             valid = _chunk_validity(pages, total_rows)
             codes = Column(data=_scatter_defined(codes.data, valid,
@@ -961,14 +1096,17 @@ def _decode_chunk(blob: bytes, chunk: ChunkInfo):
     # Group contiguous same-kind pages (a chunk is a single group unless the
     # writer fell back from dictionary to PLAIN mid-chunk).
     groups: List[Tuple[str, List[_PageSlice]]] = []
-    for p in pages:
+    for p in real:
         kind = _page_kind(p)
         if groups and groups[-1][0] == kind:
             groups[-1][1].append(p)
         else:
             groups.append((kind, [p]))
     parts = [_dense_group(ps, kind, info, dictionary) for kind, ps in groups]
-    dense_col = parts[0] if len(parts) == 1 else _concat_columns(parts)
+    if not parts:                       # every page of the chunk pruned
+        dense_col = _empty_column(info.dtype)
+    else:
+        dense_col = parts[0] if len(parts) == 1 else _concat_columns(parts)
 
     # Physical → logical representation (uint/timestamp converted types are
     # stored in the signed physical lanes; same-width casts reinterpret).
@@ -1109,16 +1247,44 @@ def row_group_row_counts(path) -> List[int]:
     return out
 
 
-def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
+def scan_predicate_leaves(predicate) -> Tuple[LeafPred, ...]:
+    """Normalize any accepted ``predicate`` argument (Expr, filter
+    tuples, LeafPreds, None) to the leaf conjunction, honoring the
+    ``SRT_SCAN_PRUNE`` kill switch (off → no leaves → no pruning)."""
+    if predicate is None:
+        return ()
+    from ..config import scan_prune
+    if not scan_prune():
+        return ()
+    from .pushdown import extract_scan_predicates
+    return extract_scan_predicates(predicate)
+
+
+def group_stats(rg: List[ChunkInfo]) -> Dict[str, Optional[ColumnStats]]:
+    """Footer statistics of one row group, keyed by column name (flat
+    columns only — LIST chunk stats describe elements, not rows)."""
+    return {c.column.name: c.stats for c in rg if c.column.max_rep == 0}
+
+
+def read_parquet_native(path, columns: Optional[Sequence[str]] = None,
+                        predicate=None) -> Table:
     """Read a Parquet file via the native page decoder into a device Table.
 
     Column pruning prunes IO: only the selected chunks' byte ranges are
-    read from the file.  Raises ``NotImplementedError`` for shapes outside
-    the supported envelope (nested schemas, INT96, DELTA encodings) —
-    callers fall back to the Arrow-backed
-    :func:`spark_rapids_tpu.io.parquet.read_parquet`.
+    read from the file.  ``predicate`` (an ``exec.expr`` tree, pandas-style
+    filter tuples, or :class:`~.pushdown.LeafPred` leaves) prunes further:
+    row groups whose footer statistics prove no match are never read, and
+    non-qualifying pages are never decompressed or uploaded.  Pruning is
+    group/page granular and page-pruned rows surface as nulls, so the
+    CALLER MUST still apply the full predicate to the result — the engine's
+    plan layer always does (pushdown never removes the filter step).
+    Raises ``NotImplementedError`` for shapes outside the supported
+    envelope (nested schemas, INT96, DELTA encodings) — callers fall back
+    to the Arrow-backed :func:`spark_rapids_tpu.io.parquet.read_parquet`.
     """
     from ..obs.metrics import counter, timer
+    from .pushdown import group_may_match, predicates_for_column
+    preds = scan_predicate_leaves(predicate)
     with timer("io.parquet.read").time():
         cols, row_groups = read_metadata(path)
         want = (list(columns) if columns is not None
@@ -1126,23 +1292,38 @@ def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
         missing = set(want) - {c.name for c in cols}
         if missing:
             raise KeyError(f"columns not in file: {sorted(missing)}")
+        col_preds = {name: predicates_for_column(preds, name)
+                     for name in want}
         per_name: Dict[str, List] = {name: [] for name in want}
         bytes_read = 0
+        bytes_skipped = 0
+        groups_read = 0
+        groups_skipped = 0
+        decode_s = 0.0
         with open(path, "rb") as f:
             for rg in row_groups:
+                if preds and not group_may_match(group_stats(rg), preds):
+                    groups_skipped += 1
+                    bytes_skipped += sum(c.total_compressed for c in rg
+                                         if c.column.name in per_name)
+                    continue
+                groups_read += 1
                 for chunk in rg:
                     if chunk.column.name not in per_name:
                         continue
                     f.seek(chunk.start_offset)
                     chunk_bytes = f.read(chunk.total_compressed)
                     bytes_read += len(chunk_bytes)
-                    per_name[chunk.column.name].append(
-                        _decode_chunk(chunk_bytes, chunk))
+                    t0 = _time.perf_counter()
+                    piece = _decode_chunk(chunk_bytes, chunk,
+                                          col_preds[chunk.column.name])
+                    decode_s += _time.perf_counter() - t0
+                    per_name[chunk.column.name].append(piece)
         dtypes_by_name = {c.name: c.dtype for c in cols}
         out = []
         for name in want:
             pieces = per_name[name]
-            if not pieces:                   # zero row groups in the file
+            if not pieces:       # zero row groups in (or surviving) the file
                 col = _empty_column(dtypes_by_name[name])
             elif all(isinstance(x, _DictStrChunk) for x in pieces):
                 col = _fuse_dict_str_chunks(pieces)
@@ -1152,11 +1333,66 @@ def read_parquet_native(path, columns: Optional[Sequence[str]] = None) -> Table:
             out.append((name, col))
         t = Table(out)
         counter("io.parquet.files").inc()
-        counter("io.parquet.row_groups").inc(len(row_groups))
+        counter("io.parquet.row_groups").inc(groups_read)
         counter("io.parquet.rows").inc(t.num_rows)
         counter("io.parquet.columns").inc(t.num_columns)
         counter("io.parquet.bytes_read").inc(bytes_read)
+        if groups_skipped:
+            counter("scan.row_groups_skipped").inc(groups_skipped)
+        if bytes_skipped:
+            counter("scan.bytes_skipped").inc(bytes_skipped)
+        if decode_s > 0:
+            counter("scan.decode.us").inc(int(decode_s * 1e6))
     return t
+
+
+def _dict_words(d: _Dict) -> List[bytes]:
+    """A string dictionary's entries, in file (first-occurrence) order."""
+    n_entries = 0 if d.np_offsets is None else len(d.np_offsets) - 1
+    return [d.np_chars[d.np_offsets[i]:d.np_offsets[i + 1]].tobytes()
+            for i in range(n_entries)]
+
+
+def _sorted_rank(words: List[bytes]) -> Optional[np.ndarray]:
+    """Old-code → sorted-code remap for a vocabulary, or None when the
+    vocabulary is already ascending (identity remap)."""
+    order = sorted(range(len(words)), key=words.__getitem__)
+    if order == list(range(len(words))):
+        return None
+    rank = np.empty(len(words), np.int32)
+    rank[np.asarray(order)] = np.arange(len(words), dtype=np.int32)
+    return rank
+
+
+def _strings_from_words(words: List[bytes]) -> Column:
+    chars = np.concatenate([np.frombuffer(w, np.uint8) for w in words]
+                           or [np.zeros(0, np.uint8)])
+    lens = np.asarray([len(w) for w in words], np.int64)
+    offsets = np.concatenate([np.zeros(1, np.int64),
+                              np.cumsum(lens)]).astype(np.int32)
+    return Column(data=jnp.asarray(chars), offsets=jnp.asarray(offsets),
+                  dtype=STRING)
+
+
+def _register_scan_encoding(col: Column, codes: Column,
+                            words: List[bytes]) -> None:
+    """Hand a scan-built (codes, sorted vocab) pair to the encoded-
+    residency registry (ops/strings.py) keyed on the materialized
+    column's buffers, so the plan binder's ``dictionary_encode_cached``
+    reuses the scan's encoding instead of a host np.unique pass.
+
+    The vocabulary must already be ascending (``dictionary_encode``'s
+    contract — ``scalar_cut`` bisects it).  Non-UTF-8 entries (spec
+    violation) simply skip registration; results are unaffected.
+    """
+    from ..obs.metrics import counter
+    from ..ops.strings import register_resident_encoding
+    try:
+        uniq = tuple(w.decode("utf-8") for w in words)
+    except UnicodeDecodeError:
+        return
+    register_resident_encoding(col, codes, uniq)
+    counter("scan.encoded_cols").inc()
 
 
 def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:
@@ -1171,10 +1407,20 @@ def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:
     gather (the single host sync of the whole column) materializes the
     result.  Before this fusion the reader paid a sync per chunk plus a
     host-side string concat — profiled at ~10 s of a 4M-row read.
+
+    Under ``SRT_ENCODED_EXEC`` the union vocabulary is additionally
+    ranked into ascending byte order (== code-point order) and the
+    (codes, vocab) pair is registered with the encoded-residency
+    registry, keyed on the materialized column — downstream code-domain
+    execution then starts from the scan's encoding for free.
     """
+    from ..config import encoded_exec
+    from ..obs.metrics import counter
+    encoded = encoded_exec()
     same_raw = len({x.dict_.raw for x in pieces}) == 1
     vocab: Dict[bytes, int] = {}
     remaps: List[Optional[np.ndarray]] = []
+    words_all: Optional[List[bytes]] = None
     if same_raw:
         # Fast path: identical dictionaries need no vocab/remap at all —
         # only emptiness matters (all-null column).
@@ -1184,21 +1430,34 @@ def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:
             return all_null_column(STRING,
                                    sum(x.codes.size for x in pieces))
         remaps = [np.zeros(0, np.int32)] * len(pieces)   # unused markers
+        if encoded:
+            words_all = _dict_words(d0)
     else:
         for x in pieces:
-            d = x.dict_
-            n_entries = 0 if d.np_offsets is None else len(d.np_offsets) - 1
-            if n_entries == 0:
+            words = _dict_words(x.dict_)
+            if not words:
                 remaps.append(None)
                 continue
-            words = [d.np_chars[d.np_offsets[i]:d.np_offsets[i + 1]]
-                     .tobytes() for i in range(n_entries)]
             remaps.append(np.asarray(
                 [vocab.setdefault(w, len(vocab)) for w in words], np.int32))
         if not vocab:                    # every chunk all-null
             from ..column import all_null_column
             return all_null_column(STRING,
                                    sum(x.codes.size for x in pieces))
+        words_all = list(vocab)
+
+    rank = None
+    if encoded and words_all is not None:
+        # Ascending vocabulary for the residency registry: compose every
+        # chunk remap with the sort ranking (identity when the writer
+        # already sorted — then the original codes are reused as-is).
+        rank = _sorted_rank(words_all)
+        if rank is not None:
+            words_all = sorted(words_all)
+            if same_raw:
+                remaps = [rank] * len(pieces)
+            else:
+                remaps = [None if r is None else rank[r] for r in remaps]
 
     code_cols = []
     for x, remap in zip(pieces, remaps):
@@ -1206,7 +1465,9 @@ def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:
         if remap is None:                # all-null chunk: any in-range code
             code_cols.append(Column(data=jnp.zeros(c.size, jnp.int32),
                                     validity=c.validity, dtype=INT32))
-        elif same_raw:                   # identical dicts: codes line up
+        elif same_raw and (rank is None or remap is not rank):
+            code_cols.append(c)          # identical dicts: codes line up
+        elif remap.size == 0:
             code_cols.append(c)
         else:
             code_cols.append(Column(
@@ -1215,21 +1476,18 @@ def _fuse_dict_str_chunks(pieces: List["_DictStrChunk"]) -> Column:
 
     codes = code_cols[0] if len(code_cols) == 1 \
         else _concat_columns(code_cols)
-    if same_raw:
+    if same_raw and rank is None:
         union_col = pieces[0].dict_.column
     else:
-        chars = np.concatenate(
-            [np.frombuffer(w, np.uint8) for w in vocab]
-            or [np.zeros(0, np.uint8)])
-        lens = np.asarray([len(w) for w in vocab], np.int64)
-        offsets = np.concatenate([np.zeros(1, np.int64),
-                                  np.cumsum(lens)]).astype(np.int32)
-        union_col = Column(data=jnp.asarray(chars),
-                           offsets=jnp.asarray(offsets), dtype=STRING)
+        union_col = _strings_from_words(words_all)
+    t0 = _time.perf_counter()
     col = union_col.gather(codes.data)
     if codes.validity is not None:
         col = col.with_validity(codes.validity if col.validity is None
                                 else (col.validity & codes.validity))
+    counter("scan.gather.us").inc(int((_time.perf_counter() - t0) * 1e6))
+    if encoded and words_all is not None:
+        _register_scan_encoding(col, codes, words_all)
     return col
 
 
@@ -1242,12 +1500,35 @@ def _materialize_piece(piece) -> Column:
 
 def _gather_dict_strings(d: _Dict, codes: Column) -> Column:
     """Codes -> strings; an empty dictionary (all-null chunk) cannot be
-    gathered from and yields an all-null column directly."""
+    gathered from and yields an all-null column directly.
+
+    Under ``SRT_ENCODED_EXEC`` the chunk's dictionary is ranked into
+    ascending order and the (codes, vocab) pair registered with the
+    encoded-residency registry, same as the whole-column fusion path —
+    this is what the row-group-streaming feed (io/feed.py) hits.
+    """
+    from ..obs.metrics import counter
     if d.column.size == 0:
         from ..column import all_null_column
         return all_null_column(STRING, codes.size)
-    col = d.column.gather(codes.data)
+    from ..config import encoded_exec
+    encoded = encoded_exec() and d.np_offsets is not None
+    words = _dict_words(d) if encoded else None
+    rank = _sorted_rank(words) if encoded else None
+    if rank is not None:
+        words = sorted(words)
+        codes = Column(data=jnp.take(jnp.asarray(rank), codes.data,
+                                     mode="clip"),
+                       validity=codes.validity, dtype=INT32)
+        dict_col = _strings_from_words(words)
+    else:
+        dict_col = d.column
+    t0 = _time.perf_counter()
+    col = dict_col.gather(codes.data)
     if codes.validity is not None:
         col = col.with_validity(codes.validity if col.validity is None
                                 else (col.validity & codes.validity))
+    counter("scan.gather.us").inc(int((_time.perf_counter() - t0) * 1e6))
+    if encoded:
+        _register_scan_encoding(col, codes, words)
     return col

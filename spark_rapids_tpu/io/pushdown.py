@@ -1,0 +1,228 @@
+"""Leaf-predicate pushdown and statistics pruning for parquet scans.
+
+The scan path decodes every row group into fully materialized columns
+before a single predicate runs, even though parquet footers and page
+headers already carry min/max/null-count statistics.  This module is the
+shared vocabulary between the plan layer and the native reader:
+
+* :class:`LeafPred` — a single column-vs-literal predicate in a small
+  closed op set, extractable from a plan's leading filter conjunction or
+  from the pandas-style ``filters=[(col, op, val), ...]`` tuples.
+* :class:`ColumnStats` — decoded min/max/null-count bounds for one
+  chunk or page (parquet_native decodes the physical bytes; this module
+  only compares).
+* :func:`may_match` — the conservative three-valued pruning test: False
+  means *no row in this unit can satisfy the predicate* (safe to skip);
+  True means "must read".  Missing or unusable statistics always answer
+  True — pruning can never change results, only skip work, because the
+  full predicate re-runs downstream over whatever was read.
+
+Pruning soundness leans on one invariant: pushdown never *removes* the
+plan's filter step.  Row-group pruning drops whole rows consistently
+across all columns (trivially safe); page pruning replaces a pruned
+page's rows with nulls (see parquet_native._walk_pages), which is safe
+only because every op here except ``is_null`` is null-rejecting — a
+placeholder null can never flip a downstream predicate to true.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, Optional, Sequence
+
+#: The closed op vocabulary.  ``isin`` carries a tuple of literals;
+#: ``is_null`` / ``is_valid`` carry no value.
+PRED_OPS = frozenset({"eq", "ne", "lt", "le", "gt", "ge",
+                      "isin", "is_null", "is_valid"})
+
+#: Ops for which a null operand row evaluates to null/false — i.e. a row
+#: forced to null by a pruned page can never newly satisfy the predicate.
+#: Page-level pruning is restricted to these (everything but ``is_null``).
+NULL_REJECTING_OPS = PRED_OPS - {"is_null"}
+
+#: pandas/pyarrow-style filter-tuple op spellings → LeafPred ops.
+TUPLE_OPS = {"=": "eq", "==": "eq", "!=": "ne", "<": "lt", "<=": "le",
+             ">": "gt", ">=": "ge", "in": "isin"}
+
+
+@dataclass(frozen=True)
+class LeafPred:
+    """One pushdown-eligible predicate: ``column <op> value``."""
+    column: str
+    op: str
+    value: Any = None
+
+    def __post_init__(self):
+        if self.op not in PRED_OPS:
+            raise ValueError(f"unknown pushdown op {self.op!r} "
+                             f"(expected one of {sorted(PRED_OPS)})")
+
+
+@dataclass(frozen=True)
+class ColumnStats:
+    """Decoded statistics for one column chunk or data page.
+
+    ``min``/``max`` are python comparables in the column's logical
+    domain (int/float/bool, or raw utf-8 ``bytes`` for strings — UTF-8
+    byte order equals code-point order, so byte comparison is correct
+    for string predicates).  Any field may be None (writer omitted it);
+    ``num_values`` counts rows INCLUDING nulls when known.
+    """
+    min: Any = None
+    max: Any = None
+    null_count: Optional[int] = None
+    num_values: Optional[int] = None
+
+
+def _usable_bound(b) -> bool:
+    if b is None:
+        return False
+    if isinstance(b, float) and b != b:        # NaN bound: unordered, unusable
+        return False
+    return True
+
+
+def _coerce_literal(value, bound):
+    """Make a predicate literal comparable to a stats bound, or None if
+    the domains don't line up (→ caller must answer "read")."""
+    if isinstance(bound, bytes):
+        if isinstance(value, str):
+            return value.encode("utf-8")
+        return value if isinstance(value, bytes) else None
+    if isinstance(value, (str, bytes)):
+        return None
+    if isinstance(value, float) and value != value:   # NaN literal never prunes
+        return None
+    return value
+
+
+def may_match(pred: LeafPred, stats: Optional[ColumnStats]) -> bool:
+    """Conservative test: can ANY row described by ``stats`` satisfy
+    ``pred``?  False is a proof (skip is safe); True means read."""
+    if stats is None:
+        return True
+    all_null = (stats.null_count is not None
+                and stats.num_values is not None
+                and stats.num_values > 0
+                and stats.null_count >= stats.num_values)
+    if pred.op == "is_null":
+        return stats.null_count != 0           # None (unknown) → True
+    if pred.op == "is_valid":
+        return not all_null
+    if all_null:
+        return False                           # null rows fail every cmp/isin
+    lo, hi = stats.min, stats.max
+    if not (_usable_bound(lo) and _usable_bound(hi)):
+        return True
+    if pred.op == "isin":
+        vals = [_coerce_literal(v, lo) for v in pred.value]
+        if any(v is None for v in vals):
+            return True
+        try:
+            return any(lo <= v <= hi for v in vals)
+        except TypeError:
+            return True
+    v = _coerce_literal(pred.value, lo)
+    if v is None:
+        return True
+    try:
+        if pred.op == "eq":
+            return lo <= v <= hi
+        if pred.op == "ne":
+            return not (lo == hi == v)
+        if pred.op == "lt":
+            return lo < v
+        if pred.op == "le":
+            return lo <= v
+        if pred.op == "gt":
+            return hi > v
+        if pred.op == "ge":
+            return hi >= v
+    except TypeError:
+        return True
+    return True
+
+
+def group_may_match(stats_by_column, preds: Sequence[LeafPred]) -> bool:
+    """AND over a conjunction: False iff some predicate's column has
+    statistics proving no row in the unit can match."""
+    for p in preds:
+        if not may_match(p, stats_by_column.get(p.column)):
+            return False
+    return True
+
+
+# -- extraction -----------------------------------------------------------
+
+def _split_conjuncts(expr):
+    from ..exec.expr import BinOp
+    if isinstance(expr, BinOp) and expr.op == "and_kleene":
+        yield from _split_conjuncts(expr.left)
+        yield from _split_conjuncts(expr.right)
+    else:
+        yield expr
+
+
+def _leaf_from_expr(expr) -> Optional[LeafPred]:
+    from ..exec.expr import FLIP_CMP, BinOp, Col, IsIn, Lit, UnOp
+    if isinstance(expr, BinOp) and expr.op in FLIP_CMP:
+        if isinstance(expr.left, Col) and isinstance(expr.right, Lit):
+            return LeafPred(expr.left.name, expr.op, expr.right.value)
+        if isinstance(expr.left, Lit) and isinstance(expr.right, Col):
+            return LeafPred(expr.right.name, FLIP_CMP[expr.op],
+                            expr.left.value)
+        return None
+    if isinstance(expr, IsIn) and isinstance(expr.operand, Col):
+        if all(isinstance(v, (bool, int, float, str, bytes))
+               for v in expr.values):
+            return LeafPred(expr.operand.name, "isin", tuple(expr.values))
+        return None
+    if isinstance(expr, UnOp) and expr.op in ("is_null", "is_valid") \
+            and isinstance(expr.operand, Col):
+        return LeafPred(expr.operand.name, expr.op)
+    return None
+
+
+def extract_scan_predicates(obj) -> tuple[LeafPred, ...]:
+    """Extract the pushdown-eligible leaves of a filter.
+
+    Accepts an :class:`~..exec.expr.Expr` (split on top-level Kleene
+    AND; non-extractable conjuncts are simply ignored — they still run
+    downstream), an iterable of ``(col, op, val)`` filter tuples
+    (pandas/pyarrow spelling; unknown ops raise), an iterable of
+    :class:`LeafPred`, or None.  The result is a conjunction: a scan
+    unit is skipped only when some ONE leaf proves it empty.
+    """
+    if obj is None:
+        return ()
+    from ..exec.expr import Expr
+    if isinstance(obj, LeafPred):
+        return (obj,)
+    if isinstance(obj, Expr):
+        leaves = (_leaf_from_expr(c) for c in _split_conjuncts(obj))
+        return tuple(p for p in leaves if p is not None)
+    preds: list[LeafPred] = []
+    for item in obj:
+        if isinstance(item, LeafPred):
+            preds.append(item)
+            continue
+        column, op, value = item
+        if op not in TUPLE_OPS:
+            raise ValueError(
+                f"unsupported filter op {op!r} for column {column!r} "
+                f"(native filters support {sorted(TUPLE_OPS)})")
+        mapped = TUPLE_OPS[op]
+        if mapped == "isin":
+            if isinstance(value, (str, bytes)) or not isinstance(
+                    value, Iterable):
+                raise ValueError(
+                    f"'in' filter on {column!r} needs a list of values")
+            value = tuple(value)
+        preds.append(LeafPred(column, mapped, value))
+    return tuple(preds)
+
+
+def predicates_for_column(preds: Sequence[LeafPred],
+                          column: str) -> tuple[LeafPred, ...]:
+    """The subset of a conjunction that constrains one column."""
+    return tuple(p for p in preds if p.column == column)

@@ -268,4 +268,14 @@ def cost_block(qm: Any) -> dict:
             # path test (like "counters") — device count varies by mesh.
             "per_device": per_device,
         },
+        # Scan-side wall split (v8): page/dictionary decode vs the string
+        # gather that late materialization defers — the encoded-execution
+        # win shows as the gather share shrinking while bytes_skipped
+        # (the "scan" block) grows.
+        "scan": {
+            "decode_seconds": round(counters.get("scan.decode.us", 0)
+                                    / 1e6, 6),
+            "gather_seconds": round(counters.get("scan.gather.us", 0)
+                                    / 1e6, 6),
+        },
     }

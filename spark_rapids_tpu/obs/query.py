@@ -176,7 +176,11 @@ class QueryMetrics:
             #     merge_collectives, ici_bytes, syncs_avoided).
             # v7: added "fingerprint" (the live-telemetry correlation
             #     key shared with obs/live.py and timeline span args).
-            "schema_version": 7,
+            # v8: added the always-present "scan" block (statistics
+            #     pruning + encoded residency: bytes/pages/row-groups
+            #     skipped, encoded column count) and the "cost" ledger's
+            #     "scan" sub-split (decode vs gather seconds).
+            "schema_version": 8,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "fingerprint": self.fingerprint,
@@ -233,6 +237,19 @@ class QueryMetrics:
                     "fallbacks": self.recovery_dist_fallbacks,
                     "cache_evictions": self.recovery_dist_evictions,
                 },
+            },
+            # Always present (zeroed on a non-pruning run): the scan
+            # pushdown ledger — what statistics pruning skipped and how
+            # many columns stayed dictionary-resident (SRT_ENCODED_EXEC).
+            "scan": {
+                "bytes_skipped": int(
+                    self.counters.get("scan.bytes_skipped", 0)),
+                "pages_skipped": int(
+                    self.counters.get("scan.pages_skipped", 0)),
+                "row_groups_skipped": int(
+                    self.counters.get("scan.row_groups_skipped", 0)),
+                "encoded_cols": int(
+                    self.counters.get("scan.encoded_cols", 0)),
             },
             # Always present (zeroed when unmetered): wall split into
             # compute/ici/host_sync/dispatch_overhead plus the HBM
@@ -473,6 +490,30 @@ def _regress_payload() -> dict:
     return regress.check_history()
 
 
+def _encoded_scan_payload() -> dict:
+    """Payload for ``bench_line("encoded_scan")``: the process-lifetime
+    scan-pushdown view — host→device bytes actually moved vs bytes whose
+    read was skipped by statistics pruning, pages/row-groups skipped,
+    columns kept dictionary-resident, and the decode/gather wall split.
+    ``bench_parquet.py`` emits it so ``--regress`` can watch the moved-
+    bytes ratio; zero counters just mean pruning never engaged."""
+    from .metrics import registry
+    snap = registry().counters_snapshot()
+    return {
+        "metric": "encoded_scan",
+        "bytes_moved": int(snap.get("io.parquet.bytes_read", 0)),
+        "bytes_skipped": int(snap.get("scan.bytes_skipped", 0)),
+        "pages_skipped": int(snap.get("scan.pages_skipped", 0)),
+        "row_groups_skipped": int(snap.get("scan.row_groups_skipped", 0)),
+        "row_groups_read": int(snap.get("io.parquet.row_groups", 0)),
+        "encoded_cols": int(snap.get("scan.encoded_cols", 0)),
+        "resident_hits": int(
+            snap.get("strings.dict_encode.resident_hit", 0)),
+        "decode_seconds": round(snap.get("scan.decode.us", 0) / 1e6, 6),
+        "gather_seconds": round(snap.get("scan.gather.us", 0) / 1e6, 6),
+    }
+
+
 _BENCH_PAYLOADS = {
     "metrics": _metrics_payload,
     "cache": _cache_payload,
@@ -480,6 +521,7 @@ _BENCH_PAYLOADS = {
     "dist_stream": _dist_stream_payload,
     "recovery": _recovery_payload,
     "regress": _regress_payload,
+    "encoded_scan": _encoded_scan_payload,
 }
 
 
@@ -490,7 +532,8 @@ def bench_line(kind: str) -> str:
     ``"cache"`` (compile cache + bucketing), ``"stream"`` (last streaming
     run), ``"dist_stream"`` (sharded-stream view of the last streaming
     run), ``"recovery"`` (process-lifetime resilience totals),
-    ``"regress"`` (perf-regression report vs the metrics history).  The
+    ``"regress"`` (perf-regression report vs the metrics history),
+    ``"encoded_scan"`` (scan pruning / encoded-residency totals).  The
     four legacy ``bench_*_line`` names are thin wrappers over this and
     emit byte-identical output.
     """

@@ -802,6 +802,66 @@ def dictionary_encode(col: Column) -> tuple[Column, list[str]]:
 # WHEN with several conditions on one column factorizes it exactly once.
 _ENCODE_CACHE: dict = {}
 
+# Encoded-residency registry (SRT_ENCODED_EXEC): producers that already
+# hold a column in (codes, sorted vocab) form — today the parquet scan,
+# which has the parquet dictionary in hand anyway — register it here so
+# dictionary_encode_cached never pays the host np.unique pass for that
+# column.  Same key/value contract as _ENCODE_CACHE: buffer identities →
+# (INT32 codes Column, ascending str tuple).  Separate from _ENCODE_CACHE
+# so the recovery ladder can drop scan residency (re-derivable from the
+# file) without touching encodings derived from live query intermediates.
+_RESIDENT_CACHE: dict = {}
+
+
+def register_resident_encoding(col: Column, codes: Column, uniq) -> None:
+    """Register a pre-built dictionary encoding for ``col``.
+
+    ``uniq`` MUST be ascending (``dictionary_encode``'s contract —
+    ``scalar_cut`` bisects it) and codes must index into it with the
+    column's null semantics preserved in ``codes.validity``."""
+    from ..exec.stats import _guarded_cache_put
+    buffers = tuple(b for b in (col.data, col.offsets, col.validity)
+                    if b is not None)
+    key = tuple(id(b) for b in buffers)
+    _guarded_cache_put(_RESIDENT_CACHE, key, buffers, (codes, tuple(uniq)))
+
+
+def resident_encoding(col: Column):
+    """The registered (codes, vocab) pair for ``col``, or None."""
+    from ..exec.stats import _guarded_cache_get
+    buffers = tuple(b for b in (col.data, col.offsets, col.validity)
+                    if b is not None)
+    return _guarded_cache_get(_RESIDENT_CACHE, tuple(id(b) for b in buffers),
+                              buffers)
+
+
+def clear_resident_encodings() -> int:
+    """Drop every resident encoding (recovery-ladder hook); returns the
+    number of entries dropped so ``evict_device_caches`` stays honest."""
+    n = len(_RESIDENT_CACHE)
+    _RESIDENT_CACHE.clear()
+    return n
+
+
+def resident_concat(pieces: list[Column], out: Column) -> bool:
+    """Propagate residency across a row-wise concat.
+
+    When every piece of ``out`` (== concat of ``pieces``) carries a
+    registered encoding over the SAME vocabulary, the concatenated codes
+    are a valid encoding of ``out`` — register it and return True.
+    Mixed or missing vocabularies return False (decode-everything path
+    takes over; never wrong, just slower)."""
+    hits = [resident_encoding(p) for p in pieces]
+    if not hits or any(h is None for h in hits):
+        return False
+    vocab = hits[0][1]
+    if any(h[1] != vocab for h in hits[1:]):
+        return False
+    from .common import concat_columns as _concat_any
+    codes = _concat_any([h[0] for h in hits])
+    register_resident_encoding(out, codes, vocab)
+    return True
+
 
 def dictionary_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
     from ..exec.stats import _guarded_cache_get, _guarded_cache_put
@@ -810,6 +870,12 @@ def dictionary_encode_cached(col: Column) -> tuple[Column, tuple[str, ...]]:
                     if b is not None)
     key = tuple(id(b) for b in buffers)
     hit = _guarded_cache_get(_ENCODE_CACHE, key, buffers)
+    if hit is None:
+        hit = _guarded_cache_get(_RESIDENT_CACHE, key, buffers)
+        if hit is not None:
+            counter("strings.dict_encode.hit").inc()
+            counter("strings.dict_encode.resident_hit").inc()
+            return hit
     if hit is None:
         counter("strings.dict_encode.miss").inc()
         codes, uniq = dictionary_encode(col)

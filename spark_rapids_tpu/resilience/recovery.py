@@ -43,13 +43,17 @@ class SplitUnavailable(RuntimeError):
 def evict_device_caches() -> int:
     """Rung 1: drop every engine-owned device-buffer cache — the
     whole-plan program LRU, the bucket pad cache, the decoded dictionary
-    table, and (when the dist layer is loaded) the sharded-program LRU,
-    the live-count memo, and the parallel-op program cache.  Returns
-    entries dropped (recorded in ``recovery.cache_evictions``).
+    table, the encoded-residency registry (scan-built dictionary codes,
+    SRT_ENCODED_EXEC), and (when the dist layer is loaded) the
+    sharded-program LRU, the live-count memo, and the parallel-op program
+    cache.  Returns entries dropped (recorded in
+    ``recovery.cache_evictions``).
 
     The dist caches are looked up via ``sys.modules`` instead of
     imported: a single-chip process that never touched the mesh must not
-    pay the dist-layer import (and has nothing to evict there anyway).
+    pay the dist-layer import (and has nothing to evict there anyway);
+    same for ops.strings — its residency registry only fills when a scan
+    ran with encoded execution on.
     """
     import sys
     from ..exec import compile as _compile
@@ -59,6 +63,9 @@ def evict_device_caches() -> int:
     _compile._DECODED_DICTS.clear()
     dropped += clear_pad_cache()
     root = __package__.rsplit(".", 1)[0]
+    strings_mod = sys.modules.get(f"{root}.ops.strings")
+    if strings_mod is not None:
+        dropped += strings_mod.clear_resident_encodings()
     dist_mod = sys.modules.get(f"{root}.exec.dist")
     if dist_mod is not None:
         dropped += len(dist_mod._DIST_COMPILED) + len(dist_mod._LIVE_COUNT)

@@ -150,11 +150,28 @@ class TestDecodeMatrix:
         _check_file(tmp_path, at, compression="snappy",
                     use_dictionary=False)
 
-    def test_native_rejects_filters(self, tmp_path):
+    def test_native_flat_filters_supported(self, tmp_path):
+        # Flat (col, op, val) conjunctions route to the native reader
+        # (statistics pruning + exact device-side re-filter) and must
+        # match Arrow's filtered read exactly.
+        path = tmp_path / "t.parquet"
+        pq.write_table(_mixed_arrow_table(n=200), path)
+        filt = [("i32", ">", 0), ("s", "!=", "row-7")]
+        got = read_parquet(path, engine="native", filters=filt)
+        want = from_arrow(pq.read_table(path, filters=filt))
+        assert_tables_equal(got, want)
+
+    def test_native_rejects_nested_dnf_filters(self, tmp_path):
+        # OR-of-conjunctions (list of lists) stays outside the native
+        # envelope: engine="native" raises, engine="auto" falls to Arrow.
         path = tmp_path / "t.parquet"
         pq.write_table(_mixed_arrow_table(n=10), path)
+        dnf = [[("i32", ">", 0)], [("i64", "<", 0)]]
         with pytest.raises(ValueError):
-            read_parquet(path, engine="native", filters=[("i32", ">", 0)])
+            read_parquet(path, engine="native", filters=dnf)
+        got = read_parquet(path, engine="auto", filters=dnf)
+        want = from_arrow(pq.read_table(path, filters=dnf))
+        assert_tables_equal(got, want)
 
     def test_all_null_column(self, tmp_path):
         at = pa.table({"x": pa.array([None, None, None], pa.int64())})

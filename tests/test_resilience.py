@@ -271,7 +271,7 @@ class TestRunPlanRecovery:
         reset_faults()
         assert run_plan(p, t).to_pydict() == oracle
         payload = json.loads(last_query_metrics().to_json())
-        assert payload["schema_version"] == 7
+        assert payload["schema_version"] == 8
         rec = payload["recovery"]
         assert rec["retries"] >= 1
         assert rec["cache_evictions"] >= 1
@@ -620,6 +620,65 @@ class TestFaultedSmoke:
                run_plan_stream(p, batches(), combine=False)]
         assert got == golden
         assert registry().snapshot().get("recovery.retries", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# encoded-scan residency under the recovery ladder (SRT_ENCODED_EXEC): the
+# registry is device state, so evict_device_caches must drop it (counted),
+# and a fault mid-encoded-execution must recover bit-identically with the
+# retry re-encoding from values
+# ---------------------------------------------------------------------------
+
+class TestEncodedScanRecovery:
+    def _dict_file(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        n = 1200
+        words = [f"w-{i}" for i in range(6)]
+        at = pa.table({
+            "s": pa.array([words[i % 6] for i in range(n)]),
+            "v": pa.array(np.arange(n, dtype=np.float64)),
+        })
+        p = tmp_path / "enc.parquet"
+        pq.write_table(at, p, row_group_size=400)
+        return p
+
+    def test_evict_drops_resident_encodings_counted(self):
+        from spark_rapids_tpu.ops.strings import (dictionary_encode,
+                                                  register_resident_encoding,
+                                                  resident_encoding,
+                                                  strings_from_pylist)
+        from spark_rapids_tpu.resilience.recovery import evict_device_caches
+        s = strings_from_pylist(["b", "a", None, "b"])
+        codes, uniq = dictionary_encode(s)
+        register_resident_encoding(s, codes, tuple(uniq))
+        assert resident_encoding(s) is not None
+        before = recovery_stats().snapshot()
+        dropped = evict_device_caches()
+        assert dropped >= 1
+        assert resident_encoding(s) is None
+        assert recovery_stats().delta(before)["cache_evictions"] == dropped
+
+    def test_oom_mid_encoded_scan_recovers_and_reencodes(self, monkeypatch,
+                                                         tmp_path):
+        from spark_rapids_tpu.io.parquet_native import read_parquet_native
+        from spark_rapids_tpu.ops.strings import resident_encoding
+        monkeypatch.setenv("SRT_ENCODED_EXEC", "1")
+        p = self._dict_file(tmp_path)
+        q = plan().filter(col("v") > 100.0).groupby_agg(
+            ["s"], [("v", "sum", "sv"), ("v", "count", "c")])
+        oracle = _rowset(run_plan(q, read_parquet_native(p)))
+        t = read_parquet_native(p)          # fresh read: residency is live
+        assert resident_encoding(t["s"]) is not None
+        monkeypatch.setenv("SRT_FAULT", "oom:dispatch:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert _rowset(run_plan(q, t)) == oracle
+        d = recovery_stats().delta(before)
+        assert d["retries"] >= 1 and d["cache_evictions"] >= 1
+        # the ladder dropped the scan residency wholesale; the retried
+        # attempt re-encoded from values — results never depended on it
+        assert resident_encoding(t["s"]) is None
 
 
 # ---------------------------------------------------------------------------
