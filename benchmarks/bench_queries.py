@@ -30,6 +30,13 @@ wall), and appends a ``recovery`` JSON line (retries / splits /
 evictions / backoff / faults injected, plus the ``dist`` block) — the
 bench-trajectory proof that the resilience ladder engages and costs
 what it claims.
+
+``--plan-opt`` replaces the default lanes with the adaptive-optimizer
+lane: the whole TPC-DS bank runs against the ``SRT_PLAN_OPT=0`` oracle
+and the optimized pass, and ONE ``plan_opt`` JSON line records wall
+seconds, bound input columns, traced step counts, per-rule rewrite
+totals, bit-identity, and whether the history-warmed rerun closed the
+telemetry feedback loop.  Exits nonzero on any parity divergence.
 """
 
 from __future__ import annotations
@@ -531,6 +538,100 @@ def bench_plans(lineitem, fact, dim):
                     chain_col="rev", leaf_col="rev_sum")
 
 
+def bench_plan_opt(sf_rows=200_000):
+    """``--plan-opt``: the TPC-DS bank under the adaptive plan optimizer
+    vs the ``SRT_PLAN_OPT=0`` oracle.
+
+    Runs every bank query twice per mode (warm compile + timed rep),
+    checks the optimized results are **bit-identical** to the oracle,
+    aggregates the optimizer's registry counters (rewrites per rule,
+    pruned input columns), and closes the telemetry feedback loop with a
+    history-warmed rerun whose reorder must report ``history_informed``.
+    Emits ONE ``plan_opt`` JSON line (teed by ``--metrics-out``); the
+    metered runs also append per-fingerprint history records, so a
+    follow-up ``--regress`` gates the optimized walls like any other
+    lane.
+    """
+    import os
+    import tempfile
+
+    from spark_rapids_tpu.exec import col, plan
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.models.tpcds_queries import QUERIES
+    from spark_rapids_tpu.obs import last_query_metrics, registry
+
+    os.environ["SRT_METRICS"] = "1"
+    t0 = time.perf_counter()
+    d = tpcds.generate(sf_rows, seed=7)
+    print(f"# plan-opt: generated sf_rows={sf_rows} in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    def sweep(opt_on):
+        os.environ["SRT_PLAN_OPT"] = "1" if opt_on else "0"
+        registry().reset()
+        outs, walls = {}, {}
+        steps_before = steps_after = bound_cols = pruned = 0
+        for nm, fn in QUERIES.items():
+            fn(d)                        # warm: compile off the clock
+            t1 = time.perf_counter()
+            out = fn(d)
+            walls[nm] = time.perf_counter() - t1
+            outs[nm] = out.to_pydict()
+            qm = last_query_metrics()
+            if qm is not None:
+                od = qm.to_dict()       # per-query counter deltas
+                bound_cols += (od["input"]["columns"]
+                               - od["opt"]["pruned_columns"])
+                pruned += od["opt"]["pruned_columns"]
+                steps_before += od["opt"]["steps_before"]
+                steps_after += od["opt"]["steps_after"]
+        snap = registry().counters_snapshot()
+        return outs, walls, steps_before, steps_after, bound_cols, \
+            pruned, snap
+
+    o_outs, o_walls, _, _, o_cols, _, _ = sweep(False)
+    outs, walls, sb, sa, cols, pruned, snap = sweep(True)
+
+    mismatched = sorted(nm for nm in QUERIES if outs[nm] != o_outs[nm])
+    rewrites = {k.rsplit(".", 1)[1]: int(v) for k, v in snap.items()
+                if k.startswith("plan.opt.rewrites.")}
+
+    # History-feedback demo: a cold analyze run records per-conjunct
+    # selectivity; the warm rerun's reorder must consume it.  The wide
+    # conjunct deliberately leads so only history can demote it.
+    hist = os.environ.get("SRT_METRICS_HISTORY")
+    if hist is None:
+        fd, hist = tempfile.mkstemp(suffix=".jsonl", prefix="srt-hist-")
+        os.close(fd)
+        os.environ["SRT_METRICS_HISTORY"] = hist
+    p = (plan()
+         .filter(col("ss_quantity") > -1)
+         .filter(col("ss_store_sk").eq(1))
+         .groupby_agg(["ss_store_sk"], [("ss_quantity", "sum", "q")]))
+    p.explain_analyze(d.store_sales)
+    p.run(d.store_sales)
+    warm_opt = last_query_metrics().to_dict()["opt"]
+
+    emit(json.dumps({
+        "metric": "plan_opt",
+        "queries": len(QUERIES),
+        "bit_identical": not mismatched,
+        "mismatched": mismatched,
+        "wall_oracle_s": round(sum(o_walls.values()), 4),
+        "wall_opt_s": round(sum(walls.values()), 4),
+        "bound_columns": {"oracle": o_cols, "optimized": cols},
+        "pruned_columns": pruned,
+        "traced_steps": {"oracle": sb, "optimized": sa},
+        "rewrites": rewrites,
+        "history_informed": bool(warm_opt["history_informed"]),
+    }, sort_keys=True))
+    if mismatched:
+        raise SystemExit(
+            f"plan-opt parity failure: {len(mismatched)} quer"
+            f"{'y' if len(mismatched) == 1 else 'ies'} diverged from the "
+            f"SRT_PLAN_OPT=0 oracle: {', '.join(mismatched)}")
+
+
 if __name__ == "__main__":
     import os
     if "--faults" in sys.argv:
@@ -544,7 +645,10 @@ if __name__ == "__main__":
     if metrics_out is not None:
         _METRICS_OUT = open(metrics_out, "a")
     try:
-        main()
+        if "--plan-opt" in sys.argv:
+            bench_plan_opt()
+        else:
+            main()
         if "--regress" in sys.argv:
             run_regress_gate()
     finally:

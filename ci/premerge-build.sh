@@ -272,6 +272,84 @@ else:
 EOF
 ls -l artifacts/regress-history.jsonl
 
+# Plan-optimizer lane: a mini-bank built to fire every rewrite rule at
+# least once (pushdown, reorder, topk, prune on the single-host query;
+# join on the dist shuffled-join -> broadcast rewrite), checked
+# bit-for-bit against the SRT_PLAN_OPT=0 oracle, then rerun under an
+# injected dispatch OOM to prove the recovery ladder (retry + split)
+# composes with optimized plans.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+python - <<'EOF'
+import os
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import registry
+from spark_rapids_tpu.parallel import make_flat_mesh, shard_table
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+
+r = np.random.default_rng(2)
+n = 4096
+fact = Table({
+    "k": Column.from_numpy(r.integers(0, 8, n).astype(np.int64)),
+    "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64)),
+    "unused": Column.from_numpy(r.uniform(0, 1, n)),
+})
+dim = Table({
+    "dk": Column.from_numpy(np.arange(8, dtype=np.int64)),
+    "w": Column.from_numpy(np.arange(8, dtype=np.int64) * 3),
+})
+mesh = make_flat_mesh()
+
+# pushdown (filter above a rename select) + reorder (two conjuncts
+# fused) + topk (sort+limit) + prune ('unused' never binds).
+q1 = (plan().select(("kk", col("k")), ("vv", col("v")))
+      .filter(col("kk") > 1).filter(col("vv") > 10)
+      .groupby_agg(["kk"], [("vv", "sum", "s")], domains={"kk": (0, 7)})
+      .sort_by(["s"], ascending=[False]).limit(3))
+# join: small unique-key build side + order-free exact aggregation
+# turns the shuffled join into a broadcast join under dist.
+q2 = (plan().join_shuffled(dim, left_on="k", right_on="dk", how="inner")
+      .groupby_agg(["k"], [("w", "sum", "ws"), ("v", "count", "c")],
+                   domains={"k": (0, 7)})
+      .sort_by(["k"]))
+
+def run_bank():
+    return [q1.run(fact).to_pydict(),
+            q2.run_dist(shard_table(fact, mesh), mesh).to_pydict()]
+
+registry().reset()
+opt = run_bank()
+snap = registry().counters_snapshot()
+for rule in ("pushdown", "reorder", "topk", "prune", "join"):
+    assert snap.get(f"plan.opt.rewrites.{rule}", 0) >= 1, (rule, snap)
+assert snap.get("plan.opt.pruned_columns", 0) >= 1, snap
+
+os.environ["SRT_PLAN_OPT"] = "0"
+oracle = run_bank()
+assert opt == oracle, "optimized plans diverged from the oracle"
+del os.environ["SRT_PLAN_OPT"]
+
+# Faulted rerun: optimizer on, dispatch OOM -> retry + bucket split.
+# A row-local query (split-capable; sort/limit plans are not, with or
+# without the optimizer) — pushdown still hoists its filter.
+qf = (plan().select(("kk", col("k")), ("vv", col("v")))
+      .filter(col("vv") > 10))
+os.environ["SRT_PLAN_OPT"] = "0"
+qf_oracle = qf.run(fact).to_pydict()
+del os.environ["SRT_PLAN_OPT"]
+os.environ["SRT_FAULT"] = "oom:dispatch:2"
+os.environ["SRT_RETRY_MAX"] = "1"
+reset_faults()
+before = recovery_stats().snapshot()
+assert qf.run(fact).to_pydict() == qf_oracle
+delta = recovery_stats().delta(before)
+assert delta["splits"] >= 1, delta
+print("plan-opt lane ok:", {k: v for k, v in sorted(snap.items())
+                            if k.startswith("plan.opt.")})
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

@@ -128,6 +128,20 @@ Knobs (all optional):
                                disables — every byte is read and the
                                full predicate runs downstream (the
                                bit-identity oracle).
+  ``SRT_PLAN_OPT``             rule-based plan-rewrite pass
+                               (exec/optimize.py) between Plan
+                               construction and bind/compile: predicate
+                               pushdown, projection pruning, filter
+                               reorder/fusion, limit-through-sort
+                               top-k, and cost-based join strategy.
+                               Default ON; ``0``/``off`` runs every
+                               plan verbatim — the bit-identity
+                               oracle.
+  ``SRT_PLAN_OPT_RULES``       comma list restricting which optimizer
+                               rules may fire (subset of
+                               ``pushdown,prune,reorder,topk,join``).
+                               Unset = all rules.  Unknown names raise
+                               at first use (jax-free validation).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -533,6 +547,50 @@ def scan_prune() -> bool:
     return raw.strip().lower() not in ("", "0", "off", "false", "no")
 
 
+PLAN_OPT_RULE_NAMES = ("pushdown", "prune", "reorder", "topk", "join")
+
+
+def plan_opt() -> bool:
+    """Plan-rewrite optimizer on/off (``SRT_PLAN_OPT``).
+
+    When on (the default), every executor entry point passes the Plan
+    through ``exec.optimize.optimize`` before bind/compile: predicate
+    pushdown, projection pruning, filter reorder/fusion,
+    limit-through-sort top-k, and (on the mesh) cost-based join
+    strategy.  ``0``/``off`` disables every rewrite — the plan runs
+    verbatim, the bit-identity oracle for parity checks."""
+    raw = os.environ.get("SRT_PLAN_OPT")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("", "0", "off", "false", "no")
+
+
+def plan_opt_rules() -> tuple[str, ...]:
+    """Enabled optimizer rule names (``SRT_PLAN_OPT_RULES``).
+
+    Unset/empty = every rule in :data:`PLAN_OPT_RULE_NAMES`.  A comma
+    list restricts the pass to those rules, preserving the pass's own
+    application order; unknown names raise ``ValueError`` (no jax
+    import needed — usable from plain config validation)."""
+    raw = os.environ.get("SRT_PLAN_OPT_RULES")
+    if raw is None or not raw.strip():
+        return PLAN_OPT_RULE_NAMES
+    seen: list[str] = []
+    for part in raw.split(","):
+        name = part.strip().lower()
+        if not name:
+            continue
+        if name not in PLAN_OPT_RULE_NAMES:
+            raise ValueError(
+                f"SRT_PLAN_OPT_RULES: unknown rule {name!r} "
+                f"(choose from {', '.join(PLAN_OPT_RULE_NAMES)})")
+        if name not in seen:
+            seen.append(name)
+    if not seen:
+        return PLAN_OPT_RULE_NAMES
+    return tuple(seen)
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -612,5 +670,6 @@ def knob_table() -> dict[str, str]:
              "SRT_SHUFFLE_RETRY_MAX", "SRT_STREAM_TIMEOUT", "SRT_FAULT",
              "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT",
              "SRT_LIVE_SERVER", "SRT_LIVE_PORT",
-             "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE")
+             "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE",
+             "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -55,8 +55,8 @@ from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate, render
 from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
-                   LimitStep, Plan, ProjectStep, SortStep, UnionAllStep,
-                   WindowStep)
+                   LimitStep, Plan, ProjectStep, SortStep, TopKStep,
+                   UnionAllStep, WindowStep)
 
 def _dense_max_cells() -> int:
     """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
@@ -78,6 +78,28 @@ _ENGINE_HIDDEN = re.compile(
 
 def _is_engine_hidden(name: str) -> bool:
     return bool(_ENGINE_HIDDEN.match(name))
+
+
+def _pruned_input(plan: Plan, table: Table) -> Table:
+    """Subset the input to an optimizer-pruned plan's live column set
+    BEFORE padding/encoding, so pruned payload columns are never bound.
+    Identity when the plan was not optimizer-narrowed or nothing drops;
+    idempotent, so ``_bind`` (ahead of the bucketing pad) and ``_Bound``
+    (direct exact-shape binds) may both call it."""
+    if getattr(plan, "opt", None) is None:
+        return table
+    from .optimize import live_input_names
+    live = live_input_names(plan)
+    if live is None:
+        return table
+    live = set(live)
+    keep = [nm for nm in table.names
+            if nm in live or _is_engine_hidden(nm)]
+    if len(keep) == len(table.names):
+        return table
+    from ..obs.metrics import counter
+    counter("plan.opt.pruned_columns").inc(len(table.names) - len(keep))
+    return table.select(keep)
 
 
 class _JoinMarkerT:
@@ -165,6 +187,7 @@ class _Bound:
 
     def __init__(self, plan: Plan, table: Table, probe_mask=None,
                  init_sel=None, logical_rows=None):
+        table = _pruned_input(plan, table)
         self.plan = plan
         self.n = table.num_rows
         self.input_names = tuple(table.names)
@@ -234,7 +257,7 @@ class _Bound:
         for step in plan.steps:
             if isinstance(step, GroupAggStep):
                 key_names.update(step.keys)
-            elif isinstance(step, SortStep):
+            elif isinstance(step, (SortStep, TopKStep)):
                 key_names.update(step.by)
             elif isinstance(step, WindowStep):
                 key_names.update(step.partition_by)
@@ -432,7 +455,7 @@ class _Bound:
                 passthrough = set()
                 self._row_aligned = False
             else:
-                if isinstance(step, (SortStep, LimitStep)):
+                if isinstance(step, (SortStep, LimitStep, TopKStep)):
                     self._row_aligned = False
                 steps.append(step)
         self.steps = tuple(steps)
@@ -861,6 +884,24 @@ def _trace_limit(cols, sel, step: LimitStep):
                         dtype=c.dtype)
            for name, c in cols.items()}
     return out, None
+
+
+def _trace_topk(cols, sel, step: TopKStep):
+    """Fused Sort→Limit(k) (the optimizer's ``topk`` rewrite): the
+    selection-leading stable sort already puts live rows first, so the
+    leading ``k`` slots are exactly what :func:`_trace_limit`'s
+    stable-argsort-and-gather would pick — a static slice replaces the
+    limit's second full-length sort pass."""
+    out, new_sel = _trace_sort(
+        cols, sel, SortStep(step.by, step.ascending, step.nulls_first))
+    n = next(iter(out.values())).size
+    k = min(step.k, n)
+    sliced = {name: Column(data=c.data[:k],
+                           validity=None if c.validity is None
+                           else c.validity[:k],
+                           dtype=c.dtype)
+              for name, c in out.items()}
+    return sliced, None if new_sel is None else new_sel[:k]
 
 
 # -- group-by: dense-domain path --------------------------------------------
@@ -1419,6 +1460,13 @@ def _step_closures(steps: tuple, group_metas: tuple[_GroupMeta, ...],
                     "a distributed plan; aggregate first")
             fns.append(lambda cols, sel, side, step=step:
                        _trace_limit(cols, sel, step))
+        elif isinstance(step, TopKStep):
+            if sharded:
+                raise TypeError(
+                    "top-k over still-sharded rows is not supported in "
+                    "a distributed plan; aggregate first")
+            fns.append(lambda cols, sel, side, step=step:
+                       _trace_topk(cols, sel, step))
         else:
             raise TypeError(f"unknown plan step {step!r}")
     return fns
@@ -1702,6 +1750,7 @@ def _bind(plan: Plan, table: Table) -> _Bound:
     inapplicable (SRT_SHAPE_BUCKETS=0, shuffled-join plans, nested/
     two-word columns)."""
     from .bucketing import prepare_input
+    table = _pruned_input(plan, table)
     bi = prepare_input(plan, table)
     if bi is None:
         return _Bound(plan, table)
@@ -1742,6 +1791,8 @@ def _final_order(steps: tuple, initial: tuple[str, ...]) -> tuple[str, ...]:
 def run_plan_padded(plan: Plan, table: Table):
     if table.num_rows == 0:
         return run_plan_eager(plan, table), None
+    from .optimize import optimize
+    plan = optimize(plan)
     bound = _bind(plan, table)
     fn = _compiled_for(bound)
     out_cols, sel = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
@@ -1758,6 +1809,8 @@ def run_plan(plan: Plan, table: Table, progress=None) -> Table:
     transitions.  None (default) pays nothing extra."""
     if table.num_rows == 0:
         return run_plan_eager(plan, table)
+    from .optimize import optimize
+    plan = optimize(plan)
     from ..config import metrics_enabled
     if metrics_enabled() or progress is not None:
         return _run_plan_metered(plan, table, progress=progress)[0]
@@ -1789,8 +1842,13 @@ def _run_plan_metered(plan: Plan, table: Table, progress=None):
         set_last_query_metrics
     from ..resilience import recovery_stats
     from ..obs import profile as _prof
+    from .optimize import source_plan
+    # Fingerprints and history records key on the user's ORIGINAL plan:
+    # that is the object the next session's optimize() fingerprints when
+    # it looks its history up.
+    src = source_plan(plan)
     qm = QueryMetrics(query_id=next_query_id(), mode="run",
-                      fingerprint=plan_fingerprint(plan),
+                      fingerprint=plan_fingerprint(src),
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     lq = _live.start("run", query_id=qm.query_id,
@@ -1816,9 +1874,10 @@ def _run_plan_metered(plan: Plan, table: Table, progress=None):
     qm.apply_recovery(recovery_stats().delta(r_before))
     lq.note_hbm(qm.hbm_peak_bytes)
     lq.finish(output_rows=t.num_rows)
+    qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(plan, qm)
+    maybe_record(src, qm)
     return t, qm
 
 
@@ -2149,6 +2208,10 @@ def _step_descriptions(bound: _Bound) -> list[tuple[str, str]]:
             out.append(("Sort", f"Sort[{', '.join(step.by)}]"))
         elif isinstance(step, LimitStep):
             out.append(("Limit", f"Limit[{step.k}]"))
+        elif isinstance(step, TopKStep):
+            out.append(("TopK",
+                        f"TopK[{', '.join(step.by)} k={step.k}; fused "
+                        f"sort+limit, static slice]"))
     return out
 
 
@@ -2162,6 +2225,8 @@ def _static_step_metrics(bound: _Bound) -> list:
 
 def explain_plan(plan: Plan, table: Table) -> str:
     """Human-readable bound physical plan (see Plan.explain)."""
+    from .optimize import optimize
+    plan = optimize(plan)
     bound = _Bound(plan, table)
     lines = [f"Plan over {table.num_rows} rows x "
              f"{table.num_columns} cols"]
@@ -2178,6 +2243,9 @@ def explain_plan(plan: Plan, table: Table) -> str:
                      isinstance(s, (FilterStep, GroupAggStep, JoinStep,
                                     JoinShuffledStep))
                      for s in bound.steps) else "0 host syncs]"))
+    info = getattr(plan, "opt", None)
+    if info is not None and info.rewrites:
+        lines.append(info.render_diff())
     return "\n".join(lines)
 
 
@@ -2198,8 +2266,14 @@ def analyze_plan(plan: Plan, table: Table):
     from ..obs.history import plan_fingerprint
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
+    from .optimize import optimize, source_plan
+    # Analyze keeps reordered conjuncts one-per-step, so each conjunct's
+    # observed selectivity lands in the history — the feedback the run
+    # modes' reorder rule reads back.
+    plan = optimize(plan, mode="analyze")
+    src = source_plan(plan)
     qm = QueryMetrics(query_id=next_query_id(), mode="analyze",
-                      fingerprint=plan_fingerprint(plan),
+                      fingerprint=plan_fingerprint(src),
                       input_rows=table.num_rows,
                       input_columns=table.num_columns)
     lq = _live.start("analyze", query_id=qm.query_id,
@@ -2212,9 +2286,10 @@ def analyze_plan(plan: Plan, table: Table):
         lq.finish(status="error", error=repr(err))
         raise
     lq.finish(output_rows=qm.output_rows)
+    qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(plan, qm)
+    maybe_record(src, qm)
     return t, qm
 
 
@@ -2314,6 +2389,8 @@ def explain_analyze_plan(plan: Plan, table: Table,
         with recording() as rec:
             text = explain_analyze_plan(plan, table)
         return text + "\n" + rec.summary()
+    from .optimize import optimize
+    plan = optimize(plan, mode="analyze")
     from ..config import metrics_enabled
     from ..obs.query import UNMEASURED_FLOAT, QueryMetrics
     header = (f"Plan over {table.num_rows} rows x "
@@ -2332,9 +2409,14 @@ def explain_analyze_plan(plan: Plan, table: Table,
                 if table.num_rows == 0 and metrics_enabled()
                 else "  (metrics unavailable: set SRT_METRICS=1 "
                      "to measure)")
+        qm.apply_opt(getattr(plan, "opt", None))
         return qm.render(header) + "\n" + note
     _, qm = analyze_plan(plan, table)
-    return qm.render(header)
+    text = qm.render(header)
+    info = getattr(plan, "opt", None)
+    if info is not None and info.rewrites:
+        text += "\n" + info.render_diff()
+    return text
 
 
 # ---------------------------------------------------------------------------
@@ -2470,6 +2552,11 @@ def run_plan_eager(plan: Plan, table: Table) -> Table:
             t = ops.sort_by(t, list(step.by), list(step.ascending),
                             list(step.nulls_first))
         elif isinstance(step, LimitStep):
+            k = min(step.k, t.num_rows)
+            t = t.gather(jnp.arange(k, dtype=jnp.int32))
+        elif isinstance(step, TopKStep):
+            t = ops.sort_by(t, list(step.by), list(step.ascending),
+                            list(step.nulls_first))
             k = min(step.k, t.num_rows)
             t = t.gather(jnp.arange(k, dtype=jnp.int32))
         else:

@@ -112,6 +112,15 @@ def run_plan_dist(plan: Plan, dist: DistTable, mesh: Mesh):
     block isolating mesh-ladder activity.
     """
     from ..config import metrics_enabled
+    from .optimize import optimize
+    # The join rule's cost model reads the live probe cardinality (the
+    # empty-input guard needs this count anyway, so the sync is shared)
+    # and the build tables themselves for the uniqueness/dtype checks.
+    axis = mesh.axis_names[0]
+    plan = optimize(plan, mode="dist",
+                    probe_rows=_live_count_cached(dist.row_mask),
+                    mesh_size=int(mesh.shape[axis]),
+                    probe_table=dist.table)
     if metrics_enabled():
         return _run_plan_dist_metered(plan, dist, mesh)
     from ..obs import timeline as _tl
@@ -134,8 +143,10 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     from ..obs.query import QueryMetrics, next_query_id, \
         set_last_query_metrics
     from ..resilience import recovery_stats
+    from .optimize import source_plan
+    src = source_plan(plan)
     qm = QueryMetrics(query_id=next_query_id(), mode="dist",
-                      fingerprint=plan_fingerprint(plan),
+                      fingerprint=plan_fingerprint(src),
                       input_rows=_live_count_cached(dist.row_mask),
                       input_columns=dist.table.num_columns)
     lq = _live.start("dist", query_id=qm.query_id,
@@ -174,9 +185,10 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     qm.apply_recovery(recovery_stats().delta(r_before))
     lq.note_hbm(qm.hbm_peak_bytes)
     lq.finish(output_rows=qm.output_rows or None)
+    qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(plan, qm)
+    maybe_record(src, qm)
     return result
 
 

@@ -67,11 +67,18 @@ from .plan import GroupAggStep, JoinShuffledStep
 from .stream import _chain_batches, _combine_setup
 
 
-def _shard_batch(batch: Table, mesh) -> DistTable:
+def _shard_batch(batch: Table, mesh, plan=None) -> DistTable:
     """Deal one host batch over the mesh at the shared bucket schedule's
     per-shard capacity.  The returned DistTable's buffers are fresh
     engine-owned copies — never the caller's — so they are always safe
-    to donate."""
+    to donate.
+
+    When ``plan`` is an optimizer-pruned plan, the batch is subset to
+    its live input columns BEFORE the deal-out — pruned payload columns
+    never pad, ship over ICI, or pin per-shard HBM."""
+    if plan is not None:
+        from .compile import _pruned_input
+        batch = _pruned_input(plan, batch)
     P = int(mesh.devices.size)
     return shard_table(batch, mesh,
                        capacity=shard_capacity(batch.num_rows, P))
@@ -213,7 +220,7 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
             t0 = _time.perf_counter()
             with _tspan("stream.dispatch", cat="stream", lane=lane,
                         batch=bi, shards=P):
-                dist_b = _shard_batch(batch, mesh)
+                dist_b = _shard_batch(batch, mesh, plan)
                 live = dist_b.live_count_device()
                 live_dev = live if live_dev is None else live_dev + live
                 result = _execute_dist_resilient(
@@ -225,7 +232,7 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
             t0 = _time.perf_counter()
             with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
                         rows=batch.num_rows, shards=P):
-                dist_b = _shard_batch(batch, mesh)
+                dist_b = _shard_batch(batch, mesh, plan)
                 record_avoided_sync("dist.live_count")
                 acct.syncs_avoided += 1
                 live = dist_b.live_count_device()
@@ -251,7 +258,7 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
                 # batch, which is never donated.
                 if any(c.is_deleted()
                        for c in state[1].exec_cols.values()):
-                    state[0] = _shard_batch(batch, mesh)
+                    state[0] = _shard_batch(batch, mesh, plan)
                     state[1] = _Bound(plan, state[0].table,
                                       probe_mask=state[0].row_mask)
                 # Looked up INSIDE the ladder closure: an evict rung
@@ -410,7 +417,7 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
         t0 = _time.perf_counter()
         with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
                     rows=batch.num_rows, shards=P):
-            dist_b = _shard_batch(batch, mesh)
+            dist_b = _shard_batch(batch, mesh, plan)
             state = [dist_b, None]
 
             def do_bind():
@@ -447,7 +454,7 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
             # A prior attempt may have donated (and lost) this batch's
             # sharded copies — re-shard from the user's batch.
             if any(c.is_deleted() for c in state[1].exec_cols.values()):
-                state[0] = _shard_batch(batch, mesh)
+                state[0] = _shard_batch(batch, mesh, plan)
                 state[1] = _Bound(plan, state[0].table,
                                   probe_mask=state[0].row_mask)
             fn = _dist_partial_program(state[1], smeta, mesh, axis,

@@ -295,6 +295,68 @@ def references(expr: Expr) -> set[str]:
     raise TypeError(f"not an expression: {expr!r}")
 
 
+def substitute(expr: Expr, mapping: dict[str, Expr]) -> Expr:
+    """Rebuild an expression with column references replaced.
+
+    ``mapping`` sends a column name to the expression it stands for —
+    the plan optimizer uses this to move a filter above a projection
+    that renamed its inputs.  References not in the mapping are kept
+    as-is; untouched subtrees are returned by identity so a no-op
+    substitution yields a structurally-equal (and often identical)
+    tree."""
+    if isinstance(expr, Col):
+        return mapping.get(expr.name, expr)
+    if isinstance(expr, Lit):
+        return expr
+    if isinstance(expr, FillNull):
+        op = substitute(expr.operand, mapping)
+        return expr if op is expr.operand else FillNull(op, expr.value)
+    if isinstance(expr, Cast):
+        op = substitute(expr.operand, mapping)
+        return expr if op is expr.operand else Cast(op, expr.to)
+    if isinstance(expr, UnOp):
+        op = substitute(expr.operand, mapping)
+        return expr if op is expr.operand else UnOp(expr.op, op)
+    if isinstance(expr, BinOp):
+        lhs = substitute(expr.left, mapping)
+        rhs = substitute(expr.right, mapping)
+        if lhs is expr.left and rhs is expr.right:
+            return expr
+        return BinOp(expr.op, lhs, rhs)
+    if isinstance(expr, IsIn):
+        op = substitute(expr.operand, mapping)
+        return expr if op is expr.operand else IsIn(op, expr.values)
+    if isinstance(expr, CaseWhen):
+        branches = tuple((substitute(c, mapping), substitute(v, mapping))
+                         for c, v in expr.branches)
+        default = (substitute(expr.default, mapping)
+                   if expr.default is not None else None)
+        if (all(nc is c and nv is v for (nc, nv), (c, v)
+                in zip(branches, expr.branches))
+                and default is expr.default):
+            return expr
+        return CaseWhen(branches, default)
+    raise TypeError(f"not an expression: {expr!r}")
+
+
+def expr_size(expr: Expr) -> int:
+    """Node count of an expression tree (optimizer fusion budget)."""
+    if isinstance(expr, (Col, Lit)):
+        return 1
+    if isinstance(expr, (FillNull, Cast, UnOp, IsIn)):
+        return 1 + expr_size(expr.operand)
+    if isinstance(expr, BinOp):
+        return 1 + expr_size(expr.left) + expr_size(expr.right)
+    if isinstance(expr, CaseWhen):
+        n = 1
+        for c, v in expr.branches:
+            n += expr_size(c) + expr_size(v)
+        if expr.default is not None:
+            n += expr_size(expr.default)
+        return n
+    raise TypeError(f"not an expression: {expr!r}")
+
+
 def evaluate(expr: Expr, env: dict[str, Column]) -> Column:
     """Evaluate an expression tree against named columns (trace-safe).
 

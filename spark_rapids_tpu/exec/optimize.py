@@ -1,0 +1,600 @@
+"""Rule-based plan optimizer — rewrites between Plan construction and
+bind/compile.
+
+The engine records per-step live rows, selection density, and a per-plan
+cost ledger keyed by a stable fingerprint, but (ROADMAP item 3) never
+acted on any of it.  This pass closes the loop: every executor entry
+point (``run_plan`` / ``analyze_plan`` / ``run_plan_stream`` /
+``run_plan_dist`` / dist-stream) calls :func:`optimize` ONCE on the
+user's plan, and the rewritten copy is what binds and compiles.  The
+rules — each independently toggleable via ``SRT_PLAN_OPT_RULES`` and
+logged — are classical relational rewrites restricted to forms that are
+*bit-identical* under the engine's selection-mask semantics:
+
+``pushdown``   Hoist filters above projections (substituting renamed
+               references) and above UNION ALL branches, toward the
+               scan.  A longer leading filter run means
+               ``Plan.scan_predicates()`` hands more conjuncts to
+               parquet row-group/page pruning.  Sound because a filter
+               only ANDs the selection mask and a projection never
+               reads it; never hoists past window functions (their
+               frames depend on the mask) or joins.
+``reorder``    Flatten each maximal run of FilterSteps into its Kleene
+               conjuncts, order them by observed selectivity from the
+               metrics history (most selective first; unknowns keep
+               their position), and fuse back into one FilterStep —
+               Kleene AND of keep-masks is order/associativity
+               invariant bitwise.  Under ``analyze`` the conjuncts stay
+               split one-per-step so per-conjunct selectivity lands in
+               the history for later runs.  Adjacent projections fuse
+               the same way (substitution through the first project's
+               definitions), so ``_step_closures`` traces fewer ops.
+``topk``       Sort followed by Limit(k) becomes one :class:`TopKStep`:
+               the same mask-leading sort, then a static ``[:k]`` slice
+               instead of the limit's argsort/gather pass.
+``prune``      Backward liveness over the step list; when the plan's
+               input needs only a known column subset, a leading
+               narrow pass-through projection is inserted so unused
+               payload columns are never bound, padded, or shipped
+               over ICI (the bind layer subsets the table before
+               padding — see compile._Bound / dist_stream).
+``join``       (``run_plan_dist`` only) Rewrite a shuffled join whose
+               build side is provably small, unique-keyed, non-null
+               and fixed-width into a broadcast join — replicating a
+               dimension table beats ``all_to_all``-ing the fact table.
+               Probe cardinality comes from ``SRT_METRICS_HISTORY``
+               (:func:`..obs.history.lookup_latest`) when the plan ran
+               before, else from the live DistTable.  Applied only
+               when a following group-by makes the row-order change
+               unobservable (order-insensitive exact aggregates).
+
+``SRT_PLAN_OPT=0`` disables the whole pass: the plan runs verbatim —
+the bit-identity oracle every rewrite is tested against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as _field
+from typing import Optional
+
+from ..config import get_logger, plan_opt, plan_opt_rules
+from ..io.pushdown import split_conjuncts
+from .expr import BinOp, Col, expr_size, references, render, substitute
+from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
+                   LimitStep, Plan, ProjectStep, SortStep, TopKStep,
+                   UnionAllStep, WindowStep)
+
+_LOG = get_logger("spark_rapids_tpu.optimize")
+
+#: Build sides beyond this row count never broadcast, whatever the cost
+#: model says — replicating more is an HBM bet the optimizer won't make.
+BROADCAST_MAX_BUILD_ROWS = 65536
+
+#: Fused-expression node budget: past this, fusing projections stops
+#: paying (trace time grows, XLA CSE has more to undo).
+FUSE_NODE_BUDGET = 256
+
+#: Aggregations whose result is exact regardless of input row order —
+#: the precondition for swapping a shuffled join (which repartitions
+#: rows by key hash) for a broadcast join (which keeps probe order).
+_ORDER_FREE_AGGS = frozenset({"count", "count_all", "min", "max",
+                              "nunique"})
+#: ... and these are order-free only over integer inputs (float
+#: accumulation order changes low bits).
+_ORDER_FREE_INT_AGGS = frozenset({"sum", "mean"})
+
+
+@dataclass
+class OptInfo:
+    """What the optimizer did to one plan — attached to the rewritten
+    Plan as ``plan.opt`` and folded into QueryMetrics' ``opt`` block."""
+    enabled: bool
+    rules: tuple
+    rewrites: dict = _field(default_factory=dict)
+    steps_before: int = 0
+    steps_after: int = 0
+    history_informed: bool = False
+    #: one-line step texts, for the explain() before/after diff
+    before: tuple = ()
+    after: tuple = ()
+    #: the user's original (un-rewritten) Plan — fingerprints, history
+    #: records, and oracle comparisons key on THIS object.
+    source: object = None
+
+    def render_diff(self) -> str:
+        """The explain() before/after step diff."""
+        if not self.rewrites:
+            return "  == Optimizer == no rewrites applied"
+        rw = " ".join(f"{k}={v}" for k, v in sorted(self.rewrites.items()))
+        lines = [f"  == Optimizer == {rw}"
+                 + (" (history-informed)" if self.history_informed else "")]
+        lines += [f"  - {t}" for t in self.before]
+        lines += [f"  + {t}" for t in self.after]
+        return "\n".join(lines)
+
+
+def source_plan(plan) -> Plan:
+    """The pre-optimization plan (identity when never optimized) — the
+    object history records and bit-identity oracles key on."""
+    info = getattr(plan, "opt", None)
+    return info.source if info is not None and info.source is not None \
+        else plan
+
+
+def live_input_names(plan) -> Optional[tuple]:
+    """The input-column subset a pruned plan actually reads, or None.
+
+    Non-None exactly when the plan leads with an all-pass-through
+    narrow projection (what the ``prune`` rule inserts): the bind
+    layers subset the input table to these names BEFORE padding /
+    sharding, which is where the pruned columns' cost would have been
+    paid."""
+    if plan.steps and _is_passthrough_narrow(plan.steps[0]):
+        return tuple(nm for nm, _ in plan.steps[0].cols)
+    return None
+
+
+def _is_passthrough_narrow(step) -> bool:
+    return (isinstance(step, ProjectStep) and step.narrow
+            and all(isinstance(ex, Col) and ex.name == nm
+                    for nm, ex in step.cols))
+
+
+# -- step text (plan-level; the bound _step_descriptions needs a table) --
+
+def _step_text(step) -> str:
+    if isinstance(step, FilterStep):
+        return f"Filter[{render(step.pred)}]"
+    if isinstance(step, ProjectStep):
+        kind = "Select" if step.narrow else "Project"
+        return f"{kind}[{', '.join(nm for nm, _ in step.cols)}]"
+    if isinstance(step, GroupAggStep):
+        return f"GroupBy[{', '.join(step.keys)}]"
+    if isinstance(step, JoinStep):
+        return f"BroadcastJoin[{', '.join(step.left_on)} {step.how}]"
+    if isinstance(step, JoinShuffledStep):
+        return f"ShuffledJoin[{', '.join(step.left_on)} {step.how}]"
+    if isinstance(step, UnionAllStep):
+        return "UnionAll"
+    if isinstance(step, WindowStep):
+        return f"Window[{step.out}={step.func}]"
+    if isinstance(step, SortStep):
+        return f"Sort[{', '.join(step.by)}]"
+    if isinstance(step, TopKStep):
+        return f"TopK[{', '.join(step.by)} k={step.k}]"
+    if isinstance(step, LimitStep):
+        return f"Limit[{step.k}]"
+    return type(step).__name__
+
+
+def plan_step_texts(plan) -> tuple:
+    return tuple(_step_text(s) for s in plan.steps)
+
+
+# -- rule: predicate pushdown --------------------------------------------
+
+def _hoist_over_project(pred, proj: ProjectStep):
+    """The predicate as seen BELOW ``proj``, or None when the hoist is
+    unsound (a referenced column is computed by the projection)."""
+    defined = dict(proj.cols)
+    mapping = {}
+    for ref in references(pred):
+        ex = defined.get(ref)
+        if ex is not None:
+            if not isinstance(ex, Col):
+                return None               # computed column: can't hoist
+            if ex.name != ref:
+                mapping[ref] = ex         # pure rename: substitute
+        elif proj.narrow:
+            return None                   # not produced — leave alone
+    return substitute(pred, mapping) if mapping else pred
+
+
+def _rule_pushdown(steps: tuple) -> tuple[tuple, int]:
+    out = list(steps)
+    count = 0
+    budget = len(out) * len(out) + 8
+    changed = True
+    while changed and budget > 0:
+        changed = False
+        budget -= 1
+        for i in range(len(out) - 1):
+            above, flt = out[i], out[i + 1]
+            if not isinstance(flt, FilterStep):
+                continue
+            if isinstance(above, ProjectStep):
+                pred = _hoist_over_project(flt.pred, above)
+                if pred is None:
+                    continue
+                out[i], out[i + 1] = FilterStep(pred), above
+                count += 1
+                changed = True
+                break
+            if isinstance(above, UnionAllStep):
+                # Filtering after UNION ALL == filtering each side: the
+                # union concatenates data and selection mask per side,
+                # and the filter ANDs the mask row-locally.
+                branch = Plan(above.plan.steps + (FilterStep(flt.pred),))
+                out[i] = FilterStep(flt.pred)
+                out[i + 1] = UnionAllStep(above.table, branch)
+                count += 1
+                changed = True
+                break
+    return tuple(out), count
+
+
+# -- rule: filter reorder / fusion ---------------------------------------
+
+def _history_selectivities(rec: Optional[dict]) -> dict:
+    """describe-text -> observed selectivity (rows_out / rows_in) from
+    one history record's measured steps."""
+    sel: dict = {}
+    if not rec:
+        return sel
+    for s in rec.get("steps", ()):
+        if not isinstance(s, dict) or s.get("kind") != "Filter":
+            continue
+        rows_in, rows_out = s.get("rows_in", -1), s.get("rows_out", -1)
+        if isinstance(rows_in, (int, float)) and rows_in > 0 \
+                and isinstance(rows_out, (int, float)) and rows_out >= 0:
+            sel[s.get("describe")] = rows_out / rows_in
+    return sel
+
+
+def _filter_describe(conjunct) -> str:
+    # Must match compile._step_descriptions' FilterStep text — that is
+    # what analyze runs record into the history.
+    return f"Filter[{render(conjunct)}] -> selection mask"
+
+
+def _rule_reorder(steps: tuple, mode: str,
+                  hist_sel: dict) -> tuple[tuple, int, bool]:
+    out: list = []
+    count = 0
+    hist_used = False
+    i = 0
+    while i < len(steps):
+        if not isinstance(steps[i], FilterStep):
+            out.append(steps[i])
+            i += 1
+            continue
+        j = i
+        while j < len(steps) and isinstance(steps[j], FilterStep):
+            j += 1
+        run = list(steps[i:j])
+        conjuncts: list = []
+        for f in run:
+            conjuncts.extend(split_conjuncts(f.pred))
+        found = [hist_sel.get(_filter_describe(c)) for c in conjuncts]
+        # Stable sort on observed selectivity: unknown conjuncts keep
+        # their relative position at selectivity 1.0 (run last).
+        order = sorted(range(len(conjuncts)),
+                       key=lambda k: 1.0 if found[k] is None else found[k])
+        ordered = [conjuncts[k] for k in order]
+        if mode == "analyze":
+            # One step per conjunct: the analyze run measures each
+            # conjunct's selectivity separately, which is what feeds
+            # this very rule on the next run.
+            new_run = [FilterStep(c) for c in ordered]
+        else:
+            pred = ordered[0]
+            for c in ordered[1:]:
+                pred = BinOp("and_kleene", pred, c)
+            new_run = [FilterStep(pred)]
+        if new_run != run:
+            count += 1
+            if any(found[k] is not None for k in order):
+                hist_used = True
+            out.extend(new_run)
+        else:
+            out.extend(run)
+        i = j
+    return tuple(out), count, hist_used
+
+
+def _fuse_projects(p1: ProjectStep, p2: ProjectStep):
+    """One ProjectStep equal to ``p1`` then ``p2``, or None when the
+    fusion blows the node budget.  Both projects evaluate against their
+    own input state, so ``p2``'s references to ``p1``-defined names are
+    substituted through ``p1``'s definitions."""
+    p1map = dict(p1.cols)
+    if p2.narrow:
+        cols = tuple((nm, substitute(ex, p1map)) for nm, ex in p2.cols)
+        fused = ProjectStep(cols, True)
+    else:
+        redefined = {nm: substitute(ex, p1map) for nm, ex in p2.cols}
+        cols = []
+        for nm, ex in p1.cols:
+            cols.append((nm, redefined.pop(nm)) if nm in redefined
+                        else (nm, ex))
+        for nm, _ in p2.cols:
+            if nm in redefined:            # genuinely new name: append
+                cols.append((nm, redefined.pop(nm)))
+        fused = ProjectStep(tuple(cols), p1.narrow)
+    if any(expr_size(ex) > FUSE_NODE_BUDGET for _, ex in fused.cols):
+        return None
+    return fused
+
+
+def _rule_fuse_projects(steps: tuple) -> tuple[tuple, int]:
+    out: list = []
+    count = 0
+    for step in steps:
+        if out and isinstance(out[-1], ProjectStep) \
+                and isinstance(step, ProjectStep):
+            fused = _fuse_projects(out[-1], step)
+            if fused is not None:
+                out[-1] = fused
+                count += 1
+                continue
+        out.append(step)
+    return tuple(out), count
+
+
+# -- rule: limit-through-sort (top-k) ------------------------------------
+
+def _rule_topk(steps: tuple) -> tuple[tuple, int]:
+    out: list = []
+    count = 0
+    i = 0
+    while i < len(steps):
+        s = steps[i]
+        if isinstance(s, SortStep) and i + 1 < len(steps) \
+                and isinstance(steps[i + 1], LimitStep):
+            out.append(TopKStep(s.by, s.ascending, s.nulls_first,
+                                steps[i + 1].k))
+            count += 1
+            i += 2
+        else:
+            out.append(s)
+            i += 1
+    return tuple(out), count
+
+
+# -- rule: projection pruning --------------------------------------------
+
+def _live_before(step, live: Optional[set]) -> Optional[set]:
+    """Column liveness at a step's INPUT, given liveness at its output
+    (None = every column is (or may be) live)."""
+    if isinstance(step, FilterStep):
+        return None if live is None else live | references(step.pred)
+    if isinstance(step, ProjectStep):
+        if step.narrow:
+            entries = step.cols if live is None else \
+                [e for e in step.cols if e[0] in live]
+            need: set = set()
+            for _, ex in entries:
+                need |= references(ex)
+            return need
+        if live is None:
+            return None                   # pass-through keeps everything
+        defined = {nm for nm, _ in step.cols}
+        need = set(live - defined)
+        for nm, ex in step.cols:
+            if nm in live:
+                need |= references(ex)
+        return need
+    if isinstance(step, GroupAggStep):
+        need = set(step.keys)
+        for c, _how, _ in step.aggs:
+            if c:
+                need.add(c)
+        return need
+    if isinstance(step, (JoinStep, JoinShuffledStep)):
+        if step.how in ("inner", "left"):
+            if live is None:
+                return None
+            payload = {n for n in step.table.names
+                       if n not in set(step.right_on)}
+            return (live - payload) | set(step.left_on)
+        # semi/anti: probe schema passes through unchanged
+        return None if live is None else live | set(step.left_on)
+    if isinstance(step, WindowStep):
+        if live is None:
+            return None
+        need = (live - {step.out}) | set(step.partition_by) \
+            | set(step.order_by)
+        if step.value:
+            need.add(step.value)
+        return need
+    if isinstance(step, (SortStep, TopKStep)):
+        return None if live is None else live | set(step.by)
+    if isinstance(step, LimitStep):
+        return live
+    # UnionAllStep (branch schema must match the FULL current schema)
+    # and anything unknown: every input column stays live.
+    return None
+
+
+def _rule_prune(steps: tuple) -> tuple[tuple, int]:
+    live: Optional[set] = None            # plan output: all columns live
+    for step in reversed(steps):
+        live = _live_before(step, live)
+    if live is None or not live:
+        return steps, 0
+    lead = ProjectStep(tuple((nm, Col(nm)) for nm in sorted(live)), True)
+    if steps and _is_passthrough_narrow(steps[0]):
+        if {nm for nm, _ in steps[0].cols} == live:
+            return steps, 0               # already exactly pruned
+        return (lead,) + steps[1:], 1
+    return (lead,) + steps, 1
+
+
+# -- rule: cost-based join strategy (dist) -------------------------------
+
+def _keys_unique_nonnull(table, keys: tuple) -> bool:
+    """Host-side check over a SMALL build table: every key column fully
+    valid and the (possibly composite) key combination unique — the
+    broadcast-join build-side contract."""
+    import numpy as np
+    arrs = []
+    for k in keys:
+        if k not in table:
+            return False
+        vals, mask = table[k].to_numpy()
+        if mask is not None and not bool(np.all(mask)):
+            return False
+        arrs.append(np.asarray(vals))
+    if not arrs:
+        return False
+    stacked = np.stack(arrs, axis=1) if len(arrs) > 1 else arrs[0]
+    uniq = np.unique(stacked, axis=0) if len(arrs) > 1 \
+        else np.unique(stacked)
+    return len(uniq) == int(table.num_rows)
+
+
+def _int_dtype(name: str, *tables) -> bool:
+    for t in tables:
+        if t is not None and name in t:
+            dt = t[name].dtype
+            return bool(dt is not None and dt.is_integer)
+    return False
+
+
+def _order_free_tail(steps: tuple, i: int, build, probe) -> bool:
+    """True when everything after the join at ``i`` makes row order
+    unobservable: only row-local steps up to a GroupAggStep whose
+    aggregates are exact regardless of input order."""
+    computed: set = set()
+    for s in steps[i + 1:]:
+        if isinstance(s, FilterStep):
+            continue
+        if isinstance(s, ProjectStep):
+            for nm, ex in s.cols:
+                if not (isinstance(ex, Col) and ex.name == nm):
+                    computed.add(nm)
+            continue
+        if isinstance(s, GroupAggStep):
+            for c, how, _ in s.aggs:
+                if how in _ORDER_FREE_AGGS:
+                    continue
+                if how in _ORDER_FREE_INT_AGGS and c not in computed \
+                        and _int_dtype(c, build, probe):
+                    continue
+                return False
+            return True
+        return False                      # sort/window/... before the agg
+    return False
+
+
+def _rule_join(steps: tuple, probe_rows, mesh_size, probe_table,
+               rec: Optional[dict]) -> tuple[tuple, int, bool]:
+    out = list(steps)
+    count = 0
+    hist_used = False
+    shards = max(int(mesh_size or 1), 1)
+    for i, step in enumerate(out):
+        if not isinstance(step, JoinShuffledStep):
+            continue
+        if step.how not in ("inner", "left"):
+            continue
+        build = step.table
+        build_rows = int(getattr(build, "num_rows", 0) or 0)
+        if build_rows == 0 or build_rows > BROADCAST_MAX_BUILD_ROWS:
+            continue
+        if any(c.offsets is not None for c in build.columns):
+            continue                      # broadcast build must be fixed-width
+        probe = probe_rows
+        if rec:
+            hist_rows = rec.get("input", {}).get("rows", 0)
+            if isinstance(hist_rows, (int, float)) and hist_rows > 0:
+                probe = int(hist_rows)
+                hist_used = True
+        if not probe:
+            continue                      # no cardinality evidence: keep
+        # Broadcast replicates the build on every shard; the shuffle
+        # moves both sides across ICI once.  Model both in rows.
+        if build_rows * shards >= probe + build_rows:
+            continue
+        if not _order_free_tail(tuple(out), i, build, probe_table):
+            continue
+        if not _keys_unique_nonnull(build, step.right_on):
+            continue
+        out[i] = JoinStep(build, step.left_on, step.right_on, step.how)
+        count += 1
+        _LOG.debug("plan-opt join: shuffled->broadcast at step %d "
+                   "(build=%d rows, probe~%d, shards=%d)",
+                   i, build_rows, probe, shards)
+    return tuple(out), count, hist_used
+
+
+# -- entry point ---------------------------------------------------------
+
+_MODES = ("run", "analyze", "stream", "dist", "dist_stream")
+
+
+def optimize(plan: Plan, *, mode: str = "run", probe_rows=None,
+             mesh_size=None, probe_table=None) -> Plan:
+    """The ONE optimize entry point every executor goes through.
+
+    Returns ``plan`` itself when the pass is off (``SRT_PLAN_OPT=0``)
+    or the plan was already optimized; otherwise a NEW Plan (the
+    original is never mutated) carrying an :class:`OptInfo` as
+    ``plan.opt`` — even when no rule fired, so QueryMetrics always
+    knows the optimizer ran.  ``mode`` shapes rule behavior (analyze
+    keeps conjuncts split for per-step measurement; ``join`` fires only
+    under ``dist``); ``probe_rows`` / ``mesh_size`` / ``probe_table``
+    feed the join cost model from the live DistTable."""
+    if mode not in _MODES:
+        raise ValueError(f"optimize mode must be one of {_MODES}, "
+                         f"got {mode!r}")
+    if getattr(plan, "opt", None) is not None:
+        return plan                       # already optimized (re-entry)
+    if not plan_opt():
+        return plan
+    rules = plan_opt_rules()
+    steps = tuple(plan.steps)
+    rewrites: dict = {}
+    history_informed = False
+
+    rec = None
+    hist_sel: dict = {}
+    if "reorder" in rules or "join" in rules:
+        from ..obs.history import lookup_latest, plan_fingerprint
+        rec = lookup_latest(plan_fingerprint(plan))
+        hist_sel = _history_selectivities(rec)
+
+    if "pushdown" in rules:
+        steps, n = _rule_pushdown(steps)
+        if n:
+            rewrites["pushdown"] = n
+    if "reorder" in rules:
+        steps, n, used = _rule_reorder(steps, mode, hist_sel)
+        history_informed = history_informed or used
+        if mode != "analyze":             # keep steps 1:1 for analyze
+            steps, n2 = _rule_fuse_projects(steps)
+            n += n2
+        if n:
+            rewrites["reorder"] = n
+    if "topk" in rules:
+        steps, n = _rule_topk(steps)
+        if n:
+            rewrites["topk"] = n
+    if "prune" in rules:
+        steps, n = _rule_prune(steps)
+        if n:
+            rewrites["prune"] = n
+    if "join" in rules and mode == "dist":
+        steps, n, used = _rule_join(steps, probe_rows, mesh_size,
+                                    probe_table, rec)
+        history_informed = history_informed or used
+        if n:
+            rewrites["join"] = n
+
+    new_plan = Plan(steps)
+    info = OptInfo(enabled=True, rules=rules, rewrites=rewrites,
+                   steps_before=len(plan.steps), steps_after=len(steps),
+                   history_informed=history_informed,
+                   before=plan_step_texts(plan),
+                   after=plan_step_texts(new_plan), source=plan)
+    object.__setattr__(new_plan, "opt", info)
+    if rewrites:
+        from ..obs.metrics import counter
+        for rule, n in rewrites.items():
+            counter(f"plan.opt.rewrites.{rule}").inc(n)
+        _LOG.debug("plan-opt (%s): %s  steps %d -> %d%s", mode,
+                   " ".join(f"{k}={v}"
+                            for k, v in sorted(rewrites.items())),
+                   info.steps_before, info.steps_after,
+                   " [history]" if history_informed else "")
+    return new_plan

@@ -150,9 +150,23 @@ class LimitStep:
     k: int
 
 
+@dataclass(frozen=True)
+class TopKStep:
+    """Fused Sort→Limit(k): the optimizer's limit-through-sort rewrite.
+
+    Sorts exactly like :class:`SortStep` (selection mask as the leading
+    key, so live rows lead) then takes a static ``[:k]`` slice of every
+    carried buffer — bit-identical to Sort then Limit, with the limit's
+    argsort/gather pass traced away."""
+    by: tuple[str, ...]
+    ascending: tuple[bool, ...]
+    nulls_first: tuple[bool, ...]
+    k: int
+
+
 Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep,
              JoinShuffledStep, UnionAllStep, WindowStep, SortStep,
-             LimitStep]
+             LimitStep, TopKStep]
 
 WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
                 "sum", "min", "max", "count")
@@ -163,6 +177,12 @@ class Plan:
     """Immutable pipeline builder; hashable (it is a compile-cache key)."""
 
     steps: tuple[Step, ...] = field(default=())
+
+    #: Optimizer record (exec/optimize.OptInfo) attached by the plan
+    #: optimizer via object.__setattr__ on *its* rewritten copy — a plain
+    #: class attribute, NOT a dataclass field, so hashing/equality (the
+    #: compile-cache key) and user-built plans are untouched.
+    opt = None
 
     # -- builders ----------------------------------------------------------
     def filter(self, pred: Expr) -> "Plan":
@@ -416,17 +436,44 @@ class Plan:
         ``io.feed.scan_parquet(..., predicate=...)`` so footer/page
         statistics prune row groups and pages before any byte is read.
 
-        Only the *leading* run of FilterSteps qualifies: past the first
-        non-filter step the predicate no longer ranges over scan columns.
-        Sound by construction — the FilterSteps stay in the plan and
-        re-run over whatever the scan yields, so pruning can only skip
-        data the filter would drop anyway."""
-        from ..io.pushdown import extract_scan_predicates
+        The walk covers the leading run of FilterSteps and ProjectSteps,
+        seeing through projections that only rename or pass columns
+        through: a filter on a renamed column maps back to its scan
+        name; a filter touching a *computed* column contributes no leaf
+        (it no longer ranges over a scan column).  Sound by construction
+        — every FilterStep stays in the plan and re-runs over whatever
+        the scan yields, so pruning can only skip data the filter would
+        drop anyway."""
+        from ..io.pushdown import LeafPred, extract_scan_predicates
+
         leaves: list = []
+        # current visible name -> scan column name; None value = computed
+        # (or renamed away) — predicates on it cannot push to the scan.
+        renames: dict[str, Optional[str]] = {}
+
+        def _scan_name(name: str) -> Optional[str]:
+            return renames[name] if name in renames else name
+
         for step in self.steps:
-            if not isinstance(step, FilterStep):
+            if isinstance(step, FilterStep):
+                for leaf in extract_scan_predicates(step.pred):
+                    src = _scan_name(leaf.column)
+                    if src is not None:
+                        leaves.append(leaf if src == leaf.column
+                                      else LeafPred(src, leaf.op,
+                                                    leaf.value))
+            elif isinstance(step, ProjectStep):
+                new: dict[str, Optional[str]] = {}
+                for nm, ex in step.cols:
+                    new[nm] = _scan_name(ex.name) \
+                        if isinstance(ex, Col) else None
+                if step.narrow:
+                    renames = new
+                else:
+                    renames = dict(renames)
+                    renames.update(new)
+            else:
                 break
-            leaves.extend(extract_scan_predicates(step.pred))
         return tuple(leaves)
 
     # -- execution ---------------------------------------------------------

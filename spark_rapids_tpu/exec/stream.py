@@ -283,6 +283,12 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
             and not callable(on_progress):
         raise ValueError(f"on_progress must be None, True, or a callable, "
                          f"got {on_progress!r}")
+    # After argument validation (bad-argument errors must not depend on
+    # the optimizer, and must stay jax-free), before the combine
+    # obstacle check — which sees the steps that will actually trace.
+    from .optimize import optimize
+    plan = optimize(plan,
+                    mode="dist_stream" if mesh is not None else "stream")
     if combine is True:
         obstacles = combine_obstacles(plan)
         if obstacles:
@@ -336,7 +342,11 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
 
     mode = "dist_stream" if mesh is not None else "stream"
     qid = next_query_id()
-    lq = _live.start(mode, plan=plan, query_id=qid,
+    # Fingerprints/history key on the pre-optimization plan (see
+    # compile._run_plan_metered).
+    from .optimize import source_plan
+    src = source_plan(plan)
+    lq = _live.start(mode, plan=src, query_id=qid,
                      observer=_live.as_observer(on_progress))
 
     acct = _Account()
@@ -432,9 +442,10 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
     qm.apply_recovery(recovery_stats().delta(r_before))
     lq.note_hbm(qm.hbm_peak_bytes)
     lq.finish(output_rows=acct.out_rows)
+    qm.apply_opt(getattr(plan, "opt", None))
     set_last_stream_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(plan, qm)
+    maybe_record(src, qm)
 
 
 def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:

@@ -163,6 +163,16 @@ def _split_conjuncts(expr):
         yield expr
 
 
+def split_conjuncts(expr) -> tuple:
+    """Top-level Kleene-AND conjuncts of an expression, left to right.
+
+    The plan optimizer's filter reordering works over this list; Kleene
+    AND of the per-conjunct keep-masks is order- and associativity-
+    invariant, so any reassembly of the same conjuncts is
+    bit-identical."""
+    return tuple(_split_conjuncts(expr))
+
+
 def _leaf_from_expr(expr) -> Optional[LeafPred]:
     from ..exec.expr import FLIP_CMP, BinOp, Col, IsIn, Lit, UnOp
     if isinstance(expr, BinOp) and expr.op in FLIP_CMP:

@@ -197,6 +197,28 @@ def load(fingerprint: Optional[str] = None,
     return out
 
 
+def lookup_latest(fingerprint: str,
+                  path: Optional[str] = None) -> Optional[dict]:
+    """The most recent history record for ``fingerprint`` that carries
+    per-step observed rows, or None.
+
+    This is the plan optimizer's telemetry feed: a record qualifies only
+    when its ``steps`` list has at least one measured ``rows_out`` (an
+    ``explain_analyze`` / metered run), because a record without step
+    observations can't inform selectivity ordering or join cardinality.
+    Corrupt lines are skipped exactly as :func:`load` skips them; a
+    missing file or empty history answers None (the cold-start case)."""
+    for rec in reversed(load(fingerprint, path=path)):
+        steps = rec.get("steps")
+        if isinstance(steps, list) and any(
+                isinstance(s, dict)
+                and isinstance(s.get("rows_out"), (int, float))
+                and s.get("rows_out") >= 0
+                for s in steps):
+            return rec
+    return None
+
+
 def last_load_skipped() -> int:
     """Corrupt lines skipped by the most recent :func:`load` call."""
     return _LOAD_SKIPPED

@@ -144,6 +144,14 @@ class QueryMetrics:
     hbm_static_bytes: int = 0               # program argument footprint
     hbm_peak_bytes: int = 0                 # max allocator peak sampled
     hbm_per_device: List[dict] = field(default_factory=list)
+    # -- plan optimizer (exec/optimize.py; zeroed when SRT_PLAN_OPT=0
+    # or no rule fired) --------------------------------------------------
+    opt_enabled: bool = False
+    opt_rules: List[str] = field(default_factory=list)
+    opt_rewrites: Dict[str, int] = field(default_factory=dict)
+    opt_steps_before: int = 0
+    opt_steps_after: int = 0
+    opt_history_informed: bool = False
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -166,6 +174,19 @@ class QueryMetrics:
         self.recovery_dist_fallbacks = int(delta.get("dist_fallbacks", 0))
         self.recovery_dist_evictions = int(delta.get("dist_evictions", 0))
 
+    def apply_opt(self, info) -> None:
+        """Fold an optimizer record (exec/optimize.OptInfo) into the opt
+        fields — the ``opt`` block of the JSON payload."""
+        if info is None:
+            return
+        self.opt_enabled = bool(info.enabled)
+        self.opt_rules = list(info.rules)
+        self.opt_rewrites = {k: int(v)
+                             for k, v in sorted(info.rewrites.items()) if v}
+        self.opt_steps_before = int(info.steps_before)
+        self.opt_steps_after = int(info.steps_after)
+        self.opt_history_informed = bool(info.history_informed)
+
     def to_dict(self) -> dict:
         from .profile import cost_block
         return {
@@ -180,7 +201,11 @@ class QueryMetrics:
             #     pruning + encoded residency: bytes/pages/row-groups
             #     skipped, encoded column count) and the "cost" ledger's
             #     "scan" sub-split (decode vs gather seconds).
-            "schema_version": 8,
+            # v9: added the always-present "opt" block (plan-optimizer
+            #     rewrites applied before bind/compile: per-rule
+            #     counters, step counts before/after, pruned input
+            #     columns, history-informed flag).
+            "schema_version": 9,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "fingerprint": self.fingerprint,
@@ -251,6 +276,19 @@ class QueryMetrics:
                 "encoded_cols": int(
                     self.counters.get("scan.encoded_cols", 0)),
             },
+            # Always present (zeroed when the optimizer is off or no
+            # rule fired): what exec/optimize.py rewrote before
+            # bind/compile.
+            "opt": {
+                "enabled": self.opt_enabled,
+                "rules": list(self.opt_rules),
+                "rewrites": dict(self.opt_rewrites),
+                "steps_before": self.opt_steps_before,
+                "steps_after": self.opt_steps_after,
+                "pruned_columns": int(
+                    self.counters.get("plan.opt.pruned_columns", 0)),
+                "history_informed": self.opt_history_informed,
+            },
             # Always present (zeroed when unmetered): wall split into
             # compute/ici/host_sync/dispatch_overhead plus the HBM
             # footprint — the regression gate's input (obs/regress.py).
@@ -296,6 +334,13 @@ class QueryMetrics:
                     f"  hbm: static={cb['hbm']['static_bytes']} "
                     f"peak={cb['hbm']['peak_bytes']} "
                     f"devices={cb['hbm']['devices']}")
+        if self.opt_enabled and self.opt_rewrites:
+            rw = " ".join(f"{k}={v}"
+                          for k, v in sorted(self.opt_rewrites.items()))
+            hist = " (history-informed)" if self.opt_history_informed else ""
+            lines.append(
+                f"  opt: steps {self.opt_steps_before} -> "
+                f"{self.opt_steps_after}  {rw}{hist}")
         if self.recovery_retries or self.recovery_splits:
             lines.append(
                 f"  recovery: retries={self.recovery_retries} "

@@ -160,17 +160,19 @@ def test_explain_analyze_measures_step_rows(metrics_on):
     text = p.explain_analyze(t)
     qm = last_query_metrics()
     assert qm.mode == "analyze"
+    # the plan optimizer's projection pruning prepends a narrow Select
+    # (a no-op here: both input columns are live)
     assert [s.kind for s in qm.steps] == \
-        ["Filter", "Project", "GroupBy[dense]"]
+        ["Select", "Filter", "Project", "GroupBy[dense]"]
     # rows chain: each step's output feeds the next step's input
     for a, b in zip(qm.steps, qm.steps[1:]):
         assert a.rows_out == b.rows_in
     assert qm.steps[0].rows_in == 1000
-    assert qm.steps[0].rows_out == 899          # v > 100.0
+    assert qm.steps[1].rows_out == 899          # v > 100.0
     assert qm.steps[-1].rows_out == 7           # 7 groups
     assert qm.output_rows == 7
     assert all(s.seconds >= 0 for s in qm.steps)
-    assert 0 < qm.steps[0].density <= 1
+    assert 0 < qm.steps[1].density <= 1
     # and the rendering carries the measurements
     assert "1000 -> 899" in text
     assert "-> 7 rows" in text
@@ -222,7 +224,7 @@ def _key_paths(obj, prefix=""):
     if isinstance(obj, dict):
         for k in sorted(obj):
             p = f"{prefix}.{k}" if prefix else k
-            if p in ("counters", "cost.hbm.per_device"):
+            if p in ("counters", "cost.hbm.per_device", "opt.rewrites"):
                 paths.append(p)
             else:
                 paths.extend(_key_paths(obj[k], p))
@@ -260,7 +262,7 @@ def test_query_metrics_json_round_trips(metrics_on):
     t = _table("js")
     _query("js").explain_analyze(t)
     payload = json.loads(last_query_metrics().to_json())
-    assert payload["schema_version"] == 8
+    assert payload["schema_version"] == 9
     assert payload["metric"] == "query_metrics"
     assert payload["output"]["rows"] == 7
     # bind-time stats probe + materialize count (first run of this table)
@@ -298,8 +300,10 @@ def test_explain_analyze_tpcds_q3_shape(metrics_on):
     text = p.explain_analyze(d.store_sales)
     qm = last_query_metrics()
     kinds = [s.kind for s in qm.steps]
-    assert kinds == ["BroadcastJoin", "BroadcastJoin", "GroupBy[dense]",
-                     "BroadcastJoin", "Sort", "Limit"]
+    # optimizer: projection pruning leads with a narrow Select over the
+    # live store_sales columns; Sort+Limit fuse into one TopK step
+    assert kinds == ["Select", "BroadcastJoin", "BroadcastJoin",
+                     "GroupBy[dense]", "BroadcastJoin", "TopK"]
     assert qm.steps[0].rows_in == d.store_sales.num_rows
     for a, b in zip(qm.steps, qm.steps[1:]):
         assert a.rows_out == b.rows_in

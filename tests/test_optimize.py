@@ -1,0 +1,524 @@
+"""Adaptive plan optimizer tests (exec/optimize.py).
+
+Two layers:
+
+1. **Rule units** — each rewrite rule applied to hand-built plans, checking
+   the rewritten step list directly (no execution needed).
+2. **Bit-identity oracles** — the same plan run with ``SRT_PLAN_OPT=0``
+   (the unoptimized oracle) and with the optimizer on, across all
+   executors (run / stream / dist / dist_stream), including null keys,
+   bucket-boundary sizes, and the faulted recovery-split path.  Results
+   must match exactly — the optimizer's contract is *bit*-identity, not
+   approximate equality.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.exec.expr import BinOp
+from spark_rapids_tpu.exec.optimize import (live_input_names, optimize,
+                                            source_plan)
+from spark_rapids_tpu.exec.plan import (FilterStep, JoinShuffledStep,
+                                        JoinStep, ProjectStep, SortStep,
+                                        TopKStep)
+from spark_rapids_tpu.parallel import make_flat_mesh, shard_table
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Optimizer defaults (on, all rules), no metrics, no history."""
+    for var in ("SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES", "SRT_METRICS",
+                "SRT_METRICS_HISTORY", "SRT_FAULT"):
+        monkeypatch.delenv(var, raising=False)
+    from spark_rapids_tpu.resilience import reset_faults
+    reset_faults()
+    yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_flat_mesh()
+
+
+def _table(n=1000, seed=0, null_keys=False):
+    r = np.random.default_rng(seed)
+    return Table([
+        ("k", Column.from_numpy(
+            r.integers(0, 8, n).astype(np.int64),
+            validity=(r.random(n) > 0.15) if null_keys else None)),
+        ("v", Column.from_numpy(r.integers(-50, 100, n).astype(np.int64),
+                                validity=r.random(n) > 0.2)),
+        ("f", Column.from_numpy(r.normal(size=n))),
+        ("unused", Column.from_numpy(r.integers(0, 5, n).astype(np.int64))),
+    ])
+
+
+def _oracle_vs_optimized(p, runner, monkeypatch):
+    """Run ``runner(p)`` with the optimizer off, then on; both results."""
+    monkeypatch.setenv("SRT_PLAN_OPT", "0")
+    want = runner(p)
+    monkeypatch.delenv("SRT_PLAN_OPT")
+    got = runner(p)
+    return want, got
+
+
+# ---------------------------------------------------------------------------
+# 1. rule units
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    def test_pushdown_over_rename_project(self):
+        p = (plan().select(("kk", col("k")), ("v", col("v")))
+             .filter(col("kk") > 3))
+        o = optimize(p)
+        assert o.opt.rewrites.get("pushdown") == 1
+        # Prune inserts a leading select; the hoisted filter references
+        # the SOURCE name k below the rename.
+        flt = next(s for s in o.steps if isinstance(s, FilterStep))
+        idx = o.steps.index(flt)
+        assert all(not isinstance(s, FilterStep)
+                   or o.steps.index(s) >= idx for s in o.steps)
+        from spark_rapids_tpu.exec.expr import references
+        assert references(flt.pred) == {"k"}
+
+    def test_pushdown_blocked_by_computed_column(self):
+        p = plan().with_columns(z=col("v") * 2).filter(col("z") > 0)
+        o = optimize(p)
+        assert "pushdown" not in o.opt.rewrites
+
+    def test_pushdown_into_union_branch(self):
+        t = _table(64, seed=1)
+        p = plan().union_all(t).filter(col("v") > 0)
+        o = optimize(p)
+        assert o.opt.rewrites.get("pushdown") == 1
+        union = next(s for s in o.steps if hasattr(s, "plan"))
+        assert isinstance(union.plan.steps[-1], FilterStep)
+
+    def test_reorder_fuses_filter_run(self):
+        p = plan().filter(col("v") > 0).filter(col("k") < 5)
+        o = optimize(p)
+        filters = [s for s in o.steps if isinstance(s, FilterStep)]
+        assert len(filters) == 1
+        assert isinstance(filters[0].pred, BinOp)
+        assert o.opt.rewrites.get("reorder", 0) >= 1
+
+    def test_analyze_mode_keeps_conjuncts_split(self):
+        p = plan().filter((col("v") > 0) & (col("k") < 5))
+        o = optimize(p, mode="analyze")
+        filters = [s for s in o.steps if isinstance(s, FilterStep)]
+        assert len(filters) == 2
+
+    def test_reorder_orders_by_history_selectivity(self, monkeypatch):
+        from spark_rapids_tpu.exec.expr import render
+        from spark_rapids_tpu.obs import history
+        rec = {"steps": [
+            {"kind": "Filter", "rows_in": 100, "rows_out": 90,
+             "describe": f"Filter[{render(col('v') > 0)}] -> selection mask"},
+            {"kind": "Filter", "rows_in": 90, "rows_out": 3,
+             "describe": f"Filter[{render(col('k') < 5)}] -> selection mask"},
+        ]}
+        monkeypatch.setattr(history, "lookup_latest", lambda *a, **k: rec)
+        p = plan().filter(col("v") > 0).filter(col("k") < 5)
+        o = optimize(p)
+        assert o.opt.history_informed
+        flt = next(s for s in o.steps if isinstance(s, FilterStep))
+        # Most selective conjunct (k < 5, 3%) must now lead the AND.
+        assert render(flt.pred).startswith("((k < 5)")
+
+    def test_topk_fuses_sort_limit(self):
+        p = plan().groupby_agg(["k"], [("v", "sum", "s")]) \
+                  .sort_by(["s"], ascending=[False]).limit(10)
+        o = optimize(p)
+        assert isinstance(o.steps[-1], TopKStep)
+        assert o.steps[-1].k == 10
+        assert not any(isinstance(s, SortStep) for s in o.steps)
+
+    def test_prune_inserts_leading_narrow_select(self):
+        p = plan().filter(col("v") > 0).groupby_agg(
+            ["k"], [("v", "sum", "s")])
+        o = optimize(p)
+        lead = o.steps[0]
+        assert isinstance(lead, ProjectStep) and lead.narrow
+        assert {nm for nm, _ in lead.cols} == {"k", "v"}
+        assert live_input_names(o) == ("k", "v")
+
+    def test_prune_never_narrows_passthrough_output(self):
+        # No projection/groupby caps the schema: every input column may
+        # reach the output, so nothing can be pruned.
+        p = plan().filter(col("v") > 0)
+        o = optimize(p)
+        assert "prune" not in o.opt.rewrites
+
+    def test_disabled_returns_plan_unchanged(self, monkeypatch):
+        monkeypatch.setenv("SRT_PLAN_OPT", "0")
+        p = plan().filter(col("v") > 0).sort_by(["k"]).limit(3)
+        assert optimize(p) is p
+        assert getattr(p, "opt", None) is None
+
+    def test_rule_subset_env(self, monkeypatch):
+        monkeypatch.setenv("SRT_PLAN_OPT_RULES", "topk")
+        p = plan().filter(col("v") > 0).filter(col("k") < 5) \
+                  .sort_by(["k"]).limit(3)
+        o = optimize(p)
+        assert set(o.opt.rewrites) == {"topk"}
+        # both filters survive un-fused
+        assert sum(isinstance(s, FilterStep) for s in o.steps) == 2
+
+    def test_reentry_guard(self):
+        p = plan().sort_by(["k"]).limit(3)
+        o = optimize(p)
+        assert optimize(o) is o
+        assert source_plan(o) is p
+        assert source_plan(p) is p
+
+    def test_original_plan_never_mutated(self):
+        p = plan().filter(col("v") > 0).sort_by(["k"]).limit(3)
+        steps = p.steps
+        o = optimize(p)
+        assert o is not p and p.steps == steps
+        assert getattr(p, "opt", None) is None
+
+
+class TestJoinRule:
+    def _dim(self, rows=6):
+        return Table([
+            ("dk", Column.from_numpy(np.arange(rows, dtype=np.int64))),
+            ("w", Column.from_numpy(
+                np.arange(rows, dtype=np.int64) * 10)),
+        ])
+
+    def _plan(self, dim):
+        return (plan()
+                .join_shuffled(dim, left_on="k", right_on="dk",
+                               how="inner")
+                .groupby_agg(["k"], [("w", "sum", "ws"),
+                                     ("v", "count", "n")]))
+
+    def test_small_unique_build_becomes_broadcast(self):
+        p = self._plan(self._dim())
+        o = optimize(p, mode="dist", probe_rows=100000, mesh_size=8,
+                     probe_table=_table(64))
+        assert o.opt.rewrites.get("join") == 1
+        assert any(isinstance(s, JoinStep) for s in o.steps)
+        assert not any(isinstance(s, JoinShuffledStep) for s in o.steps)
+
+    def test_join_rule_only_fires_in_dist_mode(self):
+        p = self._plan(self._dim())
+        o = optimize(p, probe_rows=100000, mesh_size=8)
+        assert "join" not in o.opt.rewrites
+
+    def test_duplicate_build_keys_block_rewrite(self):
+        dim = Table([
+            ("dk", Column.from_numpy(
+                np.array([0, 1, 1, 2], dtype=np.int64))),
+            ("w", Column.from_numpy(np.arange(4, dtype=np.int64))),
+        ])
+        o = optimize(self._plan(dim), mode="dist", probe_rows=100000,
+                     mesh_size=8, probe_table=_table(64))
+        assert "join" not in o.opt.rewrites
+
+    def test_cost_model_keeps_shuffle_for_small_probe(self):
+        # Replicating the build on every shard costs more than shuffling
+        # a probe this small: build_rows * shards >= probe + build_rows.
+        p = self._plan(self._dim(100))
+        o = optimize(p, mode="dist", probe_rows=50, mesh_size=8,
+                     probe_table=_table(64))
+        assert "join" not in o.opt.rewrites
+
+    def test_order_sensitive_agg_blocks_rewrite(self):
+        dim = self._dim()
+        p = (plan()
+             .join_shuffled(dim, left_on="k", right_on="dk", how="inner")
+             .groupby_agg(["k"], [("f", "sum", "fs")]))  # float sum
+        o = optimize(p, mode="dist", probe_rows=100000, mesh_size=8,
+                     probe_table=_table(64))
+        assert "join" not in o.opt.rewrites
+
+    def test_history_probe_cardinality_marks_informed(self, monkeypatch):
+        from spark_rapids_tpu.obs import history
+        rec = {"input": {"rows": 500000},
+               "steps": [{"kind": "Filter", "rows_in": 10, "rows_out": 1,
+                          "describe": "x"}]}
+        monkeypatch.setattr(history, "lookup_latest", lambda *a, **k: rec)
+        p = self._plan(self._dim())
+        o = optimize(p, mode="dist", probe_rows=None, mesh_size=8,
+                     probe_table=_table(64))
+        assert o.opt.rewrites.get("join") == 1
+        assert o.opt.history_informed
+
+
+# ---------------------------------------------------------------------------
+# 2. config / plan / history satellites
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_default_rules(self):
+        from spark_rapids_tpu.config import (PLAN_OPT_RULE_NAMES, plan_opt,
+                                             plan_opt_rules)
+        assert plan_opt() is True
+        assert plan_opt_rules() == PLAN_OPT_RULE_NAMES
+
+    def test_rules_parse_dedup_and_order(self, monkeypatch):
+        from spark_rapids_tpu.config import plan_opt_rules
+        monkeypatch.setenv("SRT_PLAN_OPT_RULES", " Topk, prune,topk ,")
+        assert plan_opt_rules() == ("topk", "prune")
+
+    def test_unknown_rule_raises(self, monkeypatch):
+        from spark_rapids_tpu.config import plan_opt_rules
+        monkeypatch.setenv("SRT_PLAN_OPT_RULES", "topk,warp")
+        with pytest.raises(ValueError, match="warp"):
+            plan_opt_rules()
+
+    def test_plan_opt_off_spellings(self, monkeypatch):
+        from spark_rapids_tpu.config import plan_opt
+        for off in ("0", "off", "false", "no", ""):
+            monkeypatch.setenv("SRT_PLAN_OPT", off)
+            assert plan_opt() is False
+        monkeypatch.setenv("SRT_PLAN_OPT", "1")
+        assert plan_opt() is True
+
+    def test_optimize_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="mode"):
+            optimize(plan(), mode="warp")
+
+
+class TestScanPredicates:
+    def test_sees_through_rename_select(self):
+        p = (plan().select(("year", col("d_year")), ("v", col("v")))
+             .filter(col("year").eq(2001)))
+        (leaf,) = p.scan_predicates()
+        assert leaf.column == "d_year" and leaf.op == "eq" \
+            and leaf.value == 2001
+
+    def test_sees_through_passthrough_with_columns(self):
+        p = plan().with_columns(z=col("v") * 2).filter(col("k") > 3)
+        (leaf,) = p.scan_predicates()
+        assert leaf.column == "k"
+
+    def test_computed_column_predicate_dropped(self):
+        p = plan().with_columns(z=col("v") * 2).filter(col("z") > 3)
+        assert p.scan_predicates() == ()
+
+    def test_direct_filter_unchanged(self):
+        p = plan().filter(col("k") > 3)
+        (leaf,) = p.scan_predicates()
+        assert leaf.column == "k" and leaf.op == "gt"
+
+
+class TestHistoryLookup:
+    def test_missing_file_answers_none(self, tmp_path):
+        from spark_rapids_tpu.obs.history import lookup_latest
+        assert lookup_latest("beef" * 4,
+                             path=str(tmp_path / "nope.jsonl")) is None
+
+    def test_unmeasured_records_skipped(self, tmp_path):
+        from spark_rapids_tpu.obs.history import lookup_latest
+        path = tmp_path / "h.jsonl"
+        fp = "beef" * 4
+        lines = [
+            json.dumps({"fingerprint": fp, "tag": "old", "steps": [
+                {"kind": "Filter", "rows_in": 10, "rows_out": 4}]}),
+            json.dumps({"fingerprint": fp, "tag": "new", "steps": [
+                {"kind": "Filter", "rows_in": -1, "rows_out": -1}]}),
+        ]
+        path.write_text("\n".join(lines) + "\n")
+        rec = lookup_latest(fp, path=str(path))
+        assert rec is not None and rec["tag"] == "old"
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        from spark_rapids_tpu.obs.history import lookup_latest
+        path = tmp_path / "h.jsonl"
+        fp = "beef" * 4
+        good = json.dumps({"fingerprint": fp, "steps": [
+            {"kind": "Filter", "rows_in": 10, "rows_out": 4}]})
+        path.write_text('{"torn": \n' + good + "\n[1,2]\n")
+        assert lookup_latest(fp, path=str(path)) is not None
+
+    def test_other_fingerprints_ignored(self, tmp_path):
+        from spark_rapids_tpu.obs.history import lookup_latest
+        path = tmp_path / "h.jsonl"
+        path.write_text(json.dumps({"fingerprint": "cafe" * 4, "steps": [
+            {"kind": "Filter", "rows_in": 10, "rows_out": 4}]}) + "\n")
+        assert lookup_latest("beef" * 4, path=str(path)) is None
+
+
+# ---------------------------------------------------------------------------
+# 3. bit-identity oracles across all executors
+# ---------------------------------------------------------------------------
+
+def _query():
+    return (plan().filter(col("v") > 0)
+            .with_columns(v2=col("v") * 2)
+            .filter(col("k") < 6)
+            .groupby_agg(["k"], [("v2", "sum", "s"), ("v", "count", "n")],
+                         domains={"k": (0, 7)})
+            .sort_by(["s"], ascending=[False]).limit(5))
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("n", [64, 65, 150, 1000])
+    def test_run_matches_oracle_at_bucket_boundaries(self, n, monkeypatch):
+        t = _table(n, seed=n)
+        want, got = _oracle_vs_optimized(
+            _query(), lambda p: p.run(t).to_pydict(), monkeypatch)
+        assert got == want
+
+    def test_run_with_null_keys(self, monkeypatch):
+        t = _table(500, seed=3, null_keys=True)
+        want, got = _oracle_vs_optimized(
+            _query(), lambda p: p.run(t).to_pydict(), monkeypatch)
+        assert got == want
+
+    def test_row_local_with_sort_and_strings_untouched(self, monkeypatch):
+        # Sort not followed by limit must NOT become top-k.
+        t = _table(200, seed=4)
+        p = plan().filter(col("v") > 0).sort_by(["k", "v"])
+        o = optimize(p)
+        assert not any(isinstance(s, TopKStep) for s in o.steps)
+        want, got = _oracle_vs_optimized(
+            p, lambda q: q.run(t).to_pydict(), monkeypatch)
+        assert got == want
+
+    def test_stream_per_batch_matches_oracle(self, monkeypatch):
+        batches = [_table(97, seed=i) for i in range(4)]
+        p = plan().filter(col("v") > 0).with_columns(v2=col("v") + 1)
+
+        def runner(q):
+            return [t.to_pydict()
+                    for t in q.run_stream(list(batches), combine=False)]
+        want, got = _oracle_vs_optimized(p, runner, monkeypatch)
+        assert got == want
+
+    def test_stream_combine_matches_oracle(self, monkeypatch):
+        batches = [_table(97, seed=i) for i in range(4)]
+        p = (plan().filter(col("v") > 0)
+             .groupby_agg(["k"], [("v", "sum", "s")],
+                          domains={"k": (0, 7)}))
+
+        def runner(q):
+            (out,) = list(q.run_stream(list(batches), combine=True))
+            return out.to_pydict()
+        want, got = _oracle_vs_optimized(p, runner, monkeypatch)
+        assert got == want
+
+    def test_dist_matches_oracle(self, mesh, monkeypatch):
+        t = _table(803, seed=5, null_keys=True)
+        p = (plan().filter(col("v") > 0)
+             .groupby_agg(["k"], [("v", "sum", "s"), ("v", "count", "n")],
+                          domains={"k": (0, 7)})
+             .sort_by(["k"]))
+
+        def runner(q):
+            return q.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        want, got = _oracle_vs_optimized(p, runner, monkeypatch)
+        assert got == want
+
+    def test_dist_broadcast_rewrite_matches_oracle(self, mesh, monkeypatch):
+        t = _table(900, seed=6)
+        dim = Table([
+            ("dk", Column.from_numpy(np.arange(8, dtype=np.int64))),
+            ("w", Column.from_numpy(
+                np.arange(8, dtype=np.int64) * 7))])
+        p = (plan()
+             .join_shuffled(dim, left_on="k", right_on="dk", how="inner")
+             .groupby_agg(["k"], [("w", "sum", "ws"),
+                                  ("v", "count", "n")],
+                          domains={"k": (0, 7)})
+             .sort_by(["k"]))
+
+        def runner(q):
+            return q.run_dist(shard_table(t, mesh), mesh).to_pydict()
+        want, got = _oracle_vs_optimized(p, runner, monkeypatch)
+        assert got == want
+
+    def test_dist_stream_matches_oracle(self, mesh, monkeypatch):
+        batches = [_table(97, seed=10 + i) for i in range(3)]
+        p = (plan().filter(col("v") > 0)
+             .groupby_agg(["k"], [("v", "sum", "s")],
+                          domains={"k": (0, 7)}))
+
+        def runner(q):
+            (out,) = list(q.run_dist_stream(list(batches), mesh,
+                                            combine=True))
+            return out.to_pydict()
+        want, got = _oracle_vs_optimized(p, runner, monkeypatch)
+        assert got == want
+
+    def test_faulted_recovery_split_with_optimizer_on(self, monkeypatch):
+        from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+        t = _table(150, seed=7)
+        p = plan().filter(col("v") > 0).with_columns(v2=col("v") * 3)
+        monkeypatch.setenv("SRT_PLAN_OPT", "0")
+        oracle = p.run(t).to_pydict()
+        monkeypatch.delenv("SRT_PLAN_OPT")
+        monkeypatch.setenv("SRT_RETRY_MAX", "1")
+        monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+        monkeypatch.setenv("SRT_FAULT", "oom:dispatch:2")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert p.run(t).to_pydict() == oracle
+        assert recovery_stats().delta(before)["splits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 4. telemetry integration: opt block, pruned columns, history feedback
+# ---------------------------------------------------------------------------
+
+class TestTelemetry:
+    def test_opt_block_and_pruned_columns(self, monkeypatch):
+        from spark_rapids_tpu.obs import last_query_metrics
+        monkeypatch.setenv("SRT_METRICS", "1")
+        t = _table(300, seed=8)
+        _query().run(t)
+        d = last_query_metrics().to_dict()
+        assert d["opt"]["enabled"] is True
+        assert d["opt"]["rewrites"]
+        assert d["opt"]["steps_before"] >= d["opt"]["steps_after"] - 1
+        # 'unused' and 'f' never feed the aggregation: both pruned
+        # before bind.
+        assert d["opt"]["pruned_columns"] >= 2
+
+    def test_oracle_metrics_report_disabled(self, monkeypatch):
+        from spark_rapids_tpu.obs import last_query_metrics
+        monkeypatch.setenv("SRT_METRICS", "1")
+        monkeypatch.setenv("SRT_PLAN_OPT", "0")
+        _query().run(_table(300, seed=8))
+        d = last_query_metrics().to_dict()
+        assert d["opt"]["enabled"] is False
+        assert d["opt"]["rewrites"] == {}
+
+    def test_history_warmed_run_is_history_informed(self, tmp_path,
+                                                    monkeypatch):
+        from spark_rapids_tpu.obs import last_query_metrics
+        monkeypatch.setenv("SRT_METRICS", "1")
+        monkeypatch.setenv("SRT_METRICS_HISTORY",
+                           str(tmp_path / "hist.jsonl"))
+        t = _table(600, seed=9)
+        # Wide-then-narrow conjunct order: v > -1000 keeps ~every row,
+        # k == 0 keeps ~1/8 — the history-fed reorder must swap them.
+        p = (plan().filter(col("v") > -1000).filter(col("k").eq(0))
+             .groupby_agg(["k"], [("v", "sum", "s")],
+                          domains={"k": (0, 7)}))
+        # Cold analyze run: conjuncts stay split, each one's observed
+        # selectivity lands in the history file.
+        p.explain_analyze(t)
+        cold = last_query_metrics().to_dict()
+        assert cold["opt"]["enabled"] and not cold["opt"]["history_informed"]
+        # Warm run: reorder reads the history back and swaps the
+        # conjuncts; the opt block records the feedback loop closing.
+        out = p.run(t)
+        warm = last_query_metrics().to_dict()
+        assert warm["opt"]["history_informed"] is True
+        assert warm["opt"]["rewrites"].get("reorder", 0) >= 1
+        monkeypatch.setenv("SRT_PLAN_OPT", "0")
+        assert p.run(t).to_pydict() == out.to_pydict()
+
+    def test_explain_shows_before_after_diff(self):
+        t = _table(64, seed=11)
+        text = _query().explain(t)
+        assert "== Optimizer ==" in text
+        assert "- Sort[s]" in text and "+ TopK[s k=5]" in text
