@@ -37,6 +37,13 @@ and the optimized pass, and ONE ``plan_opt`` JSON line records wall
 seconds, bound input columns, traced step counts, per-rule rewrite
 totals, bit-identity, and whether the history-warmed rerun closed the
 telemetry feedback loop.  Exits nonzero on any parity divergence.
+
+``--serving`` replaces the default lanes with the concurrent-serving
+lane: a closed-loop mixed 40-query load (one-shot + streaming plans,
+repeated fingerprints) over TPC-DS data through ``serve.submit``, each
+result checked bit-identical to the sequential executors, emitting ONE
+``serving`` JSON line (sustained qps, p50/p99 latency, result-cache hit
+rate, admission rejects).  Exits nonzero on any parity failure.
 """
 
 from __future__ import annotations
@@ -632,6 +639,126 @@ def bench_plan_opt(sf_rows=200_000):
             f"SRT_PLAN_OPT=0 oracle: {', '.join(mismatched)}")
 
 
+def bench_serving(sf_rows=120_000, n_queries=40, n_clients=4):
+    """``--serving``: a mixed closed-loop load over the TPC-DS data
+    through ``serve.submit`` — ``n_clients`` client threads pull from a
+    40-submission mix (one-shot and streaming plans, fingerprints
+    repeated so the result cache engages) and block on each ticket.
+
+    Every serving result is checked **bit-identical** to the same plan
+    run sequentially on the bare executors; emits ONE ``serving`` JSON
+    line (sustained qps, p50/p99 latency, result-cache hit rate,
+    admission rejects — teed by ``--metrics-out``) and exits nonzero on
+    any parity failure.
+    """
+    import os
+    import threading
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import col, plan, run_plan_stream
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.obs.query import _serving_payload
+    from spark_rapids_tpu.serve import QuerySession
+
+    os.environ["SRT_METRICS"] = "1"
+    t0 = time.perf_counter()
+    d = tpcds.generate(sf_rows, seed=7)
+    print(f"# serving: generated sf_rows={sf_rows} in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    ss = d.store_sales
+    host = {n: np.asarray(c.data) for n, c in ss.items()}
+    n_batches, step = 4, ss.num_rows // 4
+    batches = [srt.Table([(n, Column.from_numpy(v[i * step:(i + 1) * step]))
+                          for n, v in host.items()])
+               for i in range(n_batches)]
+
+    # Five distinct shapes; cycling them through 40 submissions repeats
+    # each fingerprint 8x — the result-cache's bread and butter.
+    shapes = [
+        ("agg", plan().filter(col("ss_quantity") > 10)
+         .groupby_agg(["ss_store_sk"],
+                      [("ss_ext_sales_price", "sum", "revenue")]), ss),
+        ("filter", plan().filter(col("ss_quantity") > 40)
+         .with_columns(net=col("ss_ext_sales_price")
+                       * (1 + col("ss_ext_tax"))), ss),
+        ("topk", plan().filter(col("ss_store_sk").eq(1))
+         .groupby_agg(["ss_item_sk"], [("ss_quantity", "sum", "q")]), ss),
+        ("stream_etl", plan().filter(col("ss_quantity") > 25)
+         .with_columns(net=col("ss_ext_sales_price")
+                       - col("ss_ext_discount_amt")), batches),
+        ("stream_agg", plan().filter(col("ss_quantity") > 5)
+         .groupby_agg(["ss_store_sk"], [("ss_quantity", "sum", "q")]),
+         batches),
+    ]
+
+    # Sequential oracle on the bare executors (also warms the compile
+    # caches, so serving measures serving — not first-compile walls).
+    oracle = {}
+    for name, p, inp in shapes:
+        if isinstance(inp, list):
+            oracle[name] = [t.to_pydict()
+                            for t in run_plan_stream(p, list(inp))]
+        else:
+            oracle[name] = p.run(inp).to_pydict()
+
+    session = QuerySession(max_concurrent=n_clients,
+                           result_cache_cap=256 << 20)
+    work = [shapes[i % len(shapes)] for i in range(n_queries)]
+    latencies = [None] * n_queries
+    failures = []
+    next_i = [0]
+    pick = threading.Lock()
+
+    def client():
+        while True:
+            with pick:
+                i = next_i[0]
+                if i >= n_queries:
+                    return
+                next_i[0] += 1
+            name, p, inp = work[i]
+            t1 = time.perf_counter()
+            if isinstance(inp, list):
+                ticket = session.submit(p, inp)
+                got = [t.to_pydict() for t in ticket.result()]
+            else:
+                ticket = session.submit(p, table=inp)
+                got = ticket.result().to_pydict()
+            latencies[i] = time.perf_counter() - t1
+            if got != oracle[name]:
+                failures.append(name)
+
+    t0 = time.perf_counter()
+    clients = [threading.Thread(target=client) for _ in range(n_clients)]
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join()
+    wall = time.perf_counter() - t0
+    session.close()
+
+    lat = sorted(latencies)
+    payload = _serving_payload()
+    payload.update({
+        "queries": n_queries,
+        "clients": n_clients,
+        "bit_identical": not failures,
+        "mismatched": sorted(set(failures)),
+        "wall_seconds": round(wall, 4),
+        "qps": round(n_queries / wall, 2) if wall else 0.0,
+        "latency_p50_s": round(lat[len(lat) // 2], 6),
+        "latency_p99_s": round(lat[min(len(lat) - 1,
+                                       int(len(lat) * 0.99))], 6),
+    })
+    emit(json.dumps(payload, sort_keys=True))
+    if failures:
+        raise SystemExit(
+            f"serving parity failure: {sorted(set(failures))} diverged "
+            f"from the sequential oracle")
+
+
 if __name__ == "__main__":
     import os
     if "--faults" in sys.argv:
@@ -647,6 +774,8 @@ if __name__ == "__main__":
     try:
         if "--plan-opt" in sys.argv:
             bench_plan_opt()
+        elif "--serving" in sys.argv:
+            bench_serving()
         else:
             main()
         if "--regress" in sys.argv:

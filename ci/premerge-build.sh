@@ -350,6 +350,80 @@ print("plan-opt lane ok:", {k: v for k, v in sorted(snap.items())
                             if k.startswith("plan.opt.")})
 EOF
 
+# Serving lane: N concurrent submissions through serve.submit — mixed
+# one-shot and streaming plans (stream + 8-shard dist), one query
+# fault-injected into the recovery ladder — every ticket's result must
+# stay bit-identical to the same plan run sequentially on the bare
+# executors, the faulted query must recover without disturbing its
+# neighbors, and the exporter must expose the serve queue-depth gauge.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_FAULT="oom:dist-dispatch:2:shard=3" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+SRT_LIVE_SERVER=1 SRT_LIVE_PORT=0 \
+python - <<'EOF'
+import urllib.request
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.exec.stream import run_plan_dist_stream
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.parallel import make_flat_mesh
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+from spark_rapids_tpu.serve import QuerySession
+
+r = np.random.default_rng(3)
+def mk(rows=512):
+    return Table({
+        "k": Column.from_numpy(r.integers(0, 4, rows).astype(np.int64)),
+        "v": Column.from_numpy(r.integers(0, 100, rows).astype(np.int64)),
+    })
+table = mk(4096)
+batches = [mk() for _ in range(8)]
+
+mesh = make_flat_mesh()
+assert int(mesh.devices.size) == 8
+# The dist-stream plan trips SRT_FAULT's shard-targeted OOM; the other
+# submissions must neither see the fault nor wait on its ladder.
+pd = plan().groupby_agg(["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+pa = plan().filter(col("v") > 10).groupby_agg(
+    ["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+pe = plan().filter(col("v") > 50).with_columns(w=col("v") * 2)
+
+oracle_run = pa.run(table).to_pydict()
+oracle_stream = [t.to_pydict() for t in run_plan_stream(pe, list(batches))]
+oracle_dist = [t.to_pydict() for t in
+               run_plan_dist_stream(pd, list(batches), mesh, combine=False)]
+
+reset_faults()          # re-arm: the oracle run consumed the injection
+before = recovery_stats().snapshot()
+s = QuerySession(max_concurrent=4)
+tickets = [("dist", s.submit(pd, list(batches), mesh=mesh, combine=False))]
+for _ in range(3):
+    tickets.append(("run", s.submit(pa, table=table)))
+    tickets.append(("stream", s.submit(pe, list(batches))))
+
+depth_line = None
+base = server.get().url
+with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+    for line in resp.read().decode().split("\n"):
+        if line.startswith("srt_serve_queued_queries"):
+            depth_line = line
+assert depth_line is not None, "queue-depth gauge missing from /metrics"
+
+for kind, t in tickets:
+    got = t.result(timeout=300)
+    if kind == "run":
+        assert got.to_pydict() == oracle_run, "run parity"
+    elif kind == "stream":
+        assert [x.to_pydict() for x in got] == oracle_stream, "stream parity"
+    else:
+        assert [x.to_pydict() for x in got] == oracle_dist, "dist parity"
+s.close()
+delta = recovery_stats().delta(before)
+assert delta["dist_retries"] >= 1 or delta["retries"] >= 1, delta
+print("serving lane ok:", len(tickets), "queries bit-identical,",
+      "faulted query recovered;", depth_line)
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

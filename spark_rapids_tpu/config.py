@@ -142,6 +142,29 @@ Knobs (all optional):
                                ``pushdown,prune,reorder,topk,join``).
                                Unset = all rules.  Unknown names raise
                                at first use (jax-free validation).
+  ``SRT_SERVE_MAX_CONCURRENT`` serving layer (serve/scheduler.py): max
+                               queries admitted to run concurrently;
+                               further submissions queue (>= 1,
+                               default 4).
+  ``SRT_SERVE_HBM_BUDGET``     serving admission control
+                               (serve/admission.py): aggregate HBM
+                               bytes concurrently-admitted queries may
+                               claim, estimated from per-fingerprint
+                               cost-ledger history.  Over-budget
+                               queries wait; a single query estimated
+                               above the whole budget is rejected.
+                               Unset/``0``/``off`` = no HBM budgeting.
+  ``SRT_SERVE_POLICY``         scheduler fairness policy for
+                               interleaving per-batch dispatches
+                               across admitted queries: ``rr``
+                               (round-robin, default) or ``wfair``
+                               (weighted fair by submitted weight).
+  ``SRT_RESULT_CACHE``         cross-query result cache byte cap
+                               (serve/result_cache.py): repeated
+                               submissions of the same plan fingerprint
+                               over identical input batches return the
+                               cached result (LRU by bytes).
+                               Unset/``0``/``off`` disables.
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -591,6 +614,99 @@ def plan_opt_rules() -> tuple[str, ...]:
     return tuple(seen)
 
 
+def serve_max_concurrent() -> int:
+    """Max queries the serving scheduler (serve/scheduler.py) admits to
+    run concurrently; further submissions wait in the run queue.  Each
+    admitted query holds its own in-flight window of device buffers, so
+    the knob bounds aggregate HBM pressure the way
+    ``SRT_STREAM_INFLIGHT`` does per query.  Tune with
+    ``SRT_SERVE_MAX_CONCURRENT`` (>= 1, default 4)."""
+    raw = os.environ.get("SRT_SERVE_MAX_CONCURRENT")
+    if raw is None:
+        return 4
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SERVE_MAX_CONCURRENT must be an integer >= 1, "
+            f"got {raw!r}") from None
+    if val < 1:
+        raise ValueError(
+            f"SRT_SERVE_MAX_CONCURRENT must be >= 1, got {val}")
+    return val
+
+
+def serve_hbm_budget() -> int | None:
+    """Aggregate HBM bytes the serving admission controller
+    (serve/admission.py) lets concurrently-admitted queries claim, or
+    None when HBM budgeting is off.
+
+    Per-query claims are estimated from the metrics history's
+    ``cost.hbm.peak_bytes`` for the same plan fingerprint; an estimated
+    over-commit queues the query instead of letting the OOM recovery
+    ladder fight for memory mid-flight.  Tune with
+    ``SRT_SERVE_HBM_BUDGET`` (> 0 bytes; unset/``0``/``off``
+    disables)."""
+    raw = os.environ.get("SRT_SERVE_HBM_BUDGET")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SERVE_HBM_BUDGET must be an integer byte count "
+            f"(or 0/off), got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_SERVE_HBM_BUDGET must be > 0 bytes (or 0/off), "
+            f"got {val}")
+    return val
+
+
+def serve_policy() -> str:
+    """Serving scheduler fairness policy: ``rr`` (round-robin, default)
+    or ``wfair`` (weighted fair — waiting queries are served inversely
+    to credits already spent over their weight).  Tune with
+    ``SRT_SERVE_POLICY``; unknown names raise (jax-free validation)."""
+    raw = os.environ.get("SRT_SERVE_POLICY")
+    if raw is None or not raw.strip():
+        return "rr"
+    val = raw.strip().lower()
+    if val not in ("rr", "wfair"):
+        raise ValueError(
+            f"SRT_SERVE_POLICY must be 'rr' or 'wfair', got {val!r}")
+    return val
+
+
+def result_cache_bytes() -> int | None:
+    """Byte cap of the cross-query result cache
+    (serve/result_cache.py), or None when result caching is off.
+
+    Keys are (plan fingerprint, input-identity digest); a hit returns
+    the previously materialized result without touching the device —
+    the dashboard-refresh case.  Tune with ``SRT_RESULT_CACHE`` (> 0
+    bytes; unset/``0``/``off`` disables)."""
+    raw = os.environ.get("SRT_RESULT_CACHE")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_RESULT_CACHE must be an integer byte count "
+            f"(or 0/off), got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_RESULT_CACHE must be > 0 bytes (or 0/off), got {val}")
+    return val
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -671,5 +787,7 @@ def knob_table() -> dict[str, str]:
              "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT",
              "SRT_LIVE_SERVER", "SRT_LIVE_PORT",
              "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE",
-             "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES")
+             "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES",
+             "SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
+             "SRT_SERVE_POLICY", "SRT_RESULT_CACHE")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -42,6 +42,7 @@ plan must avoid, which is why this file exists.
 from __future__ import annotations
 
 import re
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional
@@ -1369,6 +1370,50 @@ def _trace_union(cols, sel, side, meta: _UnionMeta):
 #: re-traces but does not re-compile.
 _COMPILED: "OrderedDict" = OrderedDict()
 
+#: ONE lock for every program LRU routed through :func:`_lru_lookup`
+#: (``_COMPILED``, ``exec.dist._DIST_COMPILED``, ``parallel.mesh.
+#: _DIST_PROGRAMS``) plus the wholesale clears in ``resilience.recovery.
+#: evict_device_caches``.  Reentrant because ``build()`` may itself bind
+#: a nested plan (split rung, shuffled-join lowering) and land back in a
+#: lookup on the same thread.  Held across the whole get-or-insert so
+#: concurrent serving threads never double-compile one signature or race
+#: the LRU's move-to-end/eviction bookkeeping.
+_CACHE_LOCK = threading.RLock()
+
+#: query_id -> {"hit": n, "miss": n} — per-query compile-cache
+#: attribution for the serving layer (which queries share programs, which
+#: pay the compiles).  Mutated only under ``_CACHE_LOCK``; bounded by
+#: dropping oldest entries past _CACHE_ATTRIB_KEEP.
+_CACHE_ATTRIBUTION: "OrderedDict" = OrderedDict()
+_CACHE_ATTRIB_KEEP = 256
+
+
+def _attribute_lookup(hit: bool) -> None:
+    """Charge a cache hit/miss to the current live query (if any).
+    Caller holds ``_CACHE_LOCK``."""
+    from ..obs.live import current
+    lq = current()
+    qid = getattr(lq, "query_id", None)
+    if not qid:
+        return
+    rec = _CACHE_ATTRIBUTION.get(qid)
+    if rec is None:
+        rec = _CACHE_ATTRIBUTION[qid] = {"hit": 0, "miss": 0}
+        while len(_CACHE_ATTRIBUTION) > _CACHE_ATTRIB_KEEP:
+            _CACHE_ATTRIBUTION.popitem(last=False)
+    rec["hit" if hit else "miss"] += 1
+
+
+def cache_attribution(query_id=None):
+    """Per-query compile-cache hit/miss counts (copies, race-free).
+    With ``query_id`` returns that query's ``{"hit": n, "miss": n}`` (or
+    None); without, a dict of all retained queries."""
+    with _CACHE_LOCK:
+        if query_id is not None:
+            rec = _CACHE_ATTRIBUTION.get(query_id)
+            return dict(rec) if rec is not None else None
+        return {q: dict(rec) for q, rec in _CACHE_ATTRIBUTION.items()}
+
 #: dictionary tuple -> device strings column of the uniques, so repeat
 #: materializations of a string-keyed plan skip the host rebuild +
 #: host-to-device transfer.
@@ -1509,29 +1554,39 @@ def _lru_lookup(cache, key, build, prefix, instant_name=None, **instant_kw):
     ``dist.compile_cache``, ``dist.programs``); ``instant_name`` keeps
     the plan cache's historical timeline names while new caches default
     to ``<prefix>.hit/miss``.  Returns ``(program, was_hit)``.
+
+    Thread-safe: the whole get-or-insert runs under ``_CACHE_LOCK`` so
+    concurrent queries sharing one signature compile it exactly once and
+    eviction counts stay exact (the serving layer runs many queries over
+    these caches at once).  The miss-path ``build()`` stays inside the
+    lock deliberately — atomic get-or-insert is the contract; a second
+    thread wanting the same key must wait for (and then reuse) the first
+    thread's program rather than tracing its own.
     """
     from ..config import compile_cache_cap, ensure_compile_cache
     from ..obs.metrics import counter, gauge
     from ..obs.timeline import instant, span
     ensure_compile_cache()
     iname = instant_name or prefix
-    fn = cache.get(key)
-    hit = fn is not None
-    if fn is None:
-        counter(f"{prefix}.miss").inc()
-        instant(f"{iname}.miss", cat="compile", **instant_kw)
-        with span("compile.build", cat="compile"):
-            fn = build()
-        cache[key] = fn
-        cap = compile_cache_cap()
-        while len(cache) > cap:
-            cache.popitem(last=False)
-            counter(f"{prefix}.evictions").inc()
-    else:
-        counter(f"{prefix}.hit").inc()
-        instant(f"{iname}.hit", cat="compile", **instant_kw)
-        cache.move_to_end(key)
-    gauge(f"{prefix}.size").set(len(cache))
+    with _CACHE_LOCK:
+        fn = cache.get(key)
+        hit = fn is not None
+        if fn is None:
+            counter(f"{prefix}.miss").inc()
+            instant(f"{iname}.miss", cat="compile", **instant_kw)
+            with span("compile.build", cat="compile"):
+                fn = build()
+            cache[key] = fn
+            cap = compile_cache_cap()
+            while len(cache) > cap:
+                cache.popitem(last=False)
+                counter(f"{prefix}.evictions").inc()
+        else:
+            counter(f"{prefix}.hit").inc()
+            instant(f"{iname}.hit", cat="compile", **instant_kw)
+            cache.move_to_end(key)
+        _attribute_lookup(hit)
+        gauge(f"{prefix}.size").set(len(cache))
     return fn, hit
 
 
@@ -1684,19 +1739,20 @@ def stream_combine():
     the caller drops them).  One jit handles every accumulator pytree
     (jax re-specializes per structure)."""
     global _STREAM_COMBINE
-    if _STREAM_COMBINE is None:
-        def combine(a, b):
-            out = {}
-            for k, v in a.items():
-                if k.startswith("min:"):
-                    out[k] = jnp.minimum(v, b[k])
-                elif k.startswith("max:"):
-                    out[k] = jnp.maximum(v, b[k])
-                else:           # count_all / count: / sum: / sumsq:
-                    out[k] = v + b[k]
-            return out
-        _STREAM_COMBINE = jax.jit(combine, donate_argnums=(0,))
-    return _STREAM_COMBINE
+    with _CACHE_LOCK:
+        if _STREAM_COMBINE is None:
+            def combine(a, b):
+                out = {}
+                for k, v in a.items():
+                    if k.startswith("min:"):
+                        out[k] = jnp.minimum(v, b[k])
+                    elif k.startswith("max:"):
+                        out[k] = jnp.maximum(v, b[k])
+                    else:           # count_all / count: / sum: / sumsq:
+                        out[k] = v + b[k]
+                return out
+            _STREAM_COMBINE = jax.jit(combine, donate_argnums=(0,))
+        return _STREAM_COMBINE
 
 
 def stream_merge_cells(acc: dict, axis: str, axis_size: int) -> dict:
@@ -1911,8 +1967,9 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
         bound = oom_ladder("bind", do_bind)
     if qm is not None:
         qm.bind_seconds += _time.perf_counter() - t0
-        qm.compile_cache = ("hit" if bound.signature() in _COMPILED
-                            else "miss")
+        with _CACHE_LOCK:
+            qm.compile_cache = ("hit" if bound.signature() in _COMPILED
+                                else "miss")
         qm.steps = _static_step_metrics(bound)
 
     def do_dispatch():
@@ -1940,10 +1997,14 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
             # populated it, and a counted lookup here would double the
             # hit/miss accounting the cache tests pin.
             sig = bound.signature()
+
+            def _cached_program():
+                with _CACHE_LOCK:
+                    return _COMPILED.get(sig)
             _prof.cached_analysis(
                 ("plan", sig),
                 lambda: _program_cost_info(
-                    _COMPILED.get(sig) or _compiled_for(bound), bound))
+                    _cached_program() or _compiled_for(bound), bound))
             sample_device_hbm("run.dispatch")
         t0 = _time.perf_counter()
         _live.phase("materialize")

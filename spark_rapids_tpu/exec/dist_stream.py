@@ -217,6 +217,8 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
             # Shuffled-join plans route per batch through the resilient
             # dist core (the all_to_all repartition is the work); the
             # known batch size skips its per-dispatch live-count sync.
+            if acct.on_dispatch is not None:
+                acct.on_dispatch()      # serving fairness gate
             t0 = _time.perf_counter()
             with _tspan("stream.dispatch", cat="stream", lane=lane,
                         batch=bi, shards=P):
@@ -280,6 +282,8 @@ def _drive_batches_dist(plan, source, k: int, acct, mesh):
                                               state[0].row_mask)
                 return dist_guard("dist.dispatch", invoke)
 
+            if acct.on_dispatch is not None:
+                acct.on_dispatch()      # serving fairness gate
             t0 = _time.perf_counter()
             try:
                 with _tspan("stream.dispatch", cat="stream", lane=lane,
@@ -467,6 +471,8 @@ def _drive_combine_dist(plan, source, k: int, acct, mesh, strict: bool):
                                           state[0].row_mask)
             return dist_guard("dist.dispatch", invoke)
 
+        if acct.on_dispatch is not None:
+            acct.on_dispatch()          # serving fairness gate
         t0 = _time.perf_counter()
         try:
             with _tspan("stream.partial", cat="stream", lane=lane,

@@ -85,7 +85,7 @@ class _Account:
                  "bind_s", "dispatch_s", "mat_s", "idle_s",
                  "donation_hits", "donation_misses", "peak_inflight",
                  "shards", "merge_collectives", "ici_bytes",
-                 "syncs_avoided", "live_rows", "live")
+                 "syncs_avoided", "live_rows", "live", "on_dispatch")
 
     def __init__(self):
         self.batches = self.rows = self.columns = self.out_rows = 0
@@ -100,6 +100,12 @@ class _Account:
         # stream is metered, so driver publishing is no-op method calls
         from ..obs.live import NULL_LIVE
         self.live = NULL_LIVE
+        # serving fairness gate (serve/scheduler.py): called once before
+        # each per-batch dispatch so concurrent queries interleave their
+        # batches through the shared device; None for solo streams.  The
+        # wait happens BEFORE the dispatch timer starts, so queueing time
+        # never pollutes dispatch_s.
+        self.on_dispatch = None
 
 
 def _counted_source(source: Iterator, acct: _Account, batch_counter
@@ -211,7 +217,8 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                     combine: Union[str, bool] = "auto",
                     prefetch: Union[bool, int] = False,
                     trace_timeline: Union[None, bool, str] = None,
-                    mesh=None, on_progress=None) -> Iterator:
+                    mesh=None, on_progress=None,
+                    on_dispatch=None) -> Iterator:
     """Drive ``plan`` over ``batches`` with up to ``inflight`` batches
     dispatched but unmaterialized.  Yields one Table per batch (bit-equal
     to ``run_plan`` on that batch), or — in streaming combine mode — ONE
@@ -241,6 +248,13 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
                    built-in stderr one-liner.  Forces the live-query
                    registry on for this stream even without
                    ``SRT_METRICS``.
+    ``on_dispatch``  callable invoked (no arguments) immediately before
+                   each per-batch device dispatch — the serving
+                   scheduler's fairness gate (serve/scheduler.py) blocks
+                   here to interleave batches from concurrent queries.
+                   Runs outside the dispatch timer and the recovery
+                   ladder; per-batch execution is otherwise unchanged,
+                   so results stay bit-identical.
     ``mesh``       drive the stream SHARDED: each batch is dealt over the
                    mesh (exec/dist_stream.py), per-shard bucket programs
                    compile once per (bucket, mesh), donation recycles the
@@ -283,6 +297,9 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
             and not callable(on_progress):
         raise ValueError(f"on_progress must be None, True, or a callable, "
                          f"got {on_progress!r}")
+    if on_dispatch is not None and not callable(on_dispatch):
+        raise ValueError(f"on_dispatch must be None or a callable, "
+                         f"got {on_dispatch!r}")
     # After argument validation (bad-argument errors must not depend on
     # the optimizer, and must stay jax-free), before the combine
     # obstacle check — which sees the steps that will actually trace.
@@ -295,7 +312,7 @@ def run_plan_stream(plan, batches: Iterable, inflight: Optional[int] = None,
             raise TypeError("plan cannot stream-combine: "
                             + "; ".join(obstacles))
     gen = _stream(plan, batches, inflight, combine, prefetch, mesh,
-                  on_progress)
+                  on_progress, on_dispatch)
     if trace_timeline:
         return _recorded_stream(gen, trace_timeline
                                 if isinstance(trace_timeline, str) else None)
@@ -307,7 +324,7 @@ def run_plan_dist_stream(plan, batches: Iterable, mesh,
                          combine: Union[str, bool] = "auto",
                          prefetch: Union[bool, int] = False,
                          trace_timeline: Union[None, bool, str] = None,
-                         on_progress=None) -> Iterator:
+                         on_progress=None, on_dispatch=None) -> Iterator:
     """Sharded streaming executor: :func:`run_plan_stream` with a
     required ``mesh``.  See the ``mesh=`` parameter there; this spelling
     exists so call sites that are distributed by construction fail fast
@@ -319,7 +336,7 @@ def run_plan_dist_stream(plan, batches: Iterable, mesh,
     return run_plan_stream(plan, batches, inflight=inflight,
                            combine=combine, prefetch=prefetch,
                            trace_timeline=trace_timeline, mesh=mesh,
-                           on_progress=on_progress)
+                           on_progress=on_progress, on_dispatch=on_dispatch)
 
 
 def _recorded_stream(gen, path):
@@ -332,7 +349,7 @@ def _recorded_stream(gen, path):
 
 
 def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
-            on_progress=None) -> Iterator:
+            on_progress=None, on_dispatch=None) -> Iterator:
     from ..config import metrics_enabled
     from ..obs import live as _live
     from ..obs import timeline as _tl
@@ -351,6 +368,7 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
 
     acct = _Account()
     acct.live = lq
+    acct.on_dispatch = on_dispatch
     r_before = recovery_stats().snapshot()
     feed = _timed_source(batches, acct)
     if prefetch is not False:
@@ -528,6 +546,8 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
                 return (fn(bound.exec_cols, bound.side_inputs,
                            bound.init_sel), False)
 
+            if acct.on_dispatch is not None:
+                acct.on_dispatch()      # serving fairness gate
             t0 = _time.perf_counter()
             try:
                 with _tspan("stream.dispatch", cat="stream", lane=lane,
@@ -678,6 +698,8 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             return (fn(bound.exec_cols, bound.side_inputs,
                        bound.init_sel), False)
 
+        if acct.on_dispatch is not None:
+            acct.on_dispatch()          # serving fairness gate
         t0 = _time.perf_counter()
         try:
             with _tspan("stream.partial", cat="stream", lane=lane,

@@ -68,17 +68,30 @@ def _fmt_query(q: dict) -> List[str]:
 def render_top(snap: dict, source: str = "local") -> str:
     """One frame of the ``top`` view from a ``/queries`` payload."""
     in_flight = snap.get("in_flight", [])
+    queued = snap.get("queued", [])
     recent = snap.get("recent", [])
     ts = time.strftime("%H:%M:%S",
                        time.localtime(snap.get("unix_time", time.time())))
     lines = [f"srt top — {source} pid={snap.get('pid', '?')} {ts}  "
-             f"running={len(in_flight)} recent={len(recent)}"]
+             f"running={len(in_flight)} queued={len(queued)} "
+             f"recent={len(recent)}"]
     if in_flight:
         lines.append("in-flight:")
         for q in in_flight:
             lines.extend(_fmt_query(q))
     else:
         lines.append("in-flight: (none)")
+    if queued:
+        lines.append("queued:")
+        for q in queued[:8]:
+            lines.append(
+                "  q{qid:<5} {mode:<12} {status:<8} waiting "
+                "{waited:>6.1f}s  est_hbm={est} fp={fp}".format(
+                    qid=q.get("query_id", "?"), mode=q.get("mode", "?"),
+                    status=q.get("status", "?"),
+                    waited=q.get("queued_seconds", 0.0),
+                    est=q.get("estimate_hbm_bytes", 0),
+                    fp=q.get("fingerprint", "")))
     if recent:
         lines.append("recent:")
         for q in recent[-8:]:

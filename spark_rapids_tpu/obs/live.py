@@ -20,10 +20,14 @@ Contract (mirrors obs/metrics.py):
   explicit progress observer (``Plan.run(progress=...)``,
   ``run_plan_stream(on_progress=...)``) opts a single query in without
   the env flag.
-* **on** — updates are plain attribute writes on the record (GIL-atomic
-  increments, no lock on the hot path); the registry lock is taken only
-  at query start/finish and by snapshot readers.  Readers may observe a
-  heartbeat mid-update — snapshots are monitoring data, not a ledger.
+* **on** — scalar updates are plain attribute writes on the record
+  (GIL-atomic increments, no lock on the hot path); the registry lock is
+  taken only at query start/finish and by snapshot readers, and a small
+  per-record lock guards only the container state (per-shard progress
+  dict, rung deque) so concurrent publishers never race a ``/queries``
+  or ``/metrics`` scrape mid-iteration.  Readers may still observe
+  scalar heartbeats mid-update — snapshots are monitoring data, not a
+  ledger.
 * jax-free at module load (tests/test_import_hygiene.py), like the rest
   of ``obs``.
 
@@ -134,7 +138,7 @@ class LiveQuery:
                  "batches_done", "total_batches", "inflight",
                  "peak_inflight", "shards", "shard_done", "ici_bytes",
                  "donation_hits", "donation_misses", "rungs",
-                 "hbm_peak_bytes", "_observer")
+                 "hbm_peak_bytes", "_observer", "_lock")
 
     def __init__(self, query_id: int, mode: str, fingerprint: str = "",
                  input_rows: int = 0, shards: int = 0,
@@ -165,6 +169,11 @@ class LiveQuery:
         self.rungs: deque = deque(maxlen=RUNG_KEEP)
         self.hbm_peak_bytes = 0
         self._observer = observer
+        # Guards the CONTAINER state (shard_done dict, rungs deque)
+        # against a /queries or /metrics scrape iterating mid-mutation
+        # — scalar heartbeat writes stay lock-free (GIL-atomic), so the
+        # per-batch hot path is unchanged.
+        self._lock = threading.Lock()
 
     # -- publishers (hot path: attribute writes only) --------------------
 
@@ -188,15 +197,17 @@ class LiveQuery:
 
     def set_shards(self, n: int) -> None:
         self.shards = n
-        for s in range(n):
-            self.shard_done.setdefault(s, 0)
+        with self._lock:
+            for s in range(n):
+                self.shard_done.setdefault(s, 0)
 
     def shard_batches_done(self, shards: int = 1) -> None:
         """One batch finished on each of the first ``shards`` shards
         (SPMD dispatch runs every batch on every shard)."""
-        done = self.shard_done
-        for s in range(shards):
-            done[s] = done.get(s, 0) + 1
+        with self._lock:
+            done = self.shard_done
+            for s in range(shards):
+                done[s] = done.get(s, 0) + 1
 
     def donation(self, hit: bool) -> None:
         if hit:
@@ -223,7 +234,8 @@ class LiveQuery:
         self.total_batches = int(n)
 
     def rung(self, step: str, site: str = "") -> None:
-        self.rungs.append(f"{site}:{step}" if site else step)
+        with self._lock:
+            self.rungs.append(f"{site}:{step}" if site else step)
         self._notify()
 
     def note_hbm(self, peak_bytes: int) -> None:
@@ -265,7 +277,9 @@ class LiveQuery:
                 and self.batches_done):
             remaining = max(self.total_batches - self.batches_done, 0)
             eta = round(remaining * (elapsed / self.batches_done), 3)
-        rungs = list(self.rungs)
+        with self._lock:
+            rungs = list(self.rungs)
+            shard_done = dict(self.shard_done)
         return {
             "query_id": self.query_id,
             "fingerprint": self.fingerprint,
@@ -288,7 +302,7 @@ class LiveQuery:
             "peak_inflight": self.peak_inflight,
             "shards": self.shards,
             "shard_batches": {str(s): n
-                              for s, n in sorted(self.shard_done.items())},
+                              for s, n in sorted(shard_done.items())},
             "ici_bytes": self.ici_bytes,
             "donation_hits": self.donation_hits,
             "donation_misses": self.donation_misses,
@@ -389,6 +403,22 @@ def note_hbm(peak_bytes: int) -> None:
         lq.note_hbm(peak_bytes)
 
 
+# -- serving integration -------------------------------------------------
+
+#: Optional callable returning a JSON-safe list of queued-query dicts
+#: (serve/scheduler.py registers one); pulled into every
+#: :func:`snapshot_all` so /queries, /metrics and ``obs top`` see the
+#: admission queue without the obs layer importing serve.
+_QUEUED_PROVIDER: Optional[Callable[[], List[dict]]] = None
+
+
+def set_queued_provider(
+        fn: Optional[Callable[[], List[dict]]]) -> None:
+    """Register (or clear, with None) the queued-queries provider."""
+    global _QUEUED_PROVIDER
+    _QUEUED_PROVIDER = fn
+
+
 # -- registry reads ------------------------------------------------------
 
 def get(query_id: int) -> Optional[dict]:
@@ -409,10 +439,18 @@ def snapshot_all() -> dict:
     with _LOCK:
         active = list(_ACTIVE.values())
         recent = list(_RECENT)
+    provider = _QUEUED_PROVIDER
+    queued: List[dict] = []
+    if provider is not None:
+        try:
+            queued = list(provider())
+        except Exception:       # a scrape must never fail on serve state
+            queued = []
     return {
         "pid": os.getpid(),
         "unix_time": round(time.time(), 3),
         "in_flight": [lq.snapshot() for lq in active],
+        "queued": queued,
         "recent": [lq.snapshot() for lq in recent],
     }
 
@@ -447,5 +485,6 @@ def print_progress(snap: dict) -> None:
 __all__: List[str] = [
     "LiveQuery", "NULL_LIVE", "RECENT_KEEP", "RUNG_KEEP", "add_ici",
     "as_observer", "current", "get", "note_hbm", "phase",
-    "print_progress", "reset", "rung", "snapshot_all", "start",
+    "print_progress", "reset", "rung", "set_queued_provider",
+    "snapshot_all", "start",
 ]

@@ -36,6 +36,24 @@ _LAST_LOCK = threading.Lock()
 _LAST: Optional["QueryMetrics"] = None
 _LAST_STREAM: Optional["QueryMetrics"] = None
 
+#: Thread-local serving context (serve/scheduler.py).  A scheduler
+#: worker sets this around its executor call; QueryMetrics constructed
+#: on that thread pick up the serve fields AND stash themselves back
+#: into the context dict (key "qm") so the worker can attach the
+#: metrics object to its ticket without racing the global
+#: ``set_last_*`` slots across concurrent workers.
+_SERVE_TLS = threading.local()
+
+
+def set_serve_context(info: Optional[dict]) -> None:
+    """Install (or with None clear) this thread's serving context:
+    ``{"queue_wait_seconds", "admission", "result_cache", "policy"}``."""
+    _SERVE_TLS.info = info
+
+
+def serve_context() -> Optional[dict]:
+    return getattr(_SERVE_TLS, "info", None)
+
 
 def next_query_id() -> int:
     return next(_QUERY_IDS)
@@ -152,6 +170,24 @@ class QueryMetrics:
     opt_steps_before: int = 0
     opt_steps_after: int = 0
     opt_history_informed: bool = False
+    # -- serving layer (serve/scheduler.py; zeroed/empty when the query
+    # ran outside a QuerySession) ----------------------------------------
+    serve_queue_wait_seconds: float = 0.0
+    serve_admission: str = ""           # admitted | queued | rejected
+    serve_result_cache: str = ""        # hit | miss | "" (uncacheable)
+    serve_policy: str = ""              # rr | wfair
+
+    def __post_init__(self) -> None:
+        # Adopt the ambient serving context, if a scheduler worker set
+        # one on this thread, and hand ourselves back to it.
+        info = serve_context()
+        if info is not None:
+            self.serve_queue_wait_seconds = float(
+                info.get("queue_wait_seconds", 0.0))
+            self.serve_admission = str(info.get("admission", ""))
+            self.serve_result_cache = str(info.get("result_cache", ""))
+            self.serve_policy = str(info.get("policy", ""))
+            info["qm"] = self
 
     def finish_counters(self, delta: Dict[str, int]) -> None:
         """Fold a registry counters-delta into the summary fields."""
@@ -205,7 +241,10 @@ class QueryMetrics:
             #     rewrites applied before bind/compile: per-rule
             #     counters, step counts before/after, pruned input
             #     columns, history-informed flag).
-            "schema_version": 9,
+            # v10: added the always-present "serve" block (queue wait,
+            #     admission outcome, result-cache status, scheduler
+            #     policy — empty/zero outside a QuerySession).
+            "schema_version": 10,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "fingerprint": self.fingerprint,
@@ -288,6 +327,15 @@ class QueryMetrics:
                 "pruned_columns": int(
                     self.counters.get("plan.opt.pruned_columns", 0)),
                 "history_informed": self.opt_history_informed,
+            },
+            # Always present (empty/zero outside a QuerySession): how
+            # the serving layer handled this query.
+            "serve": {
+                "queue_wait_seconds": round(
+                    self.serve_queue_wait_seconds, 6),
+                "admission": self.serve_admission,
+                "result_cache": self.serve_result_cache,
+                "policy": self.serve_policy,
             },
             # Always present (zeroed when unmetered): wall split into
             # compute/ici/host_sync/dispatch_overhead plus the HBM
@@ -559,6 +607,40 @@ def _encoded_scan_payload() -> dict:
     }
 
 
+def _serving_payload() -> dict:
+    """Payload for ``bench_line("serving")``: process-lifetime serving
+    totals from the registry — submissions/admissions/rejections, the
+    result-cache hit rate, and total queue-wait vs run time.  Latency
+    percentiles and sustained qps are closed-loop-client measurements,
+    so ``bench_queries.py --serving`` merges them into this payload
+    before emitting its one line."""
+    from .metrics import registry
+    snap = registry().snapshot()
+    hits = int(snap.get("serve.result_cache.hit", 0))
+    misses = int(snap.get("serve.result_cache.miss", 0))
+    lookups = hits + misses
+    return {
+        "metric": "serving",
+        "submitted": int(snap.get("serve.submitted", 0)),
+        "completed": int(snap.get("serve.completed", 0)),
+        "admitted": int(snap.get("serve.admitted", 0)),
+        "queued": int(snap.get("serve.queued", 0)),
+        "rejected": int(snap.get("serve.admission.rejected", 0)),
+        "hbm_waits": int(snap.get("serve.admission.hbm_waits", 0)),
+        "errors": int(snap.get("serve.errors", 0)),
+        "result_cache": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+            "evictions": int(snap.get("serve.result_cache.evictions", 0)),
+            "bytes": int(snap.get("serve.result_cache.bytes", 0)),
+        },
+        "queue_wait_seconds": round(
+            float(snap.get("serve.queue_wait.seconds", 0.0)), 6),
+        "run_seconds": round(float(snap.get("serve.run.seconds", 0.0)), 6),
+    }
+
+
 _BENCH_PAYLOADS = {
     "metrics": _metrics_payload,
     "cache": _cache_payload,
@@ -567,6 +649,7 @@ _BENCH_PAYLOADS = {
     "recovery": _recovery_payload,
     "regress": _regress_payload,
     "encoded_scan": _encoded_scan_payload,
+    "serving": _serving_payload,
 }
 
 
@@ -578,7 +661,8 @@ def bench_line(kind: str) -> str:
     run), ``"dist_stream"`` (sharded-stream view of the last streaming
     run), ``"recovery"`` (process-lifetime resilience totals),
     ``"regress"`` (perf-regression report vs the metrics history),
-    ``"encoded_scan"`` (scan pruning / encoded-residency totals).  The
+    ``"encoded_scan"`` (scan pruning / encoded-residency totals),
+    ``"serving"`` (serving-layer admission/result-cache totals).  The
     four legacy ``bench_*_line`` names are thin wrappers over this and
     emit byte-identical output.
     """

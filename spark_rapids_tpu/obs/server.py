@@ -108,6 +108,8 @@ def prometheus_text() -> str:
 
     snap = live.snapshot_all()
     _add(fam, "srt_live_queries", "gauge", {}, len(snap["in_flight"]))
+    _add(fam, "srt_serve_queued_queries", "gauge", {},
+         len(snap.get("queued", [])))
     for q in snap["in_flight"]:
         labels = {"query_id": q["query_id"], "mode": q["mode"],
                   "fingerprint": q["fingerprint"]}

@@ -58,23 +58,28 @@ def evict_device_caches() -> int:
     import sys
     from ..exec import compile as _compile
     from ..exec.bucketing import clear_pad_cache
-    dropped = len(_compile._COMPILED) + len(_compile._DECODED_DICTS)
-    _compile._COMPILED.clear()
-    _compile._DECODED_DICTS.clear()
-    dropped += clear_pad_cache()
-    root = __package__.rsplit(".", 1)[0]
-    strings_mod = sys.modules.get(f"{root}.ops.strings")
-    if strings_mod is not None:
-        dropped += strings_mod.clear_resident_encodings()
-    dist_mod = sys.modules.get(f"{root}.exec.dist")
-    if dist_mod is not None:
-        dropped += len(dist_mod._DIST_COMPILED) + len(dist_mod._LIVE_COUNT)
-        dist_mod._DIST_COMPILED.clear()
-        dist_mod._LIVE_COUNT.clear()
-    mesh_mod = sys.modules.get(f"{root}.parallel.mesh")
-    if mesh_mod is not None:
-        dropped += len(mesh_mod._DIST_PROGRAMS)
-        mesh_mod._DIST_PROGRAMS.clear()
+    # The program LRUs are shared with concurrent serving threads mid
+    # get-or-insert; take the cache lock so a wholesale clear never
+    # interleaves with a lookup's insert/move-to-end.
+    with _compile._CACHE_LOCK:
+        dropped = len(_compile._COMPILED) + len(_compile._DECODED_DICTS)
+        _compile._COMPILED.clear()
+        _compile._DECODED_DICTS.clear()
+        dropped += clear_pad_cache()
+        root = __package__.rsplit(".", 1)[0]
+        strings_mod = sys.modules.get(f"{root}.ops.strings")
+        if strings_mod is not None:
+            dropped += strings_mod.clear_resident_encodings()
+        dist_mod = sys.modules.get(f"{root}.exec.dist")
+        if dist_mod is not None:
+            dropped += (len(dist_mod._DIST_COMPILED)
+                        + len(dist_mod._LIVE_COUNT))
+            dist_mod._DIST_COMPILED.clear()
+            dist_mod._LIVE_COUNT.clear()
+        mesh_mod = sys.modules.get(f"{root}.parallel.mesh")
+        if mesh_mod is not None:
+            dropped += len(mesh_mod._DIST_PROGRAMS)
+            mesh_mod._DIST_PROGRAMS.clear()
     recovery_stats().add_evictions(dropped)
     return dropped
 

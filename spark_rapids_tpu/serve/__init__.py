@@ -1,0 +1,39 @@
+"""Concurrent query serving layer — scheduler, admission control, and
+cross-session result caching.
+
+Everything below this package executes ONE query at a time: the
+executors (``run_plan``, ``run_plan_stream``, ``run_plan_dist``,
+``run_plan_dist_stream``) assume exclusive use of the device, and the
+shared program LRUs were, until this layer, guarded only by the GIL.
+This package is the multi-tenant layer on top:
+
+* :class:`~.scheduler.QuerySession` / :func:`submit` — admit many
+  independent plans at once (``SRT_SERVE_MAX_CONCURRENT`` worker
+  threads), interleaving their per-batch dispatches through the
+  streaming executors' ``on_dispatch`` fairness gate (round-robin or
+  weighted-fair, ``SRT_SERVE_POLICY``) while reusing the donation-safe
+  machinery of exec/stream.py unchanged — results stay bit-identical
+  to running the same plans sequentially.
+* :mod:`~.admission` — per-query HBM budgeting
+  (``SRT_SERVE_HBM_BUDGET``) fed by the per-fingerprint cost-ledger
+  history: a query whose estimated peak would over-commit the budget
+  waits in the queue instead of triggering the OOM recovery ladder
+  (which stays on as the backstop).
+* :mod:`~.result_cache` — a cross-query result cache
+  (``SRT_RESULT_CACHE``) keyed by plan fingerprint + input identity for
+  repeated dashboard-style queries.
+
+Per the repo's lazy-import rule the whole package is jax-free at module
+load; executors are imported inside worker threads at first use.
+"""
+
+from __future__ import annotations
+
+from .admission import AdmissionController, AdmissionRejected
+from .result_cache import ResultCache, input_digest
+from .scheduler import QuerySession, Ticket, default_session, submit
+
+__all__ = [
+    "AdmissionController", "AdmissionRejected", "QuerySession",
+    "ResultCache", "Ticket", "default_session", "input_digest", "submit",
+]

@@ -1,0 +1,107 @@
+"""Admission control and per-query HBM budgeting for the serving layer.
+
+The OOM recovery ladder (resilience/recovery.py) rescues a query that
+over-committed device memory AFTER the allocation failed — evict,
+backoff, retry, split.  Under concurrent serving that is the wrong
+steady state: two heavy queries admitted together would spend their
+time fighting the ladder.  This module moves the decision BEFORE
+dispatch: each submission's peak-HBM claim is estimated from the
+per-fingerprint cost-ledger history (``cost.hbm.peak_bytes`` of the
+most recent measured run, obs/history.py), and the controller only lets
+a query start once the sum of running claims plus its own fits the
+budget (``SRT_SERVE_HBM_BUDGET``).  Queries that would over-commit wait
+in the run queue; a query whose own estimate exceeds the entire budget
+can never run and is rejected outright (counted on
+``serve.admission.rejected``).  Cold fingerprints (no history) claim
+zero — they admit freely and the ladder backstops them, exactly as
+before this layer existed.
+
+jax-free at module load, like the rest of the serving layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class AdmissionRejected(RuntimeError):
+    """The query's estimated HBM peak exceeds the whole serving budget —
+    it can never be admitted at this budget."""
+
+
+class AdmissionController:
+    """Budgeted admission: ``acquire`` blocks until the claim fits,
+    ``release`` frees it.  With ``budget=None`` every acquire is
+    immediate (concurrency is still bounded by the scheduler's worker
+    pool)."""
+
+    def __init__(self, budget: Optional[int] = None):
+        self.budget = budget
+        self._cond = threading.Condition()
+        self._claims: Dict[int, int] = {}
+        self._claimed = 0
+
+    @staticmethod
+    def estimate(fingerprint: str) -> int:
+        """Estimated peak HBM bytes for ``fingerprint`` from the most
+        recent measured history record, or 0 when the plan never ran
+        with metrics+history on (cold start admits freely)."""
+        if not fingerprint:
+            return 0
+        from ..obs.history import lookup_latest
+        rec = lookup_latest(fingerprint)
+        if not rec:
+            return 0
+        hbm = rec.get("cost", {}).get("hbm", {})
+        try:
+            return max(int(hbm.get("peak_bytes", 0) or 0), 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def check(self, estimate: int) -> None:
+        """Raise :class:`AdmissionRejected` when ``estimate`` alone can
+        never fit the budget."""
+        if self.budget is not None and estimate > self.budget:
+            from ..obs.metrics import counter
+            counter("serve.admission.rejected").inc()
+            raise AdmissionRejected(
+                f"estimated HBM peak of {estimate} bytes exceeds the "
+                f"serving budget of {self.budget} bytes "
+                f"(SRT_SERVE_HBM_BUDGET)")
+
+    def acquire(self, ticket_id: int, estimate: int) -> bool:
+        """Block until ``estimate`` bytes fit under the budget, then
+        claim them.  Returns True when the caller had to wait (the
+        ticket was HBM-queued, not just pool-queued)."""
+        if self.budget is None or estimate <= 0:
+            with self._cond:
+                self._claims[ticket_id] = max(estimate, 0)
+                self._claimed += max(estimate, 0)
+            return False
+        waited = False
+        from ..obs.metrics import counter, gauge
+        with self._cond:
+            while self._claimed and self._claimed + estimate > self.budget:
+                if not waited:
+                    waited = True
+                    counter("serve.admission.hbm_waits").inc()
+                self._cond.wait(0.05)
+            self._claims[ticket_id] = estimate
+            self._claimed += estimate
+            gauge("serve.hbm_claimed_bytes").set(self._claimed)
+        return waited
+
+    def release(self, ticket_id: int) -> None:
+        with self._cond:
+            self._claimed -= self._claims.pop(ticket_id, 0)
+            if self._claimed < 0:
+                self._claimed = 0
+            if self.budget is not None:
+                from ..obs.metrics import gauge
+                gauge("serve.hbm_claimed_bytes").set(self._claimed)
+            self._cond.notify_all()
+
+    def claimed_bytes(self) -> int:
+        with self._cond:
+            return self._claimed

@@ -1,0 +1,136 @@
+"""Cross-query result cache: fingerprint + input-identity keyed, LRU
+with a byte cap (``SRT_RESULT_CACHE``).
+
+Dashboard-style serving repeats the same plan over the same inputs —
+the ideal query does no device work at all.  A cache entry is keyed by
+``(plan fingerprint, execution mode, input digest)`` where the input
+digest hashes every batch's column names, dtypes, and host bytes
+(:func:`input_digest`); only concretely re-hashable inputs (a Table, or
+a list/tuple of Tables) are cacheable — iterator feeds are consumed by
+execution and cannot be identity-checked, so they always miss without
+being stored.  Values are whatever the executor returned (a Table or a
+list of Tables); their size is accounted from host column bytes and the
+LRU evicts oldest-first past the cap.
+
+Hits/misses/evictions land on ``serve.result_cache.*`` counters and the
+occupancy on the ``serve.result_cache.bytes`` gauge.  jax-free at
+module load — digesting touches numpy only at call time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+
+def _digest_table(h, t) -> bool:
+    """Fold one Table into hash ``h``; False when any column cannot be
+    rendered to host bytes (then the whole input is uncacheable)."""
+    try:
+        h.update(str(t.num_rows).encode())
+        for name, col in t.items():
+            vals, mask = col.to_numpy()
+            h.update(name.encode())
+            h.update(str(vals.dtype).encode())
+            h.update(vals.tobytes())
+            if mask is not None:
+                h.update(mask.tobytes())
+    except Exception:
+        return False
+    return True
+
+
+def input_digest(inputs: Any) -> Optional[str]:
+    """Identity digest of a query's input — a Table or a list/tuple of
+    Tables — or None when the input cannot be safely re-hashed (an
+    iterator/generator feed, or non-numpy-renderable columns)."""
+    h = hashlib.sha256()
+    if hasattr(inputs, "items") and hasattr(inputs, "num_rows"):
+        return h.hexdigest() if _digest_table(h, inputs) else None
+    if isinstance(inputs, (list, tuple)):
+        for t in inputs:
+            if not (hasattr(t, "items") and hasattr(t, "num_rows")):
+                return None
+            if not _digest_table(h, t):
+                return None
+        return h.hexdigest()
+    return None
+
+
+def result_nbytes(result: Any) -> int:
+    """Host-byte size of an executor result (Table or list of Tables);
+    0 when unmeasurable (the entry then costs nothing against the cap,
+    which is safe because unmeasurable results are also undigestable
+    and never stored)."""
+    tables = result if isinstance(result, (list, tuple)) else [result]
+    total = 0
+    for t in tables:
+        try:
+            for _, col in t.items():
+                vals, mask = col.to_numpy()
+                total += vals.nbytes + (mask.nbytes if mask is not None
+                                        else 0)
+        except Exception:
+            return 0
+    return total
+
+
+class ResultCache:
+    """Byte-capped LRU of executor results.  ``cap_bytes=None`` disables
+    — every ``get`` misses without counting and ``put`` discards."""
+
+    def __init__(self, cap_bytes: Optional[int] = None):
+        self.cap_bytes = cap_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.cap_bytes is not None
+
+    def get(self, key: Optional[Tuple]) -> Tuple[Any, bool]:
+        """Returns ``(value, hit)``; an unkeyable input (key None) or a
+        disabled cache always misses."""
+        if not self.enabled:
+            return None, False
+        from ..obs.metrics import counter
+        with self._lock:
+            if key is not None and key in self._entries:
+                value, _ = self._entries[key]
+                self._entries.move_to_end(key)
+                counter("serve.result_cache.hit").inc()
+                return value, True
+            counter("serve.result_cache.miss").inc()
+            return None, False
+
+    def put(self, key: Optional[Tuple], value: Any) -> None:
+        if not self.enabled or key is None:
+            return
+        nbytes = result_nbytes(value)
+        if nbytes <= 0 or nbytes > self.cap_bytes:
+            return
+        from ..obs.metrics import counter, gauge
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.cap_bytes and self._entries:
+                _, (_, dropped) = self._entries.popitem(last=False)
+                self._bytes -= dropped
+                counter("serve.result_cache.evictions").inc()
+            gauge("serve.result_cache.bytes").set(self._bytes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "cap_bytes": self.cap_bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

@@ -1,0 +1,435 @@
+"""Run-queue scheduler: many independent plans served concurrently over
+one device/mesh.
+
+:class:`QuerySession` owns a pool of ``SRT_SERVE_MAX_CONCURRENT``
+worker threads; :meth:`~QuerySession.submit` enqueues a plan with its
+input (a Table for one-shot execution, a batch list/iterator for the
+streaming executors, a DistTable+mesh for sharded execution) and hands
+back a :class:`Ticket` future.  Workers pop tickets FIFO, pass HBM
+admission (serve/admission.py), and run the ordinary executors — the
+only serving-specific hook in the execution path is the streaming
+drivers' ``on_dispatch`` callback, which blocks at the session's
+fairness gate so per-batch dispatches from concurrent queries
+interleave into the device's in-flight windows (round-robin by default,
+weighted-fair under ``SRT_SERVE_POLICY=wfair``).  The gate reorders
+only WHICH query dispatches next, never what a query dispatches, so
+every result is bit-identical to running the same plans sequentially —
+including when the recovery ladder is mid-rescue on a neighboring
+query.
+
+Cross-query state the session layers on top of the executors:
+
+* the result cache (serve/result_cache.py): repeated fingerprints over
+  identical inputs short-circuit at submit;
+* the admission controller's HBM budget, fed by cost-ledger history;
+* the queued-queries pane: the session registers a provider with
+  obs/live.py so ``/queries``, ``/metrics`` and ``obs top`` show the
+  run queue next to the in-flight registry;
+* the always-present ``serve`` block of QueryMetrics, populated through
+  a thread-local serve context (obs/query.py) set around each worker's
+  executor call.
+
+jax-free at module load; executors import lazily inside workers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable, List, Optional
+
+from .admission import AdmissionController, AdmissionRejected
+from .result_cache import ResultCache, input_digest
+
+_SUBMISSION_IDS = itertools.count(1)
+_AUTO = object()        # "resolve from config" sentinel (None means OFF)
+
+
+class Ticket:
+    """One submission's future: resolves to the executor's result (a
+    Table, or a list of Tables for streaming modes)."""
+
+    __slots__ = ("id", "fingerprint", "mode", "weight", "status",
+                 "submitted_unix", "queue_wait_seconds", "run_seconds",
+                 "admission", "result_cache", "estimate", "metrics",
+                 "_t_submit", "_event", "_result", "_error", "_thunk",
+                 "_cache_key")
+
+    def __init__(self, sub_id: int, fingerprint: str, mode: str,
+                 weight: float):
+        self.id = sub_id
+        self.fingerprint = fingerprint
+        self.mode = mode
+        self.weight = weight
+        self.status = "queued"
+        self.submitted_unix = time.time()
+        self.queue_wait_seconds = 0.0
+        self.run_seconds = 0.0
+        self.admission = "queued"
+        self.result_cache = ""
+        self.estimate = 0
+        self.metrics = None
+        self._t_submit = time.perf_counter()
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._thunk = None
+        self._cache_key = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until the query finishes; re-raises its error (an
+        :class:`AdmissionRejected` for rejected submissions)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"query {self.id} still {self.status} after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def snapshot(self) -> dict:
+        """JSON-safe entry for the queued-queries pane."""
+        return {
+            "query_id": self.id,
+            "fingerprint": self.fingerprint,
+            "mode": self.mode,
+            "status": self.status,
+            "weight": self.weight,
+            "estimate_hbm_bytes": self.estimate,
+            "queued_seconds": round(
+                max(time.perf_counter() - self._t_submit, 0.0), 3),
+        }
+
+
+class _FairGate:
+    """The per-batch dispatch turnstile.  ``turn(tid)`` blocks only
+    while OTHER queries are simultaneously waiting; among waiters the
+    policy picks who goes next (``rr``: least recently served;
+    ``wfair``: least credits spent per unit weight).  A lone waiter
+    always proceeds, so the gate can never deadlock a stream."""
+
+    def __init__(self, policy: str):
+        self.policy = policy
+        self._cond = threading.Condition()
+        self._waiting: dict = {}        # tid -> arrival seq
+        self._last_served: dict = {}    # tid -> service seq
+        self._credits: dict = {}        # tid -> credits spent
+        self._weights: dict = {}        # tid -> weight
+        self._seq = 0
+
+    def register(self, tid: int, weight: float) -> None:
+        with self._cond:
+            self._weights[tid] = max(float(weight), 1e-9)
+            self._credits.setdefault(tid, 0.0)
+
+    def unregister(self, tid: int) -> None:
+        with self._cond:
+            self._waiting.pop(tid, None)
+            self._last_served.pop(tid, None)
+            self._credits.pop(tid, None)
+            self._weights.pop(tid, None)
+            self._cond.notify_all()
+
+    def _chosen(self):
+        if not self._waiting:
+            return None
+        if self.policy == "wfair":
+            return min(self._waiting,
+                       key=lambda tid: (self._credits.get(tid, 0.0), tid))
+        return min(self._waiting,
+                   key=lambda tid: (self._last_served.get(tid, -1), tid))
+
+    def turn(self, tid: int) -> None:
+        with self._cond:
+            self._seq += 1
+            self._waiting[tid] = self._seq
+            self._cond.notify_all()     # arrival may change the choice
+            while self._chosen() != tid:
+                self._cond.wait(0.05)
+            del self._waiting[tid]
+            self._seq += 1
+            self._last_served[tid] = self._seq
+            self._credits[tid] = (self._credits.get(tid, 0.0)
+                                  + 1.0 / self._weights.get(tid, 1.0))
+            self._cond.notify_all()
+
+
+def _is_table(obj: Any) -> bool:
+    return hasattr(obj, "items") and hasattr(obj, "num_rows")
+
+
+class QuerySession:
+    """A serving session: worker pool + admission + fairness gate +
+    result cache.  One session per process is the normal shape
+    (:func:`default_session`); independent sessions only share the
+    process-global compile caches."""
+
+    def __init__(self, max_concurrent: Optional[int] = None,
+                 hbm_budget: Any = _AUTO, policy: Optional[str] = None,
+                 result_cache_cap: Any = _AUTO,
+                 register_queued: bool = True):
+        from ..config import (result_cache_bytes, serve_hbm_budget,
+                              serve_max_concurrent, serve_policy)
+        self.max_concurrent = (serve_max_concurrent()
+                               if max_concurrent is None
+                               else int(max_concurrent))
+        if self.max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {self.max_concurrent}")
+        self.policy = serve_policy() if policy is None else str(policy)
+        if self.policy not in ("rr", "wfair"):
+            raise ValueError(
+                f"policy must be 'rr' or 'wfair', got {self.policy!r}")
+        self.admission = AdmissionController(
+            serve_hbm_budget() if hbm_budget is _AUTO else hbm_budget)
+        self.cache = ResultCache(
+            result_cache_bytes() if result_cache_cap is _AUTO
+            else result_cache_cap)
+        self._gate = _FairGate(self.policy)
+        self._cond = threading.Condition()
+        self._queue: "deque[Ticket]" = deque()
+        self._workers: List[threading.Thread] = []
+        self._running = 0
+        self._closed = False
+        if register_queued:
+            from ..obs import live as _live
+            _live.set_queued_provider(self.queued)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, plan, batches: Optional[Iterable] = None, *,
+               table=None, dist=None, mesh=None, combine="auto",
+               inflight: Optional[int] = None,
+               weight: float = 1.0) -> Ticket:
+        """Enqueue one query; returns its :class:`Ticket` immediately.
+
+        Exactly one input shape applies:
+
+        * ``table=Table`` — one-shot ``run_plan`` (with ``mesh`` +
+          ``dist=DistTable``: ``run_plan_dist``);
+        * ``batches=`` list/iterator of Tables — the streaming executor
+          (``run_plan_stream``; sharded when ``mesh`` is given), result
+          is the list of yielded Tables;
+
+        ``weight`` feeds the ``wfair`` policy (higher = more dispatch
+        turns).  Repeated fingerprints over identical (re-hashable)
+        inputs resolve from the result cache without touching the
+        device."""
+        if (table is None) == (batches is None) and dist is None:
+            raise ValueError(
+                "submit needs exactly one of table=, batches=, or "
+                "dist=+mesh=")
+        if dist is not None and mesh is None:
+            raise ValueError("dist= needs mesh=")
+        if not (isinstance(weight, (int, float)) and weight > 0):
+            raise ValueError(f"weight must be > 0, got {weight!r}")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("session is closed")
+        from ..obs.history import plan_fingerprint
+        from ..obs.metrics import counter, gauge
+        fingerprint = plan_fingerprint(plan)
+        if dist is not None:
+            mode = "dist"
+        elif table is not None:
+            mode = "run"
+        else:
+            mode = "dist_stream" if mesh is not None else "stream"
+        t = Ticket(next(_SUBMISSION_IDS), fingerprint, mode, float(weight))
+        counter("serve.submitted").inc()
+
+        # Result cache: only identity-checkable inputs participate.
+        if self.cache.enabled and dist is None:
+            digest = input_digest(table if table is not None else batches)
+            if digest is not None:
+                t._cache_key = (fingerprint, mode, combine, digest)
+                cached, hit = self.cache.get(t._cache_key)
+                if hit:
+                    t.result_cache = "hit"
+                    t.admission = "admitted"
+                    t.status = "done"
+                    t._result = cached
+                    t._event.set()
+                    counter("serve.completed").inc()
+                    return t
+                t.result_cache = "miss"
+
+        # Admission pre-check: an estimate that can never fit rejects
+        # now, with the error delivered through the ticket.
+        t.estimate = self.admission.estimate(fingerprint)
+        try:
+            self.admission.check(t.estimate)
+        except AdmissionRejected as err:
+            t.admission = "rejected"
+            t.status = "rejected"
+            t._error = err
+            t._event.set()
+            return t
+
+        t._thunk = self._make_thunk(plan, table, batches, dist, mesh,
+                                    combine, inflight)
+        with self._cond:
+            # Admitted straight through when a worker is free AND
+            # nothing is queued ahead; otherwise the ticket waited.
+            t.admission = ("admitted"
+                           if (self._running < self.max_concurrent
+                               and not self._queue) else "queued")
+            if t.admission == "queued":
+                counter("serve.queued").inc()
+            self._queue.append(t)
+            gauge("serve.queue_depth").set(len(self._queue))
+            self._spawn_locked()
+            self._cond.notify()
+        return t
+
+    def _make_thunk(self, plan, table, batches, dist, mesh, combine,
+                    inflight):
+        if dist is not None:
+            def thunk(gate):
+                from ..exec.dist import run_plan_dist
+                return run_plan_dist(plan, dist, mesh)
+        elif table is not None:
+            def thunk(gate):
+                from ..exec.compile import run_plan
+                return run_plan(plan, table)
+        else:
+            def thunk(gate):
+                from ..exec.stream import run_plan_stream
+                return list(run_plan_stream(
+                    plan, batches, inflight=inflight, combine=combine,
+                    mesh=mesh, on_dispatch=gate))
+        return thunk
+
+    # -- worker pool -----------------------------------------------------
+
+    def _spawn_locked(self) -> None:
+        want = min(self.max_concurrent,
+                   len(self._queue) + self._running)
+        while len(self._workers) < want:
+            w = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"srt-serve-{len(self._workers)}")
+            self._workers.append(w)
+            w.start()
+
+    def _worker(self) -> None:
+        from ..obs.metrics import gauge
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.1)
+                if not self._queue:
+                    return          # closed and drained
+                t = self._queue.popleft()
+                gauge("serve.queue_depth").set(len(self._queue))
+                self._running += 1
+                gauge("serve.running").set(self._running)
+            try:
+                self._run_ticket(t)
+            finally:
+                with self._cond:
+                    self._running -= 1
+                    gauge("serve.running").set(self._running)
+                    self._cond.notify_all()
+
+    def _run_ticket(self, t: Ticket) -> None:
+        from ..obs import query as _oq
+        from ..obs.metrics import counter, timer
+        t.queue_wait_seconds = max(
+            time.perf_counter() - t._t_submit, 0.0)
+        timer("serve.queue_wait").observe(t.queue_wait_seconds)
+        counter("serve.admitted").inc()
+        t.status = "running"
+        gate = None
+        if t.mode in ("stream", "dist_stream"):
+            self._gate.register(t.id, t.weight)
+            gate = lambda: self._gate.turn(t.id)  # noqa: E731
+        info = {"queue_wait_seconds": t.queue_wait_seconds,
+                "admission": t.admission,
+                "result_cache": t.result_cache,
+                "policy": self.policy}
+        # The HBM claim: blocks this worker until running claims fit.
+        if self.admission.acquire(t.id, t.estimate):
+            t.admission = info["admission"] = "queued"
+        _oq.set_serve_context(info)
+        t0 = time.perf_counter()
+        try:
+            result = t._thunk(gate)
+        except BaseException as err:
+            t._error = err
+            t.status = "error"
+            counter("serve.errors").inc()
+        else:
+            t._result = result
+            t.status = "done"
+            self.cache.put(t._cache_key, result)
+        finally:
+            _oq.set_serve_context(None)
+            if gate is not None:
+                self._gate.unregister(t.id)
+            self.admission.release(t.id)
+            t.run_seconds = time.perf_counter() - t0
+            timer("serve.run").observe(t.run_seconds)
+            t.metrics = info.get("qm")
+            counter("serve.completed").inc()
+            t._event.set()
+
+    # -- introspection / lifecycle ---------------------------------------
+
+    def queued(self) -> List[dict]:
+        """Queued-ticket snapshots (the obs/live.py provider)."""
+        with self._cond:
+            return [t.snapshot() for t in self._queue]
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until the queue is empty and no ticket is running."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        with self._cond:
+            while self._queue or self._running:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"{len(self._queue)} queued / "
+                            f"{self._running} running after {timeout}s")
+                self._cond.wait(remaining if remaining is not None
+                                else 0.1)
+
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting submissions; with ``wait`` drain first."""
+        if wait:
+            self.drain()
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        from ..obs import live as _live
+        if _live._QUEUED_PROVIDER == self.queued:
+            _live.set_queued_provider(None)
+
+
+_DEFAULT: Optional[QuerySession] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_session() -> QuerySession:
+    """The process-wide session :func:`submit` uses, created on first
+    use from the ``SRT_SERVE_*`` knobs."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None or _DEFAULT._closed:
+            _DEFAULT = QuerySession()
+        return _DEFAULT
+
+
+def submit(plan, batches: Optional[Iterable] = None, **kw) -> Ticket:
+    """Module-level convenience: ``default_session().submit(...)``."""
+    return default_session().submit(plan, batches, **kw)

@@ -272,6 +272,62 @@ def test_cold_import_does_not_load_obs():
     assert "lazy" in out.stdout
 
 
+def test_serve_imports_without_jax():
+    """The serving layer (``spark_rapids_tpu.serve``) must work without
+    jax at import AND for everything short of executing a plan: knob
+    validation, admission math over history estimates, result-cache
+    keying, and the fairness gate are host-side scheduling a control
+    plane runs with no XLA stack."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.serve as serve\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing spark_rapids_tpu.serve pulled in jax'\n"
+        "from spark_rapids_tpu import config\n"
+        "assert config.serve_max_concurrent() == 4  # env unset below\n"
+        "assert config.serve_hbm_budget() is None\n"
+        "assert config.serve_policy() == 'rr'\n"
+        "assert config.result_cache_bytes() is None\n"
+        "a = serve.AdmissionController(budget=100)\n"
+        "try:\n"
+        "    a.check(200)\n"
+        "except serve.AdmissionRejected:\n"
+        "    pass\n"
+        "else:\n"
+        "    raise AssertionError('over-budget estimate not rejected')\n"
+        "assert a.acquire(1, 60) is False and a.claimed_bytes() == 60\n"
+        "a.release(1)\n"
+        "assert a.claimed_bytes() == 0\n"
+        "c = serve.ResultCache(cap_bytes=None)\n"
+        "assert c.get(('k',)) == (None, False)  # disabled: always miss\n"
+        "c.put(('k',), object())\n"
+        "assert c.stats()['entries'] == 0\n"
+        "assert serve.input_digest(iter([])) is None  # iterators unkeyed\n"
+        "from spark_rapids_tpu.serve.scheduler import _FairGate\n"
+        "g = _FairGate('rr')\n"
+        "g.register(1, 1.0)\n"
+        "g.turn(1)  # lone waiter never blocks\n"
+        "g.unregister(1)\n"
+        "assert 'jax' not in sys.modules, 'serving logic pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    for k in ("SRT_METRICS", "SRT_SERVE_MAX_CONCURRENT",
+              "SRT_SERVE_HBM_BUDGET", "SRT_SERVE_POLICY",
+              "SRT_RESULT_CACHE"):
+        env.pop(k, None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_watchdog_imports_without_jax():
     """The mesh stall watchdog (resilience.watchdog) must stay jax-free
     at import: the guard is plain threading, and the dist-resilience

@@ -271,7 +271,7 @@ class TestRunPlanRecovery:
         reset_faults()
         assert run_plan(p, t).to_pydict() == oracle
         payload = json.loads(last_query_metrics().to_json())
-        assert payload["schema_version"] == 9
+        assert payload["schema_version"] == 10
         rec = payload["recovery"]
         assert rec["retries"] >= 1
         assert rec["cache_evictions"] >= 1

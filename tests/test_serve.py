@@ -1,0 +1,607 @@
+"""Concurrent query serving layer (spark_rapids_tpu/serve/).
+
+The contracts pinned here:
+
+1. **Bit-identity under concurrency** — results served through
+   ``QuerySession.submit`` (one-shot and streaming, mixed) are
+   bit-identical to the same plans run sequentially on the bare
+   executors, including while the recovery ladder is rescuing a
+   fault-injected neighbor.
+2. **Shared compile caches are race-free** — N threads hammering one
+   signature through ``_lru_lookup`` build exactly once; concurrent
+   distinct-key inserts keep size + eviction accounting exact.
+3. **Live registry scrapes don't race writers** — many queries mutating
+   their records while ``/queries``/``/metrics`` snapshot concurrently
+   never corrupt a snapshot.
+4. **Admission control** — over-budget estimates queue (then run) or are
+   rejected up front through the ticket; claims release on completion.
+5. **Result cache** — repeated fingerprint + identical input short-
+   circuits bit-identically; iterator feeds never cache.
+6. **Knob validation** — the four ``SRT_SERVE_*``/``SRT_RESULT_CACHE``
+   accessors validate without jax.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu import config
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.obs import live, registry, server
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+from spark_rapids_tpu.serve import (AdmissionController, AdmissionRejected,
+                                    QuerySession, ResultCache, input_digest)
+from spark_rapids_tpu.serve.scheduler import _FairGate
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+@pytest.fixture
+def faults(monkeypatch):
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+
+
+def _mk(n, seed=0, khi=5):
+    r = np.random.default_rng(seed)
+    return Table({
+        "k": Column.from_numpy(r.integers(0, khi, n).astype(np.int64)),
+        "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64),
+                               validity=r.random(n) > 0.2),
+    })
+
+
+def _agg_plan():
+    return plan().filter(col("v") > 10).groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "c")],
+        domains={"k": (0, 4)})
+
+
+def _etl_plan():
+    return plan().filter(col("v") > 50).with_columns(w=col("v") * 2)
+
+
+@pytest.fixture
+def session():
+    s = QuerySession(max_concurrent=3, register_queued=False)
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# 1. scheduler bit-identity
+# ---------------------------------------------------------------------------
+
+class TestSchedulerIdentity:
+    def test_mixed_concurrent_load_matches_sequential(self, session):
+        table = _mk(4096, seed=1)
+        batches = [_mk(512, seed=s) for s in range(4)]
+        pa, pe = _agg_plan(), _etl_plan()
+        oracle_run = pa.run(table).to_pydict()
+        oracle_stream = [t.to_pydict()
+                         for t in run_plan_stream(pe, list(batches))]
+
+        tickets = []
+        for _ in range(4):
+            tickets.append(("run", session.submit(pa, table=table)))
+            tickets.append(("stream", session.submit(pe, list(batches))))
+        for kind, t in tickets:
+            got = t.result(timeout=300)
+            if kind == "run":
+                assert got.to_pydict() == oracle_run
+            else:
+                assert [x.to_pydict() for x in got] == oracle_stream
+            assert t.status == "done" and t.done()
+
+    def test_faulted_neighbor_does_not_disturb_others(self, session,
+                                                      faults, metrics_on):
+        """One query hits an injected dispatch OOM mid-load; the ladder
+        recovers it while every ticket (including the faulted one) stays
+        bit-identical to the fault-free sequential oracle."""
+        table = _mk(4096, seed=2)
+        batches = [_mk(512, seed=10 + s) for s in range(4)]
+        pa, pe = _agg_plan(), _etl_plan()
+        oracle_run = pa.run(table).to_pydict()
+        oracle_stream = [t.to_pydict()
+                         for t in run_plan_stream(pe, list(batches))]
+
+        faults.setenv("SRT_FAULT", "oom:dispatch:2")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        tickets = [("stream", session.submit(pe, list(batches)))]
+        for _ in range(3):
+            tickets.append(("run", session.submit(pa, table=table)))
+        for kind, t in tickets:
+            got = t.result(timeout=300)
+            if kind == "run":
+                assert got.to_pydict() == oracle_run
+            else:
+                assert [x.to_pydict() for x in got] == oracle_stream
+        delta = recovery_stats().delta(before)
+        assert delta["retries"] >= 1, delta
+
+    def test_submit_validates_inputs(self, session):
+        p = _etl_plan()
+        with pytest.raises(ValueError, match="exactly one"):
+            session.submit(p)
+        with pytest.raises(ValueError, match="exactly one"):
+            session.submit(p, [_mk(8)], table=_mk(8))
+        with pytest.raises(ValueError, match="needs mesh"):
+            session.submit(p, dist=object())
+        with pytest.raises(ValueError, match="weight"):
+            session.submit(p, table=_mk(8), weight=0)
+
+    def test_closed_session_refuses_submissions(self):
+        s = QuerySession(max_concurrent=1, register_queued=False)
+        s.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            s.submit(_etl_plan(), table=_mk(8))
+
+    def test_error_delivered_through_ticket(self, session):
+        t = session.submit(plan().filter(col("missing") > 0),
+                           table=_mk(64))
+        with pytest.raises(Exception):
+            t.result(timeout=120)
+        assert t.status == "error"
+
+
+# ---------------------------------------------------------------------------
+# 2. serve block of QueryMetrics
+# ---------------------------------------------------------------------------
+
+class TestServeMetrics:
+    def test_ticket_carries_metrics_with_serve_block(self, session,
+                                                     metrics_on):
+        t = session.submit(_agg_plan(), table=_mk(1024, seed=3))
+        t.result(timeout=300)
+        assert t.metrics is not None
+        d = t.metrics.to_dict()
+        assert d["schema_version"] == 10
+        assert d["serve"]["policy"] == "rr"
+        assert d["serve"]["admission"] in ("admitted", "queued")
+        assert d["serve"]["queue_wait_seconds"] >= 0.0
+
+    def test_serve_block_always_present_outside_session(self, metrics_on):
+        p, t = _agg_plan(), _mk(1024, seed=4)
+        p.run(t)
+        from spark_rapids_tpu.obs import last_query_metrics
+        d = last_query_metrics().to_dict()
+        assert d["serve"] == {"queue_wait_seconds": 0.0, "admission": "",
+                              "result_cache": "", "policy": ""}
+
+    def test_queue_wait_isolated_from_run_time(self):
+        """A ticket queued behind a busy pool accounts its wait in
+        queue_wait_seconds, not in the executor's timings."""
+        s = QuerySession(max_concurrent=1, register_queued=False)
+        try:
+            table = _mk(2048, seed=5)
+            p = _agg_plan()
+            p.run(table)                      # warm the compile cache
+            t1 = s.submit(p, table=table)
+            t2 = s.submit(p, table=table)
+            t1.result(timeout=300)
+            t2.result(timeout=300)
+            assert t2.queue_wait_seconds >= 0.0
+            assert t2.run_seconds >= 0.0
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. admission control
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_over_budget_estimate_rejected_via_ticket(self, monkeypatch):
+        monkeypatch.setattr(AdmissionController, "estimate",
+                            staticmethod(lambda fp: 1_000_000))
+        s = QuerySession(max_concurrent=2, hbm_budget=1000,
+                         register_queued=False)
+        try:
+            t = s.submit(_etl_plan(), table=_mk(64))
+            assert t.admission == "rejected" and t.status == "rejected"
+            with pytest.raises(AdmissionRejected, match="exceeds"):
+                t.result(timeout=5)
+        finally:
+            s.close()
+
+    def test_fitting_claims_run_and_release(self, monkeypatch, metrics_on):
+        monkeypatch.setattr(AdmissionController, "estimate",
+                            staticmethod(lambda fp: 600))
+        s = QuerySession(max_concurrent=2, hbm_budget=1000,
+                        register_queued=False)
+        try:
+            table = _mk(1024, seed=6)
+            p = _agg_plan()
+            oracle = p.run(table).to_pydict()
+            tickets = [s.submit(p, table=table) for _ in range(3)]
+            for t in tickets:
+                assert t.result(timeout=300).to_pydict() == oracle
+            assert s.admission.claimed_bytes() == 0
+        finally:
+            s.close()
+
+    def test_acquire_blocks_until_release(self):
+        a = AdmissionController(budget=100)
+        assert a.acquire(1, 60) is False
+        waited = []
+        th = threading.Thread(target=lambda: waited.append(a.acquire(2, 60)))
+        th.start()
+        time.sleep(0.15)
+        assert not waited          # still parked: 60 + 60 > 100
+        a.release(1)
+        th.join(timeout=10)
+        assert waited == [True]    # True = it had to HBM-wait
+        a.release(2)
+        assert a.claimed_bytes() == 0
+
+    def test_cold_fingerprint_estimates_zero(self):
+        assert AdmissionController.estimate("") == 0
+        assert AdmissionController.estimate("no-such-fp") == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. result cache
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    def test_repeat_submission_hits_bit_identically(self, metrics_on):
+        s = QuerySession(max_concurrent=2, result_cache_cap=64 << 20,
+                         register_queued=False)
+        try:
+            table = _mk(1024, seed=7)
+            p = _agg_plan()
+            t1 = s.submit(p, table=table)
+            first = t1.result(timeout=300).to_pydict()
+            assert t1.result_cache == "miss"
+            t2 = s.submit(p, table=table)
+            assert t2.result_cache == "hit"
+            assert t2.result(timeout=5).to_pydict() == first
+            assert t2.metrics is None       # never touched an executor
+            snap = registry().counters_snapshot()
+            assert snap.get("serve.result_cache.hit", 0) >= 1
+        finally:
+            s.close()
+
+    def test_different_input_misses(self):
+        s = QuerySession(max_concurrent=2, result_cache_cap=64 << 20,
+                         register_queued=False)
+        try:
+            p = _agg_plan()
+            s.submit(p, table=_mk(1024, seed=8)).result(timeout=300)
+            t = s.submit(p, table=_mk(1024, seed=9))
+            assert t.result_cache == "miss"
+            t.result(timeout=300)
+        finally:
+            s.close()
+
+    def test_iterator_feed_never_cached(self):
+        s = QuerySession(max_concurrent=1, result_cache_cap=64 << 20,
+                         register_queued=False)
+        try:
+            batches = [_mk(256, seed=s0) for s0 in range(3)]
+            t = s.submit(_etl_plan(), iter(list(batches)))
+            t.result(timeout=300)
+            assert t.result_cache == ""     # unkeyable, not even a miss
+            assert s.cache.stats()["entries"] == 0
+        finally:
+            s.close()
+
+    def test_input_digest_identity(self):
+        a, b = _mk(128, seed=1), _mk(128, seed=1)
+        c = _mk(128, seed=2)
+        assert input_digest(a) == input_digest(b)
+        assert input_digest(a) != input_digest(c)
+        assert input_digest([a, c]) == input_digest([b, c])
+        assert input_digest(iter([a])) is None
+
+    def test_lru_evicts_by_bytes(self):
+        c = ResultCache(cap_bytes=3000)
+        t = _mk(128, seed=0)        # ~128*(8+1)*2 bytes of host data
+        c.put(("a",), t)
+        c.put(("b",), t)
+        assert c.stats()["entries"] == 1    # second put evicted the first
+        got, hit = c.get(("b",))
+        assert hit and got is t
+        assert c.get(("a",)) == (None, False)
+
+
+# ---------------------------------------------------------------------------
+# 5. fairness policies
+# ---------------------------------------------------------------------------
+
+class TestFairGate:
+    def test_lone_waiter_never_blocks(self):
+        g = _FairGate("rr")
+        g.register(1, 1.0)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            g.turn(1)
+        assert time.perf_counter() - t0 < 1.0
+        g.unregister(1)
+
+    def _drive(self, gate, turns_by_tid):
+        order, lock = [], threading.Lock()
+
+        def spin(tid, n):
+            for _ in range(n):
+                gate.turn(tid)
+                with lock:
+                    order.append(tid)
+                time.sleep(0.01)    # keep both threads at the gate
+
+        threads = [threading.Thread(target=spin, args=(tid, n))
+                   for tid, n in turns_by_tid.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        return order
+
+    def test_rr_alternates_between_contenders(self):
+        g = _FairGate("rr")
+        g.register(1, 1.0)
+        g.register(2, 1.0)
+        order = self._drive(g, {1: 6, 2: 6})
+        assert len(order) == 12
+        # Round-robin: once both contend, no long monopoly runs.
+        longest = max(len(list(run)) for _, run in
+                      __import__("itertools").groupby(order))
+        assert longest <= 3, order
+
+    def test_wfair_favors_heavier_weight(self):
+        g = _FairGate("wfair")
+        g.register(1, 1.0)
+        g.register(2, 4.0)
+        order = self._drive(g, {1: 4, 2: 12})
+        # The weight-4 query gets ~4 turns per turn of the weight-1
+        # query while both contend: its first 8 turns complete before
+        # the light query's fourth.
+        assert order.index(2) <= 2, order
+        assert len(order) == 16
+
+    def test_policy_plumbed_from_config(self, monkeypatch):
+        monkeypatch.setenv("SRT_SERVE_POLICY", "wfair")
+        s = QuerySession(max_concurrent=1, register_queued=False)
+        try:
+            assert s.policy == "wfair" and s._gate.policy == "wfair"
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. config knobs (jax-free validation is pinned in test_import_hygiene)
+# ---------------------------------------------------------------------------
+
+class TestServeKnobs:
+    def test_defaults(self, monkeypatch):
+        for k in ("SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
+                  "SRT_SERVE_POLICY", "SRT_RESULT_CACHE"):
+            monkeypatch.delenv(k, raising=False)
+        assert config.serve_max_concurrent() == 4
+        assert config.serve_hbm_budget() is None
+        assert config.serve_policy() == "rr"
+        assert config.result_cache_bytes() is None
+
+    def test_valid_values(self, monkeypatch):
+        monkeypatch.setenv("SRT_SERVE_MAX_CONCURRENT", "9")
+        monkeypatch.setenv("SRT_SERVE_HBM_BUDGET", "123456")
+        monkeypatch.setenv("SRT_SERVE_POLICY", "wfair")
+        monkeypatch.setenv("SRT_RESULT_CACHE", "1048576")
+        assert config.serve_max_concurrent() == 9
+        assert config.serve_hbm_budget() == 123456
+        assert config.serve_policy() == "wfair"
+        assert config.result_cache_bytes() == 1048576
+
+    def test_off_values(self, monkeypatch):
+        for off in ("0", "off", "false", "no"):
+            monkeypatch.setenv("SRT_SERVE_HBM_BUDGET", off)
+            monkeypatch.setenv("SRT_RESULT_CACHE", off)
+            assert config.serve_hbm_budget() is None
+            assert config.result_cache_bytes() is None
+
+    @pytest.mark.parametrize("knob,bad", [
+        ("SRT_SERVE_MAX_CONCURRENT", "0"),
+        ("SRT_SERVE_MAX_CONCURRENT", "zebra"),
+        ("SRT_SERVE_HBM_BUDGET", "-5"),
+        ("SRT_SERVE_HBM_BUDGET", "zebra"),
+        ("SRT_SERVE_POLICY", "fifo"),
+        ("SRT_RESULT_CACHE", "-1"),
+        ("SRT_RESULT_CACHE", "zebra"),
+    ])
+    def test_invalid_values_raise(self, monkeypatch, knob, bad):
+        monkeypatch.setenv(knob, bad)
+        accessor = {
+            "SRT_SERVE_MAX_CONCURRENT": config.serve_max_concurrent,
+            "SRT_SERVE_HBM_BUDGET": config.serve_hbm_budget,
+            "SRT_SERVE_POLICY": config.serve_policy,
+            "SRT_RESULT_CACHE": config.result_cache_bytes,
+        }[knob]
+        with pytest.raises(ValueError, match=knob):
+            accessor()
+
+    def test_knob_table_lists_serve_rows(self):
+        table = config.knob_table()
+        for k in ("SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
+                  "SRT_SERVE_POLICY", "SRT_RESULT_CACHE"):
+            assert k in table
+
+
+# ---------------------------------------------------------------------------
+# 7. compile-cache thread safety (the shared-LRU hammer)
+# ---------------------------------------------------------------------------
+
+class TestCompileCacheConcurrency:
+    def test_one_key_builds_exactly_once(self, metrics_on):
+        from spark_rapids_tpu.exec.compile import _lru_lookup
+        cache = OrderedDict()
+        builds = []
+        barrier = threading.Barrier(8)
+        sentinel = object()
+
+        def build():
+            builds.append(1)
+            time.sleep(0.05)        # widen the double-compile window
+            return sentinel
+
+        got = [None] * 8
+
+        def worker(i):
+            barrier.wait()
+            fn, _ = _lru_lookup(cache, "shared-key", build, "test.hammer")
+            got[i] = fn
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(builds) == 1, f"double-compiled {len(builds)}x"
+        assert all(fn is sentinel for fn in got)
+        snap = registry().counters_snapshot()
+        assert snap.get("test.hammer.miss", 0) == 1
+        assert snap.get("test.hammer.hit", 0) == 7
+
+    def test_concurrent_inserts_keep_eviction_counts_exact(self,
+                                                           metrics_on):
+        from spark_rapids_tpu.exec.compile import _lru_lookup
+        from spark_rapids_tpu.config import compile_cache_cap
+        cache = OrderedDict()
+        cap = compile_cache_cap()
+        n_keys = cap + 17
+
+        def worker(lo):
+            for k in range(lo, n_keys, 4):
+                _lru_lookup(cache, ("k", k), lambda: object(),
+                            "test.evict")
+
+        threads = [threading.Thread(target=worker, args=(lo,))
+                   for lo in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        snap = registry().counters_snapshot()
+        assert len(cache) <= cap
+        assert snap.get("test.evict.miss", 0) == n_keys
+        assert snap.get("test.evict.evictions", 0) == n_keys - len(cache)
+
+    def test_concurrent_queries_share_one_compile(self, metrics_on):
+        """End-to-end: many sessions' workers racing the same plan
+        signature compile it once (plan.compile_cache.miss == 1 for the
+        fresh signature)."""
+        s = QuerySession(max_concurrent=4, register_queued=False)
+        try:
+            table = Table.from_pydict({
+                "hammer_k": (np.arange(2048) % 7).astype(np.int64),
+                "hammer_v": np.arange(2048, dtype=np.int64),
+            })
+            p = (plan().filter(col("hammer_v") > 100)
+                 .groupby_agg(["hammer_k"],
+                              [("hammer_v", "sum", "s")],
+                              domains={"hammer_k": (0, 6)}))
+            tickets = [s.submit(p, table=table) for _ in range(6)]
+            outs = {id(t): t.result(timeout=300).to_pydict()
+                    for t in tickets}
+            assert len(set(map(str, outs.values()))) == 1
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# 8. live-registry concurrency (writers vs scrapes)
+# ---------------------------------------------------------------------------
+
+class TestLiveRegistryConcurrency:
+    def test_many_writers_never_corrupt_scrapes(self, metrics_on):
+        """Live records mutating container state (per-shard dicts,
+        recovery rungs) at full speed must never throw inside a
+        concurrent snapshot/scrape ("dictionary changed size during
+        iteration" is the historical failure)."""
+        stop = threading.Event()
+        errors = []
+
+        def writer(seed):
+            r = np.random.default_rng(seed)
+            while not stop.is_set():
+                lq = live.start("dist_stream", force=True)
+                lq.set_shards(8)
+                for _ in range(6):
+                    lq.shard_batches_done(8)
+                    lq.batch_out(int(r.integers(1, 100)))
+                lq.rung(f"retry#{seed}")
+                lq.finish()
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    snap = live.snapshot_all()
+                    assert isinstance(snap["in_flight"], list)
+                    server.prometheus_text()
+                except Exception as e:       # pragma: no cover
+                    errors.append(e)
+                    return
+
+        writers = [threading.Thread(target=writer, args=(i,))
+                   for i in range(4)]
+        scrapers = [threading.Thread(target=scraper) for _ in range(3)]
+        for t in writers + scrapers:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in writers + scrapers:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+
+    def test_queued_provider_feeds_snapshot(self):
+        live.set_queued_provider(
+            lambda: [{"query_id": 7, "status": "queued"}])
+        try:
+            snap = live.snapshot_all()
+            assert snap["queued"] == [{"query_id": 7, "status": "queued"}]
+        finally:
+            live.set_queued_provider(None)
+        assert live.snapshot_all()["queued"] == []
+
+    def test_broken_provider_degrades_to_empty(self):
+        live.set_queued_provider(lambda: 1 / 0)
+        try:
+            assert live.snapshot_all()["queued"] == []
+        finally:
+            live.set_queued_provider(None)
+
+    def test_session_registers_and_unregisters_provider(self, metrics_on):
+        s = QuerySession(max_concurrent=1)      # register_queued=True
+        try:
+            assert live.snapshot_all()["queued"] == []
+            text = server.prometheus_text()
+            assert "srt_serve_queued_queries 0" in text
+        finally:
+            s.close()
+        # close() must drop the provider so a dead session isn't scraped
+        assert live.snapshot_all()["queued"] == []
+
+    def test_top_renders_queued_pane(self):
+        from spark_rapids_tpu.obs.__main__ import render_top
+        snap = {"pid": 1, "unix_time": 0.0, "in_flight": [], "recent": [],
+                "queued": [{"query_id": 9, "mode": "stream",
+                            "status": "queued", "queued_seconds": 1.5,
+                            "estimate_hbm_bytes": 0, "fingerprint": "ab"}]}
+        frame = render_top(snap, source="test")
+        assert "queued=1" in frame
+        assert "q9" in frame and "stream" in frame
