@@ -22,6 +22,14 @@ against the same stream with telemetry off and appends a
 ``live_overhead`` JSON line (base/live wall seconds, overhead fraction)
 — the record pinning the registry's near-zero hot-path cost.
 
+``--flight`` additionally times the same ETL stream shape with the
+flight recorder live against a metered baseline whose recorder feed is
+a no-op (both passes ``SRT_METRICS=1``, so the line isolates the ring
+appends themselves), then times one postmortem bundle dump.  Appends a
+``flight_recorder`` JSON line (base/flight wall seconds, overhead
+fraction, sustained events/sec, bundle write seconds) and exits nonzero
+when the measured overhead busts the recorder's 2% budget.
+
 ``--faults`` additionally arms a deterministic HBM-OOM injection
 (``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec),
 runs one mesh join+agg with a shard-targeted dist-dispatch OOM recovered
@@ -161,6 +169,8 @@ def main():
     bench_dist_stream(lineitem)
     if "--live" in sys.argv:
         bench_live(lineitem)
+    if "--flight" in sys.argv:
+        bench_flight(lineitem)
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
@@ -437,6 +447,130 @@ def bench_live(lineitem, n_batches=8):
         "live_seconds": round(live_s, 6),
         "overhead_frac": round(max(live_s - base_s, 0.0) / base_s, 6)},
         sort_keys=True))
+
+
+#: The flight recorder's measured-overhead budget (fraction of a
+#: metered run) — the contract obs/flight.py documents and CI enforces.
+FLIGHT_OVERHEAD_BUDGET = 0.02
+
+
+def bench_flight(lineitem, n_batches=8):
+    """``--flight``: marginal wall-clock cost of the flight recorder on
+    the metered ETL stream shape.  Both passes run with ``SRT_METRICS=1``
+    — the baseline swaps the recorder feed (``flight.record`` /
+    ``flight.trace_span``) for no-ops so the comparison isolates the
+    ring appends from the rest of the telemetry stack.  Also reports the
+    ring's sustained events/sec and the latency of one postmortem
+    ``bundle.dump`` (the write a failing query pays).  Emits the
+    ``flight_recorder`` JSON line and exits nonzero when the overhead
+    busts :data:`FLIGHT_OVERHEAD_BUDGET`."""
+    import os
+    import shutil
+    import tempfile
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import col, plan, run_plan_stream
+    from spark_rapids_tpu.obs import bundle, flight, last_stream_metrics
+
+    host = {n: np.asarray(c.data) for n, c in lineitem.items()}
+    rows = lineitem.num_rows
+    step = rows // n_batches
+
+    def feed():
+        for i in range(n_batches):
+            lo, hi = i * step, min((i + 1) * step, rows)
+            yield srt.Table([
+                (n, Column.from_numpy(v[lo:hi])) for n, v in host.items()])
+
+    p = (plan()
+         .filter(col("shipdate") <= 10_500)
+         .with_columns(disc_price=col("price") * (1 - col("disc")))
+         .with_columns(charge=col("disc_price") * (1 + col("tax"))))
+
+    def run():
+        for _ in run_plan_stream(p, feed(), prefetch=True):
+            pass
+
+    def timed(reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    had = os.environ.get("SRT_METRICS")
+    os.environ["SRT_METRICS"] = "1"
+    real_record, real_span = flight.record, flight.trace_span
+
+    def noop(*a, **k):
+        return None
+
+    try:
+        flight.record = flight.trace_span = noop
+        run()                        # warm metered compile, recorder mute
+        base_s = timed()
+
+        flight.record, flight.trace_span = real_record, real_span
+        flight.reset()
+        run()                        # warm the recorder-live path
+        flight_s = timed()
+
+        # Events/sec from one dedicated run: the timed() best-of keeps
+        # only a wall number, so measure the ring fill against its own
+        # wall (each stream run is its own query id / ring).
+        t0 = time.perf_counter()
+        run()
+        ev_dt = time.perf_counter() - t0
+        qm = last_stream_metrics()
+        ring = flight.ring_for(qm.query_id, create=False)
+        st = ring.stats() if ring is not None else {
+            "events_recorded": 0, "events_dropped": 0}
+        events = st["events_recorded"] + st["events_dropped"]
+
+        # One postmortem dump against a throwaway dir: the write latency
+        # a failing query pays on top of its failure.
+        tmp = tempfile.mkdtemp(prefix="srt-flight-bench-")
+        had_dir = os.environ.get("SRT_BUNDLE_DIR")
+        try:
+            os.environ["SRT_BUNDLE_DIR"] = tmp
+            t0 = time.perf_counter()
+            path = bundle.dump("failure", qm=qm,
+                               error=RuntimeError("bench probe"))
+            bundle_s = time.perf_counter() - t0
+            assert path is not None, "bench bundle dump wrote nothing"
+        finally:
+            if had_dir is None:
+                os.environ.pop("SRT_BUNDLE_DIR", None)
+            else:
+                os.environ["SRT_BUNDLE_DIR"] = had_dir
+            shutil.rmtree(tmp, ignore_errors=True)
+    finally:
+        flight.record, flight.trace_span = real_record, real_span
+        if had is None:
+            os.environ.pop("SRT_METRICS", None)
+        else:
+            os.environ["SRT_METRICS"] = had
+
+    over = max(flight_s - base_s, 0.0)
+    frac = over / base_s
+    emit(json.dumps({
+        "metric": "flight_recorder",
+        "base_seconds": round(base_s, 6),
+        "flight_seconds": round(flight_s, 6),
+        "overhead_frac": round(frac, 6),
+        "events": events,
+        "events_per_sec": round(events / ev_dt, 1) if ev_dt else 0.0,
+        "bundle_write_seconds": round(bundle_s, 6)},
+        sort_keys=True))
+    # Gate like live_overhead, with an absolute floor so sub-10ms timer
+    # jitter on a fast baseline cannot flake the lane.
+    if frac > FLIGHT_OVERHEAD_BUDGET and over > 0.01:
+        raise SystemExit(
+            f"flight recorder overhead {frac:.2%} "
+            f"({over * 1e3:.1f} ms on a {base_s:.3f}s baseline) exceeds "
+            f"the {FLIGHT_OVERHEAD_BUDGET:.0%} budget")
 
 
 def bench_dist_stream(lineitem, n_batches=8, batch_rows=200_000):

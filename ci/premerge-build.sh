@@ -424,6 +424,126 @@ print("serving lane ok:", len(tickets), "queries bit-identical,",
       "faulted query recovered;", depth_line)
 EOF
 
+# Diagnostics lane: the same faulted dist-stream serving mix under a
+# tight SLO with postmortem bundles armed.  The doomed dist-stream query
+# exhausts the mesh ladder (shard-targeted OOM with more charges than
+# the ladder has rungs, SRT_RETRY_MAX=1) and must leave golden-valid
+# failure + recovery_exhausted bundles whose drained flight ring is a
+# valid Chrome trace; the healthy one-shot queries succeed but breach
+# the 1 ms SLO and must leave slo_breach bundles; `obs doctor` must
+# explain every bundle (exit 0) and name the injected fault site on the
+# failed ones; and /metrics must expose parseable per-mode latency
+# histograms (cumulative buckets, +Inf == count).
+rm -rf artifacts/premerge-bundles
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_FAULT="oom:dist-dispatch:99:shard=3" SRT_METRICS=1 SRT_RETRY_BACKOFF=0 \
+SRT_RETRY_MAX=1 SRT_SLO_MS=1 SRT_BUNDLE_DIR=artifacts/premerge-bundles \
+SRT_LIVE_SERVER=1 SRT_LIVE_PORT=0 \
+python - <<'EOF'
+import glob
+import json
+import re
+import subprocess
+import sys
+import urllib.request
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.obs.bundle import validate_bundle
+from spark_rapids_tpu.parallel import make_flat_mesh
+from spark_rapids_tpu.serve import QuerySession
+
+r = np.random.default_rng(3)
+def mk(rows=512):
+    return Table({
+        "k": Column.from_numpy(r.integers(0, 4, rows).astype(np.int64)),
+        "v": Column.from_numpy(r.integers(0, 100, rows).astype(np.int64)),
+    })
+table = mk(4096)
+batches = [mk() for _ in range(8)]
+
+mesh = make_flat_mesh()
+assert int(mesh.devices.size) == 8
+# 99 charges on shard 3's dispatch exhaust the retry rungs, and the
+# sort-ending plan blocks the split rung (neither row-local nor
+# stream-combinable) — with the collect fallback unset the dist-stream
+# query MUST die and leave its postmortem behind.
+pd = (plan().groupby_agg(["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+      .sort_by(["k"]))
+pa = plan().filter(col("v") > 10).groupby_agg(
+    ["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+
+s = QuerySession(max_concurrent=4)
+tickets = [("dist", s.submit(pd, list(batches), mesh=mesh, combine=False))]
+for _ in range(3):
+    tickets.append(("run", s.submit(pa, table=table)))
+
+failed = ok = 0
+for kind, t in tickets:
+    try:
+        t.result(timeout=300)
+        ok += 1
+    except Exception:
+        assert kind == "dist", f"healthy {kind} query died"
+        failed += 1
+assert failed == 1 and ok == 3, (failed, ok)
+
+base = server.get().url
+with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+    metrics = resp.read().decode()
+s.close()
+
+# Every bundle on disk must be golden-schema valid (Perfetto-ready ring
+# included — validate_bundle runs validate_chrome_trace on the drain).
+schema = json.load(open("tests/golden/postmortem_bundle_schema.json"))
+by_reason = {}
+paths = sorted(glob.glob("artifacts/premerge-bundles/postmortem-*.json"))
+for p in paths:
+    payload = json.load(open(p))
+    errs = validate_bundle(payload, schema)
+    assert not errs, (p, errs[:3])
+    by_reason.setdefault(payload["reason"], []).append(p)
+assert by_reason.get("failure"), by_reason
+assert by_reason.get("recovery_exhausted"), by_reason
+assert by_reason.get("slo_breach"), by_reason
+
+# Doctor must turn every bundle into a verdict (exit 0) and name the
+# injected fault site on the bundles the doomed query left behind.
+for reason, group in sorted(by_reason.items()):
+    for p in group:
+        out = subprocess.run(
+            [sys.executable, "-m", "spark_rapids_tpu.obs", "doctor", p],
+            capture_output=True, text=True)
+        assert out.returncode == 0, (p, out.stdout, out.stderr)
+        if reason in ("failure", "recovery_exhausted"):
+            assert "dist-dispatch" in out.stdout, (p, out.stdout)
+
+# Latency histograms: exposition parses, per-mode srt_query_seconds
+# series present, buckets cumulative with +Inf == count.
+sample = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|\+Inf|-Inf)$')
+lines = [l for l in metrics.strip().split("\n") if not l.startswith("#")]
+bad = [l for l in lines if not sample.match(l)]
+assert not bad, bad[:5]
+run_buckets = [l for l in lines
+               if l.startswith('srt_query_seconds_bucket{')
+               and 'mode="run"' in l]
+assert run_buckets, "no per-mode srt_query_seconds histogram exposed"
+counts = [float(l.rsplit(" ", 1)[1]) for l in run_buckets]
+assert counts == sorted(counts), run_buckets
+inf = [l for l in run_buckets if 'le="+Inf"' in l]
+total = [l for l in lines if l.startswith('srt_query_seconds_count{')
+         and 'mode="run"' in l]
+assert len(inf) == 1 and len(total) == 1, (inf, total)
+assert inf[0].rsplit(" ", 1)[1] == total[0].rsplit(" ", 1)[1], (inf, total)
+
+print("diagnostics lane ok:", {k: len(v) for k, v in sorted(by_reason.items())},
+      "bundles,", len(run_buckets), "run-mode buckets")
+EOF
+ls -l artifacts/premerge-bundles
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

@@ -165,6 +165,27 @@ Knobs (all optional):
                                over identical input batches return the
                                cached result (LRU by bytes).
                                Unset/``0``/``off`` disables.
+  ``SRT_FLIGHT_EVENTS``        flight-recorder ring capacity
+                               (obs/flight.py): timeline events retained
+                               per query in the always-on (under
+                               ``SRT_METRICS=1``) fixed-size ring that
+                               postmortem bundles drain (>= 1,
+                               default 4096).
+  ``SRT_BUNDLE_DIR``           directory where postmortem bundles
+                               (obs/bundle.py) are written on terminal
+                               query failure, recovery-ladder
+                               exhaustion, admission rejection, or SLO
+                               breach.  Unset (default) disables bundle
+                               writing.
+  ``SRT_SLO_MS``               per-query latency SLO in milliseconds: a
+                               completed query slower than this writes
+                               an ``slo_breach`` postmortem bundle
+                               (> 0; unset/``0``/``off`` = no SLO).
+  ``SRT_LIVE_RECENT``          finished-query records the live registry
+                               (obs/live.py) retains for ``/queries``
+                               and postmortem lookup; oldest are
+                               LRU-dropped past the cap (>= 1,
+                               default 256).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -707,6 +728,82 @@ def result_cache_bytes() -> int | None:
     return val
 
 
+def flight_events() -> int:
+    """Per-query capacity of the flight recorder's event ring
+    (obs/flight.py).  The ring is preallocated and overwrites oldest
+    events past the cap, so diagnostics memory stays bounded no matter
+    how long a query runs.  Tune with ``SRT_FLIGHT_EVENTS`` (>= 1,
+    default 4096)."""
+    raw = os.environ.get("SRT_FLIGHT_EVENTS")
+    if raw is None:
+        return 4096
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_FLIGHT_EVENTS must be an integer >= 1, "
+            f"got {raw!r}") from None
+    if val < 1:
+        raise ValueError(
+            f"SRT_FLIGHT_EVENTS must be >= 1, got {val}")
+    return val
+
+
+def bundle_dir() -> str | None:
+    """Directory postmortem bundles (obs/bundle.py) are written to, or
+    None when bundle writing is off (the default — postmortems are an
+    operator opt-in because they persist plan text and config to disk).
+    Set with ``SRT_BUNDLE_DIR``."""
+    raw = os.environ.get("SRT_BUNDLE_DIR")
+    if raw is None or not raw.strip():
+        return None
+    return raw
+
+
+def slo_ms() -> float | None:
+    """Per-query latency SLO in milliseconds, or None when no SLO is
+    set.  A query whose total wall time exceeds the SLO writes an
+    ``slo_breach`` postmortem bundle (when ``SRT_BUNDLE_DIR`` is set)
+    even though it succeeded — the tail-latency incident record.  Tune
+    with ``SRT_SLO_MS`` (> 0; unset/``0``/``off`` disables)."""
+    raw = os.environ.get("SRT_SLO_MS")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SLO_MS must be a number of milliseconds "
+            f"(or 0/off), got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_SLO_MS must be > 0 milliseconds (or 0/off), got {val}")
+    return val
+
+
+def live_recent_keep() -> int:
+    """Finished-query records the live registry (obs/live.py) retains
+    for ``/queries`` and postmortem lookup; the oldest are dropped past
+    the cap so sustained serving cannot grow memory.  Tune with
+    ``SRT_LIVE_RECENT`` (>= 1, default 256)."""
+    raw = os.environ.get("SRT_LIVE_RECENT")
+    if raw is None:
+        return 256
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_LIVE_RECENT must be an integer >= 1, "
+            f"got {raw!r}") from None
+    if val < 1:
+        raise ValueError(
+            f"SRT_LIVE_RECENT must be >= 1, got {val}")
+    return val
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -789,5 +886,7 @@ def knob_table() -> dict[str, str]:
              "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE",
              "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES",
              "SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
-             "SRT_SERVE_POLICY", "SRT_RESULT_CACHE")
+             "SRT_SERVE_POLICY", "SRT_RESULT_CACHE",
+             "SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",
+             "SRT_LIVE_RECENT")
     return {n: os.environ.get(n, "<default>") for n in names}

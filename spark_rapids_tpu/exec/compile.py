@@ -1920,6 +1920,8 @@ def _run_plan_metered(plan: Plan, table: Table, progress=None):
             t = _execute_resilient(plan, table, qm=qm)
     except BaseException as err:
         lq.finish(status="error", error=repr(err))
+        from ..obs import bundle as _bundle
+        _bundle.dump("failure", qm=qm, error=err, plan=plan)
         raise
     finally:
         _prof.pop_collector(cc)
@@ -2345,6 +2347,8 @@ def analyze_plan(plan: Plan, table: Table):
             t = _analyze_measured(plan, table, qm, lq)
     except BaseException as err:
         lq.finish(status="error", error=repr(err))
+        from ..obs import bundle as _bundle
+        _bundle.dump("failure", qm=qm, error=err, plan=plan)
         raise
     lq.finish(output_rows=qm.output_rows)
     qm.apply_opt(getattr(plan, "opt", None))

@@ -416,6 +416,9 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
         raise
     except BaseException as err:
         lq.finish(status="error", error=repr(err))
+        from ..obs import bundle as _bundle
+        _bundle.dump("failure", query_id=qid, fingerprint=lq.fingerprint,
+                     mode=mode, error=err, plan=plan)
         raise
 
     lq.set_phase("finalize")

@@ -17,8 +17,14 @@ QueryMetrics), and :mod:`.regress` gates fresh ledgers against the
 history baseline (``SRT_REGRESS_TOL``).  :mod:`.live` is the in-flight
 side — a live-query registry every execution path heartbeats into —
 and :mod:`.server` exports it over HTTP (Prometheus ``/metrics``, JSON
-``/queries``, mid-run Chrome traces) behind ``SRT_LIVE_SERVER=1``;
-``python -m spark_rapids_tpu.obs top`` renders it as a console table.
+``/queries``, mid-run Chrome traces, SLO latency histograms) behind
+``SRT_LIVE_SERVER=1``; ``python -m spark_rapids_tpu.obs top`` renders
+it as a console table.  :mod:`.flight` is the always-on
+(``SRT_METRICS=1``) per-query flight recorder — a bounded ring of
+trace events — which :mod:`.bundle` drains into self-contained
+postmortem JSON on failure/SLO breach (``SRT_BUNDLE_DIR``), and
+:mod:`.doctor` (``python -m spark_rapids_tpu.obs doctor``) turns a
+bundle into a ranked verdict against the history baseline.
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
@@ -35,6 +41,9 @@ import importlib
 #: exported name -> (submodule, attribute | None).  None means the name
 #: IS the submodule.
 _LAZY = {
+    "bundle": ("bundle", None),
+    "doctor": ("doctor", None),
+    "flight": ("flight", None),
     "history": ("history", None),
     "live": ("live", None),
     "metrics": ("metrics", None),
@@ -70,6 +79,8 @@ _LAZY = {
     "last_stream_metrics": ("query", "last_stream_metrics"),
     "set_last_query_metrics": ("query", "set_last_query_metrics"),
     "set_last_stream_metrics": ("query", "set_last_stream_metrics"),
+    "dump_bundle": ("bundle", "dump"),
+    "diagnose": ("doctor", "diagnose"),
 }
 
 __all__ = sorted(_LAZY)

@@ -1,11 +1,18 @@
-"""``python -m spark_rapids_tpu.obs top`` — htop-style live query view.
+"""``python -m spark_rapids_tpu.obs`` — console tooling over obs state.
 
-Polls the in-process live registry (obs/live.py) or, with ``--url``, a
-remote exporter's ``/queries`` endpoint (obs/server.py) and redraws a
-console table of in-flight queries: phase, batches done / in-flight,
-rows/sec, ICI bytes, last recovery rung, and one progress bar per shard.
-``--once`` prints a single frame (scripts, CI, docs); default is a 1 Hz
-refresh until Ctrl-C.
+``top``
+    htop-style live query view: polls the in-process live registry
+    (obs/live.py) or, with ``--url``, a remote exporter's ``/queries``
+    endpoint (obs/server.py) and redraws a console table of in-flight
+    queries: phase, batches done / in-flight, rows/sec, ICI bytes, last
+    recovery rung, and one progress bar per shard.  ``--once`` prints a
+    single frame (scripts, CI, docs); default is a 1 Hz refresh until
+    Ctrl-C.
+``doctor <bundle.json | fingerprint>``
+    postmortem analysis (obs/doctor.py): rank what failed or got slow
+    in one bundle — or a plan fingerprint's newest history record —
+    against the same-fingerprint history baseline, and print the
+    verdict.  Exits 0 whenever a verdict was produced.
 
 Rendering is a pure function of the ``/queries`` JSON payload
 (:func:`render_top`), so tests drive it with synthetic snapshots and the
@@ -131,7 +138,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="refresh period in seconds (default 1.0)")
     top.add_argument("--once", action="store_true",
                      help="print one frame and exit")
+    doctor = sub.add_parser(
+        "doctor", help="explain a failed/slow query from its postmortem "
+                       "bundle or plan fingerprint")
+    doctor.add_argument("target",
+                        help="path to a postmortem bundle JSON "
+                             "(SRT_BUNDLE_DIR) or a plan fingerprint "
+                             "with history records")
+    doctor.add_argument("--history", default=None,
+                        help="metrics-history JSONL for the baseline "
+                             "(default: SRT_METRICS_HISTORY)")
     args = parser.parse_args(argv)
+    if args.command == "doctor":
+        from .doctor import main as doctor_main
+        return doctor_main(args.target, history_path=args.history)
     if args.command != "top":
         parser.print_help()
         return 2

@@ -1,0 +1,286 @@
+"""Postmortem bundles — one self-contained JSON record per incident.
+
+Under a serving scheduler the interesting failures are not
+reproducible on demand: by the time an operator looks, the queue has
+moved on and the process state that explains the incident is gone.
+This module captures it at the moment it happens.  On a terminal query
+failure, recovery-ladder exhaustion, an admission rejection, or an SLO
+breach (``SRT_SLO_MS``), :func:`dump` writes one JSON file to
+``SRT_BUNDLE_DIR`` containing everything a postmortem needs:
+
+  * the query's flight-recorder ring (obs/flight.py) drained as a valid
+    Chrome trace — the last N events before the incident, Perfetto-ready;
+  * the plan's step text and the optimizer's before/after diff
+    (exec/optimize.OptInfo);
+  * the full recovery chain — every rung the ladder attempted;
+  * the final QueryMetrics snapshot (cost ledger, serve block, HBM
+    samples) when one exists;
+  * the live-registry record, the config knob table, and the SLO state.
+
+The payload key set is golden-pinned
+(tests/golden/postmortem_bundle_schema.json, append-only like
+QueryMetrics): fleets diff bundles across releases.  :func:`dump`
+NEVER raises — diagnostics must not turn one failure into two — and is
+a no-op unless ``SRT_BUNDLE_DIR`` is set.  The directory is
+count-capped (:data:`MAX_BUNDLES`, oldest deleted) so a crash loop
+cannot fill a disk.  Jax-free at import, like all of ``obs``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config import bundle_dir, knob_table, slo_ms
+
+#: Bump on any key-set change; the golden test pins the layout.
+SCHEMA_VERSION = 1
+
+#: Incident kinds :func:`dump` accepts.
+REASONS = ("failure", "recovery_exhausted", "admission_rejected",
+           "slo_breach")
+
+#: Most bundle files kept in ``SRT_BUNDLE_DIR`` (oldest-mtime deleted).
+MAX_BUNDLES = 64
+
+_LOCK = threading.Lock()
+#: (query_id, reason) pairs already written this process: the executor
+#: and the scheduler both see the same failure, and one incident must
+#: produce one bundle.
+_DUMPED: set = set()
+
+
+def _error_block(error: Optional[BaseException]) -> Dict[str, Any]:
+    if error is None:
+        return {"type": None, "message": None, "category": None}
+    category = None
+    try:
+        from ..resilience.classify import classify
+        category = classify(error)
+    except Exception:
+        pass
+    return {"type": type(error).__name__, "message": str(error),
+            "category": category}
+
+
+def _recovery_block(summary) -> Dict[str, Any]:
+    """Serialize a resilience.classify.RecoverySummary (or None)."""
+    if summary is None:
+        return {"site": None, "category": None, "steps": [],
+                "retries": 0, "splits": 0, "cache_evictions": 0,
+                "backoff_seconds": 0.0}
+    return {
+        "site": getattr(summary, "site", None),
+        "category": getattr(summary, "category", None),
+        "steps": list(getattr(summary, "steps", ()) or ()),
+        "retries": int(getattr(summary, "retries", 0)),
+        "splits": int(getattr(summary, "splits", 0)),
+        "cache_evictions": int(getattr(summary, "cache_evictions", 0)),
+        "backoff_seconds": float(getattr(summary, "backoff_seconds", 0.0)),
+    }
+
+
+def _plan_block(plan) -> Dict[str, Any]:
+    """Step text + optimizer diff without importing the exec package:
+    the OptInfo the optimizer attached carries both sides of the story,
+    and when it is absent we only use exec.optimize if the caller's
+    process already loaded it (bundle stays jax-free on its own)."""
+    if plan is None:
+        return {"text": None, "opt_diff": None}
+    info = getattr(plan, "opt", None)
+    text = None
+    diff = None
+    try:
+        if info is not None:
+            steps = info.after or info.before
+            if steps:
+                text = "\n".join(steps)
+            diff = info.render_diff()
+        if text is None:
+            opt = sys.modules.get("spark_rapids_tpu.exec.optimize")
+            if opt is not None:
+                text = "\n".join(opt.plan_step_texts(plan))
+            else:
+                text = "\n".join(type(s).__name__
+                                 for s in getattr(plan, "steps", ()))
+    except Exception:
+        pass
+    return {"text": text, "opt_diff": diff}
+
+
+def _flight_block(query_id: Optional[int]) -> Dict[str, Any]:
+    snap = None
+    if query_id is not None:
+        from . import flight
+        snap = flight.snapshot(query_id)
+    if snap is None:
+        return {"capacity": 0, "events_recorded": 0, "events_dropped": 0,
+                "trace": {"displayTimeUnit": "ms", "traceEvents": []}}
+    return snap
+
+
+def _prune_oldest(dirpath: str) -> None:
+    try:
+        names = [n for n in os.listdir(dirpath)
+                 if n.startswith("postmortem-") and n.endswith(".json")]
+        if len(names) <= MAX_BUNDLES:
+            return
+        paths = [os.path.join(dirpath, n) for n in names]
+        paths.sort(key=lambda p: (os.path.getmtime(p), p))
+        for p in paths[:len(paths) - MAX_BUNDLES]:
+            os.unlink(p)
+    except OSError:
+        pass
+
+
+def build(reason: str, *, query_id: Optional[int] = None, qm=None,
+          fingerprint: str = "", mode: str = "",
+          error: Optional[BaseException] = None, recovery=None,
+          plan=None) -> Dict[str, Any]:
+    """The bundle payload dict (the golden-pinned shape), unwritten.
+
+    Split from :func:`dump` so tests and the doctor can build/inspect
+    payloads without touching the filesystem."""
+    if reason not in REASONS:
+        raise ValueError(f"bundle reason must be one of {REASONS}, "
+                         f"got {reason!r}")
+    if qm is not None:
+        if query_id is None:
+            query_id = qm.query_id
+        fingerprint = fingerprint or qm.fingerprint
+        mode = mode or qm.mode
+    if recovery is None and error is not None:
+        recovery = getattr(error, "summary", None)
+    try:
+        limit = slo_ms()
+    except ValueError:
+        limit = None
+    elapsed = (round(qm.total_seconds, 6)
+               if qm is not None and qm.total_seconds >= 0 else None)
+    live_rec = None
+    if query_id is not None:
+        from . import live as _live
+        live_rec = _live.get(query_id)
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "metric": "postmortem_bundle",
+        "reason": reason,
+        "unix_time": round(time.time(), 3),
+        "query_id": query_id,
+        "fingerprint": fingerprint,
+        "mode": mode,
+        "error": _error_block(error),
+        "recovery": _recovery_block(recovery),
+        "flight": _flight_block(query_id),
+        "plan": _plan_block(plan),
+        "metrics": qm.to_dict() if qm is not None else None,
+        "hbm": list(getattr(qm, "hbm_per_device", ()) or ()),
+        "live": live_rec,
+        "config": knob_table(),
+        "slo": {"slo_ms": limit, "elapsed_seconds": elapsed},
+    }
+
+
+def dump(reason: str, *, query_id: Optional[int] = None, qm=None,
+         fingerprint: str = "", mode: str = "",
+         error: Optional[BaseException] = None, recovery=None,
+         plan=None) -> Optional[str]:
+    """Write one postmortem bundle; returns its path, or None when
+    bundles are off, this (query, reason) already dumped, or anything
+    went wrong (diagnostics never raise into the failing query)."""
+    try:
+        dirpath = bundle_dir()
+        if dirpath is None:
+            return None
+        payload = build(reason, query_id=query_id, qm=qm,
+                        fingerprint=fingerprint, mode=mode, error=error,
+                        recovery=recovery, plan=plan)
+        qid = payload["query_id"]
+        key = (qid, reason)
+        with _LOCK:
+            if qid is not None and key in _DUMPED:
+                return None
+            _DUMPED.add(key)
+        os.makedirs(dirpath, exist_ok=True)
+        name = (f"postmortem-{reason}-q{qid if qid is not None else 0}"
+                f"-{int(time.time() * 1000)}-{os.getpid()}.json")
+        path = os.path.join(dirpath, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True, indent=1)
+        os.replace(tmp, path)
+        _prune_oldest(dirpath)
+        return path
+    except Exception:
+        try:
+            from .metrics import counter
+            counter("bundle.errors").inc()
+        except Exception:
+            pass
+        return None
+
+
+def maybe_slo(qm) -> Optional[str]:
+    """Dump an ``slo_breach`` bundle when ``qm`` (a completed query)
+    overran ``SRT_SLO_MS``; the success-path hook in the metered
+    executors.  Returns the bundle path or None."""
+    limit = slo_ms()
+    if limit is None or qm is None:
+        return None
+    if qm.total_seconds * 1000.0 <= limit:
+        return None
+    return dump("slo_breach", qm=qm)
+
+
+def validate_bundle(payload: dict, schema: dict) -> List[str]:
+    """Check a bundle payload against the golden-pinned schema
+    (tests/golden/postmortem_bundle_schema.json): exact top-level key
+    set, exact key sets for the fixed sub-blocks, an allowed ``reason``,
+    and a drained ring in the pinned Chrome-trace shape.  Returns
+    human-readable problems (empty = valid); shared by the test suite
+    and the CI diagnostics lane."""
+    errors: List[str] = []
+    if not isinstance(payload, dict):
+        return ["bundle is not an object"]
+    top = sorted(payload)
+    if top != sorted(schema["top_level_keys"]):
+        errors.append(f"top-level keys {top} != "
+                      f"{sorted(schema['top_level_keys'])}")
+        return errors
+    if payload["schema_version"] != schema["schema_version"]:
+        errors.append(f"schema_version {payload['schema_version']!r} != "
+                      f"{schema['schema_version']!r}")
+    if payload["metric"] != "postmortem_bundle":
+        errors.append(f"metric {payload['metric']!r}")
+    if payload["reason"] not in schema["reasons"]:
+        errors.append(f"reason {payload['reason']!r} not in "
+                      f"{schema['reasons']}")
+    for block in ("error", "recovery", "flight", "plan", "slo"):
+        sub = payload.get(block)
+        if not isinstance(sub, dict):
+            errors.append(f"{block!r} block is not an object")
+            continue
+        pinned = schema["blocks"][block]
+        if sorted(sub) != sorted(pinned):
+            errors.append(f"{block!r} keys {sorted(sub)} != {pinned}")
+    if not isinstance(payload.get("config"), dict):
+        errors.append("'config' block is not an object")
+    if not errors:
+        from .timeline import validate_chrome_trace
+        errors += [f"flight.trace: {e}" for e in validate_chrome_trace(
+            payload["flight"]["trace"], schema["chrome_trace"])]
+    return errors
+
+
+def reset() -> None:
+    """Forget which (query, reason) pairs were dumped (test isolation)."""
+    with _LOCK:
+        _DUMPED.clear()
+
+
+__all__ = ["MAX_BUNDLES", "REASONS", "SCHEMA_VERSION", "build", "dump",
+           "maybe_slo", "reset", "validate_bundle"]

@@ -1,0 +1,264 @@
+"""Postmortem doctor — explain a failed or slow query from its bundle.
+
+``python -m spark_rapids_tpu.obs doctor <bundle.json | fingerprint>``
+turns a postmortem bundle (obs/bundle.py) — or, given a bare plan
+fingerprint, the newest metrics-history record for it — into a ranked,
+human-readable verdict: what failed (the classified error and the
+recovery rungs the ladder burned through), and why it was slow (the
+cost-ledger bucket that grew, a compile/dict-encode/result-cache hit
+rate that collapsed, bucket-pad waste, queue wait) **relative to the
+history baseline for the same fingerprint**
+(:func:`obs.history.lookup_latest`, ``SRT_METRICS_HISTORY``).
+
+The analysis is pure dict-diffing over persisted JSON: jax-free, no
+process state needed, runnable on a laptop against a bundle scp'd out
+of an incident.  Findings carry a numeric severity and render
+most-damning-first; :func:`diagnose` is the library entry, ``main`` the
+CLI (exit 0 whenever a verdict was produced).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: A completed query this much slower than its baseline is a finding
+#: even without an SLO configured (wall clocks are noisy; 1.5x is not).
+SLOWDOWN_MIN_RATIO = 1.5
+
+#: Pad waste beyond this fraction of padded rows earns a finding.
+PAD_WASTE_MIN_FRAC = 0.5
+
+
+def _finding(severity: int, title: str, detail: str) -> Dict[str, Any]:
+    return {"severity": severity, "title": title, "detail": detail}
+
+
+def _ratio(new: float, old: float) -> Optional[float]:
+    if old is None or new is None or old <= 0 or new < 0:
+        return None
+    return new / old
+
+
+def _error_findings(payload: dict) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    err = payload.get("error") or {}
+    rec = payload.get("recovery") or {}
+    if err.get("type"):
+        site = rec.get("site")
+        where = f" at site {site!r}" if site else ""
+        out.append(_finding(
+            100,
+            f"{err.get('category') or 'unclassified'} failure{where}: "
+            f"{err['type']}",
+            str(err.get("message") or "")))
+    steps = rec.get("steps") or []
+    if steps:
+        out.append(_finding(
+            90,
+            f"recovery ladder attempted {len(steps)} rung(s) before "
+            f"giving up",
+            f"rungs: {', '.join(steps)}; retries={rec.get('retries', 0)} "
+            f"splits={rec.get('splits', 0)} "
+            f"cache_evictions={rec.get('cache_evictions', 0)} "
+            f"backoff={rec.get('backoff_seconds', 0.0):.3f}s"))
+    if payload.get("reason") == "admission_rejected":
+        out.append(_finding(
+            95, "rejected at admission (never ran)",
+            str(err.get("message") or "estimate exceeded the aggregate "
+                "HBM budget (SRT_SERVE_HBM_BUDGET)")))
+    return out
+
+
+def _slo_findings(payload: dict) -> List[Dict[str, Any]]:
+    slo = payload.get("slo") or {}
+    limit, elapsed = slo.get("slo_ms"), slo.get("elapsed_seconds")
+    if limit is not None and elapsed is not None \
+            and elapsed * 1000.0 > limit:
+        return [_finding(
+            85, f"SLO breach: {elapsed * 1e3:.1f}ms against "
+                f"SRT_SLO_MS={limit:g}",
+            f"the query completed, {elapsed * 1e3 - limit:.1f}ms over "
+            f"the latency objective")]
+    return []
+
+
+def _cache_findings(qm: dict, base: Optional[dict]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    if qm.get("compile_cache") == "miss":
+        extra = ""
+        if base is not None and base.get("compile_cache") == "hit":
+            extra = " (the baseline run hit)"
+        comp = (qm.get("timings") or {}).get("compile_seconds", 0.0)
+        out.append(_finding(
+            60, f"compile cache miss{extra}",
+            f"compile_seconds={comp:.3f} paid on this run; a recurring "
+            f"plan should hit the in-process or persistent XLA cache"))
+    caches = qm.get("caches") or {}
+    hits = caches.get("dict_encode_hits", 0)
+    misses = caches.get("dict_encode_misses", 0)
+    if hits + misses > 0 and misses > hits:
+        out.append(_finding(
+            40, f"dictionary-encode cache cold: {misses} miss / "
+                f"{hits} hit",
+            "string columns re-encoded on device instead of reusing "
+            "cached encodings"))
+    serve = qm.get("serve") or {}
+    if serve.get("result_cache") == "miss" and base is not None \
+            and (base.get("serve") or {}).get("result_cache") == "hit":
+        out.append(_finding(
+            35, "result cache missed where the baseline hit",
+            "identical resubmissions normally return cached results"))
+    return out
+
+
+def _cost_findings(qm: dict, base: Optional[dict]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    t = (qm.get("timings") or {}).get("total_seconds")
+    bt = (base.get("timings") or {}).get("total_seconds") \
+        if base is not None else None
+    r = _ratio(t, bt)
+    if r is not None and r >= SLOWDOWN_MIN_RATIO:
+        out.append(_finding(
+            80, f"{r:.1f}x slower than the history baseline",
+            f"total_seconds={t:.3f} vs baseline {bt:.3f} for the same "
+            f"fingerprint"))
+        cost = qm.get("cost") or {}
+        bcost = base.get("cost") or {}
+        grew = []
+        for bucket in ("compute_seconds", "ici_seconds",
+                       "host_sync_seconds", "dispatch_overhead_seconds",
+                       "unattributed_seconds"):
+            d = (cost.get(bucket) or 0.0) - (bcost.get(bucket) or 0.0)
+            if d > 0:
+                grew.append((d, bucket))
+        if grew:
+            grew.sort(reverse=True)
+            d, bucket = grew[0]
+            out.append(_finding(
+                70, f"cost ledger: {bucket} grew most (+{d:.3f}s)",
+                ", ".join(f"{b} +{x:.3f}s" for x, b in grew)))
+    qw = (qm.get("serve") or {}).get("queue_wait_seconds", 0.0)
+    if t and qw > 0.25 * t:
+        out.append(_finding(
+            55, f"queue wait dominated: {qw:.3f}s waiting vs {t:.3f}s "
+                f"running",
+            "raise SRT_SERVE_MAX_CONCURRENT or spread load; admission "
+            "and fairness state is in the bundle's metrics.serve block"))
+    counters = qm.get("counters") or {}
+    pad = counters.get("plan.bucket.pad_rows", 0)
+    total = counters.get("plan.bucket.rows_total", 0)
+    if total > 0 and pad / total > PAD_WASTE_MIN_FRAC:
+        out.append(_finding(
+            45, f"bucket padding wasted {pad / total:.0%} of padded rows",
+            f"{pad} pad rows of {total} total; widen SRT_SHAPE_BUCKETS "
+            f"growth or batch larger inputs"))
+    rec = qm.get("recovery") or {}
+    if rec.get("retries") or rec.get("splits"):
+        out.append(_finding(
+            65, f"recovery work during the run: "
+                f"{rec.get('retries', 0)} retries, "
+                f"{rec.get('splits', 0)} splits",
+            f"backoff={rec.get('backoff_seconds', 0.0):.3f}s, "
+            f"cache_evictions={rec.get('cache_evictions', 0)} — HBM "
+            f"pressure even though the query completed"))
+    return out
+
+
+def baseline_for(fingerprint: str,
+                 history_path: Optional[str] = None) -> Optional[dict]:
+    """The same-fingerprint history baseline (newest measured record)."""
+    if not fingerprint:
+        return None
+    from .history import lookup_latest
+    return lookup_latest(fingerprint, path=history_path)
+
+
+def diagnose(payload: dict, baseline: Optional[dict] = None,
+             history_path: Optional[str] = None) -> dict:
+    """Rank everything wrong with one bundle payload (or bare
+    QueryMetrics record).  Returns ``{"verdict", "fingerprint",
+    "baseline_used", "findings"}`` with findings sorted most severe
+    first; a clean bill of health is still a verdict."""
+    if payload.get("metric") == "postmortem_bundle":
+        qm = payload.get("metrics") or {}
+        bundle = payload
+    else:
+        qm = payload                    # a raw history/QueryMetrics record
+        bundle = {"reason": None, "error": {}, "recovery": {}, "slo": {}}
+    fingerprint = payload.get("fingerprint") or qm.get("fingerprint") or ""
+    if baseline is None:
+        baseline = baseline_for(fingerprint, history_path)
+    # Never let the incident record explain itself: a baseline that IS
+    # this query (same query_id) says nothing about what changed.
+    if baseline is not None \
+            and baseline.get("query_id") == qm.get("query_id"):
+        baseline = None
+    findings = (_error_findings(bundle) + _slo_findings(bundle)
+                + _cache_findings(qm, baseline)
+                + _cost_findings(qm, baseline))
+    findings.sort(key=lambda f: -f["severity"])
+    if findings:
+        verdict = findings[0]["title"]
+    elif baseline is None and not qm:
+        verdict = "no metrics in bundle and no history baseline — " \
+                  "nothing to diagnose"
+    else:
+        verdict = "no anomalies: timings, caches, and recovery are in " \
+                  "line with the baseline"
+    return {"verdict": verdict, "fingerprint": fingerprint,
+            "baseline_used": baseline is not None, "findings": findings}
+
+
+def render(report: dict) -> str:
+    """The CLI's human-readable rendering of a :func:`diagnose` report."""
+    lines = [f"== Doctor == {report['verdict']}"]
+    fp = report.get("fingerprint")
+    base = ("history baseline" if report.get("baseline_used")
+            else "no history baseline")
+    lines.append(f"  fingerprint={fp or '<none>'} ({base})")
+    for i, f in enumerate(report["findings"], 1):
+        lines.append(f"  {i}. [{f['severity']:>3}] {f['title']}")
+        if f["detail"]:
+            lines.append(f"       {f['detail']}")
+    if not report["findings"]:
+        lines.append("  (no findings)")
+    return "\n".join(lines)
+
+
+def main(target: str, history_path: Optional[str] = None) -> int:
+    """CLI body: ``target`` is a bundle path or a plan fingerprint.
+    Prints the verdict; returns 0 when one was produced, 2 when the
+    target could not be resolved."""
+    baseline: Optional[dict] = None
+    if os.path.exists(target):
+        try:
+            with open(target) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as err:
+            print(f"doctor: cannot read bundle {target!r}: {err}")
+            return 2
+    else:
+        # Fingerprint mode: diagnose the plan's NEWEST history record
+        # against its best prior run — "why did this get slow".
+        from .history import load
+        recs = load(target, path=history_path)
+        if not recs:
+            print(f"doctor: {target!r} is neither a bundle file nor a "
+                  f"fingerprint with history records "
+                  f"(SRT_METRICS_HISTORY)")
+            return 2
+        payload = recs[-1]
+        prior = [r for r in recs[:-1]
+                 if (r.get("timings") or {}).get("total_seconds", 0) > 0]
+        if prior:
+            baseline = min(
+                prior, key=lambda r: r["timings"]["total_seconds"])
+    print(render(diagnose(payload, baseline=baseline,
+                          history_path=history_path)))
+    return 0
+
+
+__all__ = ["PAD_WASTE_MIN_FRAC", "SLOWDOWN_MIN_RATIO", "baseline_for",
+           "diagnose", "main", "render"]

@@ -1,0 +1,256 @@
+"""Flight recorder — always-on bounded ring of timeline events per query.
+
+The opt-in span timeline (obs/timeline.py) answers *when and
+concurrently with what*, but only if someone thought to turn it on
+before the incident: under a serving scheduler the interesting failures
+are no longer reproducible on demand, so the trace a postmortem needs
+must already exist at the moment of failure.  This module is the
+aircraft-style flight recorder: whenever metrics are on
+(``SRT_METRICS=1``) every :func:`utils.tracing.trace` scope is also
+appended to a **fixed-size per-query ring** (``SRT_FLIGHT_EVENTS``
+slots, default 4096, preallocated) that overwrites oldest-first — so
+memory stays bounded no matter how long a query runs, and the last N
+events before a failure are always available for
+:func:`obs.bundle.dump` to drain.
+
+Contract (mirrors obs/metrics.py and obs/timeline.py):
+
+  * off unless ``SRT_METRICS=1`` — :func:`trace_span` returns None and
+    ``trace()`` composes nothing;
+  * jax-free at import (pinned by an import-hygiene test);
+  * appends are lock-free: slot indices come from an
+    ``itertools.count`` (a single C-level call, atomic under the GIL)
+    and each event writes its own slot — no lock on the hot path, the
+    measured-overhead budget is <= 2% of a metered run;
+  * :func:`chrome_trace` renders a drained ring in the exact
+    golden-pinned Chrome-trace shape (tests/golden/
+    chrome_trace_schema.json), so a bundle's ``flight.trace`` loads in
+    Perfetto and passes ``timeline.validate_chrome_trace``.
+
+Events are attributed to the ambient query via
+``timeline.current_query_id()`` — the execution paths open a
+``timeline.query_scope`` unconditionally, so attribution works even
+when the opt-in timeline is not recording.  Spans with no ambient
+query are not recorded (there is no ring to put them in).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from ..config import flight_events, metrics_enabled
+from . import timeline as _tl
+
+# The ring registry is bounded too: a long-serving process touches many
+# query ids, and rings for queries that finished cleanly are only kept
+# as LRU insurance (a bundle drains the ring at the moment of failure).
+MAX_RINGS = 64
+
+_LOCK = threading.Lock()
+_RINGS: "OrderedDict[int, FlightRing]" = OrderedDict()
+
+
+def enabled() -> bool:
+    """True when trace scopes feed the flight recorder (one env read)."""
+    return metrics_enabled()
+
+
+class FlightRing:
+    """Preallocated fixed-size event ring for one query.
+
+    ``append`` is lock-free: ``next(self._tick)`` hands out a unique
+    monotone slot index (itertools.count is a single C call, atomic
+    under the GIL) and the event tuple is written to ``slots[i % cap]``.
+    Concurrent appends from stream-executor worker threads therefore
+    never block each other; past capacity the oldest slots are simply
+    overwritten.  ``_appended`` is a last-writer-wins approximation used
+    only for the recorded/dropped stats — drain order comes from the
+    events' own timestamps, not from bookkeeping.
+    """
+
+    __slots__ = ("query_id", "capacity", "_slots", "_tick", "_appended")
+
+    def __init__(self, query_id: int, capacity: Optional[int] = None):
+        self.query_id = query_id
+        self.capacity = flight_events() if capacity is None else capacity
+        self._slots: List[Optional[tuple]] = [None] * self.capacity
+        self._tick = itertools.count()
+        self._appended = 0
+
+    def append(self, name: str, cat: str, ts_us: float, dur_us: float,
+               lane: str, args: Dict[str, Any]) -> None:
+        i = next(self._tick)
+        self._slots[i % self.capacity] = (ts_us, name, cat, dur_us, lane,
+                                          args)
+        self._appended = i + 1
+
+    def events(self) -> List[tuple]:
+        """Written slots in timestamp order (oldest first)."""
+        return sorted(s for s in self._slots if s is not None)
+
+    def stats(self) -> Dict[str, int]:
+        n = self._appended
+        return {
+            "capacity": self.capacity,
+            "events_recorded": min(n, self.capacity),
+            "events_dropped": max(n - self.capacity, 0),
+        }
+
+    def chrome_trace(self) -> dict:
+        """Render the ring as a Chrome-trace payload (golden shape).
+
+        Lane tids are assigned in order of first appearance among the
+        retained events; each lane is announced with one ``M``
+        ``thread_name`` metadata event, exactly like the timeline
+        export, so the payload passes ``validate_chrome_trace`` and
+        loads in Perfetto.
+        """
+        lanes: Dict[str, int] = {}
+        evs: List[dict] = []
+        for ts_us, name, cat, dur_us, lane, args in self.events():
+            tid = lanes.get(lane)
+            if tid is None:
+                tid = len(lanes) + 1
+                lanes[lane] = tid
+                evs.append({"name": "thread_name", "ph": "M",
+                            "pid": _tl._PID, "tid": tid,
+                            "args": {"name": lane}})
+            a = {k: _tl._coerce(v) for k, v in args.items()}
+            a.setdefault("query_id", self.query_id)
+            evs.append({"name": name, "cat": cat, "ph": "X",
+                        "pid": _tl._PID, "tid": tid,
+                        "ts": round(ts_us, 3),
+                        "dur": round(max(dur_us, 0.0), 3), "args": a})
+        return {"displayTimeUnit": "ms", "traceEvents": evs}
+
+
+class _FlightSpan:
+    """Open flight-recorder scope; appends one event on exit/``end()``
+    (idempotent, like timeline spans — drain paths may close twice)."""
+
+    __slots__ = ("_ring", "_name", "_cat", "_lane", "_args", "_t0",
+                 "_done")
+
+    def __init__(self, ring: FlightRing, name: str, cat: str,
+                 lane: Optional[str], args: Dict[str, Any]):
+        self._ring = ring
+        self._name = name
+        self._cat = cat
+        self._lane = lane
+        self._args = args
+        self._t0 = _tl.now_us()
+        self._done = False
+
+    def __enter__(self) -> "_FlightSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+        return None
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        lane = self._lane
+        if lane is None:
+            t = threading.current_thread()
+            lane = t.name or f"thread-{t.ident}"
+        self._ring.append(self._name, self._cat, self._t0,
+                          _tl.now_us() - self._t0, lane, self._args)
+
+
+def ring_for(query_id: int, create: bool = True) -> Optional[FlightRing]:
+    """The ring for ``query_id`` (LRU-registered), creating it on first
+    use when ``create``.  The registry holds at most :data:`MAX_RINGS`
+    rings; the least-recently-touched is evicted on overflow."""
+    with _LOCK:
+        ring = _RINGS.get(query_id)
+        if ring is not None:
+            _RINGS.move_to_end(query_id)
+            return ring
+        if not create:
+            return None
+        ring = _RINGS[query_id] = FlightRing(query_id)
+        while len(_RINGS) > MAX_RINGS:
+            _RINGS.popitem(last=False)
+        return ring
+
+
+def record(name: str, cat: str, ts_us: float, dur_us: float,
+           lane: Optional[str], args: Dict[str, Any]) -> None:
+    """Append one finished event to the owning query's ring — the feed
+    ``timeline.add_complete`` / ``timeline.instant`` mirror every event
+    through.  Attribution: an explicit ``query_id`` arg wins (the dist
+    path's fan-out events carry one), else the ambient
+    ``timeline.query_scope``; events with neither are not recorded."""
+    if not metrics_enabled():
+        return
+    qid = args.get("query_id")
+    if qid is None:
+        qid = _tl.current_query_id()
+        if qid is None:
+            return
+    if not isinstance(qid, int):
+        return
+    if lane is None:
+        t = threading.current_thread()
+        lane = t.name or f"thread-{t.ident}"
+    ring_for(qid).append(name, cat, ts_us, dur_us, lane, dict(args))
+
+
+def trace_span(name: str, attrs: Dict[str, Any], cat: str = "flight",
+               lane: Optional[str] = None):
+    """The flight recorder's scope for one ``trace()`` /
+    ``timeline.span()`` call, or None when off / no ambient query.  The
+    hot-path cost when on is one TLS read, one dict copy, and (at exit)
+    one counter bump plus one slot write."""
+    if not metrics_enabled():
+        return None
+    qid = attrs.get("query_id") if attrs else None
+    if qid is None:
+        qid = _tl.current_query_id()
+    if not isinstance(qid, int):
+        return None
+    return _FlightSpan(ring_for(qid), name, cat, lane, dict(attrs))
+
+
+def snapshot(query_id: int) -> Optional[Dict[str, Any]]:
+    """Drain view of one query's ring for a postmortem bundle:
+    ``{capacity, events_recorded, events_dropped, trace}`` with
+    ``trace`` in the golden Chrome-trace shape — or None when the query
+    never recorded (recorder off, or the ring was LRU-evicted)."""
+    ring = ring_for(query_id, create=False)
+    if ring is None:
+        return None
+    out: Dict[str, Any] = dict(ring.stats())
+    out["trace"] = ring.chrome_trace()
+    return out
+
+
+def discard(query_id: int) -> None:
+    """Drop one query's ring (callers that bundled it already)."""
+    with _LOCK:
+        _RINGS.pop(query_id, None)
+
+
+def reset() -> None:
+    """Drop all rings (test isolation)."""
+    with _LOCK:
+        _RINGS.clear()
+
+
+def chrome_trace(query_id: int) -> dict:
+    """The ring's Chrome-trace payload (empty payload if no ring)."""
+    ring = ring_for(query_id, create=False)
+    if ring is None:
+        return {"displayTimeUnit": "ms", "traceEvents": []}
+    return ring.chrome_trace()
+
+
+__all__ = ["FlightRing", "MAX_RINGS", "chrome_trace", "discard",
+           "enabled", "record", "ring_for", "reset", "snapshot",
+           "trace_span"]

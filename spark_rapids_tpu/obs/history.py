@@ -197,6 +197,36 @@ def load(fingerprint: Optional[str] = None,
     return out
 
 
+#: Reverse-reader block size: one seek+read per 64 KiB of tail keeps a
+#: multi-GB history file's newest-record lookup O(tail), not O(file).
+_REVERSE_BLOCK = 64 * 1024
+
+
+def _iter_lines_reversed(path: str):
+    """Yield a JSONL file's lines newest-first, reading block-wise from
+    EOF — never the whole file.  A torn final line (a writer crashed
+    mid-append) surfaces like any other line and is left to the caller's
+    corrupt-line handling."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        pos = f.tell()
+        buf = b""
+        while pos > 0:
+            step = min(_REVERSE_BLOCK, pos)
+            pos -= step
+            f.seek(pos)
+            buf = f.read(step) + buf
+            # Everything after the first newline in the buffer is whole
+            # lines; the head fragment may continue into earlier blocks.
+            lines = buf.split(b"\n")
+            buf = lines[0]
+            for line in reversed(lines[1:]):
+                if line:
+                    yield line
+        if buf:
+            yield buf
+
+
 def lookup_latest(fingerprint: str,
                   path: Optional[str] = None) -> Optional[dict]:
     """The most recent history record for ``fingerprint`` that carries
@@ -206,17 +236,46 @@ def lookup_latest(fingerprint: str,
     when its ``steps`` list has at least one measured ``rows_out`` (an
     ``explain_analyze`` / metered run), because a record without step
     observations can't inform selectivity ordering or join cardinality.
-    Corrupt lines are skipped exactly as :func:`load` skips them; a
-    missing file or empty history answers None (the cold-start case)."""
-    for rec in reversed(load(fingerprint, path=path)):
-        steps = rec.get("steps")
-        if isinstance(steps, list) and any(
-                isinstance(s, dict)
-                and isinstance(s.get("rows_out"), (int, float))
-                and s.get("rows_out") >= 0
-                for s in steps):
-            return rec
-    return None
+
+    Reads the file TAIL-FIRST (block-wise from EOF), so the per-query
+    optimizer and doctor lookups stay O(tail) on a multi-GB history
+    file instead of parsing every record ever written.  Corrupt lines —
+    including a torn final line from a crashed writer — are skipped and
+    counted exactly as :func:`load` counts them; a missing file or empty
+    history answers None (the cold-start case)."""
+    if path is None:
+        path = metrics_history_path()
+    if path is None or not os.path.exists(path):
+        return None
+    skipped = 0
+    found: Optional[dict] = None
+    try:
+        for raw in _iter_lines_reversed(path):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict) \
+                    or rec.get("fingerprint") != fingerprint:
+                continue
+            steps = rec.get("steps")
+            if isinstance(steps, list) and any(
+                    isinstance(s, dict)
+                    and isinstance(s.get("rows_out"), (int, float))
+                    and s.get("rows_out") >= 0
+                    for s in steps):
+                found = rec
+                break
+    except OSError:
+        return None
+    if skipped:
+        from .metrics import counter
+        counter("history.corrupt_lines").inc(skipped)
+    return found
 
 
 def last_load_skipped() -> int:

@@ -47,16 +47,17 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional
 
-from ..config import metrics_enabled
+from ..config import live_recent_keep, metrics_enabled
 
-#: Finished queries kept for the ``/queries`` "recent" list.
-RECENT_KEEP = 32
 #: Recovery rungs kept per live record (newest last).
 RUNG_KEEP = 16
 
 _LOCK = threading.Lock()
 _ACTIVE: "OrderedDict[int, LiveQuery]" = OrderedDict()
-_RECENT: deque = deque(maxlen=RECENT_KEEP)
+# Unbounded deque, LRU-trimmed to SRT_LIVE_RECENT on every finish:
+# sustained serving retires queries forever, and the retention cap is
+# what keeps the postmortem-lookup window from growing memory.
+_RECENT: deque = deque()
 _TLS = threading.local()
 
 
@@ -254,9 +255,12 @@ class LiveQuery:
         if output_rows is not None:
             self.rows_out = int(output_rows)
         self.phase = status
+        keep = live_recent_keep()
         with _LOCK:
             _ACTIVE.pop(self.query_id, None)
             _RECENT.append(self)
+            while len(_RECENT) > keep:
+                _RECENT.popleft()
         stack = getattr(_TLS, "stack", None)
         if stack and self in stack:
             stack.remove(self)
@@ -483,7 +487,7 @@ def print_progress(snap: dict) -> None:
 
 
 __all__: List[str] = [
-    "LiveQuery", "NULL_LIVE", "RECENT_KEEP", "RUNG_KEEP", "add_ici",
+    "LiveQuery", "NULL_LIVE", "RUNG_KEEP", "add_ici",
     "as_observer", "current", "get", "note_hbm", "phase",
     "print_progress", "reset", "rung", "set_queued_provider",
     "snapshot_all", "start",

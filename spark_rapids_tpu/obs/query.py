@@ -426,10 +426,23 @@ def _ms(seconds: float) -> str:
     return f"{seconds * 1e3:.1f}ms"
 
 
+def _on_query_complete(qm: QueryMetrics) -> None:
+    """Every completed metered query funnels through the ``set_last_*``
+    setters, so this is where the SLO surface is fed: one observation
+    into the latency histograms (obs/server.py, gated on
+    ``SRT_METRICS=1``) and the SLO-breach bundle check (obs/bundle.py,
+    gated on ``SRT_SLO_MS`` + ``SRT_BUNDLE_DIR``)."""
+    from . import server as _server
+    from .bundle import maybe_slo
+    _server.observe_query(qm)
+    maybe_slo(qm)
+
+
 def set_last_query_metrics(qm: QueryMetrics) -> None:
     global _LAST
     with _LAST_LOCK:
         _LAST = qm
+    _on_query_complete(qm)
 
 
 def last_query_metrics() -> Optional[QueryMetrics]:
@@ -444,6 +457,7 @@ def set_last_stream_metrics(qm: QueryMetrics) -> None:
     global _LAST_STREAM
     with _LAST_LOCK:
         _LAST_STREAM = qm
+    _on_query_complete(qm)
 
 
 def last_stream_metrics() -> Optional[QueryMetrics]:

@@ -6,9 +6,12 @@ queries are still running:
 
 ``/metrics``
     Prometheus text exposition (format 0.0.4) of the whole metrics
-    registry (counters, gauges, timers — obs/metrics.py) plus per-query
-    live gauges from the in-flight registry (obs/live.py), including
-    per-shard batch progress.
+    registry (counters, gauges, timers — obs/metrics.py), per-query
+    live gauges from the in-flight registry (obs/live.py) including
+    per-shard batch progress, and the hand-rolled SLO latency
+    histograms (``srt_query_seconds{mode}``,
+    ``srt_query_phase_seconds{phase}``,
+    ``srt_serve_queue_wait_seconds`` — fed once per completed query).
 ``/queries``
     JSON snapshots of in-flight and recently finished queries keyed by
     ``query_id`` + plan fingerprint (``obs.live.snapshot_all()``).
@@ -27,6 +30,7 @@ at import like the rest of ``obs``.
 
 from __future__ import annotations
 
+import bisect
 import json
 import math
 import re
@@ -34,7 +38,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from ..config import live_server_port
+from ..config import live_server_port, metrics_enabled
 
 _NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]")
 _TIMELINE_RE = re.compile(r"^/queries/(\d+)/timeline$")
@@ -89,6 +93,118 @@ def _add(fam: _Families, name: str, kind: str,
     entry[1].append((labels, value))
 
 
+# -- SLO latency histograms (hand-rolled; no prometheus_client dep) ----
+
+#: Default bucket upper bounds (seconds) — the Prometheus client's
+#: latency defaults extended to one minute, since a cold XLA compile on
+#: TPU legitimately lands in the tens of seconds (BASELINE.md).
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0)
+
+
+class _Histogram:
+    """One (family, label-set) histogram: per-bucket counts, sum, count.
+
+    ``counts[i]`` is the NON-cumulative count of observations in bucket
+    ``i`` (the last slot is the +Inf overflow); exposition renders the
+    cumulative ``_bucket{le=...}`` series the format requires."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.sum += value
+        self.count += 1
+
+
+_HIST_LOCK = threading.Lock()
+#: (family name without srt_ prefix, sorted label items) -> _Histogram.
+#: Insertion-ordered, so a family's label sets render in first-observed
+#: order under one ``# TYPE`` line.
+_HISTOGRAMS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}
+
+
+def observe_hist(name: str, value: float,
+                 labels: Optional[Dict[str, object]] = None) -> None:
+    """Record one observation into histogram ``name`` (``srt_``-prefixed
+    at exposition).  Self-gated on ``SRT_METRICS=1`` — callers pay one
+    env read when metrics are off.  Called once per query (not per
+    batch), so a plain lock is fine here where the flight ring is not."""
+    if not metrics_enabled():
+        return
+    key = (name, tuple(sorted((k, str(v))
+                              for k, v in (labels or {}).items())))
+    with _HIST_LOCK:
+        hist = _HISTOGRAMS.get(key)
+        if hist is None:
+            hist = _HISTOGRAMS[key] = _Histogram()
+        hist.observe(float(value))
+
+
+def observe_query(qm) -> None:
+    """Fold one completed query into the SLO surface:
+    ``srt_query_seconds{mode}`` plus the per-phase split
+    ``srt_query_phase_seconds{phase}``.  Hooked from
+    ``obs.query.set_last_query_metrics`` / ``set_last_stream_metrics``
+    so every metered completion lands here regardless of entry point."""
+    if not metrics_enabled() or qm is None:
+        return
+    observe_hist("query_seconds", qm.total_seconds, {"mode": qm.mode})
+    for phase, seconds in (("bind", qm.bind_seconds),
+                           ("compile", qm.compile_seconds),
+                           ("execute", qm.execute_seconds),
+                           ("materialize", qm.materialize_seconds)):
+        observe_hist("query_phase_seconds", seconds, {"phase": phase})
+
+
+def _bucket_le(bound: float) -> str:
+    """``le`` label text: ints without a trailing ``.0``, as the
+    Prometheus client renders them."""
+    return str(int(bound)) if float(bound).is_integer() else repr(bound)
+
+
+def histogram_text() -> List[str]:
+    """Exposition lines for every histogram family: cumulative
+    ``_bucket{le=...}`` series ending at ``+Inf`` (== ``_count``), then
+    ``_sum`` and ``_count`` — snapshotted under the lock so a scrape
+    mid-recording still reads a consistent (sum, count, buckets) triple."""
+    with _HIST_LOCK:
+        snap = [(name, dict(labels), hist.buckets, list(hist.counts),
+                 hist.sum, hist.count)
+                for (name, labels), hist in _HISTOGRAMS.items()]
+    lines: List[str] = []
+    seen_type = set()
+    for name, labels, buckets, counts, total, count in snap:
+        base = metric_name(name)
+        if base not in seen_type:
+            seen_type.add(base)
+            lines.append(f"# TYPE {base} histogram")
+        cum = 0
+        for bound, n in zip(buckets, counts):
+            cum += n
+            lines.append(f"{base}_bucket"
+                         f"{_render_labels({**labels, 'le': _bucket_le(bound)})}"
+                         f" {cum}")
+        lines.append(f"{base}_bucket"
+                     f"{_render_labels({**labels, 'le': '+Inf'})} {count}")
+        lines.append(f"{base}_sum{_render_labels(labels)} "
+                     f"{format_value(total)}")
+        lines.append(f"{base}_count{_render_labels(labels)} {count}")
+    return lines
+
+
+def reset_histograms() -> None:
+    """Drop all histogram state (test isolation)."""
+    with _HIST_LOCK:
+        _HISTOGRAMS.clear()
+
+
 def prometheus_text() -> str:
     """The ``/metrics`` body: registry metrics + live-query gauges."""
     from . import live
@@ -138,6 +254,7 @@ def prometheus_text() -> str:
         for labels, value in samples:
             lines.append(f"{name}{_render_labels(labels)} "
                          f"{format_value(value)}")
+    lines.extend(histogram_text())
     return "\n".join(lines) + "\n"
 
 

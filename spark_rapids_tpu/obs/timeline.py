@@ -127,6 +127,36 @@ def _lane_tid(lane: Optional[str]) -> int:
     return tid
 
 
+def _flight_add(name: str, cat: str, start_us: float, dur_us: float,
+                lane: Optional[str], args: Dict[str, Any]) -> None:
+    """Mirror one finished event into the always-on flight-recorder ring
+    (obs/flight.py, ``SRT_METRICS=1``).  Lazy by the usual rule: the
+    recorder module is only imported when it is already loaded or the
+    env flag asks for it, so the metrics-off path pays one env read."""
+    import sys
+    fl = sys.modules.get(__package__ + ".flight")
+    if fl is None:
+        from ..config import metrics_enabled
+        if not metrics_enabled():
+            return
+        from . import flight as fl
+    fl.record(name, cat, start_us, dur_us, lane, args)
+
+
+def _flight_scope(name: str, cat: str, lane: Optional[str],
+                  args: Dict[str, Any]):
+    """Flight-recorder span for a :func:`span` call while the timeline
+    itself is off, or None (same lazy-import discipline)."""
+    import sys
+    fl = sys.modules.get(__package__ + ".flight")
+    if fl is None:
+        from ..config import metrics_enabled
+        if not metrics_enabled():
+            return None
+        from . import flight as fl
+    return fl.trace_span(name, args, cat=cat, lane=lane)
+
+
 def add_complete(name: str, cat: str, start_us: float, dur_us: float,
                  lane: Optional[str] = None, **args: Any) -> None:
     """Append one finished span (``X`` event) with explicit timestamps.
@@ -134,8 +164,12 @@ def add_complete(name: str, cat: str, start_us: float, dur_us: float,
     The low-level entry point for host-side *emulated* device lanes: the
     dist path records one blocking interval and fans it out as one event
     per ``shard-{i}`` lane, since per-core device timelines are not
-    observable from the host without the jax profiler.
+    observable from the host without the jax profiler.  Every event is
+    also mirrored into the flight-recorder ring when metrics are on —
+    this is the ONE sink all finished spans pass through, so the black
+    box records regardless of whether the opt-in timeline is.
     """
+    _flight_add(name, cat, start_us, dur_us, lane, args)
     if not enabled():
         return
     with _LOCK:
@@ -151,7 +185,9 @@ def add_complete(name: str, cat: str, start_us: float, dur_us: float,
 def instant(name: str, cat: str = "engine", lane: Optional[str] = None,
             **args: Any) -> None:
     """Record a point-in-time event (``i``): cache hit/miss, recovery
-    rung, donation hit, host sync — anything without duration."""
+    rung, donation hit, host sync — anything without duration.  Mirrored
+    into the flight ring as a zero-duration event."""
+    _flight_add(name, cat, now_us(), 0.0, lane, args)
     if not enabled():
         return
     with _LOCK:
@@ -219,11 +255,14 @@ def span(name: str, cat: str = "engine", lane: Optional[str] = None,
     """Open a span; use as a context manager (or call ``.end()``).
 
     Off: returns the shared :data:`NULL_SPAN` (identity-comparable, zero
-    allocation).  ``lane`` names the horizontal track; ``None`` uses the
-    current thread's name.
+    allocation) — unless the flight recorder is on (``SRT_METRICS=1``
+    with an ambient query), in which case the scope records into the
+    per-query ring even though the timeline is not.  ``lane`` names the
+    horizontal track; ``None`` uses the current thread's name.
     """
     if not enabled():
-        return NULL_SPAN
+        fl = _flight_scope(name, cat, lane, args)
+        return NULL_SPAN if fl is None else fl
     return _Span(name, cat, lane, args)
 
 

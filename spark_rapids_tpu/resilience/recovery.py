@@ -150,4 +150,14 @@ def oom_ladder(site: str, fn: Callable,
         except Exception as exc:
             if classify(exc) not in (CATEGORY_OOM, CATEGORY_COMPILE):
                 raise
-    raise ExecutionRecoveryError(site, summary) from original
+    err = ExecutionRecoveryError(site, summary)
+    # The ladder is out of rungs: capture the postmortem HERE, while the
+    # ring still holds the events leading up to the original OOM.  The
+    # caller may still attempt the split rung; a later bundle for the
+    # same (query, reason) is deduplicated, and a successful split just
+    # leaves this bundle as the record of a near-miss.
+    from ..obs import bundle as _bundle
+    from ..obs.timeline import current_query_id
+    _bundle.dump("recovery_exhausted", query_id=current_query_id(),
+                 error=original, recovery=summary)
+    raise err from original

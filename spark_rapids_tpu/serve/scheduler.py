@@ -268,6 +268,9 @@ class QuerySession:
             t.status = "rejected"
             t._error = err
             t._event.set()
+            from ..obs import bundle as _bundle
+            _bundle.dump("admission_rejected", fingerprint=fingerprint,
+                         mode=mode, error=err, plan=plan)
             return t
 
         t._thunk = self._make_thunk(plan, table, batches, dist, mesh,
@@ -341,6 +344,9 @@ class QuerySession:
         t.queue_wait_seconds = max(
             time.perf_counter() - t._t_submit, 0.0)
         timer("serve.queue_wait").observe(t.queue_wait_seconds)
+        from ..obs import server as _server
+        _server.observe_hist("serve_queue_wait_seconds",
+                             t.queue_wait_seconds)
         counter("serve.admitted").inc()
         t.status = "running"
         gate = None
@@ -362,6 +368,13 @@ class QuerySession:
             t._error = err
             t.status = "error"
             counter("serve.errors").inc()
+            # The executor-side hook usually dumped already (dedup by
+            # query id); this catches failures that never reached a
+            # metered region (e.g. optimizer/bind errors).
+            from ..obs import bundle as _bundle
+            _bundle.dump("failure", qm=info.get("qm"),
+                         fingerprint=t.fingerprint, mode=t.mode,
+                         error=err)
         else:
             t._result = result
             t.status = "done"

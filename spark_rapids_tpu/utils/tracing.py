@@ -10,12 +10,14 @@ Everything here is a no-op unless ``SRT_TRACE=1`` (config.trace_enabled), so
 instrumented code pays nothing in production — the same opt-in contract as
 the NVTX toggle.
 
-:func:`trace` has a second, jax-free backend: when the structured span
-timeline is recording (``SRT_TRACE_TIMELINE=1`` or an active
-``obs.timeline.recording()`` scope) every trace scope is also recorded as
-a timeline span under category ``"trace"`` — the same instrumentation
-points feed the profiler and the Chrome-trace export.  With only the
-timeline on, no jax import happens.
+:func:`trace` has two further, jax-free backends: when the structured
+span timeline is recording (``SRT_TRACE_TIMELINE=1`` or an active
+``obs.timeline.recording()`` scope) every trace scope is also recorded
+as a timeline span under category ``"trace"``, and when metrics are on
+(``SRT_METRICS=1``) every scope lands in the per-query flight-recorder
+ring (obs/flight.py) that postmortem bundles drain — the same
+instrumentation points feed the profiler, the Chrome-trace export, and
+the black box.  With jax profiling off, no jax import happens.
 
 Usage::
 
@@ -55,7 +57,8 @@ _NULL_SCOPE = _NullScope()
 
 
 class _ComboScope:
-    """Both backends at once: timeline span + jax profiler annotation."""
+    """Several backends at once: timeline span, flight-recorder span,
+    jax profiler annotation — whichever subset is live."""
     __slots__ = ("_scopes",)
 
     def __init__(self, *scopes):
@@ -72,39 +75,44 @@ class _ComboScope:
         return None
 
 
-def _timeline_span(name: str, attrs: dict):
-    """The timeline backend's span for this scope, or None when the
-    recorder is off.  Avoids importing ``obs`` unless the timeline module
-    is already loaded or the env flag asks for it — a cold
-    ``import spark_rapids_tpu`` must not pull in the obs subsystem."""
+def _obs_span(name: str, attrs: dict):
+    """The jax-free backends' span for this scope, or None when both are
+    off.  ``timeline.span`` is the ONE producer: it records a timeline
+    span when the recorder is on and otherwise hands back a
+    flight-recorder scope when metrics are on (obs/flight.py), so this
+    one call covers both sinks without double-recording.  Avoids
+    importing ``obs`` unless the timeline module is already loaded or an
+    env flag asks for it — a cold ``import spark_rapids_tpu`` must not
+    pull in the obs subsystem."""
     import sys
     tl = sys.modules.get("spark_rapids_tpu.obs.timeline")
     if tl is None:
-        from ..config import timeline_enabled
-        if not timeline_enabled():
+        from ..config import metrics_enabled, timeline_enabled
+        if not (timeline_enabled() or metrics_enabled()):
             return None
         from ..obs import timeline as tl
-    if not tl.enabled():
-        return None
-    return tl.span(name, cat="trace", **attrs)
+    s = tl.span(name, cat="trace", **attrs)
+    return None if s is tl.NULL_SPAN else s
 
 
 def trace(name: str, **attrs):
-    """Named scope visible in jax profiler captures (NVTX push/pop analog)
-    and, when the span timeline is recording, in the Chrome-trace export.
+    """Named scope visible in jax profiler captures (NVTX push/pop
+    analog), in the Chrome-trace export when the span timeline is
+    recording, and in the per-query flight-recorder ring when metrics
+    are on (``SRT_METRICS=1``, obs/flight.py).
 
     ``attrs`` pass through as annotation metadata (profiler-visible metric
-    labels, e.g. ``trace("shuffle", partitions=8)``).  When both backends
-    are off this returns a shared null context: no profiler import, no
+    labels, e.g. ``trace("shuffle", partitions=8)``).  When every backend
+    is off this returns a shared null context: no profiler import, no
     annotation construction, no attr formatting."""
-    tl_span = _timeline_span(name, attrs)
+    obs_span = _obs_span(name, attrs)
     if not trace_enabled():
-        return tl_span if tl_span is not None else _NULL_SCOPE
+        return obs_span if obs_span is not None else _NULL_SCOPE
     import jax.profiler
     ann = jax.profiler.TraceAnnotation(name, **attrs)
-    if tl_span is None:
+    if obs_span is None:
         return ann
-    return _ComboScope(tl_span, ann)
+    return _ComboScope(obs_span, ann)
 
 
 def traced(fn: _F) -> _F:

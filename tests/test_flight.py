@@ -1,0 +1,589 @@
+"""Flight recorder, postmortem bundles, and the doctor (obs/flight.py,
+obs/bundle.py, obs/doctor.py) plus the tail-first history lookup.
+
+Five contracts:
+
+1. **Bounded always-on recording** — with ``SRT_METRICS=1`` every
+   ``trace()`` scope lands in a fixed-size per-query ring
+   (``SRT_FLIGHT_EVENTS`` slots) that overwrites oldest-first and
+   drains as a golden-valid Chrome trace; off and query-less spans
+   record nothing.
+2. **One incident, one bundle** — terminal failures, recovery
+   exhaustion, and SLO breaches each write exactly one self-contained
+   JSON bundle to ``SRT_BUNDLE_DIR`` matching the golden-pinned schema
+   (tests/golden/postmortem_bundle_schema.json), count-capped, and
+   ``dump`` never raises into the failing query.
+3. **The doctor explains it** — ``diagnose`` ranks the classified
+   error, the recovery chain, SLO overrun, cache regressions, and
+   cost-bucket growth against the same-fingerprint history baseline;
+   the CLI exits 0 whenever a verdict was produced.
+4. **Knob hygiene** — the four new knobs raise knob-named ValueErrors.
+5. **O(tail) history lookup** — ``lookup_latest`` reads block-wise from
+   EOF and survives a torn final line.
+"""
+
+import json
+import os
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import bundle, flight, history, timeline
+from spark_rapids_tpu.obs.doctor import diagnose, render
+from spark_rapids_tpu.obs.metrics import registry
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
+
+
+def _golden(name):
+    with open(GOLDEN / name) as f:
+        return json.load(f)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for knob in ("SRT_BUNDLE_DIR", "SRT_SLO_MS", "SRT_FLIGHT_EVENTS",
+                 "SRT_LIVE_RECENT"):
+        monkeypatch.delenv(knob, raising=False)
+    flight.reset()
+    bundle.reset()
+    registry().reset()
+    yield
+    flight.reset()
+    bundle.reset()
+    registry().reset()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    yield
+
+
+@pytest.fixture
+def metrics_off(monkeypatch):
+    monkeypatch.delenv("SRT_METRICS", raising=False)
+
+
+def _table(prefix, n=300):
+    return Table.from_pydict({
+        f"{prefix}_k": (np.arange(n) % 5).astype(np.int32),
+        f"{prefix}_v": np.arange(n, dtype=np.float32),
+    })
+
+
+def _query(prefix):
+    return (plan()
+            .filter(col(f"{prefix}_v") > 10.0)
+            .with_columns(**{f"{prefix}_d": col(f"{prefix}_v") * 2.0}))
+
+
+def _bundles(dirpath, reason=None):
+    out = []
+    for name in sorted(os.listdir(dirpath)):
+        if reason is not None and not name.startswith(f"postmortem-{reason}"):
+            continue
+        with open(os.path.join(dirpath, name)) as f:
+            out.append((os.path.join(dirpath, name), json.load(f)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. the ring
+# ---------------------------------------------------------------------------
+
+def test_ring_drains_in_timestamp_order():
+    ring = flight.FlightRing(7, capacity=8)
+    for ts in (30.0, 10.0, 20.0):
+        ring.append("step", "flight", ts, 1.0, "lane-0", {})
+    assert [e[0] for e in ring.events()] == [10.0, 20.0, 30.0]
+
+
+def test_ring_overwrites_oldest_and_counts_drops():
+    ring = flight.FlightRing(7, capacity=4)
+    for i in range(10):
+        ring.append(f"e{i}", "flight", float(i), 1.0, "lane-0", {"i": i})
+    stats = ring.stats()
+    assert stats == {"capacity": 4, "events_recorded": 4,
+                     "events_dropped": 6}
+    # only the newest <capacity> events survive
+    assert [e[0] for e in ring.events()] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_ring_capacity_from_knob(monkeypatch):
+    monkeypatch.setenv("SRT_FLIGHT_EVENTS", "16")
+    assert flight.FlightRing(1).capacity == 16
+
+
+def test_concurrent_appends_never_lose_the_ring(metrics_on):
+    # the lock-free contract: racing appenders corrupt nothing — every
+    # retained slot is a whole event and stats stay bounded
+    ring = flight.FlightRing(9, capacity=64)
+
+    def worker(base):
+        for i in range(500):
+            ring.append("w", "flight", float(base + i), 1.0,
+                        f"lane-{base}", {"i": i})
+
+    threads = [threading.Thread(target=worker, args=(k * 1000,))
+               for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    evs = ring.events()
+    assert len(evs) == 64
+    assert all(len(e) == 6 for e in evs)
+    stats = ring.stats()
+    assert stats["events_recorded"] == 64
+    assert stats["events_dropped"] == 2000 - 64
+
+
+def test_ring_chrome_trace_matches_golden():
+    ring = flight.FlightRing(42, capacity=8)
+    ring.append("dispatch", "flight", 100.0, 5.0, "main", {"batch": 0})
+    ring.append("materialize", "flight", 110.0, 2.0, "worker-1",
+                {"rows": 99, "odd": object()})
+    payload = ring.chrome_trace()
+    errors = timeline.validate_chrome_trace(
+        payload, _golden("chrome_trace_schema.json"))
+    assert errors == [], errors
+    xs = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in payload["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2 and len(ms) == 2        # one M per lane
+    assert all(e["args"]["query_id"] == 42 for e in xs)
+    assert isinstance(xs[1]["args"]["odd"], str)     # coerced, not raw
+
+
+def test_ring_registry_is_lru_bounded():
+    for qid in range(flight.MAX_RINGS + 5):
+        flight.ring_for(qid)
+    assert flight.ring_for(0, create=False) is None       # evicted
+    assert flight.ring_for(flight.MAX_RINGS + 4,
+                           create=False) is not None
+
+
+def test_trace_span_off_without_metrics(metrics_off):
+    with timeline.query_scope(5):
+        assert flight.trace_span("x", {}) is None
+
+
+def test_trace_span_needs_ambient_query(metrics_on):
+    assert flight.trace_span("x", {}) is None
+    with timeline.query_scope(5):
+        span = flight.trace_span("x", {"k": 1})
+        assert span is not None
+        with span:
+            pass
+    snap = flight.snapshot(5)
+    assert snap["events_recorded"] == 1
+
+
+def test_trace_feeds_the_ring(metrics_on):
+    from spark_rapids_tpu.utils.tracing import trace
+    with timeline.query_scope(77):
+        with trace("flight-step", batch=3):
+            pass
+    snap = flight.snapshot(77)
+    assert snap is not None and snap["events_recorded"] == 1
+    [ev] = [e for e in snap["trace"]["traceEvents"] if e["ph"] == "X"]
+    assert ev["name"] == "flight-step"
+    assert ev["args"] == {"batch": 3, "query_id": 77}
+
+
+def test_metered_run_populates_flight_ring(metrics_on):
+    from spark_rapids_tpu.obs import last_query_metrics
+    t = _table("fr")
+    _query("fr").run(t)
+    qid = last_query_metrics().query_id
+    snap = flight.snapshot(qid)
+    assert snap is not None and snap["events_recorded"] > 0
+    errors = timeline.validate_chrome_trace(
+        snap["trace"], _golden("chrome_trace_schema.json"))
+    assert errors == [], errors
+
+
+def test_unmetered_run_records_nothing(metrics_off):
+    t = _table("froff")
+    _query("froff").run(t)
+    with flight._LOCK:
+        assert not flight._RINGS
+
+
+# ---------------------------------------------------------------------------
+# 2. bundles
+# ---------------------------------------------------------------------------
+
+def test_build_matches_golden_schema(metrics_on):
+    from spark_rapids_tpu.obs import last_query_metrics
+    t = _table("bg")
+    _query("bg").run(t)
+    payload = bundle.build("failure", qm=last_query_metrics(),
+                           error=ValueError("boom"))
+    errors = bundle.validate_bundle(
+        payload, _golden("postmortem_bundle_schema.json"))
+    assert errors == [], errors
+    assert payload["error"]["type"] == "ValueError"
+    assert payload["metrics"]["metric"] == "query_metrics"
+    assert payload["config"].get("SRT_FLIGHT_EVENTS")
+
+
+def test_embedded_chrome_schema_pins_the_standalone_golden():
+    # the bundle golden embeds the chrome-trace schema verbatim so the
+    # two files cannot drift apart silently
+    assert (_golden("postmortem_bundle_schema.json")["chrome_trace"]
+            == _golden("chrome_trace_schema.json"))
+
+
+def test_bundle_rejects_unknown_reason():
+    with pytest.raises(ValueError, match="reason"):
+        bundle.build("mystery")
+
+
+def test_dump_noop_without_bundle_dir():
+    assert bundle.dump("failure", query_id=1,
+                       error=ValueError("x")) is None
+
+
+def test_dump_writes_validates_and_dedups(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    path = bundle.dump("failure", query_id=123, error=ValueError("boom"))
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    errors = bundle.validate_bundle(
+        payload, _golden("postmortem_bundle_schema.json"))
+    assert errors == [], errors
+    # same (query, reason): deduped; other reason: a second bundle
+    assert bundle.dump("failure", query_id=123,
+                       error=ValueError("boom")) is None
+    assert bundle.dump("slo_breach", query_id=123) is not None
+    assert len(os.listdir(tmp_path)) == 2
+
+
+def test_dump_never_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRT_BUNDLE_DIR",
+                       str(tmp_path / "file-not-a-dir" / "x"))
+    (tmp_path / "file-not-a-dir").write_text("in the way")
+    assert bundle.dump("failure", query_id=5,
+                       error=ValueError("x")) is None
+
+
+def test_bundle_dir_is_count_capped(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setattr(bundle, "MAX_BUNDLES", 5)
+    for qid in range(9):
+        assert bundle.dump("failure", query_id=qid,
+                           error=ValueError("x")) is not None
+    assert len(os.listdir(tmp_path)) == 5
+
+
+def test_failed_run_writes_postmortem_bundles(tmp_path, monkeypatch,
+                                              metrics_on):
+    from spark_rapids_tpu.resilience import (ExecutionRecoveryError,
+                                             reset_faults)
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRT_FAULT", "oom:dispatch:99")
+    monkeypatch.setenv("SRT_RETRY_MAX", "1")
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    reset_faults()
+    t = _table("fb")
+    p = plan().sort_by("fb_v")       # unsplittable: the ladder exhausts
+    try:
+        with pytest.raises(ExecutionRecoveryError):
+            p.run(t)
+    finally:
+        monkeypatch.delenv("SRT_FAULT")
+        reset_faults()
+    schema = _golden("postmortem_bundle_schema.json")
+    exhausted = _bundles(tmp_path, "recovery_exhausted")
+    failures = _bundles(tmp_path, "failure")
+    assert len(exhausted) == 1 and len(failures) == 1
+    for path, payload in exhausted + failures:
+        errors = bundle.validate_bundle(payload, schema)
+        assert errors == [], (path, errors)
+    _, ex = exhausted[0]
+    assert ex["error"]["category"] == "oom"
+    assert ex["recovery"]["site"] == "dispatch"
+    assert ex["recovery"]["steps"], "recovery chain missing its rungs"
+    assert ex["flight"]["events_recorded"] > 0
+    assert any(e["ph"] == "X"
+               for e in ex["flight"]["trace"]["traceEvents"])
+    # the later failure dump carries the final recovery chain: the same
+    # rungs the exhaustion bundle saw, plus whatever the ladder added on
+    # the way out (e.g. the split-unavailable verdict)
+    _, fl = failures[0]
+    assert fl["query_id"] == ex["query_id"]
+    n = len(ex["recovery"]["steps"])
+    assert fl["recovery"]["steps"][:n] == ex["recovery"]["steps"]
+
+
+def test_slo_breach_writes_bundle(tmp_path, monkeypatch, metrics_on):
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRT_SLO_MS", "0.001")      # everything breaches
+    t = _table("slo")
+    out = _query("slo").run(t)
+    assert out.num_rows > 0                        # the query succeeded
+    breaches = _bundles(tmp_path, "slo_breach")
+    assert len(breaches) == 1
+    _, payload = breaches[0]
+    errors = bundle.validate_bundle(
+        payload, _golden("postmortem_bundle_schema.json"))
+    assert errors == [], errors
+    assert payload["slo"]["slo_ms"] == 0.001
+    assert payload["slo"]["elapsed_seconds"] * 1000.0 > 0.001
+    assert payload["metrics"]["timings"]["total_seconds"] > 0
+
+
+def test_no_slo_bundle_when_within_budget(tmp_path, monkeypatch,
+                                          metrics_on):
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRT_SLO_MS", "3600000")     # one hour
+    t = _table("sok")
+    _query("sok").run(t)
+    assert _bundles(tmp_path, "slo_breach") == []
+
+
+# ---------------------------------------------------------------------------
+# 3. the doctor
+# ---------------------------------------------------------------------------
+
+def _mk_qm(query_id=1, fingerprint="f1", total=1.0, compute=0.8,
+           compile_cache="hit", queue_wait=0.0, counters=None):
+    return {
+        "metric": "query_metrics", "query_id": query_id,
+        "fingerprint": fingerprint, "mode": "run",
+        "compile_cache": compile_cache,
+        "timings": {"total_seconds": total, "compile_seconds": 0.2},
+        "cost": {"compute_seconds": compute, "ici_seconds": 0.0,
+                 "host_sync_seconds": 0.1,
+                 "dispatch_overhead_seconds": 0.1,
+                 "unattributed_seconds": total - compute - 0.2},
+        "caches": {"dict_encode_hits": 5, "dict_encode_misses": 0},
+        "serve": {"queue_wait_seconds": queue_wait, "result_cache": None},
+        "recovery": {"retries": 0, "splits": 0, "cache_evictions": 0,
+                     "backoff_seconds": 0.0},
+        "counters": counters or {},
+    }
+
+
+def test_doctor_names_the_fault_site(tmp_path, monkeypatch, metrics_on):
+    from spark_rapids_tpu.resilience import (ExecutionRecoveryError,
+                                             reset_faults)
+    monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRT_FAULT", "oom:dispatch:99")
+    monkeypatch.setenv("SRT_RETRY_MAX", "1")
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    reset_faults()
+    try:
+        with pytest.raises(ExecutionRecoveryError):
+            plan().sort_by("dm_v").run(_table("dm"))
+    finally:
+        monkeypatch.delenv("SRT_FAULT")
+        reset_faults()
+    [(path, payload)] = _bundles(tmp_path, "recovery_exhausted")
+    report = diagnose(payload)
+    assert "oom" in report["verdict"] and "dispatch" in report["verdict"]
+    titles = [f["title"] for f in report["findings"]]
+    assert any("recovery ladder" in t for t in titles)
+    text = render(report)
+    assert "== Doctor ==" in text and "dispatch" in text
+    # severities are sorted most-damning-first
+    sevs = [f["severity"] for f in report["findings"]]
+    assert sevs == sorted(sevs, reverse=True)
+
+
+def test_doctor_explains_slowdown_against_baseline():
+    payload = _mk_qm(query_id=9, total=3.0, compute=2.5,
+                     compile_cache="miss")
+    baseline = _mk_qm(query_id=3, total=1.0, compute=0.6)
+    report = diagnose(payload, baseline=baseline)
+    assert report["baseline_used"]
+    assert "3.0x slower" in report["verdict"]
+    titles = [f["title"] for f in report["findings"]]
+    assert any("compute_seconds grew most" in t for t in titles)
+    assert any("compile cache miss (the baseline run hit)" == t
+               for t in titles)
+
+
+def test_doctor_flags_queue_wait_and_pad_waste():
+    payload = _mk_qm(total=2.0, queue_wait=1.5,
+                     counters={"plan.bucket.pad_rows": 900,
+                               "plan.bucket.rows_total": 1000})
+    report = diagnose(payload, baseline=None)
+    titles = [f["title"] for f in report["findings"]]
+    assert any("queue wait dominated" in t for t in titles)
+    assert any("padding wasted 90%" in t for t in titles)
+
+
+def test_doctor_refuses_self_baseline():
+    payload = _mk_qm(query_id=9, total=3.0)
+    report = diagnose(payload, baseline=_mk_qm(query_id=9, total=1.0))
+    assert not report["baseline_used"]
+    assert "no anomalies" in report["verdict"]
+
+
+def test_doctor_cli_on_bundle_file(tmp_path, capsys):
+    payload = bundle.build("failure", query_id=4,
+                           error=RuntimeError("kaput"))
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps(payload))
+    from spark_rapids_tpu.obs.doctor import main
+    assert main(str(path)) == 0
+    out = capsys.readouterr().out
+    assert "== Doctor ==" in out and "RuntimeError" in out
+
+
+def test_doctor_cli_unknown_target_exits_2(tmp_path, capsys):
+    from spark_rapids_tpu.obs.doctor import main
+    assert main("nosuchfingerprint",
+                history_path=str(tmp_path / "none.jsonl")) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(str(bad)) == 2
+
+
+def test_doctor_cli_fingerprint_mode(tmp_path, monkeypatch, metrics_on,
+                                     capsys):
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("SRT_METRICS_HISTORY", str(hist))
+    t = _table("dfp")
+    q = _query("dfp")
+    q.run(t)
+    q.run(t)
+    recs = history.load(path=str(hist))
+    assert len(recs) == 2
+    fp = recs[-1]["fingerprint"]
+    from spark_rapids_tpu.obs.doctor import main
+    assert main(fp, history_path=str(hist)) == 0
+    out = capsys.readouterr().out
+    assert "== Doctor ==" in out and fp in out
+
+
+def test_obs_cli_doctor_subcommand(tmp_path, capsys):
+    payload = bundle.build("admission_rejected", fingerprint="fp9",
+                           mode="run")
+    path = tmp_path / "adm.json"
+    path.write_text(json.dumps(payload))
+    from spark_rapids_tpu.obs.__main__ import main
+    assert main(["doctor", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "rejected at admission" in out
+
+
+# ---------------------------------------------------------------------------
+# 4. knob hygiene
+# ---------------------------------------------------------------------------
+
+def test_flight_events_knob(monkeypatch):
+    from spark_rapids_tpu.config import flight_events
+    assert flight_events() == 4096
+    monkeypatch.setenv("SRT_FLIGHT_EVENTS", "128")
+    assert flight_events() == 128
+    for bad in ("0", "-4", "many"):
+        monkeypatch.setenv("SRT_FLIGHT_EVENTS", bad)
+        with pytest.raises(ValueError, match="SRT_FLIGHT_EVENTS"):
+            flight_events()
+
+
+def test_slo_ms_knob(monkeypatch):
+    from spark_rapids_tpu.config import slo_ms
+    assert slo_ms() is None
+    monkeypatch.setenv("SRT_SLO_MS", "250")
+    assert slo_ms() == 250.0
+    for off in ("0", "off", ""):
+        monkeypatch.setenv("SRT_SLO_MS", off)
+        assert slo_ms() is None
+    monkeypatch.setenv("SRT_SLO_MS", "fast")
+    with pytest.raises(ValueError, match="SRT_SLO_MS"):
+        slo_ms()
+
+
+def test_bundle_dir_knob(monkeypatch):
+    from spark_rapids_tpu.config import bundle_dir
+    assert bundle_dir() is None
+    monkeypatch.setenv("SRT_BUNDLE_DIR", "  ")
+    assert bundle_dir() is None
+    monkeypatch.setenv("SRT_BUNDLE_DIR", "/tmp/bundles")
+    assert bundle_dir() == "/tmp/bundles"
+
+
+def test_new_knobs_in_knob_table(monkeypatch):
+    from spark_rapids_tpu.config import knob_table
+    table = knob_table()
+    for knob in ("SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",
+                 "SRT_LIVE_RECENT"):
+        assert knob in table
+
+
+# ---------------------------------------------------------------------------
+# 5. tail-first history lookup
+# ---------------------------------------------------------------------------
+
+def _hist_line(fingerprint, query_id, measured=True, total=1.0):
+    rec = {"fingerprint": fingerprint, "query_id": query_id,
+           "timings": {"total_seconds": total},
+           "steps": [{"step": "Filter",
+                      "rows_out": 10 if measured else None}]}
+    return json.dumps(rec)
+
+
+def test_iter_lines_reversed_roundtrip(tmp_path):
+    path = tmp_path / "x.jsonl"
+    lines = [f"line-{i}-" + "p" * (40 + i % 37) for i in range(4000)]
+    path.write_text("\n".join(lines) + "\n")
+    assert path.stat().st_size > 2 * history._REVERSE_BLOCK
+    got = [raw.decode() for raw in history._iter_lines_reversed(str(path))]
+    assert got == lines[::-1]
+
+
+def test_iter_lines_reversed_no_trailing_newline(tmp_path):
+    path = tmp_path / "x.jsonl"
+    path.write_text("a\nb\nc")
+    got = [raw.decode() for raw in history._iter_lines_reversed(str(path))]
+    assert got == ["c", "b", "a"]
+
+
+def test_lookup_latest_returns_newest_measured_record(tmp_path):
+    path = tmp_path / "hist.jsonl"
+    with open(path, "w") as f:
+        f.write(_hist_line("aaa", 1, total=1.0) + "\n")
+        f.write(_hist_line("bbb", 2) + "\n")
+        f.write(_hist_line("aaa", 3, total=2.0) + "\n")
+        f.write(_hist_line("aaa", 4, measured=False) + "\n")
+    rec = history.lookup_latest("aaa", path=str(path))
+    # newest MEASURED record wins; the unmeasured newer one is skipped
+    assert rec["query_id"] == 3
+    assert history.lookup_latest("zzz", path=str(path)) is None
+    assert history.lookup_latest("aaa",
+                                 path=str(tmp_path / "no.jsonl")) is None
+
+
+def test_lookup_latest_survives_corrupt_tail(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    path = tmp_path / "hist.jsonl"
+    with open(path, "w") as f:
+        f.write(_hist_line("ct1", 7) + "\n")
+        f.write('{"fingerprint": "ct1", "torn mid-wri')     # no newline
+    rec = history.lookup_latest("ct1", path=str(path))
+    assert rec is not None and rec["query_id"] == 7
+    assert registry().counters_snapshot().get(
+        "history.corrupt_lines") == 1
+
+
+def test_lookup_latest_is_tail_first_on_big_files(tmp_path):
+    path = tmp_path / "hist.jsonl"
+    pad = "x" * 200
+    with open(path, "w") as f:
+        for i in range(2000):
+            rec = {"fingerprint": "big", "query_id": i, "pad": pad,
+                   "steps": [{"rows_out": 1}]}
+            f.write(json.dumps(rec) + "\n")
+    assert path.stat().st_size > 4 * history._REVERSE_BLOCK
+    rec = history.lookup_latest("big", path=str(path))
+    assert rec["query_id"] == 1999

@@ -256,6 +256,50 @@ def test_live_and_server_import_without_jax():
     assert "jaxfree" in out.stdout
 
 
+def test_flight_bundle_doctor_import_without_jax(tmp_path):
+    """The postmortem surface (obs.flight, obs.bundle, obs.doctor) must
+    work without jax: the flight ring is host-side bookkeeping, bundles
+    are plain JSON, and the doctor is exactly the tool an operator runs
+    on a laptop against a bundle scp'd out of an incident."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    bdir = tmp_path / "bundles"
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.flight as flight\n"
+        "import spark_rapids_tpu.obs.bundle as bundle\n"
+        "import spark_rapids_tpu.obs.doctor as doctor\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.flight/bundle/doctor pulled in jax'\n"
+        "assert flight.trace_span('x', {}) is None  # SRT_METRICS unset\n"
+        "ring = flight.FlightRing(7, capacity=4)\n"
+        "ring.append('step', 'flight', 1.0, 2.0, 'lane-0', {'batch': 0})\n"
+        "assert ring.stats()['events_recorded'] == 1\n"
+        "path = bundle.dump('failure', query_id=7,\n"
+        "                   error=ValueError('boom'))  # SRT_BUNDLE_DIR set\n"
+        "assert path is not None, 'bundle not written'\n"
+        "import json\n"
+        "payload = json.load(open(path))\n"
+        "report = doctor.diagnose(payload)\n"
+        "assert report['findings'], report\n"
+        "assert doctor.main(path) == 0\n"
+        "assert 'jax' not in sys.modules, 'the postmortem path pulled jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    for k in ("SRT_METRICS", "SRT_SLO_MS", "SRT_METRICS_HISTORY"):
+        env.pop(k, None)
+    env["SRT_BUNDLE_DIR"] = str(bdir)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

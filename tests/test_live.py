@@ -37,9 +37,11 @@ def _fresh_live(monkeypatch):
     monkeypatch.delenv("SRT_LIVE_SERVER", raising=False)
     monkeypatch.delenv("SRT_LIVE_PORT", raising=False)
     live.reset()
+    server.reset_histograms()
     yield
     server.stop()
     live.reset()
+    server.reset_histograms()
     registry().reset()
 
 
@@ -199,7 +201,11 @@ def _assert_valid_exposition(text):
             continue
         assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
         name = line.split("{", 1)[0].split(" ", 1)[0]
-        assert name == current, (
+        # histogram families expose suffixed samples under the base name
+        allowed = {current}
+        if families.get(current) == "histogram":
+            allowed = {current + s for s in ("_bucket", "_sum", "_count")}
+        assert name in allowed, (
             f"sample {name} outside its TYPE block (current={current})")
     return families
 
@@ -304,6 +310,107 @@ def test_concurrent_scrape_during_stream(metrics_on):
 
 
 # ---------------------------------------------------------------------------
+# 3a. SLO latency histograms
+# ---------------------------------------------------------------------------
+
+def _hist_samples(text, family):
+    """{(suffix, labels-string): float} for one histogram family."""
+    out = {}
+    for line in text.split("\n"):
+        if not line.startswith(family):
+            continue
+        rest = line[len(family):]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if rest.startswith(suffix):
+                sample, value = line.rsplit(" ", 1)
+                labels = sample.split("{", 1)[1][:-1] if "{" in sample else ""
+                out[(suffix, labels)] = float(value)
+    return out
+
+
+def test_query_seconds_histogram_per_mode(metrics_on):
+    t = _table("lhist")
+    _query("lhist").run(t)
+    text = server.prometheus_text()
+    families = _assert_valid_exposition(text)
+    assert families.get("srt_query_seconds") == "histogram"
+    assert families.get("srt_query_phase_seconds") == "histogram"
+    assert 'srt_query_seconds_bucket{le="+Inf",mode="run"} 1' in text
+    assert 'srt_query_seconds_count{mode="run"} 1' in text
+    for phase in ("bind", "compile", "execute", "materialize"):
+        assert f'phase="{phase}"' in text
+
+
+def test_histogram_buckets_cumulative_inf_equals_count(metrics_on):
+    for v in (0.003, 0.02, 0.02, 0.2, 7.0, 1e9):
+        server.observe_hist("lt_hist_demo", v)
+    text = "\n".join(server.histogram_text())
+    samples = _hist_samples(text, "srt_lt_hist_demo")
+    bounds = [(float(labels.split('"')[1].replace("+Inf", "inf")), v)
+              for (suffix, labels), v in samples.items()
+              if suffix == "_bucket"]
+    bounds.sort()
+    counts = [v for _, v in bounds]
+    assert counts == sorted(counts), f"non-cumulative buckets: {bounds}"
+    assert bounds[-1][0] == float("inf")
+    assert bounds[-1][1] == samples[("_count", "")] == 6
+    # the out-of-range observation lands only in +Inf
+    assert bounds[-2][1] == 5
+    assert samples[("_sum", "")] == pytest.approx(
+        0.003 + 0.02 + 0.02 + 0.2 + 7.0 + 1e9)
+
+
+def test_histogram_observation_on_its_bucket_boundary(metrics_on):
+    server.observe_hist("lt_hist_edge", 0.25)
+    text = "\n".join(server.histogram_text())
+    assert 'srt_lt_hist_edge_bucket{le="0.25"} 1' in text
+    assert 'srt_lt_hist_edge_bucket{le="0.1"} 0' in text
+
+
+def test_histogram_label_escaping(metrics_on):
+    server.observe_hist("lt_hist_esc", 0.1, {"mode": 'we"ird\\mo\nde'})
+    text = "\n".join(server.histogram_text())
+    assert 'mode="we\\"ird\\\\mo\\nde"' in text
+    _assert_valid_exposition(text)
+
+
+def test_histogram_noop_when_metrics_off(metrics_off):
+    server.observe_hist("lt_hist_off", 1.0)
+    assert server.histogram_text() == []
+
+
+def test_histogram_concurrent_scrape_while_recording(metrics_on):
+    stop = threading.Event()
+    errors = []
+
+    def recorder():
+        i = 0
+        while not stop.is_set():
+            server.observe_hist("lt_hist_conc", (i % 100) / 10.0,
+                                {"mode": "run"})
+            i += 1
+
+    th = threading.Thread(target=recorder, daemon=True)
+    th.start()
+    try:
+        for _ in range(50):
+            text = server.prometheus_text()
+            _assert_valid_exposition(text)
+            samples = _hist_samples(text, "srt_lt_hist_conc")
+            inf = samples.get(("_bucket", 'le="+Inf",mode="run"'))
+            count = samples.get(("_count", 'mode="run"'))
+            if count is not None:
+                assert inf == count, (
+                    f"torn histogram snapshot: +Inf={inf} count={count}")
+    except Exception as exc:       # pragma: no cover
+        errors.append(exc)
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errors, f"scrape failed while recording: {errors[0]!r}"
+
+
+# ---------------------------------------------------------------------------
 # 3b. HTTP endpoints
 # ---------------------------------------------------------------------------
 
@@ -382,6 +489,31 @@ def test_live_port_knob_validation(monkeypatch):
     monkeypatch.setenv("SRT_LIVE_PORT", "70000")
     with pytest.raises(ValueError):
         live_server_port()
+
+
+def test_recent_ring_bounded_by_live_recent_knob(monkeypatch):
+    monkeypatch.setenv("SRT_LIVE_RECENT", "5")
+    ids = []
+    for _ in range(12):
+        lq = live.start("run", force=True)
+        ids.append(lq.query_id)
+        lq.finish()
+    recent = live.snapshot_all()["recent"]
+    assert len(recent) == 5
+    # LRU: only the five newest finishes survive, oldest-first order kept
+    assert [q["query_id"] for q in recent] == ids[-5:]
+
+
+def test_live_recent_knob_validation(monkeypatch):
+    from spark_rapids_tpu.config import live_recent_keep
+    monkeypatch.delenv("SRT_LIVE_RECENT", raising=False)
+    assert live_recent_keep() == 256
+    monkeypatch.setenv("SRT_LIVE_RECENT", "3")
+    assert live_recent_keep() == 3
+    for bad in ("0", "-1", "lots"):
+        monkeypatch.setenv("SRT_LIVE_RECENT", bad)
+        with pytest.raises(ValueError, match="SRT_LIVE_RECENT"):
+            live_recent_keep()
 
 
 # ---------------------------------------------------------------------------
