@@ -544,6 +544,128 @@ print("diagnostics lane ok:", {k: len(v) for k, v in sorted(by_reason.items())},
 EOF
 ls -l artifacts/premerge-bundles
 
+# Capacity lane: a serving mini-bank on a deliberately undersized pool
+# (SRT_SERVE_MAX_CONCURRENT=1, result cache off) so the capacity
+# accountant has something to advise about.  Mid-run, /capacity must
+# report a busy fraction in (0, 1] and surface the enable_result_cache
+# candidate on the repeated-fingerprint bank; a second evaluation must
+# carry it through the advisor's confirm-2 hysteresis into stable
+# recommendations; the srt_capacity_* gauges must be on /metrics; and
+# `obs advisor --url` against the live server must exit 0.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 SRT_SERVE_MAX_CONCURRENT=1 SRT_RESULT_CACHE=0 \
+SRT_CAPACITY_WINDOW_S=30 SRT_LIVE_SERVER=1 SRT_LIVE_PORT=0 \
+python - <<'EOF'
+import json
+import subprocess
+import sys
+import urllib.request
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.serve import QuerySession
+
+r = np.random.default_rng(11)
+table = Table({
+    "k": Column.from_numpy(r.integers(0, 4, 4096).astype(np.int64)),
+    "v": Column.from_numpy(r.integers(0, 100, 4096).astype(np.int64)),
+})
+# One plan resubmitted unchanged: with SRT_RESULT_CACHE=0 the repeated
+# fingerprints make enable_result_cache the deterministic candidate.
+pa = plan().filter(col("v") > 10).groupby_agg(
+    ["k"], [("v", "sum", "s")], domains={"k": (0, 3)})
+
+s = QuerySession()              # max_concurrent from the env knob (=1)
+
+def bank(n):
+    tickets = [s.submit(pa, table=table) for _ in range(n)]
+    return [t.result(timeout=300) for t in tickets]
+
+def cap():
+    with urllib.request.urlopen(base + "/capacity", timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+bank(6)
+base = server.get().url         # live server autostarts on first query
+first = cap()
+snap = first["snapshot"]
+busy = snap["busy"]["dispatch_fraction"]
+assert 0.0 < busy <= 1.0, snap["busy"]
+assert snap["littles_law"]["max_concurrent"] == 1, snap["littles_law"]
+cands = [c["action"] for c in first["candidates"]]
+assert "enable_result_cache" in cands, first["candidates"]
+
+bank(6)
+second = cap()
+recs = [rec["action"] for rec in second["recommendations"]]
+assert "enable_result_cache" in recs, second
+rec = next(rec for rec in second["recommendations"]
+           if rec["action"] == "enable_result_cache")
+assert rec["evidence"].get("repeated_fingerprints"), rec
+
+with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+    metrics = resp.read().decode()
+gauges = [l for l in metrics.splitlines()
+          if l.startswith("srt_capacity_") and not l.startswith("#")]
+assert gauges, "no srt_capacity_* gauges on /metrics"
+busy_line = [l for l in gauges if l.startswith("srt_capacity_busy_fraction ")]
+assert busy_line and 0.0 < float(busy_line[0].split()[-1]) <= 1.0, busy_line
+advice = [l for l in gauges if l.startswith("srt_capacity_advice{")]
+assert any('action="enable_result_cache"' in l for l in advice), advice
+
+out = subprocess.run(
+    [sys.executable, "-m", "spark_rapids_tpu.obs", "advisor",
+     "--url", base, "--json"], capture_output=True, text=True)
+assert out.returncode == 0, (out.stdout, out.stderr)
+payload = json.loads(out.stdout)
+assert payload["verdict"], payload
+s.close()
+print("capacity lane ok: busy_fraction=%.4f verdict=%s recs=%s"
+      % (busy, second["verdict"], recs))
+EOF
+
+# Bench capacity lane on a premerge-sized table (the full 4M-row bench
+# is nightly-only): the --capacity body must emit its one `capacity`
+# JSON line and hold the accountant's <=2% overhead gate.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 python - <<'EOF'
+import io
+import json
+import sys
+import numpy as np
+sys.path.insert(0, "benchmarks")
+import bench_queries
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.column import Column
+
+rng = np.random.default_rng(7)
+n = 120_000
+lineitem = srt.Table([
+    ("qty", Column.from_numpy(rng.integers(1, 51, n).astype(np.int64))),
+    ("price", Column.from_numpy(rng.uniform(900, 105000, n))),
+    ("disc", Column.from_numpy(np.round(rng.uniform(0, 0.1, n), 2))),
+    ("tax", Column.from_numpy(np.round(rng.uniform(0, 0.08, n), 2))),
+    ("shipdate", Column.from_numpy(
+        rng.integers(8000, 11000, n).astype(np.int32))),
+])
+buf = io.StringIO()
+stdout, sys.stdout = sys.stdout, buf
+try:
+    bench_queries.bench_capacity(lineitem)
+finally:
+    sys.stdout = stdout
+lines = [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+caps = [l for l in lines if l.get("metric") == "capacity"]
+assert len(caps) == 1, lines
+line = caps[0]
+assert 0.0 < line["busy_fraction"] <= 1.0, line
+assert line["overhead_frac"] <= bench_queries.CAPACITY_OVERHEAD_BUDGET \
+    or line["capacity_seconds"] - line["base_seconds"] <= 0.01, line
+assert line["advisor_verdict"], line
+print("bench capacity lane ok:", json.dumps(line, sort_keys=True))
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

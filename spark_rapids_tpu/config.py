@@ -186,6 +186,17 @@ Knobs (all optional):
                                and postmortem lookup; oldest are
                                LRU-dropped past the cap (>= 1,
                                default 256).
+  ``SRT_CAPACITY_WINDOW_S``    rolling window the capacity accountant
+                               (obs/capacity.py) derives saturation
+                               observables over — busy fraction, queue
+                               trends, Little's-law concurrency
+                               (seconds > 0, default 60).
+  ``SRT_CAPACITY_TARGETS``     comma-separated ``key=value`` overrides
+                               of the capacity advisor's thresholds
+                               (``busy_high``, ``busy_low``,
+                               ``util_high``, ``util_low``, ``wait_s``,
+                               ``hbm_headroom``); unknown keys or
+                               non-numeric values raise.
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -804,6 +815,57 @@ def live_recent_keep() -> int:
     return val
 
 
+def capacity_window_s() -> float:
+    """Rolling window (seconds) the capacity accountant
+    (obs/capacity.py) derives saturation observables over.  Shorter
+    windows react faster but flap more — the advisor's hysteresis
+    assumes windows overlap between evaluations.  Tune with
+    ``SRT_CAPACITY_WINDOW_S`` (> 0 seconds, default 60)."""
+    raw = os.environ.get("SRT_CAPACITY_WINDOW_S")
+    if raw is None or not raw.strip():
+        return 60.0
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_CAPACITY_WINDOW_S must be a number of seconds > 0, "
+            f"got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_CAPACITY_WINDOW_S must be > 0 seconds, got {val}")
+    return val
+
+
+def capacity_targets() -> dict[str, float]:
+    """Capacity-advisor thresholds (obs/capacity.py), defaults overlaid
+    with comma-separated ``key=value`` pairs from
+    ``SRT_CAPACITY_TARGETS`` (e.g. ``busy_high=0.9,wait_s=0.5``).
+    Unknown keys and non-numeric values raise so a typo cannot
+    silently run the advisor against default thresholds."""
+    from .obs.capacity import TARGET_DEFAULTS
+    targets = dict(TARGET_DEFAULTS)
+    raw = os.environ.get("SRT_CAPACITY_TARGETS")
+    if raw is None or not raw.strip():
+        return targets
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if not sep or key not in targets:
+            raise ValueError(
+                f"SRT_CAPACITY_TARGETS entries must be key=value with "
+                f"key in {sorted(targets)}, got {part!r}")
+        try:
+            targets[key] = float(value.strip())
+        except ValueError:
+            raise ValueError(
+                f"SRT_CAPACITY_TARGETS value for {key!r} must be a "
+                f"number, got {value.strip()!r}") from None
+    return targets
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -888,5 +950,6 @@ def knob_table() -> dict[str, str]:
              "SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
              "SRT_SERVE_POLICY", "SRT_RESULT_CACHE",
              "SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",
-             "SRT_LIVE_RECENT")
+             "SRT_LIVE_RECENT", "SRT_CAPACITY_WINDOW_S",
+             "SRT_CAPACITY_TARGETS")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -306,6 +306,14 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
                 ("dist", key),
                 lambda: _dist_program_cost(fn, bound, dist.row_mask))
             sample_device_hbm("dist.dispatch")
+            if not tl_on:
+                # With the timeline off nothing mirrors this wall into
+                # the flight path, so the capacity window is fed here;
+                # the timeline-on branch below reaches it through
+                # add_complete's flight mirror.
+                from ..obs import capacity as _capacity
+                _capacity.feed_span("dist.dispatch", t_wall * 1e6,
+                                    dur_s * 1e6)
         if tl_on:
             # Block so the recorded interval covers device wall, then
             # emit it once per shard lane: the host cannot observe
@@ -333,10 +341,15 @@ def _execute_dist_resilient(plan: Plan, dist: DistTable, mesh: Mesh,
                                 lambda: materialize(bound, out_cols, sel),
                                 dist=True)
             if meter:
-                counter("dist.materialize.us").inc(
-                    max(1, int((_time.perf_counter() - t_mat) * 1e6)))
+                mat_us = max(1, int((_time.perf_counter() - t_mat) * 1e6))
+                counter("dist.materialize.us").inc(mat_us)
                 from ..utils.memory import sample_device_hbm
                 sample_device_hbm("dist.materialize")
+                # No timeline mirror exists for the dist materialize
+                # wall, so the capacity window is always fed here.
+                from ..obs import capacity as _capacity
+                _capacity.feed_span("dist.materialize", t_mat * 1e6,
+                                    mat_us)
             return result
         order = [nm for nm in _final_order(plan.steps, bound.input_names)
                  if nm in out_cols]

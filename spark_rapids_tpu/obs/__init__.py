@@ -25,6 +25,12 @@ trace events — which :mod:`.bundle` drains into self-contained
 postmortem JSON on failure/SLO breach (``SRT_BUNDLE_DIR``), and
 :mod:`.doctor` (``python -m spark_rapids_tpu.obs doctor``) turns a
 bundle into a ranked verdict against the history baseline.
+:mod:`.capacity` closes the loop at fleet level: a rolling-window
+capacity accountant fed from the serving/flight hot paths (busy
+fraction, queue trends, admission pressure, Little's-law concurrency)
+plus an autoscaling advisor with hysteresis, surfaced on ``/capacity``,
+``srt_capacity_*`` gauges, the ``obs top`` capacity pane, and
+``python -m spark_rapids_tpu.obs advisor``.
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
@@ -42,6 +48,7 @@ import importlib
 #: IS the submodule.
 _LAZY = {
     "bundle": ("bundle", None),
+    "capacity": ("capacity", None),
     "doctor": ("doctor", None),
     "flight": ("flight", None),
     "history": ("history", None),

@@ -13,10 +13,19 @@
     in one bundle — or a plan fingerprint's newest history record —
     against the same-fingerprint history baseline, and print the
     verdict.  Exits 0 whenever a verdict was produced.
+``advisor``
+    one capacity-advisor evaluation (obs/capacity.py): the saturation
+    snapshot plus ranked, evidence-cited recommendations.  Reads the
+    local in-process window by default, a remote exporter's
+    ``/capacity`` with ``--url``, or — with ``--history`` — replays a
+    metrics-history JSONL offline (newest ``--last`` records via the
+    tail-seeking reverse reader).  Exits 0 whenever a verdict was
+    produced.
 
 Rendering is a pure function of the ``/queries`` JSON payload
-(:func:`render_top`), so tests drive it with synthetic snapshots and the
-remote and local paths share one code path.
+(:func:`render_top`) / the advisor payload (:func:`render_advisor`), so
+tests drive them with synthetic snapshots and the remote and local paths
+share one code path.
 """
 
 from __future__ import annotations
@@ -111,6 +120,113 @@ def render_top(snap: dict, source: str = "local") -> str:
     return "\n".join(lines)
 
 
+def render_advisor(payload: dict, source: str = "local") -> str:
+    """Console rendering of one ``/capacity`` advisor payload — pure."""
+    snap = payload.get("snapshot") or {}
+    busy = snap.get("busy", {})
+    queue = snap.get("queue", {})
+    ll = snap.get("littles_law", {})
+    adm = snap.get("admission", {})
+    lines = [
+        f"srt advisor — {source}  verdict={payload.get('verdict', '?')}",
+        "window={w:.0f}s  busy={b:.2f}  eff_concurrency={l:.2f}/{cap}  "
+        "util_of_cap={u:.2f}  qps={qps:.2f}".format(
+            w=snap.get("window_seconds", 0.0),
+            b=busy.get("dispatch_fraction", 0.0),
+            l=ll.get("effective_concurrency", 0.0),
+            cap=ll.get("max_concurrent", "?"),
+            u=ll.get("utilization_of_cap", 0.0),
+            qps=ll.get("arrival_rate_qps", 0.0)),
+        "queue: waits={n} p95={p95:.3f}s depth={d}   admission: "
+        "hbm_waits={hw} rejected={rj}".format(
+            n=queue.get("waits", 0), p95=queue.get("wait_p95_s", 0.0),
+            d=queue.get("depth", 0), hw=adm.get("hbm_waits", 0),
+            rj=adm.get("rejected", 0)),
+    ]
+    recs = payload.get("recommendations") or []
+    cands = payload.get("candidates") or []
+    shown = recs if recs else cands
+    tag = "recommendations" if recs else "candidates (unconfirmed)"
+    if not shown:
+        lines.append("recommendations: (none — capacity looks healthy)")
+        return "\n".join(lines)
+    lines.append(f"{tag}:")
+    for rec in shown:
+        lines.append(f"  [{rec['severity']:>3}] {rec['action']}: "
+                     f"{rec['reason']}")
+        ev = rec.get("evidence") or {}
+        if ev:
+            detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev))
+            lines.append(f"        evidence: {detail}")
+    return "\n".join(lines)
+
+
+def _capacity_pane(url: Optional[str]) -> List[str]:
+    """Capacity summary lines appended under a ``top`` frame —
+    best-effort (an older exporter without ``/capacity`` just yields
+    nothing)."""
+    try:
+        if url is not None:
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/capacity", timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+        else:
+            from . import capacity
+            payload = capacity.advise()
+    except Exception:
+        return []
+    return ["", render_advisor(payload, source="capacity")]
+
+
+def _advisor_payload(url: Optional[str], history: Optional[str],
+                     last: int) -> dict:
+    """The advisor payload from one of the three sources: a remote
+    exporter's ``/capacity``, an offline metrics-history replay, or the
+    local in-process window."""
+    if url is not None:
+        with urllib.request.urlopen(url.rstrip("/") + "/capacity",
+                                    timeout=5) as resp:
+            return json.loads(resp.read().decode())
+    if history is not None:
+        return _advise_history(history, last)
+    from . import capacity
+    return capacity.advise()
+
+
+def _advise_history(path: str, last: int) -> dict:
+    """Offline advisor: replay the newest ``last`` metrics-history
+    records (tail-seeking reverse reader, so a multi-GB JSONL costs one
+    tail read) through the same pure derive/recommend core.  One-shot
+    evaluation — hysteresis needs repeated windows — so a fresh
+    ``Advisor(confirm=1)`` folds the single window."""
+    from ..config import capacity_targets
+    from . import capacity
+    from .history import _iter_lines_reversed
+    records: List[dict] = []
+    for line in _iter_lines_reversed(path):
+        if len(records) >= max(last, 1):
+            break
+        try:
+            rec = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    records.reverse()           # oldest first for the serialized replay
+    events, w0, w1 = capacity.events_from_history(records)
+    from ..config import (result_cache_bytes, serve_hbm_budget,
+                          serve_max_concurrent)
+    snap = capacity.derive(
+        events, w0, w1, max_concurrent=serve_max_concurrent(),
+        hbm_budget=serve_hbm_budget(),
+        result_cache_on=result_cache_bytes() is not None)
+    candidates = capacity.recommend(snap, capacity_targets())
+    recs = capacity.Advisor(confirm=1, clear=1).observe(candidates)
+    return {"snapshot": snap, "candidates": candidates,
+            "recommendations": recs,
+            "verdict": capacity.verdict_for(recs if recs else candidates)}
+
+
 def _fetch(url: str) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/queries",
                                 timeout=5) as resp:
@@ -148,10 +264,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     doctor.add_argument("--history", default=None,
                         help="metrics-history JSONL for the baseline "
                              "(default: SRT_METRICS_HISTORY)")
+    advisor = sub.add_parser(
+        "advisor", help="capacity snapshot + ranked autoscaling advice")
+    advisor.add_argument("--url", default=None,
+                         help="remote exporter base URL (fetches its "
+                              "/capacity); default: the local in-process "
+                              "event window")
+    advisor.add_argument("--history", default=None,
+                         help="replay a metrics-history JSONL offline "
+                              "instead of a live window")
+    advisor.add_argument("--last", type=int, default=256,
+                         help="history records to replay (newest first, "
+                              "default 256)")
+    advisor.add_argument("--json", action="store_true",
+                         help="print the raw advisor payload as JSON")
     args = parser.parse_args(argv)
     if args.command == "doctor":
         from .doctor import main as doctor_main
         return doctor_main(args.target, history_path=args.history)
+    if args.command == "advisor":
+        payload = _advisor_payload(args.url, args.history, args.last)
+        if args.json:
+            print(json.dumps(payload, sort_keys=True))
+        else:
+            print(render_advisor(
+                payload, source=args.url or args.history or "local"))
+        return 0
     if args.command != "top":
         parser.print_help()
         return 2
@@ -159,6 +297,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         while True:
             frame = render_top(_snapshot(args.url), source=source)
+            frame += "\n".join(_capacity_pane(args.url))
             if args.once:
                 print(frame)
                 return 0

@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 from ..config import bundle_dir, knob_table, slo_ms
 
 #: Bump on any key-set change; the golden test pins the layout.
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: Incident kinds :func:`dump` accepts.
 REASONS = ("failure", "recovery_exhausted", "admission_rejected",
@@ -123,6 +123,17 @@ def _flight_block(query_id: Optional[int]) -> Dict[str, Any]:
     return snap
 
 
+def _capacity_block() -> Dict[str, Any]:
+    """Capacity verdict at the moment of the incident — was the process
+    saturated when this query failed/breached?  Never raises."""
+    try:
+        from . import capacity
+        return capacity.bundle_block()
+    except Exception:
+        return {"snapshot": None, "recommendations": [],
+                "verdict": "unavailable"}
+
+
 def _prune_oldest(dirpath: str) -> None:
     try:
         names = [n for n in os.listdir(dirpath)
@@ -182,6 +193,7 @@ def build(reason: str, *, query_id: Optional[int] = None, qm=None,
         "live": live_rec,
         "config": knob_table(),
         "slo": {"slo_ms": limit, "elapsed_seconds": elapsed},
+        "capacity": _capacity_block(),
     }
 
 
@@ -259,7 +271,8 @@ def validate_bundle(payload: dict, schema: dict) -> List[str]:
     if payload["reason"] not in schema["reasons"]:
         errors.append(f"reason {payload['reason']!r} not in "
                       f"{schema['reasons']}")
-    for block in ("error", "recovery", "flight", "plan", "slo"):
+    for block in ("error", "recovery", "flight", "plan", "slo",
+                  "capacity"):
         sub = payload.get(block)
         if not isinstance(sub, dict):
             errors.append(f"{block!r} block is not an object")

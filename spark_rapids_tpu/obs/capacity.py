@@ -1,0 +1,613 @@
+"""Capacity accountant — rolling-window saturation math and an advisor.
+
+The serving layer already *emits* every signal an operator needs to
+answer "is this process saturated, and what should change?" — queue-wait
+histograms (obs/server.py), the admission controller's claimed-bytes
+ledger (serve/admission.py), dispatch/materialize span walls (the
+flight/timeline path), and per-query completions (obs/query.py).  What
+it lacks is a place that *consumes* them over a rolling window and turns
+them into decisions.  This module is that place:
+
+  * an **event window** — bounded deques of timestamped observations fed
+    from the hot paths (one gate check + one deque append when metrics
+    are on, nothing when off);
+  * **pure derivations** over a window snapshot: device-busy fraction
+    (union-merged dispatch wall over wall-clock, so the dist path's
+    fan-out of identical spans does not double-count), queue depth/wait
+    trends, admission pressure vs ``SRT_SERVE_HBM_BUDGET``, HBM headroom
+    percentiles, and Little's-law effective concurrency (L = λ·W) vs the
+    ``SRT_SERVE_MAX_CONCURRENT`` cap;
+  * an **advisor**: :func:`recommend` maps a snapshot to ranked,
+    evidence-cited actions (raise/lower the worker pool, grow the HBM
+    budget, enable the result cache, shed load), and :class:`Advisor`
+    applies hysteresis so a recommendation only surfaces after
+    ``confirm`` consecutive supporting windows and only clears after
+    ``clear`` consecutive absent ones — scrape-to-scrape flapping never
+    reaches the operator.
+
+Contract (mirrors obs/metrics.py, obs/flight.py):
+
+  * jax-free at import (pinned by an import-hygiene test);
+  * off unless ``SRT_METRICS=1`` — every ``feed_*`` returns after one
+    env read, and :func:`snapshot` over an unfed window is well-defined
+    (zero traffic, no recommendations);
+  * the derivation/advice layer is pure — ``derive`` and ``recommend``
+    take explicit inputs and are deterministic for a fixed window, so
+    the math is unit-testable without a device, a server, or a clock.
+
+Surfaces: ``/capacity`` + ``srt_capacity_*`` gauges (obs/server.py), a
+capacity pane in ``obs top`` and the ``obs advisor`` CLI
+(obs/__main__.py, also offline over a metrics-history JSONL), and a
+``capacity`` block in postmortem bundles (obs/bundle.py → obs/doctor.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..config import metrics_enabled
+
+__all__ = [
+    "feed_span", "feed_queue_wait", "feed_queue_depth",
+    "feed_admission_wait", "feed_admission_reject", "feed_hbm",
+    "feed_completion",
+    "merged_busy_seconds", "effective_concurrency", "percentile", "trend",
+    "derive", "recommend", "Advisor",
+    "window_events", "snapshot", "advise", "bundle_block",
+    "events_from_history", "reset",
+]
+
+# Spans worth metering for device-busy accounting.  Dispatch-like walls
+# cover time the device (or its dist fan-out) is working — the one-shot
+# and stream ``.dispatch`` spans, plus the combine-path stream's
+# ``.partial`` per-batch aggregation, ``.combine`` merges, and the dist
+# ``.merge_collective``.  Materialize-like walls cover device→host
+# result transfer: ``.materialize`` and the combine path's
+# ``.finalize``.
+_DISPATCH_SUFFIXES = (".dispatch", ".partial", ".combine",
+                      ".merge_collective")
+_MATERIALIZE_SUFFIXES = (".materialize", ".finalize")
+_SPAN_SUFFIXES = _DISPATCH_SUFFIXES + _MATERIALIZE_SUFFIXES
+
+# Per-kind event retention.  4096 events at serving rates covers far
+# more than any sane SRT_CAPACITY_WINDOW_S; the deques bound memory the
+# same way the flight ring does.
+_MAXEVENTS = 4096
+
+_LOCK = threading.Lock()
+_DISPATCH: "deque[Tuple[float, float]]" = deque(maxlen=_MAXEVENTS)
+_MATERIALIZE: "deque[Tuple[float, float]]" = deque(maxlen=_MAXEVENTS)
+_QUEUE_WAITS: "deque[Tuple[float, float]]" = deque(maxlen=_MAXEVENTS)
+_QUEUE_DEPTHS: "deque[Tuple[float, int]]" = deque(maxlen=_MAXEVENTS)
+_ADMISSION: "deque[Tuple[float, str, int]]" = deque(maxlen=_MAXEVENTS)
+_HBM: "deque[Tuple[float, int]]" = deque(maxlen=_MAXEVENTS)
+_COMPLETIONS: "deque[Tuple[float, str, float, str]]" = deque(
+    maxlen=_MAXEVENTS)
+
+
+def _now() -> float:
+    """Window clock in seconds — same base as ``timeline.now_us()``."""
+    return time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# Event feeds (hot path: one env read when off; gate + append when on)
+# ---------------------------------------------------------------------------
+
+def feed_span(name: str, ts_us: float, dur_us: float) -> None:
+    """Record one finished span wall.  Called from the flight-recorder
+    sinks (both the timeline-on mirror and the timeline-off scope
+    path), so dispatch walls are visible whenever metrics are on —
+    regardless of whether the opt-in timeline records."""
+    if not name.endswith(_SPAN_SUFFIXES):
+        return
+    if not metrics_enabled():
+        return
+    start = ts_us / 1e6
+    end = start + max(dur_us, 0.0) / 1e6
+    dq = (_DISPATCH if name.endswith(_DISPATCH_SUFFIXES)
+          else _MATERIALIZE)
+    with _LOCK:
+        dq.append((start, end))
+
+
+def feed_queue_wait(seconds: float) -> None:
+    """One query left the run queue after waiting ``seconds``."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _QUEUE_WAITS.append((_now(), max(seconds, 0.0)))
+
+
+def feed_queue_depth(depth: int) -> None:
+    """Run-queue depth sample (taken at submit and at worker pop)."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _QUEUE_DEPTHS.append((_now(), int(depth)))
+
+
+def feed_admission_wait() -> None:
+    """The admission controller made a query wait for HBM headroom."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _ADMISSION.append((_now(), "wait", 0))
+
+
+def feed_admission_reject(estimate_bytes: int) -> None:
+    """The admission controller rejected an over-budget claim."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _ADMISSION.append((_now(), "reject", int(estimate_bytes)))
+
+
+def feed_hbm(claimed_bytes: int) -> None:
+    """Claimed-bytes ledger sample (taken on acquire and release)."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _HBM.append((_now(), int(claimed_bytes)))
+
+
+def feed_completion(mode: str, seconds: float,
+                    fingerprint: Optional[str]) -> None:
+    """One query finished: latency + plan identity for Little's law and
+    repeated-plan (result-cache) detection."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _COMPLETIONS.append((_now(), str(mode), max(seconds, 0.0),
+                             fingerprint or ""))
+
+
+def reset() -> None:
+    """Drop all window events and advisor state (test/bench isolation —
+    mirrors ``registry().reset()`` and ``server.reset_histograms()``)."""
+    with _LOCK:
+        for dq in (_DISPATCH, _MATERIALIZE, _QUEUE_WAITS, _QUEUE_DEPTHS,
+                   _ADMISSION, _HBM, _COMPLETIONS):
+            dq.clear()
+    _ADVISOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# Pure derivations (no ambient state — unit-testable without a clock)
+# ---------------------------------------------------------------------------
+
+def merged_busy_seconds(intervals: Iterable[Tuple[float, float]],
+                        w0: float, w1: float) -> float:
+    """Union length of ``intervals`` clipped to window ``[w0, w1]``.
+
+    Overlapping spans — concurrent workers, or the dist path's 8-way
+    fan-out of one dispatch into identical per-shard spans — count
+    once, so the busy fraction derived from this is naturally <= 1.
+    """
+    clipped = sorted((max(s, w0), min(e, w1))
+                     for s, e in intervals if e > w0 and s < w1)
+    busy = 0.0
+    cur_s = cur_e = None
+    for s, e in clipped:
+        if cur_e is None:
+            cur_s, cur_e = s, e
+        elif s <= cur_e:
+            cur_e = max(cur_e, e)
+        else:
+            busy += cur_e - cur_s
+            cur_s, cur_e = s, e
+    if cur_e is not None:
+        busy += cur_e - cur_s
+    return busy
+
+
+def effective_concurrency(service_seconds: Sequence[float],
+                          window_seconds: float) -> float:
+    """Little's law: L = λ·W.  With λ = n/window and W = mean service
+    time, L reduces to total in-window service seconds over the window
+    — the average number of queries concurrently in service."""
+    if window_seconds <= 0:
+        return 0.0
+    return sum(service_seconds) / window_seconds
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None for no samples."""
+    if not values:
+        return None
+    xs = sorted(values)
+    rank = max(int(round(q / 100.0 * len(xs) + 0.5)), 1)
+    return xs[min(rank, len(xs)) - 1]
+
+
+def trend(samples: Sequence[Tuple[float, float]],
+          w0: float, w1: float) -> float:
+    """Second-half mean minus first-half mean of timestamped samples in
+    ``[w0, w1]`` — positive means the signal is rising."""
+    mid = (w0 + w1) / 2.0
+    lo = [v for t, v in samples if w0 <= t < mid]
+    hi = [v for t, v in samples if mid <= t <= w1]
+    if not lo or not hi:
+        return 0.0
+    return sum(hi) / len(hi) - sum(lo) / len(lo)
+
+
+def derive(events: Dict[str, Any], w0: float, w1: float, *,
+           max_concurrent: int, hbm_budget: Optional[int],
+           result_cache_on: bool) -> Dict[str, Any]:
+    """Saturation observables for one event window — pure.
+
+    ``events`` is the shape :func:`window_events` returns: lists of the
+    feed tuples.  All rate/fraction math is clipped to ``[w0, w1]``.
+    """
+    window = max(w1 - w0, 1e-9)
+
+    disp = [iv for iv in events.get("dispatch", ())]
+    mat = [iv for iv in events.get("materialize", ())]
+    disp_busy = merged_busy_seconds(disp, w0, w1)
+    mat_busy = merged_busy_seconds(mat, w0, w1)
+
+    waits = [v for t, v in events.get("queue_waits", ()) if w0 <= t <= w1]
+    depths = [(t, float(d)) for t, d in events.get("queue_depths", ())
+              if w0 <= t <= w1]
+    adm = [(t, kind, nb) for t, kind, nb in events.get("admission", ())
+           if w0 <= t <= w1]
+    hbm = [(t, float(b)) for t, b in events.get("hbm", ())
+           if w0 <= t <= w1]
+    comps = [(t, m, s, fp) for t, m, s, fp in events.get("completions", ())
+             if w0 <= t <= w1]
+
+    lat = [s for _, _, s, _ in comps]
+    eff = effective_concurrency(lat, window)
+    fps = [fp for _, _, _, fp in comps if fp]
+    repeated = sorted({fp for fp in fps if fps.count(fp) > 1})
+
+    hbm_vals = [b for _, b in hbm]
+    hbm_now = hbm_vals[-1] if hbm_vals else 0.0
+    headroom = None
+    if hbm_budget:
+        p95 = percentile(hbm_vals, 95.0) or 0.0
+        headroom = max(1.0 - p95 / hbm_budget, 0.0)
+
+    rejected = [nb for _, kind, nb in adm if kind == "reject"]
+    return {
+        "window_seconds": window,
+        "busy": {
+            "dispatch_seconds": disp_busy,
+            "dispatch_fraction": min(disp_busy / window, 1.0),
+            "materialize_seconds": mat_busy,
+            "materialize_fraction": min(mat_busy / window, 1.0),
+            "dispatch_spans": len(disp),
+            "materialize_spans": len(mat),
+        },
+        "queue": {
+            "waits": len(waits),
+            "wait_mean_s": sum(waits) / len(waits) if waits else 0.0,
+            "wait_p95_s": percentile(waits, 95.0) or 0.0,
+            "wait_trend_s": trend(events.get("queue_waits", ()), w0, w1),
+            "depth": int(depths[-1][1]) if depths else 0,
+            "depth_trend": trend(depths, w0, w1),
+        },
+        "admission": {
+            "hbm_waits": sum(1 for _, k, _ in adm if k == "wait"),
+            "rejected": len(rejected),
+            "rejected_bytes": int(sum(rejected)),
+            "budget_bytes": hbm_budget,
+        },
+        "hbm": {
+            "claimed_now_bytes": int(hbm_now),
+            "claimed_p50_bytes": int(percentile(hbm_vals, 50.0) or 0),
+            "claimed_p95_bytes": int(percentile(hbm_vals, 95.0) or 0),
+            "headroom_fraction": headroom,
+            "samples": len(hbm_vals),
+        },
+        "littles_law": {
+            "completions": len(comps),
+            "arrival_rate_qps": len(comps) / window,
+            "latency_mean_s": sum(lat) / len(lat) if lat else 0.0,
+            "effective_concurrency": eff,
+            "max_concurrent": max_concurrent,
+            "utilization_of_cap": min(eff / max_concurrent, 1.0)
+            if max_concurrent > 0 else 0.0,
+        },
+        "result_cache_on": bool(result_cache_on),
+        "repeated_fingerprints": repeated,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Advisor (pure rules + hysteresis)
+# ---------------------------------------------------------------------------
+
+TARGET_DEFAULTS: Dict[str, float] = {
+    # Busy-fraction band: above busy_high the device itself is the
+    # bottleneck; below busy_low it is idling.
+    "busy_high": 0.85,
+    "busy_low": 0.20,
+    # Concurrency-cap utilization band (Little's-law L over the cap).
+    "util_high": 0.85,
+    "util_low": 0.25,
+    # Queue-wait pain threshold (p95 seconds).
+    "wait_s": 0.25,
+    # Minimum acceptable HBM headroom fraction.
+    "hbm_headroom": 0.10,
+}
+
+
+def recommend(snap: Dict[str, Any],
+              targets: Optional[Dict[str, float]] = None
+              ) -> List[Dict[str, Any]]:
+    """Ranked candidate actions for one snapshot — pure and
+    deterministic.  Each candidate cites the observables that triggered
+    it so operators (and the doctor) can audit the advice."""
+    t = dict(TARGET_DEFAULTS)
+    if targets:
+        t.update(targets)
+    busy = snap["busy"]["dispatch_fraction"]
+    queue = snap["queue"]
+    adm = snap["admission"]
+    hbm = snap["hbm"]
+    ll = snap["littles_law"]
+    util = ll["utilization_of_cap"]
+    waiting = queue["waits"] > 0 or queue["depth"] > 0
+
+    out: List[Dict[str, Any]] = []
+
+    if busy >= t["busy_high"] and queue["wait_p95_s"] >= t["wait_s"] \
+            and queue["wait_trend_s"] > 0:
+        out.append({
+            "action": "shed_load", "severity": 90,
+            "reason": "device saturated and queue waits still rising — "
+                      "more workers cannot help; shed or defer load",
+            "evidence": {
+                "busy_fraction": busy,
+                "wait_p95_s": queue["wait_p95_s"],
+                "wait_trend_s": queue["wait_trend_s"],
+                "target_busy_high": t["busy_high"],
+                "target_wait_s": t["wait_s"],
+            },
+        })
+    if util >= t["util_high"] and waiting and busy < t["busy_high"]:
+        out.append({
+            "action": "raise_workers", "severity": 80,
+            "reason": "concurrency cap saturated while the device has "
+                      "headroom — raise SRT_SERVE_MAX_CONCURRENT",
+            "evidence": {
+                "utilization_of_cap": util,
+                "effective_concurrency": ll["effective_concurrency"],
+                "max_concurrent": ll["max_concurrent"],
+                "queue_waits": queue["waits"],
+                "queue_depth": queue["depth"],
+                "busy_fraction": busy,
+                "target_util_high": t["util_high"],
+            },
+        })
+    if adm["hbm_waits"] > 0 or adm["rejected"] > 0 or (
+            hbm["headroom_fraction"] is not None
+            and hbm["headroom_fraction"] < t["hbm_headroom"]):
+        out.append({
+            "action": "grow_hbm_budget", "severity": 70,
+            "reason": "admission pressure against SRT_SERVE_HBM_BUDGET "
+                      "— queries wait or are rejected for HBM headroom",
+            "evidence": {
+                "hbm_waits": adm["hbm_waits"],
+                "rejected": adm["rejected"],
+                "rejected_bytes": adm["rejected_bytes"],
+                "budget_bytes": adm["budget_bytes"],
+                "headroom_fraction": hbm["headroom_fraction"],
+                "target_hbm_headroom": t["hbm_headroom"],
+            },
+        })
+    if not snap["result_cache_on"] and snap["repeated_fingerprints"]:
+        out.append({
+            "action": "enable_result_cache", "severity": 60,
+            "reason": "repeated plan fingerprints in the window with the "
+                      "result cache off — set SRT_RESULT_CACHE",
+            "evidence": {
+                "repeated_fingerprints": snap["repeated_fingerprints"],
+                "completions": ll["completions"],
+            },
+        })
+    if util <= t["util_low"] and not waiting and busy <= t["busy_low"] \
+            and ll["completions"] > 0 and ll["max_concurrent"] > 1:
+        out.append({
+            "action": "lower_workers", "severity": 30,
+            "reason": "serving well under the concurrency cap with no "
+                      "queueing — the worker pool can shrink",
+            "evidence": {
+                "utilization_of_cap": util,
+                "busy_fraction": busy,
+                "max_concurrent": ll["max_concurrent"],
+                "target_util_low": t["util_low"],
+            },
+        })
+    out.sort(key=lambda r: (-r["severity"], r["action"]))
+    return out
+
+
+class Advisor:
+    """Hysteresis over :func:`recommend` candidates.
+
+    An action becomes *active* only after ``confirm`` consecutive
+    windows propose it, and deactivates only after ``clear``
+    consecutive windows do not — a candidate that flaps window-to-
+    window never surfaces, and an active recommendation does not
+    vanish on one quiet scrape.
+    """
+
+    def __init__(self, confirm: int = 2, clear: int = 2):
+        self.confirm = max(int(confirm), 1)
+        self.clear = max(int(clear), 1)
+        self._streak: Dict[str, int] = {}
+        self._gone: Dict[str, int] = {}
+        self._active: Dict[str, Dict[str, Any]] = {}
+
+    def reset(self) -> None:
+        self._streak.clear()
+        self._gone.clear()
+        self._active.clear()
+
+    def observe(self, candidates: List[Dict[str, Any]]
+                ) -> List[Dict[str, Any]]:
+        """Fold one window's candidates in; return the stable set."""
+        seen = {c["action"]: c for c in candidates}
+        for action, cand in seen.items():
+            self._streak[action] = self._streak.get(action, 0) + 1
+            self._gone[action] = 0
+            if self._streak[action] >= self.confirm:
+                self._active[action] = cand
+            elif action in self._active:
+                self._active[action] = cand
+        for action in list(self._streak):
+            if action in seen:
+                continue
+            self._gone[action] = self._gone.get(action, 0) + 1
+            self._streak[action] = 0
+            if action in self._active \
+                    and self._gone[action] >= self.clear:
+                del self._active[action]
+        out = list(self._active.values())
+        out.sort(key=lambda r: (-r["severity"], r["action"]))
+        return out
+
+
+def verdict_for(recommendations: List[Dict[str, Any]]) -> str:
+    """One-word operator verdict for a recommendation set."""
+    if not recommendations:
+        return "healthy"
+    top = recommendations[0]["severity"]
+    if top >= 80:
+        return "saturated"
+    if top >= 50:
+        return "pressured"
+    return "underutilized"
+
+
+# ---------------------------------------------------------------------------
+# Ambient wrappers (read knobs + the live window; thin over the pure core)
+# ---------------------------------------------------------------------------
+
+_ADVISOR = Advisor()
+
+
+def window_events(w0: float, w1: float) -> Dict[str, Any]:
+    """Copy of the live window's events clipped to ``[w0, w1]`` (span
+    intervals are kept when they overlap the window)."""
+    with _LOCK:
+        return {
+            "dispatch": [iv for iv in _DISPATCH
+                         if iv[1] > w0 and iv[0] < w1],
+            "materialize": [iv for iv in _MATERIALIZE
+                            if iv[1] > w0 and iv[0] < w1],
+            "queue_waits": [e for e in _QUEUE_WAITS if w0 <= e[0] <= w1],
+            "queue_depths": [e for e in _QUEUE_DEPTHS
+                             if w0 <= e[0] <= w1],
+            "admission": [e for e in _ADMISSION if w0 <= e[0] <= w1],
+            "hbm": [e for e in _HBM if w0 <= e[0] <= w1],
+            "completions": [e for e in _COMPLETIONS if w0 <= e[0] <= w1],
+        }
+
+
+def snapshot(window_s: Optional[float] = None) -> Dict[str, Any]:
+    """Saturation observables for the trailing window (knobs ambient)."""
+    from ..config import (capacity_window_s, result_cache_bytes,
+                          serve_hbm_budget, serve_max_concurrent)
+    window = capacity_window_s() if window_s is None else float(window_s)
+    w1 = _now()
+    w0 = w1 - window
+    return derive(window_events(w0, w1), w0, w1,
+                  max_concurrent=serve_max_concurrent(),
+                  hbm_budget=serve_hbm_budget(),
+                  result_cache_on=result_cache_bytes() is not None)
+
+
+def advise(window_s: Optional[float] = None,
+           advisor: Optional[Advisor] = None) -> Dict[str, Any]:
+    """One advisor evaluation over the live window.
+
+    ``candidates`` are this window's raw proposals (immediate — a CI
+    scrape sees them on the first evaluation); ``recommendations`` are
+    the hysteresis-stable set from ``advisor`` (the module-level one by
+    default, so repeated ``/capacity`` scrapes confirm/clear actions).
+    """
+    from ..config import capacity_targets
+    snap = snapshot(window_s)
+    candidates = recommend(snap, capacity_targets())
+    adv = _ADVISOR if advisor is None else advisor
+    recs = adv.observe(candidates)
+    return {
+        "snapshot": snap,
+        "candidates": candidates,
+        "recommendations": recs,
+        "verdict": verdict_for(recs if recs else candidates),
+    }
+
+
+def bundle_block() -> Dict[str, Any]:
+    """Capacity block for a postmortem bundle — never raises (a broken
+    accountant must not block an incident bundle)."""
+    try:
+        payload = advise()
+        return {
+            "snapshot": payload["snapshot"],
+            "recommendations": payload["recommendations"]
+            or payload["candidates"],
+            "verdict": payload["verdict"],
+        }
+    except Exception as exc:  # pragma: no cover - defensive
+        return {"snapshot": None, "recommendations": [],
+                "verdict": f"unavailable: {type(exc).__name__}"}
+
+
+# ---------------------------------------------------------------------------
+# Offline: synthesize a window from metrics-history records
+# ---------------------------------------------------------------------------
+
+def events_from_history(records: Sequence[Dict[str, Any]]
+                        ) -> Tuple[Dict[str, Any], float, float]:
+    """Window events synthesized from metrics-history records
+    (obs/history.py JSONL, oldest first).
+
+    History records carry durations but no wall-clock timestamps, so
+    the replay is *serialized*: records are laid back-to-back on a
+    synthetic clock (each query occupies ``[cursor, cursor +
+    total_seconds]``, dispatch wall is the trailing
+    ``execute_seconds``).  Busy fractions read as "of serialized
+    runtime"; queue/admission/cache signals carry over exactly.
+    Returns ``(events, w0, w1)`` for :func:`derive`.
+    """
+    cursor = 0.0
+    ev: Dict[str, List[Any]] = {
+        "dispatch": [], "materialize": [], "queue_waits": [],
+        "queue_depths": [], "admission": [], "hbm": [], "completions": [],
+    }
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        timings = rec.get("timings") or {}
+        total = float(rec.get("total_seconds") or 0.0)
+        execute = float(timings.get("execute_seconds") or 0.0)
+        t_end = cursor + total
+        if execute > 0:
+            ev["dispatch"].append((t_end - min(execute, total), t_end))
+        serve = rec.get("serve") or {}
+        qw = serve.get("queue_wait_seconds")
+        if qw is not None:
+            ev["queue_waits"].append((t_end, float(qw)))
+        admission = serve.get("admission")
+        if admission == "queued":
+            ev["admission"].append((t_end, "wait", 0))
+        elif admission == "rejected":
+            ev["admission"].append((t_end, "reject", 0))
+        cost = rec.get("cost") or {}
+        hbm = cost.get("hbm") or {}
+        peak = hbm.get("peak_bytes")
+        if peak:
+            ev["hbm"].append((t_end, int(peak)))
+        ev["completions"].append((t_end, str(rec.get("mode") or "?"),
+                                  total, str(rec.get("fingerprint") or "")))
+        cursor = t_end
+    return ev, 0.0, max(cursor, 1e-9)

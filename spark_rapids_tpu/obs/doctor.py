@@ -166,6 +166,28 @@ def _cost_findings(qm: dict, base: Optional[dict]) -> List[Dict[str, Any]]:
     return out
 
 
+def _capacity_findings(bundle: dict) -> List[Dict[str, Any]]:
+    """Process-saturation context at the moment of the incident — the
+    bundle's ``capacity`` block (obs/capacity.py; absent in pre-v2
+    bundles).  A failure under a saturated process reads differently
+    from the same failure on an idle one."""
+    cap = bundle.get("capacity")
+    if not isinstance(cap, dict):
+        return []
+    out: List[Dict[str, Any]] = []
+    for rec in cap.get("recommendations") or []:
+        action = rec.get("action", "?")
+        ev = rec.get("evidence") or {}
+        detail = str(rec.get("reason") or "")
+        if ev:
+            detail += " — evidence: " + ", ".join(
+                f"{k}={ev[k]}" for k in sorted(ev))
+        out.append(_finding(
+            50, f"capacity advisor ({cap.get('verdict', '?')}): {action}",
+            detail))
+    return out
+
+
 def baseline_for(fingerprint: str,
                  history_path: Optional[str] = None) -> Optional[dict]:
     """The same-fingerprint history baseline (newest measured record)."""
@@ -197,7 +219,8 @@ def diagnose(payload: dict, baseline: Optional[dict] = None,
         baseline = None
     findings = (_error_findings(bundle) + _slo_findings(bundle)
                 + _cache_findings(qm, baseline)
-                + _cost_findings(qm, baseline))
+                + _cost_findings(qm, baseline)
+                + _capacity_findings(bundle))
     findings.sort(key=lambda f: -f["severity"])
     if findings:
         verdict = findings[0]["title"]

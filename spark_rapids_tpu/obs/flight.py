@@ -42,6 +42,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from ..config import flight_events, metrics_enabled
+from . import capacity as _cap
 from . import timeline as _tl
 
 # The ring registry is bounded too: a long-serving process touches many
@@ -159,8 +160,10 @@ class _FlightSpan:
         if lane is None:
             t = threading.current_thread()
             lane = t.name or f"thread-{t.ident}"
-        self._ring.append(self._name, self._cat, self._t0,
-                          _tl.now_us() - self._t0, lane, self._args)
+        dur_us = _tl.now_us() - self._t0
+        _cap.feed_span(self._name, self._t0, dur_us)
+        self._ring.append(self._name, self._cat, self._t0, dur_us, lane,
+                          self._args)
 
 
 def ring_for(query_id: int, create: bool = True) -> Optional[FlightRing]:
@@ -189,6 +192,9 @@ def record(name: str, cat: str, ts_us: float, dur_us: float,
     ``timeline.query_scope``; events with neither are not recorded."""
     if not metrics_enabled():
         return
+    # Capacity accounting wants the wall regardless of query
+    # attribution (interval-union dedups the dist fan-out's copies).
+    _cap.feed_span(name, ts_us, dur_us)
     qid = args.get("query_id")
     if qid is None:
         qid = _tl.current_query_id()

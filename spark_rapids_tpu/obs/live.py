@@ -256,11 +256,18 @@ class LiveQuery:
             self.rows_out = int(output_rows)
         self.phase = status
         keep = live_recent_keep()
+        evicted = 0
         with _LOCK:
             _ACTIVE.pop(self.query_id, None)
             _RECENT.append(self)
             while len(_RECENT) > keep:
                 _RECENT.popleft()
+                evicted += 1
+        if evicted:
+            # LRU drops were previously invisible; the counter exports
+            # as srt_live_recent_evictions_total on /metrics.
+            from .metrics import counter
+            counter("live.recent_evictions").inc(evicted)
         stack = getattr(_TLS, "stack", None)
         if stack and self in stack:
             stack.remove(self)

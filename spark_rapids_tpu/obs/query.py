@@ -432,9 +432,11 @@ def _on_query_complete(qm: QueryMetrics) -> None:
     into the latency histograms (obs/server.py, gated on
     ``SRT_METRICS=1``) and the SLO-breach bundle check (obs/bundle.py,
     gated on ``SRT_SLO_MS`` + ``SRT_BUNDLE_DIR``)."""
+    from . import capacity as _capacity
     from . import server as _server
-    from .bundle import maybe_slo
     _server.observe_query(qm)
+    _capacity.feed_completion(qm.mode, qm.total_seconds, qm.fingerprint)
+    from .bundle import maybe_slo
     maybe_slo(qm)
 
 

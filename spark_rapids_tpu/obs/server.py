@@ -15,6 +15,13 @@ queries are still running:
 ``/queries``
     JSON snapshots of in-flight and recently finished queries keyed by
     ``query_id`` + plan fingerprint (``obs.live.snapshot_all()``).
+``/capacity``
+    One capacity-advisor evaluation (obs/capacity.py) over the rolling
+    ``SRT_CAPACITY_WINDOW_S`` window: the saturation snapshot, this
+    window's raw candidates, and the hysteresis-stable recommendation
+    set.  The same observables export as ``srt_capacity_*`` gauges on
+    ``/metrics`` (snapshot only — scraping ``/metrics`` must not
+    advance the advisor's hysteresis).
 ``/queries/<id>/timeline``
     Chrome-trace JSON of a *still-running* query: recorded events whose
     span args carry that ``query_id``, plus a non-destructive render of
@@ -205,6 +212,42 @@ def reset_histograms() -> None:
         _HISTOGRAMS.clear()
 
 
+def capacity_gauges(fam: _Families) -> None:
+    """Fold the capacity snapshot into ``/metrics`` as ``srt_capacity_*``
+    gauges.  Uses :func:`obs.capacity.snapshot` + :func:`recommend`
+    directly — NOT :func:`advise` — so scrapes never advance the
+    advisor's hysteresis state (only ``/capacity`` and the CLI do)."""
+    from . import capacity
+    from ..config import capacity_targets
+    try:
+        snap = capacity.snapshot()
+        candidates = capacity.recommend(snap, capacity_targets())
+    except Exception:       # a broken accountant must not break /metrics
+        return
+    busy, queue, ll = snap["busy"], snap["queue"], snap["littles_law"]
+    adm, hbm = snap["admission"], snap["hbm"]
+    for name, value in (
+            ("window_seconds", snap["window_seconds"]),
+            ("busy_fraction", busy["dispatch_fraction"]),
+            ("materialize_fraction", busy["materialize_fraction"]),
+            ("queue_waits", queue["waits"]),
+            ("queue_wait_p95_seconds", queue["wait_p95_s"]),
+            ("queue_depth", queue["depth"]),
+            ("admission_hbm_waits", adm["hbm_waits"]),
+            ("admission_rejected_bytes", adm["rejected_bytes"]),
+            ("hbm_claimed_p95_bytes", hbm["claimed_p95_bytes"]),
+            ("arrival_rate_qps", ll["arrival_rate_qps"]),
+            ("effective_concurrency", ll["effective_concurrency"]),
+            ("utilization_of_cap", ll["utilization_of_cap"])):
+        _add(fam, f"srt_capacity_{name}", "gauge", {}, value)
+    if hbm["headroom_fraction"] is not None:
+        _add(fam, "srt_capacity_hbm_headroom_fraction", "gauge", {},
+             hbm["headroom_fraction"])
+    for cand in candidates:
+        _add(fam, "srt_capacity_advice", "gauge",
+             {"action": cand["action"]}, cand["severity"])
+
+
 def prometheus_text() -> str:
     """The ``/metrics`` body: registry metrics + live-query gauges."""
     from . import live
@@ -247,6 +290,7 @@ def prometheus_text() -> str:
         for shard, done in q["shard_batches"].items():
             _add(fam, "srt_live_query_shard_batches", "gauge",
                  {"query_id": q["query_id"], "shard": shard}, done)
+    capacity_gauges(fam)
 
     lines: List[str] = []
     for name, (kind, samples) in fam.items():
@@ -298,6 +342,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if path == "/queries":
                 body = json.dumps(live.snapshot_all(), sort_keys=True)
+                self._send(200, body.encode(), "application/json")
+                return
+            if path == "/capacity":
+                from . import capacity
+                body = json.dumps(capacity.advise(), sort_keys=True)
                 self._send(200, body.encode(), "application/json")
                 return
             m = _TIMELINE_RE.match(path)

@@ -132,10 +132,14 @@ def _flight_add(name: str, cat: str, start_us: float, dur_us: float,
     """Mirror one finished event into the always-on flight-recorder ring
     (obs/flight.py, ``SRT_METRICS=1``).  Lazy by the usual rule: the
     recorder module is only imported when it is already loaded or the
-    env flag asks for it, so the metrics-off path pays one env read."""
+    env flag asks for it, so the metrics-off path pays one env read.
+    sys.modules can hand back a module another worker thread is still
+    executing (the peek bypasses the import lock), so a partial module
+    — no ``record`` yet — falls through to a real import, which blocks
+    until that thread finishes initialising it."""
     import sys
     fl = sys.modules.get(__package__ + ".flight")
-    if fl is None:
+    if fl is None or getattr(fl, "record", None) is None:
         from ..config import metrics_enabled
         if not metrics_enabled():
             return
@@ -146,10 +150,11 @@ def _flight_add(name: str, cat: str, start_us: float, dur_us: float,
 def _flight_scope(name: str, cat: str, lane: Optional[str],
                   args: Dict[str, Any]):
     """Flight-recorder span for a :func:`span` call while the timeline
-    itself is off, or None (same lazy-import discipline)."""
+    itself is off, or None (same lazy-import and partial-module
+    discipline as :func:`_flight_add`)."""
     import sys
     fl = sys.modules.get(__package__ + ".flight")
-    if fl is None:
+    if fl is None or getattr(fl, "trace_span", None) is None:
         from ..config import metrics_enabled
         if not metrics_enabled():
             return None

@@ -65,6 +65,8 @@ class AdmissionController:
         if self.budget is not None and estimate > self.budget:
             from ..obs.metrics import counter
             counter("serve.admission.rejected").inc()
+            from ..obs import capacity
+            capacity.feed_admission_reject(estimate)
             raise AdmissionRejected(
                 f"estimated HBM peak of {estimate} bytes exceeds the "
                 f"serving budget of {self.budget} bytes "
@@ -80,16 +82,19 @@ class AdmissionController:
                 self._claimed += max(estimate, 0)
             return False
         waited = False
+        from ..obs import capacity
         from ..obs.metrics import counter, gauge
         with self._cond:
             while self._claimed and self._claimed + estimate > self.budget:
                 if not waited:
                     waited = True
                     counter("serve.admission.hbm_waits").inc()
+                    capacity.feed_admission_wait()
                 self._cond.wait(0.05)
             self._claims[ticket_id] = estimate
             self._claimed += estimate
             gauge("serve.hbm_claimed_bytes").set(self._claimed)
+            capacity.feed_hbm(self._claimed)
         return waited
 
     def release(self, ticket_id: int) -> None:
@@ -100,6 +105,8 @@ class AdmissionController:
             if self.budget is not None:
                 from ..obs.metrics import gauge
                 gauge("serve.hbm_claimed_bytes").set(self._claimed)
+                from ..obs import capacity
+                capacity.feed_hbm(self._claimed)
             self._cond.notify_all()
 
     def claimed_bytes(self) -> int:

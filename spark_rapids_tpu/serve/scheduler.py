@@ -285,6 +285,8 @@ class QuerySession:
                 counter("serve.queued").inc()
             self._queue.append(t)
             gauge("serve.queue_depth").set(len(self._queue))
+            from ..obs import capacity as _capacity
+            _capacity.feed_queue_depth(len(self._queue))
             self._spawn_locked()
             self._cond.notify()
         return t
@@ -328,6 +330,8 @@ class QuerySession:
                     return          # closed and drained
                 t = self._queue.popleft()
                 gauge("serve.queue_depth").set(len(self._queue))
+                from ..obs import capacity as _capacity
+                _capacity.feed_queue_depth(len(self._queue))
                 self._running += 1
                 gauge("serve.running").set(self._running)
             try:
@@ -347,6 +351,8 @@ class QuerySession:
         from ..obs import server as _server
         _server.observe_hist("serve_queue_wait_seconds",
                              t.queue_wait_seconds)
+        from ..obs import capacity as _capacity
+        _capacity.feed_queue_wait(t.queue_wait_seconds)
         counter("serve.admitted").inc()
         t.status = "running"
         gate = None

@@ -1,0 +1,542 @@
+"""Capacity accountant + autoscaling advisor (obs/capacity.py) and its
+surfaces (``/capacity``, ``srt_capacity_*`` gauges, ``obs advisor``).
+
+Five contracts:
+
+1. **Pure math** — busy-seconds union-merge (overlaps and the dist
+   fan-out count once), Little's-law effective concurrency, nearest-rank
+   percentiles, and trend are plain functions over explicit inputs:
+   zero-traffic, single-query, and saturated synthetic windows all
+   derive well-defined observables.
+2. **Deterministic advice with hysteresis** — ``recommend`` is a pure
+   ranked mapping of snapshot → evidence-cited actions; ``Advisor``
+   surfaces an action only after ``confirm`` consecutive windows and
+   clears it only after ``clear`` absent ones, so flapping candidates
+   never reach the operator.
+3. **Gated feeds** — every ``feed_*`` is a no-op unless ``SRT_METRICS=1``
+   and the accountant survives concurrent feeding while being scraped.
+4. **Surfaces** — ``/capacity`` serves the advisor payload,
+   ``/metrics`` exports ``srt_capacity_*`` gauges and
+   ``srt_live_recent_evictions_total``, bundles carry a ``capacity``
+   block the doctor renders, and the offline history replay drives the
+   same derive/recommend core.
+5. **Knob + state hygiene** — the new knobs raise knob-named
+   ValueErrors, and ``reset()`` / ``server.reset_histograms()`` give
+   back-to-back lanes a clean slate.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from spark_rapids_tpu import config
+from spark_rapids_tpu.obs import capacity
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.obs.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for knob in ("SRT_CAPACITY_WINDOW_S", "SRT_CAPACITY_TARGETS",
+                 "SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
+                 "SRT_RESULT_CACHE", "SRT_LIVE_RECENT"):
+        monkeypatch.delenv(knob, raising=False)
+    capacity.reset()
+    registry().reset()
+    server.reset_histograms()
+    yield
+    capacity.reset()
+    registry().reset()
+    server.reset_histograms()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    yield
+
+
+@pytest.fixture
+def metrics_off(monkeypatch):
+    monkeypatch.delenv("SRT_METRICS", raising=False)
+
+
+def _derive(events, w0=0.0, w1=10.0, max_concurrent=4, hbm_budget=None,
+            result_cache_on=False):
+    return capacity.derive(events, w0, w1, max_concurrent=max_concurrent,
+                           hbm_budget=hbm_budget,
+                           result_cache_on=result_cache_on)
+
+
+# -- pure math ---------------------------------------------------------
+
+
+def test_merged_busy_counts_overlaps_once():
+    # Two workers concurrently busy 1..3 and 2..4: union is 1..4 = 3s,
+    # not 4s — this is what keeps busy fraction <= 1 under the dist
+    # path's 8-way fan-out of identical spans.
+    assert capacity.merged_busy_seconds(
+        [(1.0, 3.0), (2.0, 4.0)], 0.0, 10.0) == pytest.approx(3.0)
+    # The fan-out case literally: 8 copies of one interval.
+    assert capacity.merged_busy_seconds(
+        [(1.0, 2.0)] * 8, 0.0, 10.0) == pytest.approx(1.0)
+
+
+def test_merged_busy_clips_to_window():
+    # A span straddling the window start only counts its in-window part.
+    assert capacity.merged_busy_seconds(
+        [(-5.0, 5.0)], 0.0, 10.0) == pytest.approx(5.0)
+    assert capacity.merged_busy_seconds([], 0.0, 10.0) == 0.0
+
+
+def test_littles_law_effective_concurrency():
+    # 4 queries of 5s each inside a 10s window: L = 20/10 = 2 queries
+    # concurrently in service on average.
+    assert capacity.effective_concurrency(
+        [5.0] * 4, 10.0) == pytest.approx(2.0)
+    assert capacity.effective_concurrency([], 10.0) == 0.0
+
+
+def test_percentile_nearest_rank():
+    xs = [0.1, 0.2, 0.3, 0.4, 0.5]
+    assert capacity.percentile(xs, 50.0) == pytest.approx(0.3)
+    assert capacity.percentile(xs, 95.0) == pytest.approx(0.5)
+    assert capacity.percentile([], 95.0) is None
+
+
+def test_trend_is_second_half_minus_first_half():
+    rising = [(1.0, 0.1), (2.0, 0.1), (8.0, 0.5), (9.0, 0.5)]
+    assert capacity.trend(rising, 0.0, 10.0) == pytest.approx(0.4)
+    assert capacity.trend([(1.0, 1.0)], 0.0, 10.0) == 0.0  # one half empty
+
+
+# -- derive over synthetic windows -------------------------------------
+
+
+def test_zero_traffic_window_is_well_defined():
+    snap = _derive({})
+    assert snap["busy"]["dispatch_fraction"] == 0.0
+    assert snap["queue"]["waits"] == 0
+    assert snap["littles_law"]["effective_concurrency"] == 0.0
+    assert snap["littles_law"]["utilization_of_cap"] == 0.0
+    assert snap["hbm"]["headroom_fraction"] is None
+    assert capacity.recommend(snap) == []
+
+
+def test_single_query_window():
+    events = {
+        "dispatch": [(2.0, 5.0)],
+        "completions": [(5.0, "table", 4.0, "fpA")],
+    }
+    snap = _derive(events)
+    assert snap["busy"]["dispatch_fraction"] == pytest.approx(0.3)
+    assert snap["littles_law"]["completions"] == 1
+    assert snap["littles_law"]["effective_concurrency"] == \
+        pytest.approx(0.4)
+    # One healthy query earns no advice.
+    assert capacity.recommend(snap) == []
+
+
+def test_saturated_window_recommends_raise_workers():
+    # Cap of 1 fully utilized, queue backing up, device has headroom.
+    events = {
+        "dispatch": [(float(i), i + 0.4) for i in range(10)],
+        "queue_waits": [(float(i), 0.5 + 0.1 * i) for i in range(10)],
+        "queue_depths": [(9.0, 4)],
+        "completions": [(float(i), "table", 1.0, f"fp{i}")
+                        for i in range(10)],
+    }
+    snap = _derive(events, max_concurrent=1)
+    assert 0.0 < snap["busy"]["dispatch_fraction"] <= 1.0
+    assert snap["littles_law"]["utilization_of_cap"] == 1.0
+    recs = capacity.recommend(snap)
+    actions = [r["action"] for r in recs]
+    assert "raise_workers" in actions
+    top = recs[actions.index("raise_workers")]
+    # Evidence cites the observables that triggered the action.
+    assert top["evidence"]["max_concurrent"] == 1
+    assert top["evidence"]["queue_waits"] == 10
+
+
+def test_saturated_device_recommends_shed_load():
+    events = {
+        "dispatch": [(0.0, 9.9)],
+        "queue_waits": [(1.0, 0.3), (2.0, 0.3), (8.0, 1.0), (9.0, 1.2)],
+        "queue_depths": [(9.0, 6)],
+        "completions": [(9.0, "table", 9.0, "fpA")],
+    }
+    snap = _derive(events, max_concurrent=1)
+    recs = capacity.recommend(snap)
+    assert recs and recs[0]["action"] == "shed_load"
+    assert recs[0]["severity"] == 90
+    # raise_workers must NOT fire when the device itself is the
+    # bottleneck.
+    assert "raise_workers" not in [r["action"] for r in recs]
+
+
+def test_admission_pressure_recommends_grow_hbm_budget():
+    events = {"admission": [(1.0, "wait", 0), (2.0, "reject", 512)],
+              "hbm": [(1.0, 950), (2.0, 980)]}
+    snap = _derive(events, hbm_budget=1000)
+    assert snap["hbm"]["headroom_fraction"] == pytest.approx(0.02)
+    recs = capacity.recommend(snap)
+    assert [r["action"] for r in recs] == ["grow_hbm_budget"]
+    assert recs[0]["evidence"]["rejected_bytes"] == 512
+
+
+def test_repeated_plans_without_cache_recommend_result_cache():
+    events = {"completions": [(1.0, "table", 0.1, "fpA"),
+                              (2.0, "table", 0.1, "fpA"),
+                              (3.0, "table", 0.1, "fpB")]}
+    snap = _derive(events, result_cache_on=False)
+    assert snap["repeated_fingerprints"] == ["fpA"]
+    assert "enable_result_cache" in \
+        [r["action"] for r in capacity.recommend(snap)]
+    # With the cache on the advice disappears.
+    snap_on = _derive(events, result_cache_on=True)
+    assert "enable_result_cache" not in \
+        [r["action"] for r in capacity.recommend(snap_on)]
+
+
+def test_idle_pool_recommends_lower_workers():
+    events = {"dispatch": [(1.0, 1.1)],
+              "completions": [(1.1, "table", 0.1, "fpA")]}
+    snap = _derive(events, max_concurrent=8)
+    recs = capacity.recommend(snap)
+    assert [r["action"] for r in recs] == ["lower_workers"]
+
+
+def test_recommend_is_deterministic_and_ranked():
+    events = {
+        "dispatch": [(float(i), i + 0.2) for i in range(10)],
+        "queue_waits": [(float(i), 0.6) for i in range(10)],
+        "queue_depths": [(9.0, 3)],
+        "admission": [(5.0, "wait", 0)],
+        "completions": [(float(i), "table", 1.0, "fpA")
+                        for i in range(10)],
+    }
+    snap = _derive(events, max_concurrent=1)
+    a = capacity.recommend(snap)
+    b = capacity.recommend(snap)
+    assert a == b
+    assert [r["severity"] for r in a] == \
+        sorted((r["severity"] for r in a), reverse=True)
+
+
+def test_targets_override_changes_thresholds():
+    events = {"dispatch": [(1.0, 1.1)],
+              "completions": [(1.1, "table", 0.1, "fpA")]}
+    snap = _derive(events, max_concurrent=8)
+    # Idle pool at the defaults → lower_workers; tightening util_low to
+    # zero silences it — the targets override is honored.
+    assert [r["action"] for r in capacity.recommend(snap)] == \
+        ["lower_workers"]
+    assert capacity.recommend(snap, {"util_low": 0.0}) == []
+
+
+# -- hysteresis --------------------------------------------------------
+
+
+CAND = {"action": "raise_workers", "severity": 80, "reason": "r",
+        "evidence": {}}
+
+
+def test_advisor_confirms_after_n_windows():
+    adv = capacity.Advisor(confirm=2, clear=2)
+    assert adv.observe([CAND]) == []          # 1st sighting: not yet
+    assert adv.observe([CAND]) == [CAND]      # 2nd: confirmed
+    assert adv.observe([CAND]) == [CAND]
+
+
+def test_advisor_flapping_candidate_never_surfaces():
+    adv = capacity.Advisor(confirm=2, clear=2)
+    for _ in range(6):                        # present, absent, present…
+        assert adv.observe([CAND]) == []
+        adv.observe([])
+    # The absent window resets the streak each time, so a candidate
+    # alternating window-to-window is never recommended.
+
+
+def test_advisor_clears_after_n_quiet_windows():
+    adv = capacity.Advisor(confirm=1, clear=2)
+    assert adv.observe([CAND]) == [CAND]
+    assert adv.observe([]) == [CAND]          # 1 quiet window: sticky
+    assert adv.observe([]) == []              # 2nd: cleared
+    assert adv.observe([]) == []
+
+
+def test_verdict_for():
+    assert capacity.verdict_for([]) == "healthy"
+    assert capacity.verdict_for([CAND]) == "saturated"
+    assert capacity.verdict_for(
+        [{"action": "grow_hbm_budget", "severity": 70}]) == "pressured"
+    assert capacity.verdict_for(
+        [{"action": "lower_workers", "severity": 30}]) == "underutilized"
+
+
+# -- feeds, gating, concurrency ----------------------------------------
+
+
+def test_feeds_are_noops_when_metrics_off(metrics_off):
+    capacity.feed_span("run.dispatch", 0.0, 1e6)
+    capacity.feed_queue_wait(1.0)
+    capacity.feed_queue_depth(5)
+    capacity.feed_admission_wait()
+    capacity.feed_admission_reject(100)
+    capacity.feed_hbm(100)
+    capacity.feed_completion("table", 1.0, "fp")
+    snap = capacity.snapshot(window_s=3600)
+    assert snap["littles_law"]["completions"] == 0
+    assert snap["queue"]["waits"] == 0
+    assert snap["busy"]["dispatch_spans"] == 0
+
+
+def test_feed_span_filters_non_dispatch_names(metrics_on):
+    # Feed timestamps share timeline.now_us()'s perf_counter base, so
+    # the synthetic spans must be now-relative to land in the window.
+    import time
+    now_us = time.perf_counter() * 1e6
+    capacity.feed_span("scan.parquet", now_us - 2e6, 1e6)  # not metered
+    capacity.feed_span("run.dispatch", now_us - 2e6, 1e6)
+    capacity.feed_span("stream.materialize", now_us - 2e6, 1e6)
+    snap = capacity.snapshot(window_s=3600)
+    assert snap["busy"]["dispatch_spans"] == 1
+    assert snap["busy"]["materialize_spans"] == 1
+
+
+def test_feed_span_classifies_combine_path_names(metrics_on):
+    # The combine-path dist stream's device walls are named
+    # stream.partial / stream.combine / stream.merge_collective, and
+    # its device->host wall stream.finalize; backpressure is a wait,
+    # not device work, and must stay out of the busy math.
+    import time
+    now_us = time.perf_counter() * 1e6
+    for name in ("stream.partial", "stream.combine",
+                 "stream.merge_collective"):
+        capacity.feed_span(name, now_us - 5e6, 1e6)
+    capacity.feed_span("stream.finalize", now_us - 2e6, 1e6)
+    capacity.feed_span("stream.backpressure", now_us - 2e6, 1e6)
+    snap = capacity.snapshot(window_s=3600)
+    assert snap["busy"]["dispatch_spans"] == 3
+    assert snap["busy"]["materialize_spans"] == 1
+
+
+def test_flight_span_feeds_capacity(metrics_on):
+    # The timeline-off serving configuration: spans reach the
+    # accountant through the flight recorder's scope path.
+    from spark_rapids_tpu.obs import flight, timeline
+    with timeline.query_scope(424242):
+        span = flight.trace_span("run.dispatch", {})
+        assert span is not None
+        span.end()
+    snap = capacity.snapshot(window_s=3600)
+    assert snap["busy"]["dispatch_spans"] == 1
+
+
+def test_concurrent_feeding_while_scraping(metrics_on):
+    # Feeder threads hammer every feed while scrapers render /metrics
+    # text and advisor payloads — no exceptions, consistent output.
+    stop = threading.Event()
+    errors = []
+
+    def feeder():
+        i = 0
+        while not stop.is_set():
+            capacity.feed_span("run.dispatch", i * 1e3, 5e2)
+            capacity.feed_queue_wait(0.01)
+            capacity.feed_queue_depth(i % 7)
+            capacity.feed_hbm(i)
+            capacity.feed_completion("table", 0.01, f"fp{i % 3}")
+            i += 1
+
+    def scraper():
+        # 8 full advise+exposition rounds against 3 hammering feeders is
+        # plenty of interleaving; 50 rounds cost ~35s of suite time.
+        try:
+            for _ in range(8):
+                payload = capacity.advise(window_s=5.0)
+                assert 0.0 <= payload["snapshot"]["busy"][
+                    "dispatch_fraction"] <= 1.0
+                text = server.prometheus_text()
+                assert "srt_capacity_busy_fraction" in text
+        except Exception as exc:       # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=feeder) for _ in range(3)]
+    threads += [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads[3:]:
+        t.join(timeout=60)
+    stop.set()
+    for t in threads[:3]:
+        t.join(timeout=10)
+    assert not errors, errors
+
+
+# -- surfaces ----------------------------------------------------------
+
+
+def test_capacity_endpoint_and_gauges(metrics_on):
+    import time
+    capacity.feed_span("run.dispatch",
+                       time.perf_counter() * 1e6 - 3e6, 2e6)
+    capacity.feed_queue_wait(0.4)
+    capacity.feed_completion("table", 0.5, "fpA")
+    capacity.feed_completion("table", 0.5, "fpA")
+    srv = server.start(port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/capacity",
+                                    timeout=5) as resp:
+            payload = json.loads(resp.read().decode())
+        assert set(payload) == {"snapshot", "candidates",
+                                "recommendations", "verdict"}
+        assert payload["snapshot"]["littles_law"]["completions"] == 2
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert "srt_capacity_busy_fraction" in text
+        assert "srt_capacity_effective_concurrency" in text
+        assert "# TYPE srt_capacity_busy_fraction gauge" in text
+    finally:
+        server.stop()
+
+
+def test_metrics_scrape_does_not_advance_hysteresis(metrics_on):
+    # /metrics must be a read-only observer: repeated scrapes never
+    # confirm an action into the advisor's stable set.
+    capacity.feed_completion("table", 0.1, "fpA")
+    capacity.feed_completion("table", 0.1, "fpA")
+    for _ in range(5):
+        server.prometheus_text()
+    payload = capacity.advise(window_s=3600)
+    # First real advise(): the enable_result_cache candidate is fresh
+    # (streak 1), so it cannot be confirmed yet.
+    assert payload["candidates"]
+    assert payload["recommendations"] == []
+
+
+def test_advise_confirms_across_evaluations(metrics_on):
+    capacity.feed_completion("table", 0.1, "fpA")
+    capacity.feed_completion("table", 0.1, "fpA")
+    first = capacity.advise(window_s=3600)
+    second = capacity.advise(window_s=3600)
+    assert first["recommendations"] == []
+    assert "enable_result_cache" in \
+        [r["action"] for r in second["recommendations"]]
+    assert second["verdict"] == "pressured"
+
+
+def test_bundle_carries_capacity_block(metrics_on):
+    from spark_rapids_tpu.obs import bundle
+    capacity.feed_completion("table", 0.1, "fpA")
+    payload = bundle.build("failure")
+    assert set(payload["capacity"]) == {"snapshot", "recommendations",
+                                        "verdict"}
+    from spark_rapids_tpu.obs.doctor import diagnose
+    report = diagnose(payload)
+    assert "verdict" in report          # old bundles (no block) also fine
+    assert diagnose({"metric": "postmortem_bundle", "error": {},
+                     "recovery": {}, "slo": {}, "metrics": {},
+                     "fingerprint": ""})["verdict"]
+
+
+def test_render_advisor_is_pure():
+    from spark_rapids_tpu.obs.__main__ import render_advisor
+    payload = {
+        "verdict": "saturated",
+        "snapshot": _derive({"dispatch": [(0.0, 5.0)]}),
+        "candidates": [],
+        "recommendations": [dict(CAND, evidence={"busy_fraction": 0.9})],
+    }
+    out = render_advisor(payload, source="test")
+    assert "verdict=saturated" in out
+    assert "raise_workers" in out
+    assert "busy_fraction=0.9" in out
+    empty = render_advisor({"verdict": "healthy", "snapshot": _derive({}),
+                            "candidates": [], "recommendations": []})
+    assert "none — capacity looks healthy" in empty
+
+
+def test_offline_history_replay(tmp_path, metrics_on, monkeypatch):
+    monkeypatch.setenv("SRT_SERVE_MAX_CONCURRENT", "1")
+    path = tmp_path / "hist.jsonl"
+    recs = [{"fingerprint": "fpA", "mode": "table", "total_seconds": 1.0,
+             "timings": {"execute_seconds": 0.9},
+             "serve": {"queue_wait_seconds": 0.5, "admission": "queued"},
+             "cost": {"hbm": {"peak_bytes": 1 << 20}}}] * 5
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    from spark_rapids_tpu.obs.__main__ import _advise_history
+    payload = _advise_history(str(path), last=256)
+    snap = payload["snapshot"]
+    assert 0.0 < snap["busy"]["dispatch_fraction"] <= 1.0
+    assert snap["littles_law"]["completions"] == 5
+    assert payload["recommendations"], payload
+    # events_from_history lays records back-to-back: 5 x 1s.
+    events, w0, w1 = capacity.events_from_history(recs)
+    assert w1 - w0 == pytest.approx(5.0)
+    assert len(events["dispatch"]) == 5
+
+
+# -- satellites: histogram reset + eviction counter --------------------
+
+
+def test_reset_histograms_isolates_lanes(metrics_on):
+    server.observe_hist("query_seconds", 0.5, {"mode": "table"})
+    assert "srt_query_seconds_bucket" in "\n".join(server.histogram_text())
+    server.reset_histograms()
+    # A back-to-back bench lane starts from zero observations.
+    assert server.histogram_text() == []
+    server.observe_hist("query_seconds", 0.1, {"mode": "table"})
+    text = "\n".join(server.histogram_text())
+    assert "srt_query_seconds_count" in text
+    assert 'srt_query_seconds_count{mode="table"} 1' in text
+
+
+def test_recent_evictions_counter(metrics_on, monkeypatch):
+    from spark_rapids_tpu.obs import live
+    monkeypatch.setenv("SRT_LIVE_RECENT", "2")
+    live.reset()
+    try:
+        for i in range(5):
+            live.start("table", force=True).finish()
+        # 5 finishes with keep=2: 3 evictions counted.
+        assert registry().counter("live.recent_evictions").value == 3
+        assert "srt_live_recent_evictions_total 3" in \
+            server.prometheus_text()
+    finally:
+        live.reset()
+
+
+# -- knob hygiene ------------------------------------------------------
+
+
+def test_capacity_window_knob(monkeypatch):
+    assert config.capacity_window_s() == 60.0
+    monkeypatch.setenv("SRT_CAPACITY_WINDOW_S", "12.5")
+    assert config.capacity_window_s() == 12.5
+    monkeypatch.setenv("SRT_CAPACITY_WINDOW_S", "0")
+    with pytest.raises(ValueError, match="SRT_CAPACITY_WINDOW_S"):
+        config.capacity_window_s()
+    monkeypatch.setenv("SRT_CAPACITY_WINDOW_S", "soon")
+    with pytest.raises(ValueError, match="SRT_CAPACITY_WINDOW_S"):
+        config.capacity_window_s()
+
+
+def test_capacity_targets_knob(monkeypatch):
+    assert config.capacity_targets() == capacity.TARGET_DEFAULTS
+    monkeypatch.setenv("SRT_CAPACITY_TARGETS",
+                       "busy_high=0.9, wait_s=0.5")
+    t = config.capacity_targets()
+    assert t["busy_high"] == 0.9 and t["wait_s"] == 0.5
+    assert t["busy_low"] == capacity.TARGET_DEFAULTS["busy_low"]
+    monkeypatch.setenv("SRT_CAPACITY_TARGETS", "warp_factor=9")
+    with pytest.raises(ValueError, match="SRT_CAPACITY_TARGETS"):
+        config.capacity_targets()
+    monkeypatch.setenv("SRT_CAPACITY_TARGETS", "busy_high=very")
+    with pytest.raises(ValueError, match="SRT_CAPACITY_TARGETS"):
+        config.capacity_targets()

@@ -8,6 +8,7 @@ therefore import-time only for explicitly-configured accelerator
 platforms and otherwise deferred to the engine's first compile.
 """
 
+import json
 import subprocess
 import sys
 
@@ -294,6 +295,48 @@ def test_flight_bundle_doctor_import_without_jax(tmp_path):
     for k in ("SRT_METRICS", "SRT_SLO_MS", "SRT_METRICS_HISTORY"):
         env.pop(k, None)
     env["SRT_BUNDLE_DIR"] = str(bdir)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
+def test_capacity_advisor_import_without_jax(tmp_path):
+    """The capacity accountant + advisor (obs.capacity) must work
+    without jax: saturation math and autoscaling advice are exactly what
+    a fleet-controller sidecar evaluates, and it never runs queries.
+    The offline CLI path over a metrics-history JSONL is jax-free too."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text(json.dumps({
+        "fingerprint": "fpA", "mode": "table", "total_seconds": 1.0,
+        "timings": {"execute_seconds": 0.9},
+        "serve": {"queue_wait_seconds": 0.5, "admission": "queued"},
+        "cost": {"hbm": {"peak_bytes": 1048576}}}) + "\n")
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.capacity as capacity\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.capacity pulled in jax'\n"
+        "capacity.feed_completion('table', 0.1, 'fp')  # SRT_METRICS unset\n"
+        "snap = capacity.snapshot(window_s=60)\n"
+        "assert snap['littles_law']['completions'] == 0\n"
+        "assert capacity.recommend(snap) == []\n"
+        "import spark_rapids_tpu.obs.__main__ as cli\n"
+        f"payload = cli._advise_history({str(hist)!r}, last=16)\n"
+        "assert payload['snapshot']['littles_law']['completions'] == 1\n"
+        "assert 'jax' not in sys.modules, 'the advisor path pulled jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    for k in ("SRT_METRICS", "SRT_CAPACITY_WINDOW_S",
+              "SRT_CAPACITY_TARGETS"):
+        env.pop(k, None)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
