@@ -39,6 +39,14 @@ capacity wall seconds, overhead fraction, busy fraction, effective
 concurrency, advisor verdict) and exits nonzero when the measured
 overhead busts the accountant's 2% budget.
 
+``--workload`` additionally times a deliberately-overlapping two-plan
+mini-bank (shared filter+project prefix, divergent aggregations) with
+the workload analyzer's completion feed live against a metered baseline
+whose ``feed_*`` hooks are no-ops, then runs one workload evaluation
+over the window those runs fed.  Appends a ``workload`` JSON line (top
+op hotspot, top subplan overlap candidate, muted-vs-live overhead) and
+exits nonzero when the measured overhead busts the analyzer's 2% budget.
+
 ``--faults`` additionally arms a deterministic HBM-OOM injection
 (``SRT_FAULT=oom:materialize:1`` unless the env already sets a spec),
 runs one mesh join+agg with a shard-targeted dist-dispatch OOM recovered
@@ -182,6 +190,8 @@ def main():
         bench_flight(lineitem)
     if "--capacity" in sys.argv:
         bench_capacity(lineitem)
+    if "--workload" in sys.argv:
+        bench_workload(lineitem)
 
     from spark_rapids_tpu.config import metrics_enabled
     if metrics_enabled():
@@ -704,6 +714,136 @@ def bench_capacity(lineitem, n_batches=8):
             f"capacity accountant overhead {frac:.2%} "
             f"({over * 1e3:.1f} ms on a {base_s:.3f}s baseline) exceeds "
             f"the {CAPACITY_OVERHEAD_BUDGET:.0%} budget")
+
+
+#: The workload analyzer's measured-overhead budget (fraction of a
+#: metered run) — the contract obs/workload.py documents and CI
+#: enforces, same shape as the capacity accountant's.
+WORKLOAD_OVERHEAD_BUDGET = 0.02
+
+
+def bench_workload(lineitem, rows=1_000_000):
+    """``--workload``: marginal wall-clock cost of the workload
+    analyzer's completion feed on a deliberately-overlapping mini-bank
+    (two one-shot plans sharing a filter+project prefix with divergent
+    aggregations — the fragment-cache motivating shape), plus one
+    workload evaluation over the window the live rounds fed.  Both
+    passes run with ``SRT_METRICS=1`` — the baseline swaps every
+    ``workload.feed_*`` for no-ops so the comparison isolates the
+    normalize+append feed from the rest of the telemetry stack.  Emits
+    the ``workload`` JSON line (top hotspot, top overlap candidate,
+    muted-vs-live overhead) and exits nonzero past
+    :data:`WORKLOAD_OVERHEAD_BUDGET`."""
+    import os
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.config import workload_topk
+    from spark_rapids_tpu.exec import col, plan
+    from spark_rapids_tpu.obs import workload
+
+    sub = srt.Table([(nm, Column(data=c.data[:rows],
+                                 validity=None if c.validity is None
+                                 else c.validity[:rows], dtype=c.dtype))
+                     for nm, c in lineitem.items()])
+
+    # Shared filter+project prefix, divergent tails: the canonical
+    # overlap-candidate shape the miner must surface.
+    prefix = (plan()
+              .filter(col("shipdate") <= 10_500)
+              .with_columns(disc_price=col("price") * (1 - col("disc"))))
+    # Both tails consume the same column set so the optimizer's pruning
+    # projection is identical and the shared prefix keeps one
+    # fingerprint across both plans (plans=2 in the overlap evidence).
+    pa = prefix.groupby_agg(["flag", "status"],
+                            [("disc_price", "sum", "rev"),
+                             ("qty", "count", "n")])
+    pb = prefix.groupby_agg(["status", "flag"],
+                            [("disc_price", "max", "top_rev"),
+                             ("qty", "sum", "sum_qty")])
+
+    def run():
+        pa.run(sub)
+        pb.run(sub)
+
+    def timed_once():
+        t0 = time.perf_counter()
+        run()
+        return time.perf_counter() - t0
+
+    feed_names = [n for n in dir(workload) if n.startswith("feed_")]
+    real_feeds = {n: getattr(workload, n) for n in feed_names}
+
+    def noop(*a, **k):
+        return []
+
+    def mute():
+        for n in feed_names:
+            setattr(workload, n, noop)
+
+    def unmute():
+        for n, f in real_feeds.items():
+            setattr(workload, n, f)
+
+    had = os.environ.get("SRT_METRICS")
+    os.environ["SRT_METRICS"] = "1"
+    try:
+        mute()
+        run()                       # warm metered compile, analyzer mute
+        unmute()
+        workload.reset()
+        run()                       # warm the analyzer-live path
+
+        # Interleave muted/live rounds and keep each side's min (same
+        # discipline as the flight/capacity lanes: the feed's true cost
+        # is step normalization + a deque append, far below run jitter).
+        base_s = wl_s = float("inf")
+        t_loop0 = time.perf_counter()
+        for _ in range(5):
+            mute()
+            base_s = min(base_s, timed_once())
+            unmute()
+            wl_s = min(wl_s, timed_once())
+
+        # One workload evaluation over the window the live rounds fed —
+        # one-shot (confirm=1): a bench lane has no repeated windows.
+        window = max(time.perf_counter() - t_loop0 + 1.0, 10.0)
+        snap = workload.snapshot(window_s=window)
+        candidates = workload.recommend(snap)
+        recs = workload.Advisor(confirm=1, clear=1).observe(candidates)
+        verdict = workload.verdict_for(recs if recs else candidates)
+    finally:
+        for n, f in real_feeds.items():
+            setattr(workload, n, f)
+        if had is None:
+            os.environ.pop("SRT_METRICS", None)
+        else:
+            os.environ["SRT_METRICS"] = had
+
+    hotspots = snap.get("hotspots") or []
+    overlaps = snap.get("overlaps") or []
+    over = max(wl_s - base_s, 0.0)
+    frac = over / base_s
+    emit(json.dumps({
+        "metric": "workload",
+        "base_seconds": round(base_s, 6),
+        "workload_seconds": round(wl_s, 6),
+        "overhead_frac": round(frac, 6),
+        "queries": snap["queries"],
+        "plans": snap["plans"],
+        "topk": workload_topk(),
+        "top_hotspot": hotspots[0] if hotspots else None,
+        "top_overlap": overlaps[0] if overlaps else None,
+        "advisor_verdict": verdict,
+        "recommendations": [r["action"] for r in recs]},
+        sort_keys=True))
+    # Gate like the flight/capacity lanes, with the same absolute floor
+    # so sub-10ms timer jitter on a fast baseline cannot flake the lane.
+    if frac > WORKLOAD_OVERHEAD_BUDGET and over > 0.01:
+        raise SystemExit(
+            f"workload analyzer overhead {frac:.2%} "
+            f"({over * 1e3:.1f} ms on a {base_s:.3f}s baseline) exceeds "
+            f"the {WORKLOAD_OVERHEAD_BUDGET:.0%} budget")
 
 
 def bench_dist_stream(lineitem, n_batches=8, batch_rows=200_000):

@@ -17,7 +17,7 @@ mkdir -p "$(dirname "$BENCH_OUT")"
 # Benchmarks want the real device; skip gracefully on CPU-only runners.
 if python -c 'import jax; assert jax.default_backend() != "cpu"' 2>/dev/null; then
     python bench.py | tee -a "$BENCH_OUT"
-    python benchmarks/bench_queries.py --capacity | tee -a "$BENCH_OUT"
+    python benchmarks/bench_queries.py --capacity --workload | tee -a "$BENCH_OUT"
 else
     echo "nightly: no accelerator on this runner; benchmarks skipped"
 fi

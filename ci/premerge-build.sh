@@ -666,6 +666,174 @@ assert line["advisor_verdict"], line
 print("bench capacity lane ok:", json.dumps(line, sort_keys=True))
 EOF
 
+# Workload lane: an overlapping mini-bank (shared broadcast-join prefix,
+# divergent filters) through the serving scheduler so the workload
+# analyzer has cross-query structure to mine.  Mid-run, /workload must
+# rank Filter as the dominant hotspot kind (pa carries two unfusable
+# Filter steps, pb one, so Filter strictly leads under the analyzer's
+# uniform attribution), surface the shared join prefix as a cross-plan
+# overlap candidate, and — after a second window — carry it through the
+# advisor's confirm-2 hysteresis into stable recommendations; the
+# srt_workload_* gauges must be on /metrics; and both the live-url and
+# offline-history forms of `obs workload` must exit 0.
+mkdir -p artifacts
+rm -f artifacts/premerge-workload-history.jsonl
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 SRT_RESULT_CACHE=0 SRT_WORKLOAD_WINDOW_S=60 \
+SRT_METRICS_HISTORY=artifacts/premerge-workload-history.jsonl \
+SRT_LIVE_SERVER=1 SRT_LIVE_PORT=0 \
+python - <<'EOF'
+import json
+import subprocess
+import sys
+import urllib.request
+import numpy as np
+from spark_rapids_tpu import Column, Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import server
+from spark_rapids_tpu.serve import QuerySession
+
+r = np.random.default_rng(23)
+n = 65_536
+table = Table({
+    "k": Column.from_numpy(r.integers(0, 4, n).astype(np.int64)),
+    "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64)),
+})
+dim = Table({
+    "dk": Column.from_numpy(np.arange(4, dtype=np.int64)),
+    "grp": Column.from_numpy(np.array([0, 1, 0, 1], dtype=np.int64)),
+})
+# Shared leading join (identical step text in both plans), divergent
+# filters after it.  pa's second filter references the computed column
+# w, so pushdown cannot hoist it and the two Filter steps survive
+# optimization un-fused — Filter is the strictly dominant step kind.
+join = plan().join_broadcast(dim, left_on="k", right_on="dk")
+pa = (join.filter(col("v") > 10)
+          .with_columns(w=col("v") * 2)
+          .filter(col("w") < 150)
+          .groupby_agg(["grp"], [("v", "sum", "s")],
+                       domains={"grp": (0, 1)}))
+pb = (join.filter(col("v") < 90)
+          .groupby_agg(["grp"], [("v", "count", "n")],
+                       domains={"grp": (0, 1)}))
+
+s = QuerySession()
+
+def bank(n):
+    tickets = [s.submit(p, table=table) for _ in range(n) for p in (pa, pb)]
+    return [t.result(timeout=300) for t in tickets]
+
+def wl():
+    with urllib.request.urlopen(base + "/workload", timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+bank(3)
+base = server.get().url         # live server autostarts on first query
+first = wl()
+snap = first["snapshot"]
+assert snap["queries"] >= 6 and snap["plans"] == 2, snap
+assert snap["tickets"] >= 6, snap     # scheduler feed_ticket engaged
+hot = snap["hotspots"]
+assert hot and hot[0]["kind"] == "Filter", hot
+assert hot[0]["seconds"] > 0.0, hot
+assert hot == sorted(hot, key=lambda h: (-h["seconds"], h["kind"])), hot
+cands = first["candidates"]
+shared = [c for c in cands
+          if c["action"].startswith("materialize_subplan:")
+          and c["evidence"]["plans"] >= 2]
+assert shared, cands                  # the shared join prefix surfaced
+
+bank(3)
+second = wl()
+recs = second["recommendations"]
+confirmed = [c for c in recs
+             if c["action"].startswith("materialize_subplan:")
+             and c["evidence"]["plans"] >= 2]
+assert confirmed, second              # survived confirm-2 hysteresis
+# The kernel-target candidate needs the absolute seconds floor; only
+# pin it when this runner's window cleared the floor with margin.
+if second["snapshot"]["step_seconds"] >= 0.1:
+    assert any(c["action"] == "pallas_kernel:Filter"
+               for c in second["candidates"]), second["candidates"]
+
+with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+    metrics = resp.read().decode()
+gauges = [l for l in metrics.splitlines()
+          if l.startswith("srt_workload_") and not l.startswith("#")]
+assert gauges, "no srt_workload_* gauges on /metrics"
+hotline = [l for l in gauges
+           if l.startswith('srt_workload_hotspot_seconds{kind="Filter"}')]
+assert hotline and float(hotline[0].split()[-1]) > 0.0, gauges
+advice = [l for l in gauges if l.startswith("srt_workload_advice{")]
+assert any("materialize_subplan:" in l for l in advice), advice
+
+out = subprocess.run(
+    [sys.executable, "-m", "spark_rapids_tpu.obs", "workload",
+     "--url", base, "--json"], capture_output=True, text=True)
+assert out.returncode == 0, (out.stdout, out.stderr)
+assert json.loads(out.stdout)["verdict"], out.stdout
+
+# Offline replay over the history the bank just wrote must name the
+# same dominant kind from the persisted per-kind evidence.
+out = subprocess.run(
+    [sys.executable, "-m", "spark_rapids_tpu.obs", "workload",
+     "--history", "artifacts/premerge-workload-history.jsonl", "--json"],
+    capture_output=True, text=True)
+assert out.returncode == 0, (out.stdout, out.stderr)
+offline = json.loads(out.stdout)
+ohot = offline["snapshot"]["hotspots"]
+assert ohot and ohot[0]["kind"] == "Filter", ohot
+s.close()
+print("workload lane ok: top=%s overlap_plans=%d verdict=%s"
+      % (hot[0]["kind"], confirmed[0]["evidence"]["plans"],
+         second["verdict"]))
+EOF
+
+# Bench workload lane on a premerge-sized table (the full 4M-row bench
+# is nightly-only): the --workload body must emit its one `workload`
+# JSON line and hold the analyzer's <=2% overhead gate.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 python - <<'EOF'
+import io
+import json
+import sys
+import numpy as np
+sys.path.insert(0, "benchmarks")
+import bench_queries
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.column import Column
+
+rng = np.random.default_rng(7)
+n = 120_000
+lineitem = srt.Table([
+    ("flag", Column.from_numpy(rng.integers(0, 3, n).astype(np.int8))),
+    ("status", Column.from_numpy(rng.integers(0, 2, n).astype(np.int8))),
+    ("qty", Column.from_numpy(rng.integers(1, 51, n).astype(np.int64))),
+    ("price", Column.from_numpy(rng.uniform(900, 105000, n))),
+    ("disc", Column.from_numpy(np.round(rng.uniform(0, 0.1, n), 2))),
+    ("tax", Column.from_numpy(np.round(rng.uniform(0, 0.08, n), 2))),
+    ("shipdate", Column.from_numpy(
+        rng.integers(8000, 11000, n).astype(np.int32))),
+])
+buf = io.StringIO()
+stdout, sys.stdout = sys.stdout, buf
+try:
+    bench_queries.bench_workload(lineitem, rows=60_000)
+finally:
+    sys.stdout = stdout
+lines = [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+wl = [l for l in lines if l.get("metric") == "workload"]
+assert len(wl) == 1, lines
+line = wl[0]
+assert line["queries"] > 0 and line["plans"] == 2, line
+assert line["top_hotspot"] and line["top_hotspot"]["seconds"] > 0.0, line
+assert line["top_overlap"] and line["top_overlap"]["count"] >= 2, line
+assert line["overhead_frac"] <= bench_queries.WORKLOAD_OVERHEAD_BUDGET \
+    or line["workload_seconds"] - line["base_seconds"] <= 0.01, line
+assert line["advisor_verdict"], line
+print("bench workload lane ok:", json.dumps(line, sort_keys=True))
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

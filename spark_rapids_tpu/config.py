@@ -197,6 +197,13 @@ Knobs (all optional):
                                ``util_high``, ``util_low``, ``wait_s``,
                                ``hbm_headroom``); unknown keys or
                                non-numeric values raise.
+  ``SRT_WORKLOAD_WINDOW_S``    rolling window the workload analyzer
+                               (obs/workload.py) mines op hotspots and
+                               cross-query subplan overlaps over
+                               (seconds > 0, default 300).
+  ``SRT_WORKLOAD_TOPK``        ranked entries each workload report
+                               (hotspots, overlap candidates) retains
+                               (>= 1, default 8).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -866,6 +873,46 @@ def capacity_targets() -> dict[str, float]:
     return targets
 
 
+def workload_window_s() -> float:
+    """Rolling window (seconds) the workload analyzer (obs/workload.py)
+    mines op hotspots and cross-query subplan overlaps over.  Longer
+    than the capacity window by default — overlap mining needs enough
+    completed queries for recurrence to mean anything.  Tune with
+    ``SRT_WORKLOAD_WINDOW_S`` (> 0 seconds, default 300)."""
+    raw = os.environ.get("SRT_WORKLOAD_WINDOW_S")
+    if raw is None or not raw.strip():
+        return 300.0
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_WORKLOAD_WINDOW_S must be a number of seconds > 0, "
+            f"got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_WORKLOAD_WINDOW_S must be > 0 seconds, got {val}")
+    return val
+
+
+def workload_topk() -> int:
+    """Ranked entries each workload report (op hotspots, overlap
+    candidates) retains — the rest are aggregated but not surfaced.
+    Tune with ``SRT_WORKLOAD_TOPK`` (>= 1, default 8)."""
+    raw = os.environ.get("SRT_WORKLOAD_TOPK")
+    if raw is None or not raw.strip():
+        return 8
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_WORKLOAD_TOPK must be an integer >= 1, "
+            f"got {raw!r}") from None
+    if val < 1:
+        raise ValueError(
+            f"SRT_WORKLOAD_TOPK must be >= 1, got {val}")
+    return val
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -951,5 +998,6 @@ def knob_table() -> dict[str, str]:
              "SRT_SERVE_POLICY", "SRT_RESULT_CACHE",
              "SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",
              "SRT_LIVE_RECENT", "SRT_CAPACITY_WINDOW_S",
-             "SRT_CAPACITY_TARGETS")
+             "SRT_CAPACITY_TARGETS", "SRT_WORKLOAD_WINDOW_S",
+             "SRT_WORKLOAD_TOPK")
     return {n: os.environ.get(n, "<default>") for n in names}

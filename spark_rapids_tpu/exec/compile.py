@@ -1935,7 +1935,7 @@ def _run_plan_metered(plan: Plan, table: Table, progress=None):
     qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(src, qm)
+    maybe_record(src, qm, optimized=plan)
     return t, qm
 
 
@@ -1964,8 +1964,8 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
 
     t0 = _time.perf_counter()
     _live.phase("bind")
-    with _tspan("run.bind", cat="execute", rows=table.num_rows,
-                depth=depth):
+    with _tspan("run.bind", cat="execute", step_kind="bind",
+                rows=table.num_rows, depth=depth):
         bound = oom_ladder("bind", do_bind)
     if qm is not None:
         qm.bind_seconds += _time.perf_counter() - t0
@@ -1985,7 +1985,8 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
     try:
         t0 = _time.perf_counter()
         _live.phase("dispatch")
-        with _tspan("run.dispatch", cat="execute", depth=depth):
+        with _tspan("run.dispatch", cat="execute", step_kind="dispatch",
+                    depth=depth):
             out_cols, sel = oom_ladder("dispatch", do_dispatch)
         if qm is not None:
             qm.execute_seconds += _time.perf_counter() - t0
@@ -2010,7 +2011,8 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
             sample_device_hbm("run.dispatch")
         t0 = _time.perf_counter()
         _live.phase("materialize")
-        with _tspan("run.materialize", cat="execute", depth=depth):
+        with _tspan("run.materialize", cat="execute",
+                    step_kind="materialize", depth=depth):
             t = oom_ladder("materialize",
                            lambda: materialize(bound, out_cols, sel))
         if qm is not None:
@@ -2354,7 +2356,7 @@ def analyze_plan(plan: Plan, table: Table):
     qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(src, qm)
+    maybe_record(src, qm, optimized=plan)
     return t, qm
 
 

@@ -188,7 +188,7 @@ def _run_plan_dist_metered(plan: Plan, dist: DistTable, mesh: Mesh):
     qm.apply_opt(getattr(plan, "opt", None))
     set_last_query_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(src, qm)
+    maybe_record(src, qm, optimized=plan)
     return result
 
 

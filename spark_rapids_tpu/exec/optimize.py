@@ -171,6 +171,28 @@ def plan_step_texts(plan) -> tuple:
     return tuple(_step_text(s) for s in plan.steps)
 
 
+#: Step types a materializable subplan prefix may consist of: the
+#: leading scan(+filter/project/join) pipeline before any aggregation,
+#: sort, window, or union changes the row population's identity.  The
+#: workload analyzer (obs/workload.py) mines cross-query recurrence of
+#: these prefixes as fragment-materialization candidates.
+PREFIX_STEP_TYPES = (FilterStep, ProjectStep, JoinStep, JoinShuffledStep)
+
+
+def prefix_step_texts(plan) -> tuple:
+    """Canonical step texts of every leading scan/filter/project/join
+    prefix of ``plan``, shortest first: ``((t1,), (t1, t2), ...)`` up to
+    the maximal leading run of :data:`PREFIX_STEP_TYPES` steps.  Hash
+    each entry with ``obs.history.subplan_fingerprint`` to get the
+    subplan fingerprints the overlap miner counts."""
+    texts = []
+    for step in plan.steps:
+        if not isinstance(step, PREFIX_STEP_TYPES):
+            break
+        texts.append(_step_text(step))
+    return tuple(tuple(texts[:i + 1]) for i in range(len(texts)))
+
+
 # -- rule: predicate pushdown --------------------------------------------
 
 def _hoist_over_project(pred, proj: ProjectStep):

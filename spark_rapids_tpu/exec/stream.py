@@ -466,7 +466,7 @@ def _stream(plan, batches, k: int, combine, prefetch, mesh=None,
     qm.apply_opt(getattr(plan, "opt", None))
     set_last_stream_metrics(qm)
     from ..obs.history import maybe_record
-    maybe_record(src, qm)
+    maybe_record(src, qm, optimized=plan)
 
 
 def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
@@ -500,7 +500,8 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
     def materialize_entry(entry):
         _, bound, out_cols, sel, bi = entry
         with _tspan("stream.materialize", cat="stream",
-                    lane=f"batch-{bi}", batch=bi):
+                    step_kind="materialize", lane=f"batch-{bi}",
+                    batch=bi):
             return oom_ladder("materialize",
                               lambda: materialize(bound, out_cols, sel))
 
@@ -526,8 +527,8 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
             pending.append(("ready", run_plan_eager(plan, batch), bi))
         else:
             t0 = _time.perf_counter()
-            with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
-                        rows=batch.num_rows):
+            with _tspan("stream.bind", cat="stream", step_kind="bind",
+                        lane=lane, batch=bi, rows=batch.num_rows):
                 bound_holder = [oom_ladder(
                     "bind",
                     lambda: (fault_point("bind"), _bind(plan, batch))[1],
@@ -553,16 +554,16 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
                 acct.on_dispatch()      # serving fairness gate
             t0 = _time.perf_counter()
             try:
-                with _tspan("stream.dispatch", cat="stream", lane=lane,
-                            batch=bi):
+                with _tspan("stream.dispatch", cat="stream",
+                            step_kind="dispatch", lane=lane, batch=bi):
                     (out_cols, sel), reclaimed = oom_ladder(
                         "dispatch", do_dispatch, drain=drain_inflight)
             except ExecutionRecoveryError as err:
                 if err.category != "oom":
                     raise
                 try:    # last rung: split the batch, ride as ready
-                    with _tspan("stream.split", cat="stream", lane=lane,
-                                batch=bi):
+                    with _tspan("stream.split", cat="stream",
+                                step_kind="split", lane=lane, batch=bi):
                         pending.append(
                             ("ready", _split_batch(plan, batch, None, 0),
                              bi))
@@ -666,8 +667,8 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             last_empty = batch          # contributes no groups
             continue
         t0 = _time.perf_counter()
-        with _tspan("stream.bind", cat="stream", lane=lane, batch=bi,
-                    rows=batch.num_rows):
+        with _tspan("stream.bind", cat="stream", step_kind="bind", lane=lane,
+                    batch=bi, rows=batch.num_rows):
             bound_holder = [oom_ladder(
                 "bind", lambda: (fault_point("bind"), _bind(plan, batch))[1],
                 drain=drain_levels)]
@@ -705,16 +706,16 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             acct.on_dispatch()          # serving fairness gate
         t0 = _time.perf_counter()
         try:
-            with _tspan("stream.partial", cat="stream", lane=lane,
-                        batch=bi):
+            with _tspan("stream.partial", cat="stream", step_kind="dispatch",
+                        lane=lane, batch=bi):
                 acc, reclaimed = oom_ladder("dispatch", do_partial,
                                             drain=drain_levels)
         except ExecutionRecoveryError as err:
             if err.category != "oom":
                 raise
             try:
-                with _tspan("stream.split", cat="stream", lane=lane,
-                            batch=bi):
+                with _tspan("stream.split", cat="stream", step_kind="split",
+                            lane=lane, batch=bi):
                     acc = split_partial(batch)
             except SplitUnavailable as unavailable:
                 err.add_step(f"split-unavailable: {unavailable}")
@@ -735,8 +736,8 @@ def _drive_combine(plan, source, k: int, acct: _Account,
         i = 0
         while i < len(levels) and levels[i] is not None:
             lv, acc_in = levels[i], acc
-            with _tspan("stream.combine", cat="stream", lane="combine",
-                        level=i, batch=bi):
+            with _tspan("stream.combine", cat="stream", step_kind="dispatch",
+                        lane="combine", level=i, batch=bi):
                 acc = oom_ladder(
                     "stream-combine",
                     lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
@@ -756,7 +757,8 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             inflight_gauge.set(since_block)
         if since_block >= k:
             with _tspan("stream.backpressure", cat="stream",
-                        lane="combine", level=i):
+                        step_kind="backpressure", lane="combine",
+                        level=i):
                 jax.block_until_ready(levels[i])
             since_block = 0
 
@@ -773,13 +775,15 @@ def _drive_combine(plan, source, k: int, acct: _Account,
             total = lv
             continue
         t, l = total, lv
-        with _tspan("stream.combine", cat="stream", lane="combine"):
+        with _tspan("stream.combine", cat="stream", step_kind="dispatch",
+                lane="combine"):
             total = oom_ladder(
                 "stream-combine",
                 lambda t=t, l=l: (fault_point("stream-combine"),
                                   merge(t, l))[1])
     t0 = _time.perf_counter()
-    with _tspan("stream.finalize", cat="stream", lane="combine"):
+    with _tspan("stream.finalize", cat="stream", step_kind="materialize",
+                lane="combine"):
         out = oom_ladder(
             "materialize",
             lambda: stream_finalize(bound0, smeta, total, dtypes))

@@ -30,7 +30,14 @@ capacity accountant fed from the serving/flight hot paths (busy
 fraction, queue trends, admission pressure, Little's-law concurrency)
 plus an autoscaling advisor with hysteresis, surfaced on ``/capacity``,
 ``srt_capacity_*`` gauges, the ``obs top`` capacity pane, and
-``python -m spark_rapids_tpu.obs advisor``.
+``python -m spark_rapids_tpu.obs advisor``.  :mod:`.workload` mines the
+same telemetry ACROSS queries: an op-hotspot profiler (per-step-kind
+cost ledger aggregation naming the next Pallas kernel targets) and a
+cross-query subplan overlap miner (recurring optimized plan prefixes
+scored for materialization benefit), surfaced on ``/workload``,
+``srt_workload_*`` gauges, the ``obs top`` workload pane,
+``python -m spark_rapids_tpu.obs workload``, and a ``workload``
+postmortem-bundle block the doctor reads.
 
 Import hygiene: nothing under ``obs`` imports jax at module load (tested
 by tests/test_import_hygiene.py) — metrics post-processing must not drag
@@ -59,8 +66,10 @@ _LAZY = {
     "regress": ("regress", None),
     "server": ("server", None),
     "timeline": ("timeline", None),
+    "workload": ("workload", None),
     "load_history": ("history", "load"),
     "plan_fingerprint": ("history", "plan_fingerprint"),
+    "subplan_fingerprint": ("history", "subplan_fingerprint"),
     "NULL_METRIC": ("metrics", "NULL_METRIC"),
     "Counter": ("metrics", "Counter"),
     "Gauge": ("metrics", "Gauge"),

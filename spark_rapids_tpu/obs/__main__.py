@@ -21,11 +21,18 @@
     metrics-history JSONL offline (newest ``--last`` records via the
     tail-seeking reverse reader).  Exits 0 whenever a verdict was
     produced.
+``workload``
+    one workload-intelligence evaluation (obs/workload.py): the fleet's
+    op-hotspot table (cost-dominant step kinds with per-kind
+    seconds/bytes evidence) and cross-query subplan overlap candidates.
+    Same three sources as ``advisor``: local window, a remote
+    exporter's ``/workload`` with ``--url``, or ``--history`` offline
+    replay.  Exits 0 whenever a verdict was produced.
 
 Rendering is a pure function of the ``/queries`` JSON payload
-(:func:`render_top`) / the advisor payload (:func:`render_advisor`), so
-tests drive them with synthetic snapshots and the remote and local paths
-share one code path.
+(:func:`render_top`) / the advisor payloads (:func:`render_advisor`,
+:func:`render_workload`), so tests drive them with synthetic snapshots
+and the remote and local paths share one code path.
 """
 
 from __future__ import annotations
@@ -193,26 +200,25 @@ def _advisor_payload(url: Optional[str], history: Optional[str],
     return capacity.advise()
 
 
+def _history_records(path: str, last: int) -> List[dict]:
+    """The newest ``last`` metrics-history records, oldest first —
+    the shared front half of every offline replay, on
+    :func:`obs.history.iter_records` (tail-seeking reverse reader, so a
+    multi-GB JSONL costs one tail read)."""
+    from .history import iter_records
+    records = list(iter_records(path, last=max(last, 1)))
+    records.reverse()           # oldest first for the serialized replay
+    return records
+
+
 def _advise_history(path: str, last: int) -> dict:
     """Offline advisor: replay the newest ``last`` metrics-history
-    records (tail-seeking reverse reader, so a multi-GB JSONL costs one
-    tail read) through the same pure derive/recommend core.  One-shot
+    records through the same pure derive/recommend core.  One-shot
     evaluation — hysteresis needs repeated windows — so a fresh
     ``Advisor(confirm=1)`` folds the single window."""
     from ..config import capacity_targets
     from . import capacity
-    from .history import _iter_lines_reversed
-    records: List[dict] = []
-    for line in _iter_lines_reversed(path):
-        if len(records) >= max(last, 1):
-            break
-        try:
-            rec = json.loads(line)
-        except (ValueError, TypeError):
-            continue
-        if isinstance(rec, dict):
-            records.append(rec)
-    records.reverse()           # oldest first for the serialized replay
+    records = _history_records(path, last)
     events, w0, w1 = capacity.events_from_history(records)
     from ..config import (result_cache_bytes, serve_hbm_budget,
                           serve_max_concurrent)
@@ -225,6 +231,114 @@ def _advise_history(path: str, last: int) -> dict:
     return {"snapshot": snap, "candidates": candidates,
             "recommendations": recs,
             "verdict": capacity.verdict_for(recs if recs else candidates)}
+
+
+def render_workload(payload: dict, source: str = "local") -> str:
+    """Console rendering of one ``/workload`` payload — pure."""
+    snap = payload.get("snapshot") or {}
+    lines = [
+        f"srt workload — {source}  verdict={payload.get('verdict', '?')}",
+        "window={w:.0f}s  queries={q}  plans={p}  step_seconds={s:.3f}  "
+        "tickets={t}".format(
+            w=snap.get("window_seconds", 0.0),
+            q=snap.get("queries", 0), p=snap.get("plans", 0),
+            s=snap.get("step_seconds", 0.0),
+            t=snap.get("tickets", 0)),
+    ]
+    hotspots = snap.get("hotspots") or []
+    if hotspots:
+        lines.append("op hotspots (by attributed seconds):")
+        for h in hotspots:
+            p95 = h.get("per_row_p95_s")
+            lines.append(
+                "  {kind:<24} {sec:>9.4f}s {share:>5.0%}  "
+                "queries={q:<3} bytes={b:>7} ici={ici:.4f}s "
+                "syncs={hs:.0f}  p95/row={p95}  win~{win:.4f}s".format(
+                    kind=h["kind"], sec=h["seconds"], share=h["share"],
+                    q=h["queries"], b=_human(h["bytes"]),
+                    ici=h["ici_seconds"], hs=h["host_syncs"],
+                    p95=f"{p95:.2e}s" if p95 is not None else "n/a",
+                    win=h["projected_win_s"]))
+    else:
+        lines.append("op hotspots: (none — window is empty)")
+    overlaps = snap.get("overlaps") or []
+    if overlaps:
+        lines.append("overlap candidates (by benefit score):")
+        for o in overlaps:
+            lines.append(
+                "  {fp} depth={d} {kinds:<32} x{n} plans={p} "
+                "inflight={i} mean={m:.4f}s est={b}B score={s}".format(
+                    fp=o["prefix_fingerprint"], d=o["depth"],
+                    kinds=" > ".join(o["kinds"]), n=o["count"],
+                    p=o["plans"], i=o["inflight"], m=o["seconds_mean"],
+                    b=_human(o["est_result_bytes"]),
+                    s=_human(o["benefit_score"])))
+    else:
+        lines.append("overlap candidates: (none recurring)")
+    recs = payload.get("recommendations") or []
+    cands = payload.get("candidates") or []
+    shown = recs if recs else cands
+    tag = "recommendations" if recs else "candidates (unconfirmed)"
+    if not shown:
+        lines.append("recommendations: (none — workload looks quiet)")
+        return "\n".join(lines)
+    lines.append(f"{tag}:")
+    for rec in shown:
+        lines.append(f"  [{rec['severity']:>3}] {rec['action']}: "
+                     f"{rec['reason']}")
+        ev = rec.get("evidence") or {}
+        if ev:
+            detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev))
+            lines.append(f"        evidence: {detail}")
+    return "\n".join(lines)
+
+
+def _workload_pane(url: Optional[str]) -> List[str]:
+    """Workload summary lines appended under a ``top`` frame —
+    best-effort, like :func:`_capacity_pane`."""
+    try:
+        if url is not None:
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/workload", timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+        else:
+            from . import workload
+            payload = workload.advise()
+    except Exception:
+        return []
+    return ["", render_workload(payload, source="workload")]
+
+
+def _workload_payload(url: Optional[str], history: Optional[str],
+                      last: int) -> dict:
+    """The workload payload from one of the three sources: a remote
+    exporter's ``/workload``, an offline metrics-history replay, or the
+    local in-process window."""
+    if url is not None:
+        with urllib.request.urlopen(url.rstrip("/") + "/workload",
+                                    timeout=5) as resp:
+            return json.loads(resp.read().decode())
+    if history is not None:
+        return _workload_history(history, last)
+    from . import workload
+    return workload.advise()
+
+
+def _workload_history(path: str, last: int) -> dict:
+    """Offline workload intelligence: replay the newest ``last``
+    metrics-history records through the same pure derive/recommend
+    core.  One-shot evaluation, so a fresh ``Advisor(confirm=1)`` folds
+    the single window (the same discipline as :func:`_advise_history`)."""
+    from ..config import workload_topk
+    from . import workload
+    records = _history_records(path, last)
+    norm, window = workload.records_from_history(records)
+    snap = workload.derive(norm, [], window, topk=workload_topk())
+    candidates = workload.recommend(snap)
+    recs = workload.Advisor(confirm=1, clear=1).observe(candidates)
+    return {"snapshot": snap, "candidates": candidates,
+            "recommendations": recs,
+            "verdict": workload.verdict_for(recs if recs else candidates)}
 
 
 def _fetch(url: str) -> dict:
@@ -278,6 +392,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "default 256)")
     advisor.add_argument("--json", action="store_true",
                          help="print the raw advisor payload as JSON")
+    workload_p = sub.add_parser(
+        "workload", help="fleet op-hotspot table + cross-query subplan "
+                         "overlap candidates")
+    workload_p.add_argument("--url", default=None,
+                            help="remote exporter base URL (fetches its "
+                                 "/workload); default: the local "
+                                 "in-process window")
+    workload_p.add_argument("--history", default=None,
+                            help="replay a metrics-history JSONL offline "
+                                 "instead of a live window")
+    workload_p.add_argument("--last", type=int, default=256,
+                            help="history records to replay (newest "
+                                 "first, default 256)")
+    workload_p.add_argument("--json", action="store_true",
+                            help="print the raw workload payload as JSON")
     args = parser.parse_args(argv)
     if args.command == "doctor":
         from .doctor import main as doctor_main
@@ -290,6 +419,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(render_advisor(
                 payload, source=args.url or args.history or "local"))
         return 0
+    if args.command == "workload":
+        payload = _workload_payload(args.url, args.history, args.last)
+        if args.json:
+            print(json.dumps(payload, sort_keys=True))
+        else:
+            print(render_workload(
+                payload, source=args.url or args.history or "local"))
+        return 0
     if args.command != "top":
         parser.print_help()
         return 2
@@ -298,6 +435,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         while True:
             frame = render_top(_snapshot(args.url), source=source)
             frame += "\n".join(_capacity_pane(args.url))
+            frame += "\n".join(_workload_pane(args.url))
             if args.once:
                 print(frame)
                 return 0

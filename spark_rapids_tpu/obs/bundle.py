@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 from ..config import bundle_dir, knob_table, slo_ms
 
 #: Bump on any key-set change; the golden test pins the layout.
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 #: Incident kinds :func:`dump` accepts.
 REASONS = ("failure", "recovery_exhausted", "admission_rejected",
@@ -134,6 +134,19 @@ def _capacity_block() -> Dict[str, Any]:
                 "verdict": "unavailable"}
 
 
+def _workload_block() -> Dict[str, Any]:
+    """Workload context at the moment of the incident — where does this
+    query's work sit in the fleet's hotspot/overlap picture?  The doctor
+    compares the query's dominant step kind against the fleet's top
+    hotspot.  Never raises."""
+    try:
+        from . import workload
+        return workload.bundle_block()
+    except Exception:
+        return {"snapshot": None, "recommendations": [],
+                "verdict": "unavailable"}
+
+
 def _prune_oldest(dirpath: str) -> None:
     try:
         names = [n for n in os.listdir(dirpath)
@@ -194,6 +207,7 @@ def build(reason: str, *, query_id: Optional[int] = None, qm=None,
         "config": knob_table(),
         "slo": {"slo_ms": limit, "elapsed_seconds": elapsed},
         "capacity": _capacity_block(),
+        "workload": _workload_block(),
     }
 
 
@@ -272,7 +286,7 @@ def validate_bundle(payload: dict, schema: dict) -> List[str]:
         errors.append(f"reason {payload['reason']!r} not in "
                       f"{schema['reasons']}")
     for block in ("error", "recovery", "flight", "plan", "slo",
-                  "capacity"):
+                  "capacity", "workload"):
         sub = payload.get(block)
         if not isinstance(sub, dict):
             errors.append(f"{block!r} block is not an object")

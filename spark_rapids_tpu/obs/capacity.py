@@ -51,6 +51,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 from ..config import metrics_enabled
 
 __all__ = [
+    "span_step_kind",
     "feed_span", "feed_queue_wait", "feed_queue_depth",
     "feed_admission_wait", "feed_admission_reject", "feed_hbm",
     "feed_completion",
@@ -71,6 +72,21 @@ _DISPATCH_SUFFIXES = (".dispatch", ".partial", ".combine",
                       ".merge_collective")
 _MATERIALIZE_SUFFIXES = (".materialize", ".finalize")
 _SPAN_SUFFIXES = _DISPATCH_SUFFIXES + _MATERIALIZE_SUFFIXES
+
+
+def span_step_kind(name: str) -> Optional[str]:
+    """Stable busy-classification label for a span name — the ONE
+    name→kind mapping capacity accounting and workload hotspot
+    attribution share.  The executors stamp the same label into the
+    span's ``step_kind`` arg (exec/compile.py, exec/stream.py), so a
+    trace reader, this accountant, and the workload analyzer agree on
+    what a span was doing; ``None`` means not busy-metered (bind,
+    split, backpressure, ...)."""
+    if name.endswith(_DISPATCH_SUFFIXES):
+        return "dispatch"
+    if name.endswith(_MATERIALIZE_SUFFIXES):
+        return "materialize"
+    return None
 
 # Per-kind event retention.  4096 events at serving rates covers far
 # more than any sane SRT_CAPACITY_WINDOW_S; the deques bound memory the
@@ -102,14 +118,14 @@ def feed_span(name: str, ts_us: float, dur_us: float) -> None:
     sinks (both the timeline-on mirror and the timeline-off scope
     path), so dispatch walls are visible whenever metrics are on —
     regardless of whether the opt-in timeline records."""
-    if not name.endswith(_SPAN_SUFFIXES):
+    kind = span_step_kind(name)
+    if kind is None:
         return
     if not metrics_enabled():
         return
     start = ts_us / 1e6
     end = start + max(dur_us, 0.0) / 1e6
-    dq = (_DISPATCH if name.endswith(_DISPATCH_SUFFIXES)
-          else _MATERIALIZE)
+    dq = _DISPATCH if kind == "dispatch" else _MATERIALIZE
     with _LOCK:
         dq.append((start, end))
 

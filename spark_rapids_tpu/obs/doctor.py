@@ -188,6 +188,58 @@ def _capacity_findings(bundle: dict) -> List[Dict[str, Any]]:
     return out
 
 
+def _workload_findings(bundle: dict, qm: dict) -> List[Dict[str, Any]]:
+    """Fleet-workload context for this query — the bundle's ``workload``
+    block (obs/workload.py; absent in pre-v3 bundles).
+
+    Two signals: (a) this query's cost-dominant step kind is also the
+    fleet's #1 hotspot — its slowness is a workload-wide kernel gap, not
+    a per-query anomaly; (b) the workload advisor confirmed a
+    materialization candidate whose prefix this query's plan carries —
+    the incident query is paying for work the fleet keeps repeating."""
+    wl = bundle.get("workload")
+    if not isinstance(wl, dict):
+        return []
+    snap = wl.get("snapshot") or {}
+    hotspots = snap.get("hotspots") or []
+    out: List[Dict[str, Any]] = []
+    steps = qm.get("steps") or []
+    if hotspots and steps:
+        by_kind: Dict[str, float] = {}
+        for s in steps:
+            if isinstance(s, dict) and s.get("kind"):
+                sec = float(s.get("seconds", -1.0) or 0.0)
+                by_kind[s["kind"]] = by_kind.get(s["kind"], 0.0) \
+                    + max(sec, 0.0)
+        if by_kind:
+            dominant = max(sorted(by_kind), key=lambda k: by_kind[k])
+            top = hotspots[0]
+            if dominant == top.get("kind"):
+                out.append(_finding(
+                    50, f"this query's dominant step kind "
+                        f"({dominant!r}) is the fleet's #1 hotspot",
+                    f"fleet: {top.get('seconds', 0.0):.3f}s across "
+                    f"{top.get('queries', 0)} queries "
+                    f"({top.get('share', 0.0):.0%} of attributed step "
+                    f"seconds, projected kernel win "
+                    f"~{top.get('projected_win_s', 0.0):.3f}s) — a "
+                    f"Pallas kernel for this kind helps the whole "
+                    f"workload, not just this query"))
+    for rec in wl.get("recommendations") or []:
+        action = rec.get("action", "?")
+        if not str(action).startswith("materialize_subplan:"):
+            continue
+        ev = rec.get("evidence") or {}
+        detail = str(rec.get("reason") or "")
+        if ev:
+            detail += " — evidence: " + ", ".join(
+                f"{k}={ev[k]}" for k in sorted(ev))
+        out.append(_finding(
+            45, f"workload advisor ({wl.get('verdict', '?')}): {action}",
+            detail))
+    return out
+
+
 def baseline_for(fingerprint: str,
                  history_path: Optional[str] = None) -> Optional[dict]:
     """The same-fingerprint history baseline (newest measured record)."""
@@ -220,7 +272,8 @@ def diagnose(payload: dict, baseline: Optional[dict] = None,
     findings = (_error_findings(bundle) + _slo_findings(bundle)
                 + _cache_findings(qm, baseline)
                 + _cost_findings(qm, baseline)
-                + _capacity_findings(bundle))
+                + _capacity_findings(bundle)
+                + _workload_findings(bundle, qm))
     findings.sort(key=lambda f: -f["severity"])
     if findings:
         verdict = findings[0]["title"]

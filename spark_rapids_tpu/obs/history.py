@@ -22,7 +22,8 @@ import hashlib
 import json
 import os
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, Iterable, Iterator, List, Optional
 
 from ..config import metrics_history_max_mb, metrics_history_path
 
@@ -73,7 +74,18 @@ def plan_fingerprint(plan: Any) -> str:
     return hashlib.sha256(_plan_text(plan).encode()).hexdigest()[:16]
 
 
-def record(plan: Any, qm: Any, path: str) -> dict:
+def subplan_fingerprint(texts: Iterable[str]) -> str:
+    """Stable 16-hex-digit fingerprint of a subplan given its ordered
+    step texts — the same sha256[:16] idiom as :func:`plan_fingerprint`,
+    so prefix fingerprints computed from a live plan
+    (exec/optimize.prefix_step_texts) and from a history record's
+    recorded step describes share one hash space.  The workload
+    analyzer's overlap miner keys on this."""
+    return hashlib.sha256("\n".join(texts).encode()).hexdigest()[:16]
+
+
+def record(plan: Any, qm: Any, path: str,
+           prefixes: Optional[List[dict]] = None) -> dict:
     """Append one history record for ``qm`` to ``path``; returns it.
 
     Concurrent-writer safe: the record goes out as ONE ``os.write`` on an
@@ -83,8 +95,15 @@ def record(plan: Any, qm: Any, path: str) -> dict:
     threads of this process."""
     # The computed fingerprint is authoritative: it overwrites the
     # to_dict() copy (qm.fingerprint may be "" when the producer never
-    # had the plan), so history records always key correctly.
-    rec = {**qm.to_dict(), "fingerprint": plan_fingerprint(plan)}
+    # had the plan), so history records always key correctly.  The
+    # wall-clock stamp and the subplan ``prefixes`` live on the history
+    # line, not in to_dict(): QueryMetrics payloads are diffed across
+    # runs, history records are windowed by ``iter_records(since=)`` and
+    # mined by the workload analyzer's overlap miner.
+    rec = {**qm.to_dict(), "fingerprint": plan_fingerprint(plan),
+           "unix_time": round(time.time(), 3)}
+    if prefixes:
+        rec["prefixes"] = prefixes
     data = (json.dumps(rec, sort_keys=True) + "\n").encode()
     with _LOCK:
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
@@ -136,13 +155,29 @@ def _maybe_truncate(path: str) -> None:
     counter("history.truncated_records").inc(len(lines) - len(keep))
 
 
-def maybe_record(plan: Any, qm: Any) -> Optional[dict]:
+def maybe_record(plan: Any, qm: Any, optimized: Any = None
+                 ) -> Optional[dict]:
     """History hook called by the execution paths: one env read when the
-    sink is unset, one appended JSONL line when it is."""
-    path = metrics_history_path()
-    if path is None or qm is None:
+    sink is unset, one appended JSONL line when it is.
+
+    Also the live workload-analyzer feed — this is the one completion
+    point that holds both the plan and the QueryMetrics, so every
+    metered run/analyze/stream/dist query lands in the workload window
+    here whether or not the history sink is set.  ``optimized`` is the
+    post-rewrite plan that actually ran (subplan-prefix canonicalization
+    wants the optimized step order, per the workload miner's contract);
+    ``plan`` stays the source plan the fingerprint keys on.  The
+    computed prefixes are embedded in the JSONL record so offline replay
+    shares the live hash space."""
+    if qm is None:
         return None
-    return record(plan, qm, path)
+    from . import workload as _workload
+    prefixes = _workload.feed_query(
+        plan if optimized is None else optimized, qm)
+    path = metrics_history_path()
+    if path is None:
+        return None
+    return record(plan, qm, path, prefixes=prefixes)
 
 
 def load(fingerprint: Optional[str] = None,
@@ -225,6 +260,63 @@ def _iter_lines_reversed(path: str):
                     yield line
         if buf:
             yield buf
+
+
+def iter_records(path: Optional[str] = None, *,
+                 fingerprint: Optional[str] = None,
+                 since: Optional[float] = None,
+                 last: Optional[int] = None) -> Iterator[dict]:
+    """Stream parsed history records **newest-first** off the
+    tail-seeking reverse reader — the shared filtered iterator every
+    offline replay (capacity advisor, workload analyzer) builds on, so
+    a multi-GB JSONL costs one tail read, never a full parse.
+
+    ``fingerprint`` keeps only one plan's records; ``since`` keeps only
+    records whose ``unix_time`` stamp is >= the cutoff (records written
+    before the stamp existed have none and are kept — offline replay
+    should not silently drop an old corpus); ``last`` stops after that
+    many yielded records.  Corrupt lines are skipped and counted on the
+    ``history.corrupt_lines`` counter, exactly like :func:`load`.
+    Missing file / unset path yields nothing (the cold-start case)."""
+    if path is None:
+        path = metrics_history_path()
+    if path is None or not os.path.exists(path):
+        return
+    skipped = 0
+    yielded = 0
+    try:
+        for raw in _iter_lines_reversed(path):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
+            if fingerprint is not None \
+                    and rec.get("fingerprint") != fingerprint:
+                continue
+            ts = rec.get("unix_time")
+            if since is not None and isinstance(ts, (int, float)) \
+                    and ts < since:
+                # Stamps are monotone within one writer, but multiple
+                # processes interleave — keep scanning rather than
+                # breaking on the first too-old record.
+                continue
+            yield rec
+            yielded += 1
+            if last is not None and yielded >= max(last, 1):
+                break
+    except OSError:
+        return
+    finally:
+        if skipped:
+            from .metrics import counter
+            counter("history.corrupt_lines").inc(skipped)
 
 
 def lookup_latest(fingerprint: str,

@@ -657,6 +657,28 @@ def _serving_payload() -> dict:
     }
 
 
+def _workload_payload() -> dict:
+    """Payload for ``bench_line("workload")``: the fleet-intelligence
+    view of the current workload window — the top op hotspot (the next
+    Pallas kernel target) and the top subplan overlap candidate (the
+    next materialization target), each with its evidence.
+    ``bench_queries.py --workload`` merges its measured live-vs-muted
+    feed overhead into this payload before emitting its one line."""
+    from . import workload
+    snap = workload.snapshot()
+    hotspots = snap.get("hotspots") or []
+    overlaps = snap.get("overlaps") or []
+    return {
+        "metric": "workload",
+        "queries": snap.get("queries", 0),
+        "plans": snap.get("plans", 0),
+        "step_seconds": snap.get("step_seconds", 0.0),
+        "step_kinds": snap.get("step_kinds", 0),
+        "top_hotspot": hotspots[0] if hotspots else None,
+        "top_overlap": overlaps[0] if overlaps else None,
+    }
+
+
 _BENCH_PAYLOADS = {
     "metrics": _metrics_payload,
     "cache": _cache_payload,
@@ -666,6 +688,7 @@ _BENCH_PAYLOADS = {
     "regress": _regress_payload,
     "encoded_scan": _encoded_scan_payload,
     "serving": _serving_payload,
+    "workload": _workload_payload,
 }
 
 
@@ -678,7 +701,8 @@ def bench_line(kind: str) -> str:
     run), ``"recovery"`` (process-lifetime resilience totals),
     ``"regress"`` (perf-regression report vs the metrics history),
     ``"encoded_scan"`` (scan pruning / encoded-residency totals),
-    ``"serving"`` (serving-layer admission/result-cache totals).  The
+    ``"serving"`` (serving-layer admission/result-cache totals),
+    ``"workload"`` (top op hotspot + top subplan overlap candidate).  The
     four legacy ``bench_*_line`` names are thin wrappers over this and
     emit byte-identical output.
     """

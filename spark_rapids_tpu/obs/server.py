@@ -248,6 +248,45 @@ def capacity_gauges(fam: _Families) -> None:
              {"action": cand["action"]}, cand["severity"])
 
 
+def workload_gauges(fam: _Families) -> None:
+    """Fold the workload snapshot into ``/metrics`` as ``srt_workload_*``
+    gauges.  Same scrape discipline as :func:`capacity_gauges`:
+    snapshot() + recommend() only — NOT advise() — so scrapes never
+    advance the workload advisor's hysteresis (only ``/workload`` and
+    the CLI do)."""
+    from . import workload
+    try:
+        snap = workload.snapshot()
+        candidates = workload.recommend(snap)
+    except Exception:           # a broken miner must not break /metrics
+        return
+    for name, value in (
+            ("window_seconds", snap["window_seconds"]),
+            ("queries", snap["queries"]),
+            ("plans", snap["plans"]),
+            ("step_seconds", snap["step_seconds"]),
+            ("step_kinds", snap["step_kinds"]),
+            ("tickets", snap["tickets"])):
+        _add(fam, f"srt_workload_{name}", "gauge", {}, value)
+    for h in snap["hotspots"]:
+        labels = {"kind": h["kind"]}
+        _add(fam, "srt_workload_hotspot_seconds", "gauge", labels,
+             h["seconds"])
+        _add(fam, "srt_workload_hotspot_share", "gauge", labels,
+             h["share"])
+        _add(fam, "srt_workload_hotspot_projected_win_seconds", "gauge",
+             labels, h["projected_win_s"])
+    for o in snap["overlaps"]:
+        labels = {"prefix": o["prefix_fingerprint"]}
+        _add(fam, "srt_workload_overlap_count", "gauge", labels,
+             o["count"])
+        _add(fam, "srt_workload_overlap_benefit_score", "gauge", labels,
+             o["benefit_score"])
+    for cand in candidates:
+        _add(fam, "srt_workload_advice", "gauge",
+             {"action": cand["action"]}, cand["severity"])
+
+
 def prometheus_text() -> str:
     """The ``/metrics`` body: registry metrics + live-query gauges."""
     from . import live
@@ -291,6 +330,7 @@ def prometheus_text() -> str:
             _add(fam, "srt_live_query_shard_batches", "gauge",
                  {"query_id": q["query_id"], "shard": shard}, done)
     capacity_gauges(fam)
+    workload_gauges(fam)
 
     lines: List[str] = []
     for name, (kind, samples) in fam.items():
@@ -347,6 +387,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/capacity":
                 from . import capacity
                 body = json.dumps(capacity.advise(), sort_keys=True)
+                self._send(200, body.encode(), "application/json")
+                return
+            if path == "/workload":
+                from . import workload
+                body = json.dumps(workload.advise(), sort_keys=True)
                 self._send(200, body.encode(), "application/json")
                 return
             m = _TIMELINE_RE.match(path)

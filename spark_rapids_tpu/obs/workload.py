@@ -1,0 +1,684 @@
+"""Workload intelligence — fleet-wide op hotspots and subplan overlap.
+
+The obs stack explains one query (flight recorder → bundle → doctor)
+and one process (capacity accountant), but the two biggest roadmap
+bets need evidence about the *workload*: which step kinds dominate the
+fleet's cost ledger (ROADMAP item 1 — the next Pallas kernel targets)
+and which subplan prefixes recur across queries (ROADMAP item 4 —
+fragment-materialization candidates, the Presto-GPU fragment-cache
+motivation).  This module mines both from what the stack already
+emits:
+
+  * a **query window** — a bounded deque of normalized per-query
+    workload records fed at completion (obs/history.maybe_record, which
+    has both the optimized plan and the QueryMetrics) plus the
+    scheduler's submitted tickets (serve/scheduler.py);
+  * **op hotspots**: the per-plan cost ledger aggregated by step kind
+    across the window — seconds, bytes, ICI, host syncs per kind, with
+    p50/p95 per-row cost from measured (analyze) steps — ranked so the
+    top entries name kernel targets with a projected win;
+  * **overlap candidates**: optimized plan prefixes (leading
+    scan/filter/project/join runs, exec/optimize.prefix_step_texts)
+    canonicalized into subplan fingerprints
+    (obs/history.subplan_fingerprint), counted for cross-query
+    recurrence, and scored as frequency x measured prefix cost x
+    estimated result bytes;
+  * the same confirm/clear **hysteresis** discipline as the capacity
+    advisor (:class:`obs.capacity.Advisor` is reused verbatim), so a
+    recommendation only surfaces after consecutive supporting windows.
+
+Contract (mirrors obs/capacity.py):
+
+  * jax-free at import (pinned by an import-hygiene test);
+  * off unless ``SRT_METRICS=1`` — every ``feed_*`` returns after one
+    env read, and :func:`snapshot` over an unfed window is well-defined
+    (no hotspots, no candidates);
+  * ``derive`` / ``recommend`` are pure over explicit inputs — the
+    mining math is unit-testable without a device, server, or clock.
+
+Surfaces: ``/workload`` + ``srt_workload_*`` gauges (obs/server.py —
+scrapes use snapshot()+recommend() and never advance hysteresis), a
+workload pane in ``obs top`` and ``python -m spark_rapids_tpu.obs
+workload`` (live ``--url``, in-process, or offline ``--history`` over
+the reverse reader), and a ``workload`` block in postmortem bundles
+(obs/bundle.py → obs/doctor.py findings).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import metrics_enabled
+from .capacity import Advisor, percentile
+
+__all__ = [
+    "KERNEL_SPEEDUP", "HOTSPOT_MIN_SHARE", "HOTSPOT_MIN_SECONDS",
+    "OVERLAP_MIN_COUNT",
+    "feed_query", "feed_ticket",
+    "plan_prefixes", "prefixes_from_steps",
+    "record_from_history", "records_from_history",
+    "derive", "recommend", "Advisor", "verdict_for",
+    "window_records", "snapshot", "advise", "bundle_block", "reset",
+    "validate_payload",
+]
+
+#: Assumed speedup of a hand-written Pallas kernel over the current XLA
+#: lowering for one step kind — the "projected win" a hotspot cites is
+#: its window seconds x (1 - 1/KERNEL_SPEEDUP).  A planning prior, not
+#: a measurement; the point is ranking, the constant is documented.
+KERNEL_SPEEDUP = 2.0
+
+#: A step kind must hold at least this share of attributed step seconds
+#: (and this many absolute seconds) before the advisor proposes a
+#: kernel for it — tiny windows must not nominate noise.
+HOTSPOT_MIN_SHARE = 0.25
+HOTSPOT_MIN_SECONDS = 0.02
+
+#: A subplan prefix must recur at least this many times in the window
+#: before it is a materialization candidate.
+OVERLAP_MIN_COUNT = 2
+
+#: Per-row result-size floor (bytes) used when a prefix's output width
+#: is unknown — the benefit score only needs a consistent scale.
+_EST_BYTES_PER_ROW = 8
+
+# Window retention: same bound-memory discipline as obs/capacity.py.
+_MAXEVENTS = 4096
+
+_LOCK = threading.Lock()
+#: (t, normalized record) — completed queries.
+_QUERIES: "deque[Tuple[float, Dict[str, Any]]]" = deque(maxlen=_MAXEVENTS)
+#: (t, plan fingerprint, prefix fingerprints) — submitted tickets.
+_TICKETS: "deque[Tuple[float, str, Tuple[str, ...]]]" = deque(
+    maxlen=_MAXEVENTS)
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# Prefix canonicalization (shared by the live feed and the history sink)
+# ---------------------------------------------------------------------------
+
+def _text_kind(text: str) -> str:
+    """Step kind from an optimize._step_text ("Filter[x>1]" -> "Filter")."""
+    return text.split("[", 1)[0]
+
+
+def plan_prefixes(plan, qm=None) -> List[Dict[str, Any]]:
+    """Canonical subplan prefixes of an **optimized** plan, each scored
+    with measured cost when ``qm`` carries per-step observations.
+
+    Returns ``[{fingerprint, depth, kinds, seconds, measured,
+    est_result_bytes}]`` — fingerprints from
+    ``history.subplan_fingerprint`` over
+    ``exec.optimize.prefix_step_texts``, so a live plan, a scheduler
+    ticket, and a history record share one hash space.  ``seconds`` is
+    the summed measured step seconds over the prefix (analyze runs);
+    unmeasured prefixes fall back to a depth-proportional share of
+    ``qm.execute_seconds`` with ``measured=False``.  Never raises —
+    a plan the prefix walker cannot read yields no prefixes."""
+    try:
+        from ..exec.optimize import prefix_step_texts
+        from .history import subplan_fingerprint
+        prefix_texts = prefix_step_texts(plan)
+    except Exception:
+        return []
+    steps = list(getattr(qm, "steps", ()) or ()) if qm is not None else []
+    n_steps = max(len(getattr(plan, "steps", ())), 1)
+    execute = float(getattr(qm, "execute_seconds", 0.0) or 0.0) \
+        if qm is not None else 0.0
+    input_rows = int(getattr(qm, "input_rows", 0) or 0) \
+        if qm is not None else 0
+    out: List[Dict[str, Any]] = []
+    for texts in prefix_texts:
+        depth = len(texts)
+        secs = [s.seconds for s in steps[:depth]
+                if getattr(s, "seconds", -1.0) >= 0.0]
+        measured = len(secs) == depth and depth > 0
+        seconds = sum(secs) if measured \
+            else execute * depth / n_steps
+        rows_out = -1
+        if depth <= len(steps):
+            rows_out = int(getattr(steps[depth - 1], "rows_out", -1))
+        est_rows = rows_out if rows_out >= 0 else input_rows
+        out.append({
+            "fingerprint": subplan_fingerprint(texts),
+            "depth": depth,
+            "kinds": [_text_kind(t) for t in texts],
+            "seconds": round(max(seconds, 0.0), 6),
+            "measured": bool(measured),
+            "est_result_bytes": int(max(est_rows, 0)) * _EST_BYTES_PER_ROW,
+        })
+    return out
+
+
+def prefixes_from_steps(steps: Sequence[dict],
+                        input_rows: int = 0,
+                        execute_seconds: float = 0.0
+                        ) -> List[Dict[str, Any]]:
+    """Prefix dicts recovered from a history record's ``steps`` list —
+    the fallback for records written before the sink embedded
+    ``prefixes``.  Canonicalizes over the recorded ``describe`` texts
+    (stable for one logical plan, a *different* hash space from
+    :func:`plan_prefixes` — old-corpus overlaps still mine correctly
+    against each other, just not against new-format records)."""
+    from .history import subplan_fingerprint
+    lead: List[dict] = []
+    for s in steps:
+        if not isinstance(s, dict):
+            break
+        kind = str(s.get("kind") or "")
+        if _text_kind(kind) not in ("Filter", "Select", "Project",
+                                    "BroadcastJoin", "ShuffledJoin"):
+            break
+        lead.append(s)
+    n_steps = max(len(steps), 1)
+    out: List[Dict[str, Any]] = []
+    for depth in range(1, len(lead) + 1):
+        texts = [str(s.get("describe") or s.get("kind") or "")
+                 for s in lead[:depth]]
+        secs = [float(s.get("seconds", -1.0)) for s in lead[:depth]]
+        measured = all(x >= 0.0 for x in secs) and depth > 0
+        seconds = sum(secs) if measured \
+            else execute_seconds * depth / n_steps
+        rows_out = lead[depth - 1].get("rows_out", -1)
+        rows_out = int(rows_out) if isinstance(rows_out, (int, float)) \
+            else -1
+        est_rows = rows_out if rows_out >= 0 else input_rows
+        out.append({
+            "fingerprint": subplan_fingerprint(texts),
+            "depth": depth,
+            "kinds": [_text_kind(str(s.get("kind") or "?"))
+                      for s in lead[:depth]],
+            "seconds": round(max(seconds, 0.0), 6),
+            "measured": bool(measured),
+            "est_result_bytes": int(max(est_rows, 0)) * _EST_BYTES_PER_ROW,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Record normalization (one shape for the live feed and offline replay)
+# ---------------------------------------------------------------------------
+
+def record_from_history(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """One metrics-history JSONL record (obs/history.py — the
+    QueryMetrics.to_dict shape plus the sink's extras) normalized into
+    the workload-window record shape, or None for a non-record."""
+    if not isinstance(rec, dict):
+        return None
+    timings = rec.get("timings") or {}
+    cost = rec.get("cost") or {}
+    analysis = cost.get("analysis") or {}
+    host = rec.get("host") or {}
+    steps_in = rec.get("steps") or []
+    steps = []
+    for s in steps_in:
+        if not isinstance(s, dict) or not s.get("kind"):
+            continue
+        steps.append({
+            "kind": str(s["kind"]),
+            "seconds": float(s.get("seconds", -1.0) or 0.0),
+            "rows_in": int(s.get("rows_in", -1) or 0),
+            "rows_out": int(s.get("rows_out", -1) or 0),
+        })
+    execute = float(timings.get("execute_seconds") or 0.0)
+    input_rows = int((rec.get("input") or {}).get("rows") or 0)
+    prefixes = rec.get("prefixes")
+    if not isinstance(prefixes, list):
+        prefixes = prefixes_from_steps(steps_in, input_rows=input_rows,
+                                       execute_seconds=execute)
+    return {
+        "fingerprint": str(rec.get("fingerprint") or ""),
+        "mode": str(rec.get("mode") or "?"),
+        "total_seconds": float(rec.get("total_seconds")
+                               or timings.get("total_seconds") or 0.0),
+        "execute_seconds": execute,
+        "input_rows": input_rows,
+        "steps": steps,
+        "bytes_accessed": float(analysis.get("bytes_accessed") or 0.0),
+        "ici_seconds": float(cost.get("ici_seconds") or 0.0),
+        "host_syncs": int(host.get("syncs") or 0),
+        "prefixes": [p for p in prefixes if isinstance(p, dict)],
+    }
+
+
+def _record_from_qm(plan, qm) -> Dict[str, Any]:
+    """Normalized workload record straight off a completed QueryMetrics
+    (no to_dict round-trip on the hot completion path)."""
+    from .profile import cost_block
+    cb = cost_block(qm)
+    steps = [{
+        "kind": str(s.kind),
+        "seconds": float(getattr(s, "seconds", -1.0)),
+        "rows_in": int(getattr(s, "rows_in", -1)),
+        "rows_out": int(getattr(s, "rows_out", -1)),
+    } for s in (qm.steps or []) if getattr(s, "kind", None)]
+    return {
+        "fingerprint": str(qm.fingerprint or ""),
+        "mode": str(qm.mode or "?"),
+        "total_seconds": max(float(qm.total_seconds), 0.0),
+        "execute_seconds": max(float(qm.execute_seconds), 0.0),
+        "input_rows": int(qm.input_rows or 0),
+        "steps": steps,
+        "bytes_accessed": float(
+            (cb.get("analysis") or {}).get("bytes_accessed") or 0.0),
+        "ici_seconds": float(cb.get("ici_seconds") or 0.0),
+        "host_syncs": int(qm.host_syncs or 0),
+        "prefixes": plan_prefixes(plan, qm),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Feeds (hot path: one env read when off; normalize + append when on)
+# ---------------------------------------------------------------------------
+
+def feed_query(plan, qm) -> List[Dict[str, Any]]:
+    """One query completed: fold it into the workload window.  Called
+    from ``obs.history.maybe_record`` — the one completion point that
+    holds both the optimized plan and the QueryMetrics — so every
+    metered run/analyze/stream/dist query lands here.  Returns the
+    plan's prefix dicts so the history sink can embed them in the JSONL
+    record (offline replay then shares the live hash space)."""
+    if qm is None or not metrics_enabled():
+        return []
+    rec = _record_from_qm(plan, qm)
+    with _LOCK:
+        _QUERIES.append((_now(), rec))
+    return rec["prefixes"]
+
+
+def feed_ticket(fingerprint: str, plan) -> None:
+    """One ticket submitted to the serving scheduler: its plan's prefix
+    fingerprints join the window as in-flight recurrence evidence."""
+    if not metrics_enabled():
+        return
+    fps = tuple(p["fingerprint"] for p in plan_prefixes(plan))
+    with _LOCK:
+        _TICKETS.append((_now(), str(fingerprint or ""), fps))
+
+
+def reset() -> None:
+    """Drop the window and advisor state (test/bench isolation)."""
+    with _LOCK:
+        _QUERIES.clear()
+        _TICKETS.clear()
+    _ADVISOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# Pure derivations
+# ---------------------------------------------------------------------------
+
+def derive(records: Sequence[Dict[str, Any]],
+           tickets: Sequence[Tuple[str, Tuple[str, ...]]],
+           window_seconds: float, *, topk: int,
+           inflight_plans: Sequence[str] = ()) -> Dict[str, Any]:
+    """The workload snapshot for one window of normalized records —
+    pure.  ``tickets`` are ``(plan_fp, prefix_fps)`` pairs from the
+    scheduler feed; ``inflight_plans`` are the live registry's
+    currently-running plan fingerprints (context only).
+
+    Hotspot attribution: measured step seconds are used directly;
+    records without per-step measurements spread their
+    ``execute_seconds`` across their steps uniformly.  Record-level
+    ledger totals (bytes accessed, ICI seconds, host syncs) are
+    attributed to kinds proportionally to each step's seconds share —
+    an explainable estimate, cited as such.
+    """
+    topk = max(int(topk), 1)
+    window = max(window_seconds, 1e-9)
+
+    kinds: Dict[str, Dict[str, Any]] = {}
+    per_row: Dict[str, List[float]] = {}
+    overlaps: Dict[str, Dict[str, Any]] = {}
+    modes: Dict[str, int] = {}
+    plans = set()
+    total_step_seconds = 0.0
+
+    for rec in records:
+        fp = rec.get("fingerprint") or ""
+        if fp:
+            plans.add(fp)
+        modes[rec.get("mode", "?")] = modes.get(rec.get("mode", "?"), 0) + 1
+        steps = rec.get("steps") or []
+        n = len(steps)
+        secs = []
+        for s in steps:
+            sec = float(s.get("seconds", -1.0))
+            if sec < 0.0:
+                sec = float(rec.get("execute_seconds") or 0.0) / max(n, 1)
+            secs.append(max(sec, 0.0))
+        rec_total = sum(secs)
+        total_step_seconds += rec_total
+        for s, sec in zip(steps, secs):
+            kind = s["kind"]
+            share = sec / rec_total if rec_total > 0 else 1.0 / max(n, 1)
+            agg = kinds.setdefault(kind, {
+                "kind": kind, "seconds": 0.0, "steps": 0, "queries": set(),
+                "rows_in": 0, "rows_out": 0, "bytes": 0.0,
+                "ici_seconds": 0.0, "host_syncs": 0.0,
+            })
+            agg["seconds"] += sec
+            agg["steps"] += 1
+            agg["queries"].add(fp or id(rec))
+            if s.get("rows_in", -1) >= 0:
+                agg["rows_in"] += int(s["rows_in"])
+                agg["rows_out"] += max(int(s.get("rows_out", 0)), 0)
+                measured_sec = float(s.get("seconds", -1.0))
+                if measured_sec >= 0.0 and s["rows_in"] > 0:
+                    per_row.setdefault(kind, []).append(
+                        measured_sec / s["rows_in"])
+            agg["bytes"] += share * float(rec.get("bytes_accessed") or 0.0)
+            agg["ici_seconds"] += share * float(
+                rec.get("ici_seconds") or 0.0)
+            agg["host_syncs"] += share * float(rec.get("host_syncs") or 0)
+        for p in rec.get("prefixes") or []:
+            pfp = p.get("fingerprint")
+            if not pfp:
+                continue
+            o = overlaps.setdefault(pfp, {
+                "prefix_fingerprint": pfp, "depth": int(p.get("depth", 0)),
+                "kinds": list(p.get("kinds") or ()), "count": 0,
+                "plans": set(), "inflight": 0, "seconds_sum": 0.0,
+                "measured": False, "est_result_bytes": 0,
+            })
+            o["count"] += 1
+            if fp:
+                o["plans"].add(fp)
+            o["seconds_sum"] += float(p.get("seconds") or 0.0)
+            o["measured"] = o["measured"] or bool(p.get("measured"))
+            o["est_result_bytes"] = max(
+                o["est_result_bytes"], int(p.get("est_result_bytes") or 0))
+
+    for _plan_fp, fps in tickets:
+        for pfp in fps:
+            if pfp in overlaps:
+                overlaps[pfp]["inflight"] += 1
+
+    hotspots: List[Dict[str, Any]] = []
+    for agg in kinds.values():
+        sec = agg["seconds"]
+        share = sec / total_step_seconds if total_step_seconds > 0 else 0.0
+        samples = per_row.get(agg["kind"], [])
+        hotspots.append({
+            "kind": agg["kind"],
+            "seconds": round(sec, 6),
+            "share": round(share, 4),
+            "steps": agg["steps"],
+            "queries": len(agg["queries"]),
+            "rows_in": agg["rows_in"],
+            "rows_out": agg["rows_out"],
+            "bytes": round(agg["bytes"], 1),
+            "ici_seconds": round(agg["ici_seconds"], 6),
+            "host_syncs": round(agg["host_syncs"], 1),
+            "per_row_p50_s": percentile(samples, 50.0),
+            "per_row_p95_s": percentile(samples, 95.0),
+            "projected_win_s": round(sec * (1.0 - 1.0 / KERNEL_SPEEDUP), 6),
+        })
+    hotspots.sort(key=lambda h: (-h["seconds"], h["kind"]))
+
+    cands: List[Dict[str, Any]] = []
+    for o in overlaps.values():
+        mean = o["seconds_sum"] / o["count"] if o["count"] else 0.0
+        cands.append({
+            "prefix_fingerprint": o["prefix_fingerprint"],
+            "depth": o["depth"],
+            "kinds": o["kinds"],
+            "count": o["count"],
+            "plans": len(o["plans"]),
+            "inflight": o["inflight"],
+            "seconds_mean": round(mean, 6),
+            "measured": o["measured"],
+            "est_result_bytes": o["est_result_bytes"],
+            "benefit_score": round(
+                o["count"] * mean * max(o["est_result_bytes"], 1), 3),
+        })
+    cands = [c for c in cands if c["count"] >= OVERLAP_MIN_COUNT]
+    # Nested prefixes of one chain all recur together; among candidates
+    # covering the same query set at the same frequency, keep only the
+    # highest-benefit depth so the report names each chain once.
+    best: Dict[Tuple[int, int], Dict[str, Any]] = {}
+    for c in cands:
+        key = (c["count"], c["plans"])
+        cur = best.get(key)
+        if cur is None or (c["benefit_score"], c["depth"]) \
+                > (cur["benefit_score"], cur["depth"]):
+            best[key] = c
+    ranked = sorted(best.values(),
+                    key=lambda c: (-c["benefit_score"], -c["count"],
+                                   c["prefix_fingerprint"]))
+
+    return {
+        "window_seconds": window,
+        "queries": len(records),
+        "plans": len(plans),
+        "modes": dict(sorted(modes.items())),
+        "step_seconds": round(total_step_seconds, 6),
+        "step_kinds": len(kinds),
+        "hotspots": hotspots[:topk],
+        "overlaps": ranked[:topk],
+        "tickets": len(tickets),
+        "inflight_plans": sorted(set(fp for fp in inflight_plans if fp)),
+    }
+
+
+def recommend(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Ranked candidate actions for one workload snapshot — pure.
+
+    ``pallas_kernel:<kind>`` names a kernel target whose step kind
+    dominates the window; ``materialize_subplan:<fp>`` names a
+    recurring prefix worth a fragment cache.  Each cites its evidence,
+    like the capacity advisor's candidates."""
+    out: List[Dict[str, Any]] = []
+    for rank, h in enumerate(snap.get("hotspots") or []):
+        if h["share"] < HOTSPOT_MIN_SHARE \
+                or h["seconds"] < HOTSPOT_MIN_SECONDS:
+            continue
+        severity = 80 if h["share"] >= 0.5 else \
+            (65 if h["share"] >= 0.35 else 50)
+        out.append({
+            "action": f"pallas_kernel:{h['kind']}",
+            "severity": severity,
+            "reason": f"step kind {h['kind']!r} holds "
+                      f"{h['share']:.0%} of attributed step seconds in "
+                      f"the window — the top Pallas kernel target "
+                      f"(rank {rank + 1})",
+            "evidence": {
+                "seconds": h["seconds"],
+                "share": h["share"],
+                "queries": h["queries"],
+                "bytes": h["bytes"],
+                "ici_seconds": h["ici_seconds"],
+                "host_syncs": h["host_syncs"],
+                "per_row_p95_s": h["per_row_p95_s"],
+                "projected_win_s": h["projected_win_s"],
+            },
+        })
+    for o in snap.get("overlaps") or []:
+        if o["count"] < OVERLAP_MIN_COUNT or o["seconds_mean"] <= 0.0:
+            continue
+        severity = 75 if (o["count"] >= 4 and o["measured"]) else 55
+        out.append({
+            "action": f"materialize_subplan:{o['prefix_fingerprint']}",
+            "severity": severity,
+            "reason": f"subplan prefix "
+                      f"{' > '.join(o['kinds'])} recurred "
+                      f"{o['count']}x across {o['plans']} plan(s) — "
+                      f"materializing it would amortize "
+                      f"{o['seconds_mean']:.4f}s per recurrence",
+            "evidence": {
+                "prefix_fingerprint": o["prefix_fingerprint"],
+                "depth": o["depth"],
+                "count": o["count"],
+                "plans": o["plans"],
+                "inflight": o["inflight"],
+                "seconds_mean": o["seconds_mean"],
+                "measured": o["measured"],
+                "est_result_bytes": o["est_result_bytes"],
+                "benefit_score": o["benefit_score"],
+            },
+        })
+    out.sort(key=lambda r: (-r["severity"], r["action"]))
+    return out
+
+
+def verdict_for(recommendations: List[Dict[str, Any]]) -> str:
+    """One-word operator verdict for a workload recommendation set."""
+    if not recommendations:
+        return "quiet"
+    top = recommendations[0]["severity"]
+    if top >= 75:
+        return "actionable"
+    if top >= 50:
+        return "suggestive"
+    return "informational"
+
+
+# ---------------------------------------------------------------------------
+# Ambient wrappers (knobs + the live window; thin over the pure core)
+# ---------------------------------------------------------------------------
+
+_ADVISOR = Advisor()
+
+
+def window_records(w0: float, w1: float
+                   ) -> Tuple[List[Dict[str, Any]],
+                              List[Tuple[str, Tuple[str, ...]]]]:
+    """Copies of the live window's query records and ticket feeds whose
+    timestamps fall in ``[w0, w1]``."""
+    with _LOCK:
+        recs = [r for t, r in _QUERIES if w0 <= t <= w1]
+        tks = [(fp, fps) for t, fp, fps in _TICKETS if w0 <= t <= w1]
+    return recs, tks
+
+
+def _live_inflight_plans() -> List[str]:
+    """Plan fingerprints currently running per the live registry —
+    best-effort context for the snapshot."""
+    try:
+        from . import live
+        snap = live.snapshot_all()
+        return [q.get("fingerprint") or ""
+                for q in snap.get("in_flight", [])]
+    except Exception:
+        return []
+
+
+def snapshot(window_s: Optional[float] = None) -> Dict[str, Any]:
+    """Workload observables for the trailing window (knobs ambient)."""
+    from ..config import workload_topk, workload_window_s
+    window = workload_window_s() if window_s is None else float(window_s)
+    w1 = _now()
+    recs, tks = window_records(w1 - window, w1)
+    return derive(recs, tks, window, topk=workload_topk(),
+                  inflight_plans=_live_inflight_plans())
+
+
+def advise(window_s: Optional[float] = None,
+           advisor: Optional[Advisor] = None) -> Dict[str, Any]:
+    """One workload-advisor evaluation over the live window —
+    ``candidates`` are this window's raw proposals,
+    ``recommendations`` the hysteresis-stable set (the module-level
+    advisor by default, so repeated ``/workload`` fetches confirm and
+    clear actions; ``/metrics`` scrapes never call this)."""
+    snap = snapshot(window_s)
+    candidates = recommend(snap)
+    adv = _ADVISOR if advisor is None else advisor
+    recs = adv.observe(candidates)
+    return {
+        "snapshot": snap,
+        "candidates": candidates,
+        "recommendations": recs,
+        "verdict": verdict_for(recs if recs else candidates),
+    }
+
+
+def bundle_block() -> Dict[str, Any]:
+    """Workload block for a postmortem bundle — never raises, like
+    capacity.bundle_block (a broken miner must not block an incident
+    bundle)."""
+    try:
+        payload = advise()
+        return {
+            "snapshot": payload["snapshot"],
+            "recommendations": payload["recommendations"]
+            or payload["candidates"],
+            "verdict": payload["verdict"],
+        }
+    except Exception as exc:  # pragma: no cover - defensive
+        return {"snapshot": None, "recommendations": [],
+                "verdict": f"unavailable: {type(exc).__name__}"}
+
+
+def validate_payload(payload: Dict[str, Any],
+                     schema: Dict[str, Any]) -> List[str]:
+    """Check a ``/workload`` payload (also the shape ``obs workload
+    --json`` prints for every source) against the golden-pinned schema
+    (tests/golden/workload_endpoint_schema.json): exact top-level and
+    snapshot key sets, exact per-entry key sets for hotspots, overlap
+    candidates, and recommendations, a pinned verdict vocabulary, and a
+    pinned action namespace.  Returns human-readable problems (empty =
+    valid); shared by the test suite and the CI workload lane."""
+    errors: List[str] = []
+    if not isinstance(payload, dict):
+        return ["payload is not an object"]
+    if sorted(payload) != sorted(schema["top_level_keys"]):
+        return [f"top-level keys {sorted(payload)} != "
+                f"{sorted(schema['top_level_keys'])}"]
+    snap = payload["snapshot"]
+    if not isinstance(snap, dict):
+        return ["'snapshot' is not an object"]
+    if sorted(snap) != sorted(schema["snapshot_keys"]):
+        errors.append(f"snapshot keys {sorted(snap)} != "
+                      f"{sorted(schema['snapshot_keys'])}")
+    for i, h in enumerate(snap.get("hotspots") or []):
+        if not isinstance(h, dict) \
+                or sorted(h) != sorted(schema["hotspot_keys"]):
+            errors.append(f"hotspots[{i}] keys != {schema['hotspot_keys']}")
+    for i, o in enumerate(snap.get("overlaps") or []):
+        if not isinstance(o, dict) \
+                or sorted(o) != sorted(schema["overlap_keys"]):
+            errors.append(f"overlaps[{i}] keys != {schema['overlap_keys']}")
+    for group in ("candidates", "recommendations"):
+        for i, r in enumerate(payload.get(group) or []):
+            if not isinstance(r, dict) \
+                    or sorted(r) != sorted(schema["recommendation_keys"]):
+                errors.append(f"{group}[{i}] keys != "
+                              f"{schema['recommendation_keys']}")
+                continue
+            action = str(r.get("action") or "")
+            if action.split(":", 1)[0] not in schema["actions"]:
+                errors.append(f"{group}[{i}] action {action!r} outside "
+                              f"the pinned namespace {schema['actions']}")
+    if payload.get("verdict") not in schema["verdicts"]:
+        errors.append(f"verdict {payload.get('verdict')!r} not in "
+                      f"{schema['verdicts']}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Offline: replay metrics-history records through the same pure core
+# ---------------------------------------------------------------------------
+
+def records_from_history(records: Sequence[Dict[str, Any]]
+                         ) -> Tuple[List[Dict[str, Any]], float]:
+    """Normalize history JSONL records (oldest first) for
+    :func:`derive`.  Returns ``(records, window_seconds)`` — the replay
+    is serialized like capacity.events_from_history: the synthetic
+    window is the summed total_seconds, so hotspot shares read as "of
+    serialized runtime"."""
+    out: List[Dict[str, Any]] = []
+    cursor = 0.0
+    for rec in records:
+        norm = record_from_history(rec)
+        if norm is None:
+            continue
+        out.append(norm)
+        cursor += norm["total_seconds"]
+    return out, max(cursor, 1e-9)

@@ -233,6 +233,11 @@ class QuerySession:
         from ..obs.history import plan_fingerprint
         from ..obs.metrics import counter, gauge
         fingerprint = plan_fingerprint(plan)
+        # Workload intelligence: a submitted ticket's subplan prefixes
+        # are in-flight recurrence evidence for the overlap miner (one
+        # env read when metrics are off).
+        from ..obs import workload as _workload
+        _workload.feed_ticket(fingerprint, plan)
         if dist is not None:
             mode = "dist"
         elif table is not None:

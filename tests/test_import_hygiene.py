@@ -343,6 +343,57 @@ def test_capacity_advisor_import_without_jax(tmp_path):
     assert "jaxfree" in out.stdout
 
 
+def test_workload_import_without_jax(tmp_path):
+    """The workload analyzer (obs.workload) must work without jax: a
+    fleet sidecar mines hotspots and subplan overlaps from history
+    JSONL and scheduler feeds, never running a query.  The gated feeds,
+    the pure derive/recommend core, and the offline ``obs workload
+    --history`` replay are all jax-free."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    hist = tmp_path / "hist.jsonl"
+    with open(hist, "w") as f:
+        for fp in ("fpA", "fpB"):
+            f.write(json.dumps({
+                "fingerprint": fp, "mode": "table", "total_seconds": 1.0,
+                "timings": {"execute_seconds": 0.8},
+                "input": {"rows": 1000},
+                "steps": [{"kind": "Filter", "describe": "Filter[v>10]",
+                           "seconds": 0.6, "rows_in": 1000,
+                           "rows_out": 500}]}) + "\n")
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "import spark_rapids_tpu.obs.workload as workload\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing obs.workload pulled in jax'\n"
+        "assert workload.feed_query(None, object()) == []  # metrics off\n"
+        "workload.feed_ticket('fp', object())\n"
+        "snap = workload.snapshot(window_s=60)\n"
+        "assert snap['queries'] == 0 and snap['tickets'] == 0\n"
+        "assert workload.recommend(snap) == []\n"
+        "assert workload.verdict_for([]) == 'quiet'\n"
+        "import spark_rapids_tpu.obs.__main__ as cli\n"
+        f"payload = cli._workload_history({str(hist)!r}, last=16)\n"
+        "hot = payload['snapshot']['hotspots']\n"
+        "assert hot and hot[0]['kind'] == 'Filter', hot\n"
+        "assert payload['snapshot']['overlaps'], payload\n"
+        "assert 'jax' not in sys.modules, 'the workload path pulled jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    for k in ("SRT_METRICS", "SRT_WORKLOAD_WINDOW_S", "SRT_WORKLOAD_TOPK",
+              "SRT_METRICS_HISTORY"):
+        env.pop(k, None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_cold_import_does_not_load_obs():
     """A plain ``import spark_rapids_tpu`` must not pay for the metrics
     subsystem (it is lazy-imported at the first metered region)."""

@@ -1,0 +1,605 @@
+"""Workload intelligence (obs/workload.py) and its surfaces
+(``/workload``, ``srt_workload_*`` gauges, ``obs workload``, the bundle
+``workload`` block).
+
+Five contracts, mirroring tests/test_capacity.py:
+
+1. **Pure mining math** — hotspot attribution (measured seconds direct,
+   unmeasured spread uniformly, ledger totals split by seconds share),
+   per-row percentiles, overlap counting/dedup/benefit scoring, and
+   ``recommend``/``verdict_for`` are plain functions over explicit
+   inputs.
+2. **One prefix hash space** — ``plan_prefixes`` (live),
+   ``prefixes_from_steps`` (old-corpus fallback), and the history
+   sink's embedded ``prefixes`` canonicalize stably, so live windows
+   and offline replay mine the same fingerprints.
+3. **Deterministic advice with hysteresis** — the same confirm/clear
+   ``Advisor`` discipline as the capacity advisor; ``/metrics`` scrapes
+   never advance it.
+4. **Gated feeds** — every ``feed_*`` is a no-op unless
+   ``SRT_METRICS=1``; a metered run lands in the window via
+   ``history.maybe_record`` with the optimized plan's prefixes.
+5. **Surfaces** — ``/workload`` matches the golden-pinned endpoint
+   schema, gauges are on ``/metrics``, bundles carry a ``workload``
+   block the doctor turns into fleet-context findings, and the offline
+   replay drives the same derive/recommend core through the shared
+   ``history.iter_records`` reader.
+"""
+
+import json
+import pathlib
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table, config
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.obs import capacity, history, server, workload
+from spark_rapids_tpu.obs.metrics import registry
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
+
+
+def _golden(name):
+    with open(GOLDEN / name) as f:
+        return json.load(f)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for knob in ("SRT_WORKLOAD_WINDOW_S", "SRT_WORKLOAD_TOPK",
+                 "SRT_METRICS_HISTORY", "SRT_RESULT_CACHE"):
+        monkeypatch.delenv(knob, raising=False)
+    workload.reset()
+    capacity.reset()
+    registry().reset()
+    server.reset_histograms()
+    yield
+    workload.reset()
+    capacity.reset()
+    registry().reset()
+    server.reset_histograms()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    yield
+
+
+@pytest.fixture
+def metrics_off(monkeypatch):
+    monkeypatch.delenv("SRT_METRICS", raising=False)
+    yield
+
+
+def _rec(fp="fpA", steps=(), execute=1.0, total=1.5, rows=1000,
+         bytes_accessed=0.0, ici=0.0, syncs=0, prefixes=(), mode="table"):
+    """A normalized workload-window record (the derive() input shape)."""
+    return {
+        "fingerprint": fp, "mode": mode, "total_seconds": total,
+        "execute_seconds": execute, "input_rows": rows,
+        "steps": [dict(s) for s in steps],
+        "bytes_accessed": bytes_accessed, "ici_seconds": ici,
+        "host_syncs": syncs, "prefixes": [dict(p) for p in prefixes],
+    }
+
+
+def _step(kind, seconds, rows_in=-1, rows_out=-1):
+    return {"kind": kind, "seconds": seconds,
+            "rows_in": rows_in, "rows_out": rows_out}
+
+
+def _hot(kind, seconds, share, **over):
+    h = {"kind": kind, "seconds": seconds, "share": share, "steps": 1,
+         "queries": 1, "rows_in": 0, "rows_out": 0, "bytes": 0.0,
+         "ici_seconds": 0.0, "host_syncs": 0.0, "per_row_p50_s": None,
+         "per_row_p95_s": None,
+         "projected_win_s": seconds * (1 - 1 / workload.KERNEL_SPEEDUP)}
+    h.update(over)
+    return h
+
+
+def _overlap(fp, count, seconds_mean, measured, plans=2, **over):
+    o = {"prefix_fingerprint": fp, "depth": 2, "kinds": ["Filter", "Project"],
+         "count": count, "plans": plans, "inflight": 0,
+         "seconds_mean": seconds_mean, "measured": measured,
+         "est_result_bytes": 800,
+         "benefit_score": count * seconds_mean * 800}
+    o.update(over)
+    return o
+
+
+def _table(n=400):
+    return Table.from_pydict({
+        "k": (np.arange(n) % 5).astype(np.int32),
+        "v": np.arange(n, dtype=np.float32),
+    })
+
+
+def _query():
+    return (plan()
+            .filter(col("v") > 10.0)
+            .with_columns(d=col("v") * 2.0)
+            .groupby_agg(["k"], [("d", "sum", "s")], domains={"k": (0, 4)}))
+
+
+# -- pure mining math --------------------------------------------------
+
+
+def test_derive_empty_window():
+    snap = workload.derive([], [], 60.0, topk=8)
+    assert snap["queries"] == 0 and snap["plans"] == 0
+    assert snap["hotspots"] == [] and snap["overlaps"] == []
+    assert snap["step_seconds"] == 0.0
+    assert workload.recommend(snap) == []
+    assert workload.verdict_for([]) == "quiet"
+
+
+def test_hotspot_ranking_share_and_projected_win():
+    recs = [_rec(fp, steps=[_step("Filter", 0.6, 1000, 500),
+                            _step("GroupBy[dense]", 0.2, 500, 10)])
+            for fp in ("fpA", "fpB")]
+    snap = workload.derive(recs, [], 60.0, topk=8)
+    hot = snap["hotspots"]
+    assert [h["kind"] for h in hot] == ["Filter", "GroupBy[dense]"]
+    assert hot[0]["seconds"] == pytest.approx(1.2)
+    assert hot[0]["share"] == pytest.approx(0.75)
+    assert hot[0]["queries"] == 2 and hot[0]["steps"] == 2
+    assert hot[0]["projected_win_s"] == pytest.approx(
+        1.2 * (1 - 1 / workload.KERNEL_SPEEDUP))
+    assert snap["step_seconds"] == pytest.approx(1.6)
+    assert snap["plans"] == 2 and snap["step_kinds"] == 2
+
+
+def test_unmeasured_steps_spread_execute_uniformly():
+    rec = _rec(steps=[_step("Filter", -1.0), _step("Sort", -1.0)],
+               execute=1.0)
+    snap = workload.derive([rec], [], 60.0, topk=8)
+    by_kind = {h["kind"]: h for h in snap["hotspots"]}
+    assert by_kind["Filter"]["seconds"] == pytest.approx(0.5)
+    assert by_kind["Sort"]["seconds"] == pytest.approx(0.5)
+    # No measured per-step observations: no per-row percentiles.
+    assert by_kind["Filter"]["per_row_p95_s"] is None
+
+
+def test_ledger_totals_attributed_by_seconds_share():
+    rec = _rec(steps=[_step("Filter", 0.75, 100, 50),
+                      _step("Sort", 0.25, 50, 50)],
+               bytes_accessed=1000.0, ici=0.4, syncs=8)
+    snap = workload.derive([rec], [], 60.0, topk=8)
+    by_kind = {h["kind"]: h for h in snap["hotspots"]}
+    assert by_kind["Filter"]["bytes"] == pytest.approx(750.0)
+    assert by_kind["Sort"]["bytes"] == pytest.approx(250.0)
+    assert by_kind["Filter"]["ici_seconds"] == pytest.approx(0.3)
+    assert by_kind["Filter"]["host_syncs"] == pytest.approx(6.0)
+
+
+def test_per_row_percentiles_from_measured_steps():
+    recs = [_rec("fpA", steps=[_step("Filter", 0.1, 1000, 500)]),
+            _rec("fpB", steps=[_step("Filter", 0.2, 1000, 500)]),
+            _rec("fpC", steps=[_step("Filter", 0.3, 1000, 500)])]
+    snap = workload.derive(recs, [], 60.0, topk=8)
+    [h] = snap["hotspots"]
+    assert h["per_row_p50_s"] == pytest.approx(0.2 / 1000)
+    assert h["per_row_p95_s"] == pytest.approx(0.3 / 1000)
+    assert h["rows_in"] == 3000 and h["rows_out"] == 1500
+
+
+def test_topk_bounds_both_reports():
+    recs = [_rec(f"fp{i}", steps=[_step(f"Kind{i}", 0.1 * (i + 1))])
+            for i in range(5)]
+    snap = workload.derive(recs, [], 60.0, topk=2)
+    assert len(snap["hotspots"]) == 2
+    assert snap["step_kinds"] == 5          # aggregated, not surfaced
+
+
+def test_overlap_counting_dedup_and_ticket_inflight():
+    p1 = {"fingerprint": "p1", "depth": 1, "kinds": ["Filter"],
+          "seconds": 0.1, "measured": True, "est_result_bytes": 800}
+    p2 = {"fingerprint": "p2", "depth": 2, "kinds": ["Filter", "Project"],
+          "seconds": 0.3, "measured": True, "est_result_bytes": 400}
+    lone = {"fingerprint": "p3", "depth": 1, "kinds": ["Filter"],
+            "seconds": 0.5, "measured": True, "est_result_bytes": 100}
+    recs = [_rec("fpA", prefixes=[p1, p2]),
+            _rec("fpB", prefixes=[p1, p2]),
+            _rec("fpC", prefixes=[lone])]
+    tickets = [("fpT", ("p2", "unknown"))]
+    snap = workload.derive(recs, tickets, 60.0, topk=8)
+    # p1 and p2 recur together (same count, same plan set): the dedup
+    # keeps only the higher-benefit depth; the once-seen p3 is below
+    # OVERLAP_MIN_COUNT.
+    assert [o["prefix_fingerprint"] for o in snap["overlaps"]] == ["p2"]
+    [o] = snap["overlaps"]
+    assert o["count"] == 2 and o["plans"] == 2 and o["inflight"] == 1
+    assert o["seconds_mean"] == pytest.approx(0.3)
+    assert o["benefit_score"] == pytest.approx(2 * 0.3 * 400)
+    assert snap["tickets"] == 1
+
+
+def test_recommend_thresholds_severities_and_order():
+    snap = {
+        "hotspots": [
+            _hot("Dominant", 1.0, 0.60),      # >= 0.5 -> 80
+            _hot("Strong", 1.0, 0.40),        # >= 0.35 -> 65
+            _hot("Borderline", 1.0, 0.30),    # >= MIN_SHARE -> 50
+            _hot("TooSmall", 0.01, 0.30),     # under the seconds floor
+            _hot("ThinShare", 1.0, 0.10),     # under MIN_SHARE
+        ],
+        "overlaps": [
+            _overlap("hotfp", 4, 0.2, True),      # measured, >= 4 -> 75
+            _overlap("coldfp", 2, 0.2, False),    # -> 55
+            _overlap("freefp", 4, 0.0, True),     # zero mean cost: skip
+        ],
+    }
+    recs = workload.recommend(snap)
+    assert [(r["action"], r["severity"]) for r in recs] == [
+        ("pallas_kernel:Dominant", 80),
+        ("materialize_subplan:hotfp", 75),
+        ("pallas_kernel:Strong", 65),
+        ("materialize_subplan:coldfp", 55),
+        ("pallas_kernel:Borderline", 50),
+    ]
+    assert recs[0]["evidence"]["projected_win_s"] == pytest.approx(0.5)
+    assert recs[1]["evidence"]["count"] == 4
+    assert workload.verdict_for(recs) == "actionable"
+    assert workload.verdict_for(recs[2:]) == "suggestive"
+    assert workload.verdict_for(
+        [dict(recs[0], severity=40)]) == "informational"
+
+
+# -- prefix canonicalization (one hash space) --------------------------
+
+
+def test_plan_prefixes_stable_and_plan_sensitive():
+    p = _query()
+    a = workload.plan_prefixes(p)
+    b = workload.plan_prefixes(_query())
+    assert a and [x["fingerprint"] for x in a] \
+        == [x["fingerprint"] for x in b]
+    assert [x["depth"] for x in a] == list(range(1, len(a) + 1))
+    assert a[0]["kinds"][0] == "Filter"
+    # Without a qm there is no cost/rows evidence, only structure.
+    assert a[0]["seconds"] == 0.0 and a[0]["measured"] is False
+    other = workload.plan_prefixes(plan().filter(col("v") > 99.0))
+    assert other[0]["fingerprint"] != a[0]["fingerprint"]
+    # A plan the walker cannot read yields no prefixes, never raises.
+    assert workload.plan_prefixes(object()) == []
+
+
+def test_prefixes_from_steps_fallback():
+    steps = [
+        {"kind": "Filter", "describe": "Filter[v>10]", "seconds": 0.5,
+         "rows_in": 100, "rows_out": 50},
+        {"kind": "Project", "describe": "Project[d=v*2]", "seconds": 0.25,
+         "rows_in": 50, "rows_out": 50},
+        {"kind": "GroupBy[dense]", "describe": "GroupBy[k]", "seconds": 0.1,
+         "rows_in": 50, "rows_out": 5},
+    ]
+    out = workload.prefixes_from_steps(steps)
+    # The leading Filter/Project run, not the GroupBy tail.
+    assert [p["depth"] for p in out] == [1, 2]
+    assert out[1]["kinds"] == ["Filter", "Project"]
+    assert out[1]["seconds"] == pytest.approx(0.75)
+    assert out[1]["measured"] is True
+    assert out[1]["est_result_bytes"] == 50 * 8
+    # Canonicalization is exactly subplan_fingerprint over describes.
+    assert out[1]["fingerprint"] == history.subplan_fingerprint(
+        ["Filter[v>10]", "Project[d=v*2]"])
+    assert workload.prefixes_from_steps(steps) == out
+
+
+def test_subplan_fingerprint_is_stable_hex():
+    fp = history.subplan_fingerprint(["Filter[v>10]", "Project[d]"])
+    assert fp == history.subplan_fingerprint(["Filter[v>10]", "Project[d]"])
+    assert len(fp) == 16 and int(fp, 16) >= 0
+    assert fp != history.subplan_fingerprint(["Filter[v>11]", "Project[d]"])
+
+
+def test_record_from_history_normalizes_and_falls_back():
+    raw = {
+        "fingerprint": "fpH", "mode": "table", "total_seconds": 1.5,
+        "timings": {"execute_seconds": 1.0}, "input": {"rows": 1000},
+        "steps": [{"kind": "Filter", "describe": "Filter[v>10]",
+                   "seconds": 0.5, "rows_in": 100, "rows_out": 50}],
+        "cost": {"ici_seconds": 0.2, "analysis": {"bytes_accessed": 5000}},
+        "host": {"syncs": 3},
+    }
+    norm = workload.record_from_history(raw)
+    assert norm["fingerprint"] == "fpH"
+    assert norm["execute_seconds"] == pytest.approx(1.0)
+    assert norm["bytes_accessed"] == pytest.approx(5000.0)
+    assert norm["ici_seconds"] == pytest.approx(0.2)
+    assert norm["host_syncs"] == 3 and norm["input_rows"] == 1000
+    # No embedded prefixes: recovered from the recorded describe texts.
+    assert norm["prefixes"] and norm["prefixes"][0]["fingerprint"] \
+        == history.subplan_fingerprint(["Filter[v>10]"])
+    # Embedded prefixes (new-format records) are used verbatim.
+    pinned = [{"fingerprint": "livehash", "depth": 1, "kinds": ["Filter"],
+               "seconds": 0.5, "measured": True, "est_result_bytes": 8}]
+    norm2 = workload.record_from_history(dict(raw, prefixes=pinned))
+    assert norm2["prefixes"] == pinned
+    assert workload.record_from_history("not a record") is None
+    recs, window = workload.records_from_history([raw, raw])
+    assert len(recs) == 2 and window == pytest.approx(3.0)
+
+
+# -- gated feeds + live wiring -----------------------------------------
+
+
+def test_feeds_are_noops_when_metrics_off(metrics_off):
+    assert workload.feed_query(object(), object()) == []
+    workload.feed_ticket("fpA", object())
+    snap = workload.snapshot(window_s=3600)
+    assert snap["queries"] == 0 and snap["tickets"] == 0
+
+
+def test_feed_query_rejects_missing_qm(metrics_on):
+    assert workload.feed_query(_query(), None) == []
+    assert workload.snapshot(window_s=3600)["queries"] == 0
+
+
+def test_metered_run_lands_in_window_with_prefixes(metrics_on):
+    t = _table()
+    q = _query()
+    q.run(t)
+    q.run(t)
+    snap = workload.snapshot(window_s=3600)
+    assert snap["queries"] == 2 and snap["plans"] == 1
+    assert snap["hotspots"] and snap["step_seconds"] > 0.0
+    # The optimized plan's prefix recurred across both runs.
+    assert snap["overlaps"] and snap["overlaps"][0]["count"] == 2
+
+
+def test_feed_ticket_counts_in_window(metrics_on):
+    workload.feed_ticket("fpT", _query())
+    assert workload.snapshot(window_s=3600)["tickets"] == 1
+
+
+def test_history_sink_embeds_live_prefixes(metrics_on, tmp_path,
+                                           monkeypatch):
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("SRT_METRICS_HISTORY", str(path))
+    _query().run(_table())
+    [raw] = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert raw["prefixes"], raw.keys()
+    # The embedded fingerprints are exactly the live window's hash space.
+    window_recs, _ = workload.window_records(0.0, float("inf"))
+    window_fps = {p["fingerprint"] for r in window_recs
+                  for p in r["prefixes"]}
+    assert {p["fingerprint"] for p in raw["prefixes"]} == window_fps
+
+
+# -- hysteresis + surfaces ---------------------------------------------
+
+
+def test_metrics_scrape_does_not_advance_hysteresis(metrics_on):
+    t = _table()
+    q = _query()
+    q.run(t)
+    q.run(t)
+    for _ in range(5):
+        server.prometheus_text()
+    payload = workload.advise(window_s=3600)
+    # First real advise(): candidates are fresh (streak 1), so nothing
+    # can be confirmed yet no matter how often /metrics was scraped.
+    assert payload["candidates"]
+    assert payload["recommendations"] == []
+
+
+def test_advise_confirms_across_evaluations(metrics_on):
+    t = _table()
+    q = _query()
+    q.run(t)
+    q.run(t)
+    first = workload.advise(window_s=3600)
+    second = workload.advise(window_s=3600)
+    assert first["recommendations"] == []
+    actions = [r["action"] for r in second["recommendations"]]
+    assert any(a.startswith("materialize_subplan:") for a in actions)
+    assert second["verdict"] in ("suggestive", "actionable")
+
+
+def test_workload_endpoint_and_gauges_match_golden(metrics_on):
+    t = _table()
+    q = _query()
+    q.run(t)
+    q.run(t)
+    schema = _golden("workload_endpoint_schema.json")
+    srv = server.start(port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/workload",
+                                    timeout=5) as resp:
+            payload = json.loads(resp.read().decode())
+        assert workload.validate_payload(payload, schema) == []
+        assert payload["snapshot"]["queries"] == 2
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert "srt_workload_queries 2" in text
+        assert 'srt_workload_hotspot_seconds{kind="' in text
+        assert "# TYPE srt_workload_queries gauge" in text
+    finally:
+        server.stop()
+
+
+def test_validate_payload_flags_drift():
+    schema = _golden("workload_endpoint_schema.json")
+    snap = workload.derive([], [], 60.0, topk=8)
+    good = {"snapshot": snap, "candidates": [], "recommendations": [],
+            "verdict": "quiet"}
+    assert workload.validate_payload(good, schema) == []
+    assert workload.validate_payload({"snapshot": snap}, schema)
+    bad_snap = dict(snap)
+    bad_snap.pop("tickets")
+    assert workload.validate_payload(dict(good, snapshot=bad_snap), schema)
+    rogue = dict(good, candidates=[
+        {"action": "rm_rf:/", "severity": 99, "reason": "", "evidence": {}}])
+    assert any("namespace" in e
+               for e in workload.validate_payload(rogue, schema))
+    assert workload.validate_payload(dict(good, verdict="?"), schema)
+
+
+def test_bundle_carries_workload_block(metrics_on):
+    from spark_rapids_tpu.obs import bundle
+    _query().run(_table())
+    payload = bundle.build("failure")
+    assert set(payload["workload"]) == {"snapshot", "recommendations",
+                                        "verdict"}
+    errors = bundle.validate_bundle(
+        payload, _golden("postmortem_bundle_schema.json"))
+    assert errors == [], errors
+
+
+def test_doctor_turns_workload_block_into_findings():
+    from spark_rapids_tpu.obs.doctor import diagnose
+    payload = {
+        "metric": "postmortem_bundle", "fingerprint": "fpA",
+        "error": {}, "recovery": {}, "slo": {},
+        "metrics": {"steps": [{"kind": "Filter", "seconds": 0.9},
+                              {"kind": "GroupBy[dense]", "seconds": 0.1}]},
+        "workload": {
+            "snapshot": {"hotspots": [
+                {"kind": "Filter", "seconds": 5.0, "queries": 7,
+                 "share": 0.6, "projected_win_s": 2.5}]},
+            "recommendations": [
+                {"action": "materialize_subplan:abc123", "severity": 75,
+                 "reason": "recurs 4x", "evidence": {"count": 4}}],
+            "verdict": "actionable",
+        },
+    }
+    report = diagnose(payload)
+    titles = [f["title"] for f in report["findings"]]
+    assert any("fleet's #1 hotspot" in t for t in titles), titles
+    assert any("materialize_subplan:abc123" in t for t in titles), titles
+    # Pre-v3 bundles (no workload block) still diagnose cleanly.
+    payload.pop("workload")
+    assert diagnose(payload)["verdict"]
+
+
+def test_render_workload_is_pure():
+    from spark_rapids_tpu.obs.__main__ import render_workload
+    snap = workload.derive(
+        [_rec("fpA", steps=[_step("Filter", 0.6, 1000, 500)],
+              prefixes=[{"fingerprint": "pX", "depth": 1,
+                         "kinds": ["Filter"], "seconds": 0.6,
+                         "measured": True, "est_result_bytes": 4000}]),
+         _rec("fpB", steps=[_step("Filter", 0.6, 1000, 500)],
+              prefixes=[{"fingerprint": "pX", "depth": 1,
+                         "kinds": ["Filter"], "seconds": 0.6,
+                         "measured": True, "est_result_bytes": 4000}])],
+        [], 60.0, topk=8)
+    cands = workload.recommend(snap)
+    out = render_workload({"snapshot": snap, "candidates": cands,
+                           "recommendations": [],
+                           "verdict": workload.verdict_for(cands)},
+                          source="test")
+    assert "verdict=" in out and "Filter" in out
+    assert "op hotspots" in out and "pX" in out
+    assert "candidates (unconfirmed):" in out
+    empty = render_workload({"snapshot": workload.derive([], [], 1, topk=1),
+                             "candidates": [], "recommendations": [],
+                             "verdict": "quiet"})
+    assert "none — workload looks quiet" in empty
+
+
+# -- offline replay (shared history reader) ----------------------------
+
+
+def _history_file(tmp_path, n=4):
+    path = tmp_path / "hist.jsonl"
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "fingerprint": f"fp{i % 2}", "mode": "table",
+                "total_seconds": 1.0,
+                "timings": {"execute_seconds": 0.8},
+                "input": {"rows": 1000},
+                "steps": [
+                    {"kind": "Filter", "describe": "Filter[v>10]",
+                     "seconds": 0.6, "rows_in": 1000, "rows_out": 500},
+                    {"kind": "Sort", "describe": "Sort[v]",
+                     "seconds": 0.2, "rows_in": 500, "rows_out": 500}],
+                "unix_time": 1000.0 + i}) + "\n")
+    return path
+
+
+def test_offline_history_replay_ranks_kinds(tmp_path):
+    from spark_rapids_tpu.obs.__main__ import _workload_history
+    payload = _workload_history(str(_history_file(tmp_path)), last=256)
+    snap = payload["snapshot"]
+    assert snap["queries"] == 4 and snap["plans"] == 2
+    assert [h["kind"] for h in snap["hotspots"]] == ["Filter", "Sort"]
+    assert snap["hotspots"][0]["seconds"] == pytest.approx(2.4)
+    # The shared Filter prefix recurred across both fingerprints.
+    assert snap["overlaps"] and snap["overlaps"][0]["plans"] == 2
+    # One-shot advisor (confirm=1): recommendations surface immediately.
+    assert payload["recommendations"], payload
+    assert workload.validate_payload(
+        payload, _golden("workload_endpoint_schema.json")) == []
+
+
+def test_iter_records_filters_and_counts_corruption(tmp_path, metrics_on):
+    path = _history_file(tmp_path)
+    with open(path, "a") as f:
+        f.write("{corrupt\n")
+    recs = list(history.iter_records(str(path)))
+    assert len(recs) == 4                      # newest first, junk skipped
+    assert recs[0]["unix_time"] == pytest.approx(1003.0)
+    assert registry().counter("history.corrupt_lines").value == 1
+    assert len(list(history.iter_records(str(path), last=2))) == 2
+    assert all(r["fingerprint"] == "fp1"
+               for r in history.iter_records(str(path), fingerprint="fp1"))
+    assert len(list(history.iter_records(str(path), since=1002.0))) == 2
+    assert list(history.iter_records(str(tmp_path / "missing.jsonl"))) == []
+
+
+# -- satellite pins ----------------------------------------------------
+
+
+def test_span_step_kind_args_agree_with_capacity(metrics_on):
+    # The executors stamp step_kind into every metered span's args; the
+    # label must agree with capacity.span_step_kind's busy
+    # classification so trace readers and the accountant never diverge.
+    from spark_rapids_tpu.obs import flight, last_query_metrics
+    _query().run(_table())
+    qid = last_query_metrics().query_id
+    snap = flight.snapshot(qid)
+    assert snap is not None
+    xs = [e for e in snap["trace"]["traceEvents"] if e["ph"] == "X"]
+    metered = [e for e in xs
+               if capacity.span_step_kind(e["name"]) is not None]
+    assert metered, [e["name"] for e in xs]
+    for e in metered:
+        assert e["args"].get("step_kind") \
+            == capacity.span_step_kind(e["name"]), e
+
+
+def test_workload_knob_hygiene(monkeypatch):
+    assert config.workload_window_s() == 300.0
+    assert config.workload_topk() == 8
+    monkeypatch.setenv("SRT_WORKLOAD_WINDOW_S", "12.5")
+    monkeypatch.setenv("SRT_WORKLOAD_TOPK", "3")
+    assert config.workload_window_s() == 12.5
+    assert config.workload_topk() == 3
+    for knob, bad in (("SRT_WORKLOAD_WINDOW_S", "soon"),
+                      ("SRT_WORKLOAD_WINDOW_S", "0"),
+                      ("SRT_WORKLOAD_TOPK", "many"),
+                      ("SRT_WORKLOAD_TOPK", "0")):
+        monkeypatch.setenv(knob, bad)
+        with pytest.raises(ValueError, match=knob):
+            (config.workload_window_s if "WINDOW" in knob
+             else config.workload_topk)()
+        monkeypatch.delenv(knob)
+
+
+def test_snapshot_honors_knobs(metrics_on, monkeypatch):
+    t = _table()
+    q = _query()
+    q.run(t)
+    q.run(t)
+    monkeypatch.setenv("SRT_WORKLOAD_TOPK", "1")
+    snap = workload.snapshot(window_s=3600)
+    assert len(snap["hotspots"]) == 1
+    assert snap["step_kinds"] >= 1
