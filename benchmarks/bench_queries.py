@@ -1166,6 +1166,198 @@ def bench_serving(sf_rows=120_000, n_queries=40, n_clients=4):
             f"from the sequential oracle")
 
 
+def bench_semantic(sf_rows=120_000, n_queries=40, n_clients=4,
+                   n_batches=6):
+    """``--semantic``: the semantic subplan cache + materialized views
+    under a workload-representative load (``SRT_SEMANTIC_CACHE=1``,
+    ``SRT_VIEWS=1``).
+
+    Two measurements, one ``semantic_cache`` JSON line:
+
+    * an overlapping broadcast-join bank (shared filter+join prefix,
+      divergent aggregation tails) driven through ``serve.submit`` by
+      ``n_clients`` closed-loop clients — every served result is
+      checked **bit-identical** to the bare-executor oracle computed
+      with the cache off, and the line reports sustained qps,
+      p50/p99 latency, and the subplan cache's hit rate;
+    * one materialized view folded batch-by-batch — the incremental
+      ``refresh()`` after the last fold is timed against a full
+      streaming-combine recompute over the whole history, checked
+      bit-identical, and reported as the refresh delta.
+
+    Exits nonzero on any parity loss (CSE splice or view maintenance).
+    """
+    import os
+    import threading
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import col, plan, run_plan_stream
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.serve import QuerySession
+    from spark_rapids_tpu.serve import semantic
+    from spark_rapids_tpu import views as views_pkg
+
+    os.environ["SRT_METRICS"] = "1"
+    saved = {k: os.environ.get(k)
+             for k in ("SRT_SEMANTIC_CACHE", "SRT_VIEWS")}
+    t0 = time.perf_counter()
+    d = tpcds.generate(sf_rows, seed=7)
+    print(f"# semantic: generated sf_rows={sf_rows} in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    ss = d.store_sales
+    stores = srt.Table([
+        ("s_store_sk", d.store["s_store_sk"]),
+        ("s_number_employees", d.store["s_number_employees"]),
+    ])
+    smax = int(np.asarray(d.store["s_store_sk"].data).max())
+
+    # Shared filter+broadcast-join prefix; the tails aggregate the SAME
+    # column set so the optimizer's pruning projection (and with it the
+    # prefix fingerprint) is identical across the bank.
+    def bank_plan(aggs):
+        return (plan()
+                .filter(col("ss_quantity") > 10)
+                .join_broadcast(stores, left_on="ss_store_sk",
+                                right_on="s_store_sk")
+                .groupby_agg(["ss_store_sk"], aggs))
+
+    shapes = [
+        ("sum", bank_plan([("ss_ext_sales_price", "sum", "rev"),
+                           ("ss_quantity", "sum", "qty")])),
+        ("minmax", bank_plan([("ss_ext_sales_price", "min", "lo"),
+                              ("ss_ext_sales_price", "max", "hi"),
+                              ("ss_quantity", "count", "n")])),
+        ("mean", bank_plan([("ss_ext_sales_price", "mean", "avg"),
+                            ("ss_quantity", "max", "qmax")])),
+    ]
+
+    # Oracle with the cache OFF — the bare executor is the bit-identity
+    # reference (and warms the compile caches off the clock).
+    os.environ["SRT_SEMANTIC_CACHE"] = "0"
+    os.environ["SRT_VIEWS"] = "0"
+    semantic.reset()
+    views_pkg.reset()
+    oracle = {name: p.run(ss).to_pydict() for name, p in shapes}
+
+    os.environ["SRT_SEMANTIC_CACHE"] = "1"
+    os.environ["SRT_VIEWS"] = "1"
+    session = QuerySession(max_concurrent=n_clients,
+                           register_queued=False)
+    work = [shapes[i % len(shapes)] for i in range(n_queries)]
+    latencies = [None] * n_queries
+    failures = []
+    next_i = [0]
+    pick = threading.Lock()
+
+    def client():
+        while True:
+            with pick:
+                i = next_i[0]
+                if i >= n_queries:
+                    return
+                next_i[0] += 1
+            name, p = work[i]
+            t1 = time.perf_counter()
+            got = session.submit(p, table=ss).result().to_pydict()
+            latencies[i] = time.perf_counter() - t1
+            if got != oracle[name]:
+                failures.append(name)
+
+    try:
+        # Warm-up: two sequential passes over the bank materialize the
+        # shared prefix (interest threshold 2) and compile the spliced
+        # program off the clock — otherwise the one cold splice compile
+        # outlives every other query in the bank and the timed window
+        # closes with the entry still in flight.  The timed closed-loop
+        # below measures steady-state hit traffic.
+        for _ in range(2):
+            for name, p in shapes:
+                got = session.submit(p, table=ss).result().to_pydict()
+                if got != oracle[name]:
+                    failures.append(name)
+        t1 = time.perf_counter()
+        clients = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join()
+        wall = time.perf_counter() - t1
+        session.close()
+        cse = semantic.stats()
+
+        # Materialized view: fold batch-by-batch, time the incremental
+        # refresh after the last fold against a full recompute.
+        host = {n: np.asarray(c.data) for n, c in ss.items()}
+        step = max(1, ss.num_rows // n_batches)
+        batches = [srt.Table([(n, Column.from_numpy(
+            v[i * step:(i + 1) * step])) for n, v in host.items()])
+            for i in range(n_batches)]
+        batches = [b for b in batches if b.num_rows]
+        pv = (plan()
+              .filter(col("ss_quantity") > 10)
+              .groupby_agg(["ss_store_sk"],
+                           [("ss_ext_sales_price", "sum", "rev"),
+                            ("ss_quantity", "sum", "qty")],
+                           domains={"ss_store_sk": (0, smax)}))
+        view = views_pkg.register("bench:rev_by_store", pv)
+        for b in batches[:-1]:
+            view.fold(b)
+        view.refresh()                       # steady state: fresh view
+        view.fold(batches[-1])               # one new batch arrives
+        t2 = time.perf_counter()
+        incr = view.result()                 # incremental refresh
+        refresh_s = time.perf_counter() - t2
+        list(run_plan_stream(pv, list(batches), combine=True))  # warm
+        t3 = time.perf_counter()
+        full = list(run_plan_stream(pv, list(batches), combine=True))[0]
+        full_s = time.perf_counter() - t3
+        view_identical = incr.to_pydict() == full.to_pydict()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        semantic.reset()
+        views_pkg.reset()
+
+    lat = sorted(t for t in latencies if t is not None)
+    emit(json.dumps({
+        "metric": "semantic_cache",
+        "queries": n_queries,
+        "clients": n_clients,
+        "bit_identical": not failures,
+        "mismatched": sorted(set(failures)),
+        "wall_seconds": round(wall, 4),
+        "qps": round(n_queries / wall, 2) if wall else 0.0,
+        "latency_p50_s": round(lat[len(lat) // 2], 6),
+        "latency_p99_s": round(lat[min(len(lat) - 1,
+                                       int(len(lat) * 0.99))], 6),
+        "subplan_hit_rate": cse["hit_rate"],
+        "subplan_hits": cse["hits"],
+        "subplan_misses": cse["misses"],
+        "materializations": cse["materializations"],
+        "evictions": cse["evictions"],
+        "cache_bytes": cse["bytes"],
+        "view_batches": len(batches),
+        "view_identical": view_identical,
+        "view_refresh_s": round(refresh_s, 6),
+        "view_full_recompute_s": round(full_s, 6),
+        "view_refresh_delta_s": round(full_s - refresh_s, 6),
+    }, sort_keys=True))
+    if failures:
+        raise SystemExit(
+            f"semantic-cache parity failure: {sorted(set(failures))} "
+            f"diverged from the cache-off oracle")
+    if not view_identical:
+        raise SystemExit(
+            "materialized-view parity failure: incremental refresh "
+            "diverged from the full streaming-combine recompute")
+
+
 if __name__ == "__main__":
     import os
     if "--faults" in sys.argv:
@@ -1183,6 +1375,8 @@ if __name__ == "__main__":
             bench_plan_opt()
         elif "--serving" in sys.argv:
             bench_serving()
+        elif "--semantic" in sys.argv:
+            bench_semantic()
         else:
             main()
         if "--regress" in sys.argv:

@@ -18,6 +18,8 @@ mkdir -p "$(dirname "$BENCH_OUT")"
 if python -c 'import jax; assert jax.default_backend() != "cpu"' 2>/dev/null; then
     python bench.py | tee -a "$BENCH_OUT"
     python benchmarks/bench_queries.py --capacity --workload | tee -a "$BENCH_OUT"
+    # Standalone lane: exits nonzero on any CSE-splice or view parity loss.
+    python benchmarks/bench_queries.py --semantic | tee -a "$BENCH_OUT"
 else
     echo "nightly: no accelerator on this runner; benchmarks skipped"
 fi

@@ -834,6 +834,126 @@ assert line["advisor_verdict"], line
 print("bench workload lane ok:", json.dumps(line, sort_keys=True))
 EOF
 
+# Semantic-cache lane: an overlapping broadcast-join bank through the
+# serving scheduler with the subplan cache ON.  The shared
+# filter+join prefix must materialize once and fan out as cache hits,
+# every served result must stay bit-identical to the cache-off oracle
+# (float aggregation columns included — the splice is
+# position-preserving precisely so the accumulation order matches),
+# one materialized view must refresh incrementally to exactly the
+# full streaming-combine recompute, and the advisor's confirmed
+# materialize_subplan recommendation must auto-register an
+# ``auto:<fp>`` view (SRT_VIEWS_AUTO).
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 SRT_RESULT_CACHE=0 SRT_SEMANTIC_CACHE=1 SRT_VIEWS=1 \
+SRT_VIEWS_AUTO=1 SRT_WORKLOAD_WINDOW_S=60 \
+python - <<'EOF'
+import numpy as np
+from spark_rapids_tpu import Column, Table, views
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.obs import workload
+from spark_rapids_tpu.serve import QuerySession, semantic
+
+r = np.random.default_rng(31)
+n = 65_536
+table = Table({
+    "k": Column.from_numpy(r.integers(0, 8, n).astype(np.int64)),
+    "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64)),
+    "x": Column.from_numpy(r.uniform(0.0, 50.0, n)),
+})
+dim = Table({
+    "dk": Column.from_numpy(np.arange(8, dtype=np.int64)),
+    "w": Column.from_numpy(r.uniform(0.5, 2.0, 8)),
+})
+# Shared filter+broadcast-join prefix, divergent aggregation tails
+# over the same column set (so the optimizer's pruning projection —
+# and with it the prefix fingerprint — is identical across the bank).
+base = plan().filter(col("v") > 10).join_broadcast(
+    dim, left_on="k", right_on="dk")
+pa = base.groupby_agg(["k"], [("x", "sum", "sx"), ("w", "sum", "sw"),
+                              ("v", "count", "nv")],
+                      domains={"k": (0, 7)})
+pb = base.groupby_agg(["k"], [("x", "mean", "mx"), ("w", "max", "hw"),
+                              ("v", "sum", "sv")],
+                      domains={"k": (0, 7)})
+want = {"a": pa.run(table).to_pydict(), "b": pb.run(table).to_pydict()}
+
+s = QuerySession(max_concurrent=3, register_queued=False)
+for _ in range(3):                    # sequential: interest -> splice
+    for name, p in (("a", pa), ("b", pb)):
+        got = s.submit(p, table=table).result(timeout=300).to_pydict()
+        assert got == want[name], f"splice parity lost on {name!r}"
+tickets = [s.submit(p, table=table)   # concurrent fan-out, all hits
+           for _ in range(3) for p in (pa, pb)]
+for name, t in zip(("a", "b") * 3, tickets):
+    assert t.result(timeout=300).to_pydict() == want[name], name
+st = semantic.stats()
+assert st["materializations"] >= 1, st
+assert st["hits"] > 0, st             # the shared prefix fanned out
+
+# Incremental view maintenance == one-shot streaming recompute.
+host = {nm: np.asarray(c.data) for nm, c in table.items()}
+step = n // 4
+batches = [Table({nm: Column.from_numpy(v[i * step:(i + 1) * step])
+                  for nm, v in host.items()}) for i in range(4)]
+pv = plan().filter(col("v") > 10).groupby_agg(
+    ["k"], [("x", "sum", "sx"), ("v", "count", "nv")],
+    domains={"k": (0, 7)})
+view = views.register("premerge:x_by_k", pv)
+for b in batches[:-1]:
+    view.fold(b)
+view.refresh()                        # steady state: fresh view
+view.fold(batches[-1])                # one new batch arrives
+incr = view.result().to_pydict()
+full = list(run_plan_stream(pv, batches, combine=True))[0].to_pydict()
+assert incr == full, "incremental refresh diverged from full recompute"
+
+# Policy closure: the advisor's confirmed materialize_subplan
+# recommendation reaches the semantic cache's sink and auto-registers
+# a view over the hot prefix.
+payload = workload.advise(advisor=workload.Advisor(confirm=1, clear=4))
+auto = [nm for nm in views.names() if nm.startswith("auto:")]
+assert auto, (payload["recommendations"], views.names())
+assert semantic.confirmed_fps(), payload["recommendations"]
+s.close()
+print("semantic lane ok: hits=%d hit_rate=%.2f auto_views=%d"
+      % (st["hits"], st["hit_rate"], len(auto)))
+semantic.reset()
+views.reset()
+workload.reset()
+EOF
+
+# Semantic bench gate on a premerge-sized table (the full-size
+# --semantic lane is nightly-only): the one `semantic_cache` JSON line
+# must report bit-identity, a nonzero subplan hit rate, and an
+# incremental view refresh bit-identical to the full recompute.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+SRT_METRICS=1 python - <<'EOF'
+import io
+import json
+import sys
+sys.path.insert(0, "benchmarks")
+import bench_queries
+
+buf = io.StringIO()
+stdout, sys.stdout = sys.stdout, buf
+try:
+    bench_queries.bench_semantic(sf_rows=60_000, n_queries=18,
+                                 n_clients=3, n_batches=4)
+finally:
+    sys.stdout = stdout
+lines = [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+sem = [l for l in lines if l.get("metric") == "semantic_cache"]
+assert len(sem) == 1, lines
+line = sem[0]
+assert line["bit_identical"] and not line["mismatched"], line
+assert line["subplan_hits"] > 0 and line["subplan_hit_rate"] > 0.0, line
+assert line["materializations"] >= 1, line
+assert line["view_identical"], line
+assert line["view_batches"] >= 2, line
+print("bench semantic lane ok:", json.dumps(line, sort_keys=True))
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

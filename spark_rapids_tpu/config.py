@@ -204,6 +204,29 @@ Knobs (all optional):
   ``SRT_WORKLOAD_TOPK``        ranked entries each workload report
                                (hotspots, overlap candidates) retains
                                (>= 1, default 8).
+  ``SRT_SEMANTIC_CACHE``       ``1`` enables the semantic subplan cache
+                               (serve/semantic.py): shared optimized-plan
+                               prefixes across serving tickets are
+                               computed once and spliced into the other
+                               tickets as a ``CachedSourceStep`` leaf.
+                               Off (default): every ticket recomputes its
+                               whole plan — the bit-identity oracle.
+  ``SRT_SEMANTIC_CACHE_BYTES`` byte cap of the semantic subplan cache's
+                               materialized-prefix LRU (> 0 bytes,
+                               default 256 MiB).
+  ``SRT_VIEWS``                ``1`` enables the materialized-view
+                               registry (views/registry.py):
+                               group-by-terminated plans registered as
+                               views fold newly streamed batches into a
+                               dense partial accumulator, so a refresh
+                               costs one delta instead of a full scan.
+                               Off (default): registration refuses — the
+                               recompute-everything oracle.
+  ``SRT_VIEWS_AUTO``           ``1`` lets the workload advisor's
+                               *confirmed* ``materialize_subplan:<fp>``
+                               recommendations auto-register matching
+                               group-by-terminated plans as views
+                               (requires ``SRT_VIEWS=1``).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -913,6 +936,82 @@ def workload_topk() -> int:
     return val
 
 
+def _strict_flag(name: str) -> bool:
+    """Boolean knob that REFUSES garbage: truthy spellings enable,
+    ``0``/``off``/``false``/``no``/empty disable, anything else raises a
+    knob-named ``ValueError`` (a typo must not silently run the oracle
+    path while the operator believes the feature is on)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return False
+    val = raw.strip().lower()
+    if val in _TRUTHY:
+        return True
+    if val in ("", "0", "off", "false", "no"):
+        return False
+    raise ValueError(
+        f"{name} must be 0/off or 1/on, got {raw!r}")
+
+
+def semantic_cache_enabled() -> bool:
+    """Semantic subplan cache on/off (``SRT_SEMANTIC_CACHE``).
+
+    When on, the serving scheduler's one-shot (``run``) tickets
+    canonicalize their optimized plan's leading scan/filter/project/join
+    prefix (exec/optimize.prefix_step_texts → the workload miner's
+    subplan-fingerprint hash space), compute each cross-ticket shared
+    prefix once, and splice the materialized fragment into the other
+    tickets as a ``CachedSourceStep`` leaf (serve/semantic.py).  Off
+    (the default) every ticket recomputes its whole plan — the
+    bit-identity oracle the splice path is tested against."""
+    return _strict_flag("SRT_SEMANTIC_CACHE")
+
+
+def semantic_cache_bytes() -> int:
+    """Byte cap of the semantic subplan cache's materialized-prefix LRU
+    (serve/semantic.py).  Entries are whole materialized prefix results,
+    so the cap bounds host+device bytes the cache may pin; eviction is
+    hit-rate-aware (cold entries go first) and reports back to the
+    workload advisor.  Tune with ``SRT_SEMANTIC_CACHE_BYTES`` (> 0
+    bytes, default 256 MiB)."""
+    raw = os.environ.get("SRT_SEMANTIC_CACHE_BYTES")
+    if raw is None or not raw.strip():
+        return 256 << 20
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SEMANTIC_CACHE_BYTES must be an integer byte count "
+            f"> 0, got {raw!r}") from None
+    if val <= 0:
+        raise ValueError(
+            f"SRT_SEMANTIC_CACHE_BYTES must be > 0 bytes, got {val}")
+    return val
+
+
+def views_enabled() -> bool:
+    """Materialized-view registry on/off (``SRT_VIEWS``).
+
+    When on, ``views.registry.register`` accepts group-by-terminated
+    combinable plans and maintains each view's dense partial-accumulator
+    state incrementally through the streaming-combine machinery
+    (exec/stream.py); a refresh folds only batches seen since the last
+    one.  Off (the default) registration raises — recompute-everything
+    is the oracle incremental maintenance is tested against."""
+    return _strict_flag("SRT_VIEWS")
+
+
+def views_auto() -> bool:
+    """Advisor-driven view auto-registration on/off
+    (``SRT_VIEWS_AUTO``).  When on (and ``SRT_VIEWS=1``), a *confirmed*
+    ``materialize_subplan:<fp>`` recommendation from the workload
+    advisor (obs/workload.py hysteresis) auto-registers a matching
+    group-by-terminated plan seen carrying that prefix as view
+    ``auto:<fp>`` — the policy-closure loop.  Off (the default) the
+    advisor only recommends."""
+    return _strict_flag("SRT_VIEWS_AUTO")
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -999,5 +1098,6 @@ def knob_table() -> dict[str, str]:
              "SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",
              "SRT_LIVE_RECENT", "SRT_CAPACITY_WINDOW_S",
              "SRT_CAPACITY_TARGETS", "SRT_WORKLOAD_WINDOW_S",
-             "SRT_WORKLOAD_TOPK")
+             "SRT_WORKLOAD_TOPK", "SRT_SEMANTIC_CACHE",
+             "SRT_SEMANTIC_CACHE_BYTES", "SRT_VIEWS", "SRT_VIEWS_AUTO")
     return {n: os.environ.get(n, "<default>") for n in names}

@@ -55,9 +55,9 @@ from ..dtypes import BOOL8, INT32, INT64, DType, TypeId
 from ..table import Table
 from ..ops.groupby import _agg_out_dtype, _minmax_identity, _sum_dtype
 from .expr import Col, evaluate, render
-from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
-                   LimitStep, Plan, ProjectStep, SortStep, TopKStep,
-                   UnionAllStep, WindowStep)
+from .plan import (CachedSourceStep, FilterStep, GroupAggStep,
+                   JoinShuffledStep, JoinStep, LimitStep, Plan, ProjectStep,
+                   SortStep, TopKStep, UnionAllStep, WindowStep)
 
 def _dense_max_cells() -> int:
     """Max dense group-by cells (SRT_DENSE_MAX_CELLS, default 256).
@@ -1797,6 +1797,65 @@ def stream_finalize(bound: _Bound, smeta: _GroupMeta, acc,
     return materialize(bound, out_cols, live)
 
 
+_CACHED_SOURCE_RESOLVER = None
+
+
+def set_cached_source_resolver(fn) -> None:
+    """Register the semantic cache's ``key -> Table`` resolver for
+    :class:`~.plan.CachedSourceStep` leaves (serve/semantic.py installs
+    it once at first use; ``None`` uninstalls).  Kept as a registration
+    hook so the executor stays import-independent of the serving
+    layer."""
+    global _CACHED_SOURCE_RESOLVER
+    _CACHED_SOURCE_RESOLVER = fn
+
+
+def _resolve_cached_source(plan: Plan, table: Table):
+    """Resolve a leading ``CachedSourceStep`` into its materialized
+    prefix Table and strip the marker — identity for ordinary plans.
+
+    Runs ONCE at the top of :func:`run_plan`, before the empty-input
+    check, the recovery ladder, and batch splitting, so every downstream
+    path (retry, OOM split, metering) operates on the resolved input and
+    can never re-resolve half-split inputs against the full cached
+    fragment."""
+    if not plan.steps or not isinstance(plan.steps[0], CachedSourceStep):
+        return plan, table
+    step = plan.steps[0]
+    if _CACHED_SOURCE_RESOLVER is None:
+        raise RuntimeError(
+            f"plan carries CachedSourceStep({step.key!r}) but no cached-"
+            f"source resolver is registered (serve/semantic.py installs "
+            f"one; a spliced plan cannot run outside it)")
+    resolved = _CACHED_SOURCE_RESOLVER(step.key)
+    if resolved is None:
+        raise RuntimeError(
+            f"semantic cache entry {step.key!r} is gone (evicted without "
+            f"a pin?) — the spliced plan cannot run")
+    # Position-preserving payloads carry (table, names, sel_name): the
+    # table is padded at the source's logical length and the prefix's
+    # live-row selection rides as a column, so the suffix re-enters the
+    # exact (columns, selection) state of the fused program — float
+    # accumulation order, and therefore bits, match the oracle.  A bare
+    # Table (legacy/diagnostic resolvers) splices compacted.
+    from .optimize import resume_prefix_steps
+    if isinstance(resolved, tuple):
+        resolved, names, sel_name = resolved
+        pre = resume_prefix_steps(names, sel_name)
+    else:
+        pre = ()
+    stripped = Plan(pre + tuple(plan.steps[1:]))
+    info = getattr(plan, "opt", None)
+    if info is not None:
+        object.__setattr__(stripped, "opt", info)
+    # Non-field marker (like Plan.opt): lets the postmortem bundle's
+    # semantic block tell a spliced query from a full recompute.
+    object.__setattr__(stripped, "_cached_source_key", step.key)
+    from ..obs.metrics import counter
+    counter("serve.semantic.resolved").inc()
+    return stripped, resolved
+
+
 def _bind(plan: Plan, table: Table) -> _Bound:
     """Bind through the shape-bucketing layer: pad the input up to its
     bucket capacity (exec/bucketing.py) and carry the live-row mask as
@@ -1806,6 +1865,10 @@ def _bind(plan: Plan, table: Table) -> _Bound:
     inapplicable (SRT_SHAPE_BUCKETS=0, shuffled-join plans, nested/
     two-word columns)."""
     from .bucketing import prepare_input
+    if plan.steps and isinstance(plan.steps[0], CachedSourceStep):
+        raise RuntimeError(
+            "CachedSourceStep reached _bind unresolved — spliced plans "
+            "must enter through run_plan")
     table = _pruned_input(plan, table)
     bi = prepare_input(plan, table)
     if bi is None:
@@ -1863,6 +1926,7 @@ def run_plan(plan: Plan, table: Table, progress=None) -> Table:
     (obs/live.py) even without ``SRT_METRICS``: ``True`` renders a
     stderr progress line, a callable receives live snapshots at phase
     transitions.  None (default) pays nothing extra."""
+    plan, table = _resolve_cached_source(plan, table)
     if table.num_rows == 0:
         return run_plan_eager(plan, table)
     from .optimize import optimize

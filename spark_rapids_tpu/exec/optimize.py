@@ -60,9 +60,10 @@ from typing import Optional
 from ..config import get_logger, plan_opt, plan_opt_rules
 from ..io.pushdown import split_conjuncts
 from .expr import BinOp, Col, expr_size, references, render, substitute
-from .plan import (FilterStep, GroupAggStep, JoinShuffledStep, JoinStep,
-                   LimitStep, Plan, ProjectStep, SortStep, TopKStep,
-                   UnionAllStep, WindowStep)
+from .plan import (CachedSourceStep, FilterStep, GroupAggStep,
+                   JoinShuffledStep, JoinStep, LimitStep, Plan,
+                   ProjectStep, SortStep, TopKStep, UnionAllStep,
+                   WindowStep)
 
 _LOG = get_logger("spark_rapids_tpu.optimize")
 
@@ -164,6 +165,8 @@ def _step_text(step) -> str:
         return f"TopK[{', '.join(step.by)} k={step.k}]"
     if isinstance(step, LimitStep):
         return f"Limit[{step.k}]"
+    if isinstance(step, CachedSourceStep):
+        return f"CachedSource[{step.key[:16]}]"
     return type(step).__name__
 
 
@@ -191,6 +194,64 @@ def prefix_step_texts(plan) -> tuple:
             break
         texts.append(_step_text(step))
     return tuple(tuple(texts[:i + 1]) for i in range(len(texts)))
+
+
+def prefix_plan(plan: Plan, depth: int) -> Plan:
+    """The standalone sub-plan of ``plan``'s first ``depth`` steps, ready
+    to run as-is: it carries its own OptInfo (so ``optimize``'s re-entry
+    check skips it — the steps were already rewritten as part of the
+    parent) with ``source=None``, so its fingerprint / history records
+    key on the prefix itself, never on the full plan it was cut from.
+    This is what the semantic cache (serve/semantic.py) executes once to
+    materialize a shared fragment."""
+    if not (0 < depth <= len(plan.steps)):
+        raise ValueError(f"prefix depth must be in 1..{len(plan.steps)}, "
+                         f"got {depth}")
+    sub = Plan(tuple(plan.steps[:depth]))
+    info = getattr(plan, "opt", None)
+    sub_info = OptInfo(
+        enabled=info.enabled if info is not None else True,
+        rules=info.rules if info is not None else (),
+        steps_before=depth, steps_after=depth,
+        before=plan_step_texts(sub), after=plan_step_texts(sub))
+    object.__setattr__(sub, "opt", sub_info)
+    return sub
+
+
+def resume_prefix_steps(names: tuple, sel_name) -> tuple:
+    """Steps that re-enter the executor's ``(columns, selection)`` state
+    from a *position-preserving* materialized prefix (a table padded at
+    the source's logical length, carrying the prefix's live-row
+    selection as a ``sel_name`` column): a filter on the stored
+    selection restores the mask, and a narrow select drops the carrier
+    column and restores the boundary column order.  Without this, a
+    compacted prefix result re-orders float accumulation in downstream
+    aggregations (last-ulp drift vs the fused run) — the fused executor
+    never compacts between steps, so neither may the splice."""
+    from .plan import Col, FilterStep, ProjectStep
+    steps = []
+    if sel_name is not None:
+        steps.append(FilterStep(Col(sel_name)))
+    steps.append(ProjectStep(tuple((nm, Col(nm)) for nm in names),
+                             narrow=True))
+    return tuple(steps)
+
+
+def splice_prefix(plan: Plan, depth: int, key: str) -> Plan:
+    """``plan`` resuming AFTER its first ``depth`` steps, sourced from a
+    :class:`~.plan.CachedSourceStep` leaf carrying ``key`` — the
+    semantic cache's splice.  The parent's OptInfo rides along unchanged
+    (``source`` still names the user's original plan, so fingerprints,
+    history records, and bit-identity oracles are untouched, and
+    ``optimize``'s re-entry check runs the spliced plan verbatim)."""
+    if not (0 < depth < len(plan.steps)):
+        raise ValueError(f"splice depth must be in 1..{len(plan.steps) - 1}"
+                         f" (a strict prefix), got {depth}")
+    spliced = Plan((CachedSourceStep(key),) + tuple(plan.steps[depth:]))
+    info = getattr(plan, "opt", None)
+    if info is not None:
+        object.__setattr__(spliced, "opt", info)
+    return spliced
 
 
 # -- rule: predicate pushdown --------------------------------------------

@@ -139,6 +139,24 @@ class UnionAllStep:
 
 
 @dataclass(frozen=True)
+class CachedSourceStep:
+    """Leaf marker for a semantically-cached subplan prefix
+    (serve/semantic.py).
+
+    The splice helper (exec/optimize.splice_prefix) replaces a plan's
+    already-materialized leading scan/filter/project/join run with this
+    step; ``run_plan`` resolves ``key`` through the registered resolver
+    (exec/compile.set_cached_source_resolver) into the materialized
+    prefix Table BEFORE binding, then strips the step — so the recovery
+    ladder, batch splitting, and metering all operate on the resolved
+    input and never see the marker.  ``key`` is
+    ``<subplan_fingerprint>/<input_digest>``: the fragment is shared
+    only across tickets whose prefix steps AND input bytes are
+    identical."""
+    key: str
+
+
+@dataclass(frozen=True)
 class SortStep:
     by: tuple[str, ...]
     ascending: tuple[bool, ...]
@@ -166,7 +184,7 @@ class TopKStep:
 
 Step = Union[FilterStep, ProjectStep, GroupAggStep, JoinStep,
              JoinShuffledStep, UnionAllStep, WindowStep, SortStep,
-             LimitStep, TopKStep]
+             LimitStep, TopKStep, CachedSourceStep]
 
 WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
                 "sum", "min", "max", "count")

@@ -28,6 +28,12 @@
     Same three sources as ``advisor``: local window, a remote
     exporter's ``/workload`` with ``--url``, or ``--history`` offline
     replay.  Exits 0 whenever a verdict was produced.
+``views``
+    the semantic-cache / materialized-view state (views.views_payload):
+    registered views with batch counts, staleness, hit counts, and last
+    refresh time, plus the subplan cache's hit-rate line and the
+    workload advisor's semantic outcome feed.  Local in-process state
+    by default, a remote exporter's ``/views`` with ``--url``.
 
 Rendering is a pure function of the ``/queries`` JSON payload
 (:func:`render_top`) / the advisor payloads (:func:`render_advisor`,
@@ -341,6 +347,62 @@ def _workload_history(path: str, last: int) -> dict:
             "verdict": workload.verdict_for(recs if recs else candidates)}
 
 
+def render_views(payload: dict, source: str = "local") -> str:
+    """Console rendering of one ``/views`` payload — pure."""
+    sem = payload.get("semantic_cache") or {}
+    outcomes = payload.get("outcomes") or {}
+    lines = [
+        f"srt views — {source}  views_enabled="
+        f"{payload.get('views_enabled', False)}  "
+        f"auto={payload.get('views_auto', False)}",
+        "semantic cache: enabled={en}  entries={n}  bytes={b}/{cap}  "
+        "hits={h} misses={m} hit_rate={hr:.0%}  materialized={mt} "
+        "evicted={ev}".format(
+            en=sem.get("enabled", False), n=sem.get("entries", 0),
+            b=_human(sem.get("bytes", 0)),
+            cap=_human(sem.get("cap_bytes", 0) or 0),
+            h=sem.get("hits", 0), m=sem.get("misses", 0),
+            hr=sem.get("hit_rate", 0.0),
+            mt=sem.get("materializations", 0),
+            ev=sem.get("evictions", 0)),
+    ]
+    confirmed = sem.get("confirmed_prefixes") or []
+    if confirmed:
+        lines.append("confirmed prefixes: " + " ".join(confirmed))
+    views = payload.get("views") or []
+    if views:
+        lines.append("materialized views:")
+        for v in views:
+            last = v.get("last_refresh_s")
+            lines.append(
+                "  {name:<28}{auto} batches={b:<4} rows={r:>8} "
+                "{state:<6} refreshes={rf:<3} hits={h:<3} "
+                "last_refresh={last}".format(
+                    name=v["name"], auto=" [auto]" if v.get("auto") else "",
+                    b=v.get("batches", 0), r=_human(v.get("rows", 0)),
+                    state="STALE" if v.get("stale") else "fresh",
+                    rf=v.get("refreshes", 0), h=v.get("hits", 0),
+                    last=f"{last:.4f}s" if last is not None else "never"))
+    else:
+        lines.append("materialized views: (none registered)")
+    cold = outcomes.get("cold_evicted") or []
+    if cold:
+        lines.append("cold-evicted prefixes (advisor damped): "
+                     + " ".join(cold))
+    return "\n".join(lines)
+
+
+def _views_payload(url: Optional[str]) -> dict:
+    """The views payload from a remote exporter's ``/views`` or the
+    local in-process registries."""
+    if url is not None:
+        with urllib.request.urlopen(url.rstrip("/") + "/views",
+                                    timeout=5) as resp:
+            return json.loads(resp.read().decode())
+    from ..views import views_payload
+    return views_payload()
+
+
 def _fetch(url: str) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/queries",
                                 timeout=5) as resp:
@@ -407,6 +469,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  "first, default 256)")
     workload_p.add_argument("--json", action="store_true",
                             help="print the raw workload payload as JSON")
+    views_p = sub.add_parser(
+        "views", help="semantic-cache stats + materialized-view table")
+    views_p.add_argument("--url", default=None,
+                         help="remote exporter base URL (fetches its "
+                              "/views); default: the local in-process "
+                              "registries")
+    views_p.add_argument("--json", action="store_true",
+                         help="print the raw views payload as JSON")
     args = parser.parse_args(argv)
     if args.command == "doctor":
         from .doctor import main as doctor_main
@@ -426,6 +496,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(render_workload(
                 payload, source=args.url or args.history or "local"))
+        return 0
+    if args.command == "views":
+        payload = _views_payload(args.url)
+        if args.json:
+            print(json.dumps(payload, sort_keys=True))
+        else:
+            print(render_views(payload, source=args.url or "local"))
         return 0
     if args.command != "top":
         parser.print_help()

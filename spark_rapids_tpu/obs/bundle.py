@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 from ..config import bundle_dir, knob_table, slo_ms
 
 #: Bump on any key-set change; the golden test pins the layout.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 #: Incident kinds :func:`dump` accepts.
 REASONS = ("failure", "recovery_exhausted", "admission_rejected",
@@ -147,6 +147,23 @@ def _workload_block() -> Dict[str, Any]:
                 "verdict": "unavailable"}
 
 
+def _semantic_block(plan) -> Dict[str, Any]:
+    """Semantic-cache context for the incident query: was the cache on,
+    did this query splice a cached prefix, and did it *recompute* a
+    prefix the workload advisor had confirmed for materialization (the
+    doctor's hot_prefix_recompute finding)?  Uses serve.semantic only
+    when the process already loaded it — the bundle stays jax-free and
+    serve-free on its own.  Never raises."""
+    try:
+        semantic = sys.modules.get("spark_rapids_tpu.serve.semantic")
+        if semantic is not None:
+            return semantic.bundle_block(plan)
+    except Exception:
+        pass
+    return {"enabled": False, "used": False, "prefix_fingerprints": [],
+            "hot_prefix_recompute": False}
+
+
 def _prune_oldest(dirpath: str) -> None:
     try:
         names = [n for n in os.listdir(dirpath)
@@ -208,6 +225,7 @@ def build(reason: str, *, query_id: Optional[int] = None, qm=None,
         "slo": {"slo_ms": limit, "elapsed_seconds": elapsed},
         "capacity": _capacity_block(),
         "workload": _workload_block(),
+        "semantic": _semantic_block(plan),
     }
 
 
@@ -286,7 +304,7 @@ def validate_bundle(payload: dict, schema: dict) -> List[str]:
         errors.append(f"reason {payload['reason']!r} not in "
                       f"{schema['reasons']}")
     for block in ("error", "recovery", "flight", "plan", "slo",
-                  "capacity", "workload"):
+                  "capacity", "workload", "semantic"):
         sub = payload.get(block)
         if not isinstance(sub, dict):
             errors.append(f"{block!r} block is not an object")

@@ -240,6 +240,32 @@ def _workload_findings(bundle: dict, qm: dict) -> List[Dict[str, Any]]:
     return out
 
 
+def _semantic_findings(bundle: dict) -> List[Dict[str, Any]]:
+    """Semantic-cache context — the bundle's ``semantic`` block
+    (serve/semantic.py; absent in pre-v4 bundles).  The load-bearing
+    signal: this query recomputed a subplan prefix the workload advisor
+    had *confirmed* as a materialization candidate and the semantic
+    cache did not serve it — the failed/slow query paid for work the
+    serving layer was supposed to amortize."""
+    sem = bundle.get("semantic")
+    if not isinstance(sem, dict):
+        return []
+    if not sem.get("hot_prefix_recompute"):
+        return []
+    fps = [fp for fp in sem.get("prefix_fingerprints") or [] if fp]
+    state = ("SRT_SEMANTIC_CACHE is on but had no materialization to "
+             "serve" if sem.get("enabled")
+             else "SRT_SEMANTIC_CACHE is off")
+    return [_finding(
+        60, "query recomputed a hot shared subplan prefix",
+        f"the workload advisor confirmed a materialize_subplan "
+        f"candidate matching this plan's prefix chain "
+        f"({', '.join(fps) or '<unknown>'}) but the query did not use "
+        f"a cached materialization — {state}; the semantic subplan "
+        f"cache or a registered view (SRT_VIEWS) would absorb this "
+        f"recurring work")]
+
+
 def baseline_for(fingerprint: str,
                  history_path: Optional[str] = None) -> Optional[dict]:
     """The same-fingerprint history baseline (newest measured record)."""
@@ -273,7 +299,8 @@ def diagnose(payload: dict, baseline: Optional[dict] = None,
                 + _cache_findings(qm, baseline)
                 + _cost_findings(qm, baseline)
                 + _capacity_findings(bundle)
-                + _workload_findings(bundle, qm))
+                + _workload_findings(bundle, qm)
+                + _semantic_findings(bundle))
     findings.sort(key=lambda f: -f["severity"])
     if findings:
         verdict = findings[0]["title"]

@@ -22,6 +22,12 @@ queries are still running:
     set.  The same observables export as ``srt_capacity_*`` gauges on
     ``/metrics`` (snapshot only — scraping ``/metrics`` must not
     advance the advisor's hysteresis).
+``/views``
+    JSON snapshot of the semantic-cache + materialized-view state
+    (views.registry.views_payload): registered views with staleness
+    and hit counts, semantic subplan-cache stats, and the workload
+    advisor's semantic outcome feed.  The same state exports as
+    ``srt_semantic_*`` / ``srt_view_*`` gauges on ``/metrics``.
 ``/queries/<id>/timeline``
     Chrome-trace JSON of a *still-running* query: recorded events whose
     span args carry that ``query_id``, plus a non-destructive render of
@@ -287,6 +293,41 @@ def workload_gauges(fam: _Families) -> None:
              {"action": cand["action"]}, cand["severity"])
 
 
+def semantic_gauges(fam: _Families) -> None:
+    """Fold the semantic-cache and view state into ``/metrics`` as
+    ``srt_semantic_*`` / ``srt_view_*`` gauges.  Reads only modules the
+    process already loaded (``sys.modules``) — a scrape never imports
+    the serving layer, and a process that never served stays silent."""
+    import sys as _sys
+    semantic = _sys.modules.get("spark_rapids_tpu.serve.semantic")
+    if semantic is not None:
+        try:
+            s = semantic.stats()
+            for name in ("entries", "bytes", "hits", "misses",
+                         "materializations", "evictions"):
+                _add(fam, f"srt_semantic_cache_{name}", "gauge", {},
+                     s[name])
+            _add(fam, "srt_semantic_cache_hit_rate", "gauge", {},
+                 s["hit_rate"])
+        except Exception:   # a broken cache must not break /metrics
+            pass
+    registry = _sys.modules.get("spark_rapids_tpu.views.registry")
+    if registry is not None:
+        try:
+            views = registry.snapshot()
+            _add(fam, "srt_views_registered", "gauge", {}, len(views))
+            for v in views:
+                labels = {"view": v["name"]}
+                _add(fam, "srt_view_batches", "gauge", labels,
+                     v["batches"])
+                _add(fam, "srt_view_stale", "gauge", labels, v["stale"])
+                _add(fam, "srt_view_hits", "gauge", labels, v["hits"])
+                _add(fam, "srt_view_refreshes", "gauge", labels,
+                     v["refreshes"])
+        except Exception:   # a broken registry must not break /metrics
+            pass
+
+
 def prometheus_text() -> str:
     """The ``/metrics`` body: registry metrics + live-query gauges."""
     from . import live
@@ -331,6 +372,7 @@ def prometheus_text() -> str:
                  {"query_id": q["query_id"], "shard": shard}, done)
     capacity_gauges(fam)
     workload_gauges(fam)
+    semantic_gauges(fam)
 
     lines: List[str] = []
     for name, (kind, samples) in fam.items():
@@ -392,6 +434,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/workload":
                 from . import workload
                 body = json.dumps(workload.advise(), sort_keys=True)
+                self._send(200, body.encode(), "application/json")
+                return
+            if path == "/views":
+                from ..views import views_payload
+                body = json.dumps(views_payload(), sort_keys=True)
                 self._send(200, body.encode(), "application/json")
                 return
             m = _TIMELINE_RE.match(path)

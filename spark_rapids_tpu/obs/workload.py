@@ -56,8 +56,9 @@ from .capacity import Advisor, percentile
 
 __all__ = [
     "KERNEL_SPEEDUP", "HOTSPOT_MIN_SHARE", "HOTSPOT_MIN_SECONDS",
-    "OVERLAP_MIN_COUNT",
-    "feed_query", "feed_ticket",
+    "OVERLAP_MIN_COUNT", "COLD_SEVERITY_CAP",
+    "feed_query", "feed_ticket", "feed_semantic",
+    "semantic_stats", "cold_evicted_fps", "set_confirmed_sink",
     "plan_prefixes", "prefixes_from_steps",
     "record_from_history", "records_from_history",
     "derive", "recommend", "Advisor", "verdict_for",
@@ -80,6 +81,13 @@ HOTSPOT_MIN_SECONDS = 0.02
 #: A subplan prefix must recur at least this many times in the window
 #: before it is a materialization candidate.
 OVERLAP_MIN_COUNT = 2
+
+#: Severity ceiling for a ``materialize_subplan`` recommendation whose
+#: prefix was already materialized once and evicted without a single
+#: hit (the semantic cache's outcome feed, :func:`feed_semantic`) —
+#: evidence the workload does not actually reuse it, so the advisor
+#: stops shouting about it (40 < the "suggestive" threshold of 50).
+COLD_SEVERITY_CAP = 40
 
 #: Per-row result-size floor (bytes) used when a prefix's output width
 #: is unknown — the benefit score only needs a consistent scale.
@@ -303,11 +311,74 @@ def feed_ticket(fingerprint: str, plan) -> None:
         _TICKETS.append((_now(), str(fingerprint or ""), fps))
 
 
+#: Semantic-cache outcome feed: event name -> count, plus per-prefix
+#: hit totals and the cold-evicted prefix set that damps future
+#: recommendations.  This is the loop-closing channel — the cache
+#: reports what happened to materializations the advisor proposed.
+_SEMANTIC_EVENTS: Dict[str, int] = {}
+_SEMANTIC_HITS: Dict[str, int] = {}
+_COLD_EVICTED: set = set()
+_CONFIRMED_SINK = None
+
+
+def feed_semantic(event: str, prefix_fp: str = "", hits: int = 0) -> None:
+    """One semantic-cache/view lifecycle event (serve/semantic.py,
+    views/registry.py): ``hit``, ``miss``, ``materialize``, ``evict``,
+    ``view_fold``, ``view_refresh``, ``view_hit``, ``auto_view``.  An
+    ``evict`` with ``hits == 0`` marks the prefix cold — future
+    ``materialize_subplan`` recommendations for it are damped
+    (:data:`COLD_SEVERITY_CAP`)."""
+    if not metrics_enabled():
+        return
+    with _LOCK:
+        _SEMANTIC_EVENTS[event] = _SEMANTIC_EVENTS.get(event, 0) + 1
+        if prefix_fp and event == "hit":
+            _SEMANTIC_HITS[prefix_fp] = \
+                _SEMANTIC_HITS.get(prefix_fp, 0) + max(int(hits), 1)
+        if prefix_fp and event == "evict":
+            if int(hits) <= 0:
+                _COLD_EVICTED.add(prefix_fp)
+            else:
+                _COLD_EVICTED.discard(prefix_fp)
+
+
+def semantic_stats() -> Dict[str, Any]:
+    """Aggregated semantic-cache outcome counts for the window —
+    consumed by the ``/views`` endpoint and the semantic bench lane."""
+    with _LOCK:
+        return {
+            "events": dict(sorted(_SEMANTIC_EVENTS.items())),
+            "prefix_hits": dict(sorted(_SEMANTIC_HITS.items())),
+            "cold_evicted": sorted(_COLD_EVICTED),
+        }
+
+
+def cold_evicted_fps() -> Tuple[str, ...]:
+    """Prefixes materialized once and evicted hitless (damping input
+    for :func:`recommend`)."""
+    with _LOCK:
+        return tuple(sorted(_COLD_EVICTED))
+
+
+def set_confirmed_sink(fn) -> None:
+    """Register a callback invoked by :func:`advise` with the list of
+    hysteresis-*confirmed* ``materialize_subplan`` prefix fingerprints —
+    the channel through which confirmed recommendations reach the
+    semantic cache (and, under ``SRT_VIEWS_AUTO``, auto-register
+    views).  ``None`` uninstalls.  Failures in the sink never break
+    advise()."""
+    global _CONFIRMED_SINK
+    _CONFIRMED_SINK = fn
+
+
 def reset() -> None:
     """Drop the window and advisor state (test/bench isolation)."""
     with _LOCK:
         _QUERIES.clear()
         _TICKETS.clear()
+        _SEMANTIC_EVENTS.clear()
+        _SEMANTIC_HITS.clear()
+        _COLD_EVICTED.clear()
     _ADVISOR.reset()
 
 
@@ -468,13 +539,17 @@ def derive(records: Sequence[Dict[str, Any]],
     }
 
 
-def recommend(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
+def recommend(snap: Dict[str, Any],
+              cold_evicted: Sequence[str] = ()) -> List[Dict[str, Any]]:
     """Ranked candidate actions for one workload snapshot — pure.
 
     ``pallas_kernel:<kind>`` names a kernel target whose step kind
     dominates the window; ``materialize_subplan:<fp>`` names a
     recurring prefix worth a fragment cache.  Each cites its evidence,
-    like the capacity advisor's candidates."""
+    like the capacity advisor's candidates.  ``cold_evicted`` prefixes
+    (materialized before, evicted hitless — :func:`cold_evicted_fps`)
+    have their severity capped at :data:`COLD_SEVERITY_CAP`."""
+    cold = set(cold_evicted)
     out: List[Dict[str, Any]] = []
     for rank, h in enumerate(snap.get("hotspots") or []):
         if h["share"] < HOTSPOT_MIN_SHARE \
@@ -504,14 +579,21 @@ def recommend(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
         if o["count"] < OVERLAP_MIN_COUNT or o["seconds_mean"] <= 0.0:
             continue
         severity = 75 if (o["count"] >= 4 and o["measured"]) else 55
+        damped = o["prefix_fingerprint"] in cold
+        if damped:
+            severity = min(severity, COLD_SEVERITY_CAP)
+        reason = (f"subplan prefix "
+                  f"{' > '.join(o['kinds'])} recurred "
+                  f"{o['count']}x across {o['plans']} plan(s) — "
+                  f"materializing it would amortize "
+                  f"{o['seconds_mean']:.4f}s per recurrence")
+        if damped:
+            reason += (" (damped: a previous materialization was "
+                       "evicted without a hit)")
         out.append({
             "action": f"materialize_subplan:{o['prefix_fingerprint']}",
             "severity": severity,
-            "reason": f"subplan prefix "
-                      f"{' > '.join(o['kinds'])} recurred "
-                      f"{o['count']}x across {o['plans']} plan(s) — "
-                      f"materializing it would amortize "
-                      f"{o['seconds_mean']:.4f}s per recurrence",
+            "reason": reason,
             "evidence": {
                 "prefix_fingerprint": o["prefix_fingerprint"],
                 "depth": o["depth"],
@@ -588,9 +670,18 @@ def advise(window_s: Optional[float] = None,
     advisor by default, so repeated ``/workload`` fetches confirm and
     clear actions; ``/metrics`` scrapes never call this)."""
     snap = snapshot(window_s)
-    candidates = recommend(snap)
+    candidates = recommend(snap, cold_evicted=cold_evicted_fps())
     adv = _ADVISOR if advisor is None else advisor
     recs = adv.observe(candidates)
+    sink = _CONFIRMED_SINK
+    if sink is not None:
+        confirmed = [r["action"].split(":", 1)[1] for r in recs
+                     if r["action"].startswith("materialize_subplan:")]
+        if confirmed:
+            try:
+                sink(confirmed)
+            except Exception:  # a broken sink must not break advise()
+                pass
     return {
         "snapshot": snap,
         "candidates": candidates,

@@ -22,6 +22,11 @@ This package is the multi-tenant layer on top:
 * :mod:`~.result_cache` — a cross-query result cache
   (``SRT_RESULT_CACHE``) keyed by plan fingerprint + input identity for
   repeated dashboard-style queries.
+* :mod:`~.semantic` — a semantic subplan cache
+  (``SRT_SEMANTIC_CACHE``): cross-ticket common-subexpression
+  elimination over shared plan prefixes, with materialized results
+  spliced back into concurrent queries and hit-rate feedback to the
+  workload advisor.
 
 Per the repo's lazy-import rule the whole package is jax-free at module
 load; executors are imported inside worker threads at first use.
@@ -32,8 +37,10 @@ from __future__ import annotations
 from .admission import AdmissionController, AdmissionRejected
 from .result_cache import ResultCache, input_digest
 from .scheduler import QuerySession, Ticket, default_session, submit
+from .semantic import SemanticCache, run_table_plan
 
 __all__ = [
     "AdmissionController", "AdmissionRejected", "QuerySession",
-    "ResultCache", "Ticket", "default_session", "input_digest", "submit",
+    "ResultCache", "SemanticCache", "Ticket", "default_session",
+    "input_digest", "run_table_plan", "submit",
 ]

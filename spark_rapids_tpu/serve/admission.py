@@ -40,6 +40,7 @@ class AdmissionController:
         self.budget = budget
         self._cond = threading.Condition()
         self._claims: Dict[int, int] = {}
+        self._cache_claims: Dict[str, int] = {}
         self._claimed = 0
 
     @staticmethod
@@ -107,6 +108,39 @@ class AdmissionController:
                 gauge("serve.hbm_claimed_bytes").set(self._claimed)
                 from ..obs import capacity
                 capacity.feed_hbm(self._claimed)
+            self._cond.notify_all()
+
+    def claim_cache(self, key: str, nbytes: int) -> bool:
+        """Non-blocking claim for a long-lived cache resident (semantic
+        subplan cache, materialized views).  Unlike :meth:`acquire`,
+        never waits: a materialization is an optimization, so when the
+        claim would not fit under the budget *right now* it is simply
+        denied (counted on ``serve.semantic.admission_denied``) and the
+        caller skips caching.  Budget-less controllers admit freely."""
+        nbytes = max(int(nbytes), 0)
+        with self._cond:
+            if self.budget is not None and \
+                    self._claimed + nbytes > self.budget:
+                from ..obs.metrics import counter
+                counter("serve.semantic.admission_denied").inc()
+                return False
+            self._cache_claims[key] = \
+                self._cache_claims.get(key, 0) + nbytes
+            self._claimed += nbytes
+            if self.budget is not None and nbytes:
+                from ..obs.metrics import gauge
+                gauge("serve.hbm_claimed_bytes").set(self._claimed)
+        return True
+
+    def release_cache(self, key: str) -> None:
+        """Free a cache resident's claim (eviction or invalidation)."""
+        with self._cond:
+            self._claimed -= self._cache_claims.pop(key, 0)
+            if self._claimed < 0:
+                self._claimed = 0
+            if self.budget is not None:
+                from ..obs.metrics import gauge
+                gauge("serve.hbm_claimed_bytes").set(self._claimed)
             self._cond.notify_all()
 
     def claimed_bytes(self) -> int:

@@ -30,6 +30,10 @@ def _digest_table(h, t) -> bool:
     rendered to host bytes (then the whole input is uncacheable)."""
     try:
         h.update(str(t.num_rows).encode())
+        # Mutation-generation stamp (Table.mark_mutated): an in-place
+        # buffer write moves the table off generation 0, so its digest no
+        # longer collides with the pristine bytes that were cached.
+        h.update(str(getattr(t, "generation", 0)).encode())
         for name, col in t.items():
             vals, mask = col.to_numpy()
             h.update(name.encode())
@@ -77,6 +81,15 @@ def result_nbytes(result: Any) -> int:
     return total
 
 
+def _value_generations(value: Any) -> Tuple[int, ...]:
+    """Generation stamps of every Table inside an executor result, in
+    order — the snapshot taken at ``put`` and re-checked at ``get`` so a
+    cached value mutated in place (Table.mark_mutated) is invalidated
+    instead of served."""
+    tables = value if isinstance(value, (list, tuple)) else [value]
+    return tuple(getattr(t, "generation", 0) for t in tables)
+
+
 class ResultCache:
     """Byte-capped LRU of executor results.  ``cap_bytes=None`` disables
     — every ``get`` misses without counting and ``put`` discards."""
@@ -84,7 +97,8 @@ class ResultCache:
     def __init__(self, cap_bytes: Optional[int] = None):
         self.cap_bytes = cap_bytes
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[Tuple, Tuple[Any, int]]" = OrderedDict()
+        self._entries: "OrderedDict[Tuple, Tuple[Any, int, Tuple[int, ...]]]" \
+            = OrderedDict()
         self._bytes = 0
 
     @property
@@ -93,13 +107,22 @@ class ResultCache:
 
     def get(self, key: Optional[Tuple]) -> Tuple[Any, bool]:
         """Returns ``(value, hit)``; an unkeyable input (key None) or a
-        disabled cache always misses."""
+        disabled cache always misses.  A stored value whose Tables moved
+        off their put-time generation (mutated in place) is dropped and
+        counted on ``serve.result_cache.stale_invalidations``."""
         if not self.enabled:
             return None, False
-        from ..obs.metrics import counter
+        from ..obs.metrics import counter, gauge
         with self._lock:
             if key is not None and key in self._entries:
-                value, _ = self._entries[key]
+                value, nbytes, gens = self._entries[key]
+                if _value_generations(value) != gens:
+                    del self._entries[key]
+                    self._bytes -= nbytes
+                    counter("serve.result_cache.stale_invalidations").inc()
+                    counter("serve.result_cache.miss").inc()
+                    gauge("serve.result_cache.bytes").set(self._bytes)
+                    return None, False
                 self._entries.move_to_end(key)
                 counter("serve.result_cache.hit").inc()
                 return value, True
@@ -117,10 +140,10 @@ class ResultCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._entries[key] = (value, nbytes)
+            self._entries[key] = (value, nbytes, _value_generations(value))
             self._bytes += nbytes
             while self._bytes > self.cap_bytes and self._entries:
-                _, (_, dropped) = self._entries.popitem(last=False)
+                _, (_, dropped, _) = self._entries.popitem(last=False)
                 self._bytes -= dropped
                 counter("serve.result_cache.evictions").inc()
             gauge("serve.result_cache.bytes").set(self._bytes)

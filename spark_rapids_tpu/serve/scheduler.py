@@ -304,8 +304,11 @@ class QuerySession:
                 return run_plan_dist(plan, dist, mesh)
         elif table is not None:
             def thunk(gate):
-                from ..exec.compile import run_plan
-                return run_plan(plan, table)
+                # Cross-ticket prefix CSE (SRT_SEMANTIC_CACHE); a plain
+                # run_plan pass-through when the cache is off.
+                from .semantic import run_table_plan
+                return run_table_plan(plan, table,
+                                      admission=self.admission)
         else:
             def thunk(gate):
                 from ..exec.stream import run_plan_stream

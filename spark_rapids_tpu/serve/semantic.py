@@ -1,0 +1,546 @@
+"""Semantic subplan cache — cross-ticket common-subexpression
+elimination for the serving layer (``SRT_SEMANTIC_CACHE``).
+
+The workload miner (obs/workload.py) already *names* recurring subplan
+prefixes (``materialize_subplan:<fp>`` recommendations); this module
+closes the loop by actually materializing them.  At submission time the
+scheduler's run-mode thunk enters :func:`run_table_plan` instead of
+``run_plan`` directly:
+
+  * the optimized plan's leading Filter/Project/Join chain is
+    canonicalized exactly like the miner does —
+    ``exec.optimize.prefix_step_texts`` hashed through
+    ``obs.history.subplan_fingerprint`` — and keyed together with the
+    submission's input identity (``serve.result_cache.input_digest``),
+    so two *different* queries over the same input that share a prefix
+    share one cache entry;
+  * on a hit, the shared prefix is **not recomputed**: the plan is
+    spliced (``exec.optimize.splice_prefix``) so a ``CachedSourceStep``
+    leaf stands in for the prefix, and the executor resolves it to the
+    materialized Table (``exec.compile.set_cached_source_resolver``)
+    before binding, splitting, or metering — split-retry rungs operate
+    on the resolved input and can never double-count it;
+  * on a miss, interest is tallied per key; the *second* submission
+    wanting the same prefix (or the first, when the workload advisor
+    has **confirmed** the prefix) materializes it once under a
+    non-blocking single-flight claim — a concurrent loser simply runs
+    its full plan, so there is no cross-ticket blocking and no
+    deadlock surface;
+  * entries live in a byte-capped LRU whose eviction is hit-rate aware
+    (fewest hits evict first, recency breaks ties), whose bytes are
+    claimed against the admission controller's HBM budget
+    (``AdmissionController.claim_cache`` — denied claims skip caching,
+    never block), and whose *outcomes* feed back into the advisor:
+    a cold eviction (zero hits) damps future ``materialize_subplan``
+    recommendations for that prefix
+    (``obs.workload.feed_semantic``).
+
+Entries are pinned for the duration of any ticket holding a splice
+into them, so eviction can never invalidate a running query.  Off
+(``SRT_SEMANTIC_CACHE=0``, the default) this module is a transparent
+pass-through to ``run_plan`` — the bit-identity oracle.
+
+jax-free at module load, like the rest of the serving layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import (semantic_cache_bytes, semantic_cache_enabled,
+                      views_auto, views_enabled)
+from .result_cache import input_digest, result_nbytes
+
+#: A prefix must be wanted by this many submissions before it is
+#: materialized (1 for advisor-confirmed prefixes — the policy loop's
+#: fast path).
+MATERIALIZE_MIN_INTEREST = 2
+
+#: Bound on the interest / auto-candidate side tables.
+_MAX_TRACKED = 4096
+
+
+class _Entry:
+    __slots__ = ("key", "prefix_fp", "value", "nbytes", "hits", "pins")
+
+    def __init__(self, key: str, prefix_fp: str, value: Any, nbytes: int):
+        self.key = key
+        self.prefix_fp = prefix_fp
+        self.value = value
+        self.nbytes = nbytes
+        self.hits = 0
+        self.pins = 0
+
+
+class SemanticCache:
+    """Byte-capped, hit-rate-aware LRU of materialized subplan prefixes.
+
+    Keys are ``<subplan_fingerprint>/<input_digest>``.  Unlike the
+    result cache's oldest-first LRU, eviction prefers entries with the
+    fewest hits (recency breaks ties) — a materialization that never
+    paid for itself goes first, and its cold eviction is reported to
+    the workload advisor.  Pinned entries (a ticket holds a splice into
+    them) are never evicted."""
+
+    def __init__(self, cap_bytes: int, admission=None):
+        self.cap_bytes = int(cap_bytes)
+        self.admission = admission
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self.hit_count = 0
+        self.miss_count = 0
+        self.materialize_count = 0
+        self.evict_count = 0
+
+    def get(self, key: str) -> Optional[_Entry]:
+        """Counting lookup: a present entry is a hit (bumps its score
+        and recency), an absent one is NOT counted here — the caller
+        counts one miss per submission, not per probed depth."""
+        from ..obs.metrics import counter
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            entry.hits += 1
+            self._entries.move_to_end(key)
+            self.hit_count += 1
+        counter("serve.semantic.hit").inc()
+        from ..obs import workload
+        workload.feed_semantic("hit", entry.prefix_fp)
+        return entry
+
+    def peek(self, key: str) -> Optional[Any]:
+        """Uncounted value lookup — the executor's CachedSourceStep
+        resolver (the hit was already counted at splice time)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            return None if entry is None else entry.value
+
+    def note_miss(self) -> None:
+        from ..obs.metrics import counter
+        with self._lock:
+            self.miss_count += 1
+        counter("serve.semantic.miss").inc()
+        from ..obs import workload
+        workload.feed_semantic("miss")
+
+    def pin(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.pins += 1
+
+    def unpin(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.pins > 0:
+                entry.pins -= 1
+
+    def put(self, key: str, prefix_fp: str, value: Any) -> bool:
+        """Store a materialized prefix; False when it cannot be cached
+        (unmeasurable, larger than the cap, or denied an HBM claim by
+        the admission controller)."""
+        nbytes = result_nbytes(value[0] if isinstance(value, tuple)
+                               else value)
+        if nbytes <= 0 or nbytes > self.cap_bytes:
+            return False
+        if self.admission is not None \
+                and not self.admission.claim_cache(f"semantic:{key}", nbytes):
+            return False
+        from ..obs.metrics import counter, gauge
+        evicted: List[_Entry] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                evicted.append(old)
+            self._entries[key] = _Entry(key, prefix_fp, value, nbytes)
+            self._bytes += nbytes
+            self.materialize_count += 1
+            evicted.extend(self._evict_locked())
+            gauge("serve.semantic.bytes").set(self._bytes)
+        counter("serve.semantic.materialize").inc()
+        for entry in evicted:
+            self._report_evicted(entry)
+        return True
+
+    def _evict_locked(self) -> List[_Entry]:
+        """Evict unpinned entries, fewest-hits / least-recent first,
+        until under the cap.  Caller holds the lock."""
+        if self._bytes <= self.cap_bytes:
+            return []
+        order = {k: i for i, k in enumerate(self._entries)}
+        victims = sorted(
+            (e for e in self._entries.values() if e.pins == 0),
+            key=lambda e: (e.hits, order[e.key]))
+        evicted: List[_Entry] = []
+        for entry in victims:
+            if self._bytes <= self.cap_bytes:
+                break
+            del self._entries[entry.key]
+            self._bytes -= entry.nbytes
+            self.evict_count += 1
+            evicted.append(entry)
+        return evicted
+
+    def _report_evicted(self, entry: _Entry) -> None:
+        from ..obs.metrics import counter
+        counter("serve.semantic.evict").inc()
+        if self.admission is not None:
+            self.admission.release_cache(f"semantic:{entry.key}")
+        from ..obs import workload
+        workload.feed_semantic("evict", entry.prefix_fp, hits=entry.hits)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lookups = self.hit_count + self.miss_count
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "cap_bytes": self.cap_bytes,
+                "hits": self.hit_count,
+                "misses": self.miss_count,
+                "hit_rate": round(self.hit_count / lookups, 4)
+                if lookups else 0.0,
+                "materializations": self.materialize_count,
+                "evictions": self.evict_count,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+        if self.admission is not None:
+            for entry in entries:
+                self.admission.release_cache(f"semantic:{entry.key}")
+
+
+# ---------------------------------------------------------------------------
+# Module state (one cache per process, like the compile cache)
+# ---------------------------------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_CACHE: Optional[SemanticCache] = None
+_INTEREST: Dict[str, int] = {}
+_INFLIGHT: set = set()
+_CONFIRMED: set = set()
+_AUTO_CANDIDATES: "OrderedDict[str, Any]" = OrderedDict()
+
+
+def _resolver(key: str):
+    cache = _CACHE
+    return None if cache is None else cache.peek(key)
+
+
+def _ensure_cache(admission=None) -> SemanticCache:
+    global _CACHE
+    with _STATE_LOCK:
+        if _CACHE is None:
+            _CACHE = SemanticCache(semantic_cache_bytes(),
+                                   admission=admission)
+            from ..exec.compile import set_cached_source_resolver
+            set_cached_source_resolver(_resolver)
+        elif _CACHE.admission is None and admission is not None:
+            _CACHE.admission = admission
+        return _CACHE
+
+
+def _note_interest(key: str) -> int:
+    with _STATE_LOCK:
+        if key not in _INTEREST and len(_INTEREST) >= _MAX_TRACKED:
+            _INTEREST.pop(next(iter(_INTEREST)))
+        _INTEREST[key] = _INTEREST.get(key, 0) + 1
+        return _INTEREST[key]
+
+
+def confirmed_fps() -> Tuple[str, ...]:
+    """Prefix fingerprints the workload advisor has *confirmed* as
+    materialization targets (hysteresis-stable recommendations routed
+    here through ``obs.workload.set_confirmed_sink``)."""
+    with _STATE_LOCK:
+        return tuple(sorted(_CONFIRMED))
+
+
+def _note_auto_candidate(opt) -> None:
+    """Remember group-by-terminated plans by their prefix fingerprints,
+    so a later advisor confirmation can auto-register them as
+    materialized views (``SRT_VIEWS_AUTO``).  Structural check only —
+    jax-free, fallible, never raises."""
+    try:
+        steps = getattr(opt, "steps", ())
+        if not steps or type(steps[-1]).__name__ != "GroupAggStep" \
+                or getattr(steps[-1], "sets", None) is not None:
+            return
+        from ..exec.optimize import prefix_step_texts, source_plan
+        from ..obs.history import subplan_fingerprint
+        src = source_plan(opt)
+        with _STATE_LOCK:
+            for texts in prefix_step_texts(opt):
+                fp = subplan_fingerprint(texts)
+                if fp not in _AUTO_CANDIDATES:
+                    while len(_AUTO_CANDIDATES) >= _MAX_TRACKED:
+                        _AUTO_CANDIDATES.popitem(last=False)
+                    _AUTO_CANDIDATES[fp] = src
+    except Exception:
+        pass
+
+
+def _on_confirmed(fps: List[str]) -> None:
+    """The workload advisor's confirmed-recommendation sink: remember
+    confirmed prefixes (they materialize on first interest) and — under
+    ``SRT_VIEWS`` + ``SRT_VIEWS_AUTO`` — auto-register any known
+    group-by-terminated plan over a confirmed prefix as a materialized
+    view named ``auto:<fp>``."""
+    with _STATE_LOCK:
+        _CONFIRMED.update(fps)
+        candidates = {fp: _AUTO_CANDIDATES[fp] for fp in fps
+                      if fp in _AUTO_CANDIDATES}
+    if not candidates or not views_enabled() or not views_auto():
+        return
+    from ..views import registry
+    from ..obs import workload
+    from ..obs.metrics import counter
+    for fp, plan in candidates.items():
+        name = f"auto:{fp}"
+        if registry.get(name) is not None:
+            continue
+        try:
+            registry.register(name, plan, auto=True)
+        except Exception:
+            continue
+        counter("serve.semantic.auto_view").inc()
+        workload.feed_semantic("auto_view", fp)
+
+
+# The sink is installed at import: the advisor's confirmations reach
+# the cache whether or not a query ran through it yet (workload is
+# jax-free, so this costs nothing at import).
+from ..obs import workload as _workload  # noqa: E402
+
+_workload.set_confirmed_sink(_on_confirmed)
+
+
+# ---------------------------------------------------------------------------
+# The serving entry point
+# ---------------------------------------------------------------------------
+
+def run_table_plan(plan, table, admission=None):
+    """``run_plan`` with cross-ticket prefix CSE — the serving
+    scheduler's run-mode executor.  Bit-identical to
+    ``run_plan(plan, table)``; with ``SRT_SEMANTIC_CACHE=0`` it *is*
+    ``run_plan(plan, table)``."""
+    from ..exec.compile import run_plan
+    if not semantic_cache_enabled():
+        return run_plan(plan, table)
+    from ..exec.optimize import (optimize, prefix_plan, prefix_step_texts,
+                                 splice_prefix)
+    from ..obs.history import subplan_fingerprint
+    opt = optimize(plan)
+    if getattr(table, "num_rows", 0) <= 0:
+        return run_plan(opt, table)
+    nsteps = len(opt.steps)
+    # Strict prefixes only, and only row-aligned ones: a shuffled join
+    # replaces the row population (its expansion is not index-aligned
+    # with the input), so its output cannot be cached in the
+    # position-preserving form the bit-identity splice requires.
+    chains = [texts for texts in prefix_step_texts(opt)
+              if len(texts) < nsteps
+              and not any(t.startswith("ShuffledJoin[") for t in texts)]
+    if not chains:
+        return run_plan(opt, table)
+    digest = input_digest(table)
+    if digest is None:
+        return run_plan(opt, table)
+    cache = _ensure_cache(admission)
+    _note_auto_candidate(opt)
+    keyed = sorted(((len(texts), subplan_fingerprint(texts))
+                    for texts in chains), reverse=True)
+    keyed = [(depth, fp, f"{fp}/{digest}") for depth, fp in keyed]
+
+    for depth, fp, key in keyed:                       # deepest hit wins
+        if cache.get(key) is None:
+            continue
+        cache.pin(key)
+        try:
+            return run_plan(splice_prefix(opt, depth, key), table)
+        finally:
+            cache.unpin(key)
+
+    cache.note_miss()
+    confirmed = confirmed_fps()
+    target = None
+    for depth, fp, key in keyed:                       # deepest eligible
+        interest = _note_interest(key)
+        threshold = 1 if fp in confirmed else MATERIALIZE_MIN_INTEREST
+        if target is None and interest >= threshold:
+            target = (depth, fp, key)
+    if target is None:
+        return run_plan(opt, table)
+
+    depth, fp, key = target
+    with _STATE_LOCK:                                  # single flight
+        if key in _INFLIGHT:
+            target = None
+        else:
+            _INFLIGHT.add(key)
+    if target is None:                                 # lost the claim:
+        return run_plan(opt, table)                    # full plan, no wait
+    try:
+        try:
+            payload = _materialize_prefix(prefix_plan(opt, depth), table)
+        except Exception:
+            # The padded runner has no recovery ladder — an injected
+            # fault (or OOM) aborts the materialization attempt and the
+            # submission falls through to the full resilient run.
+            payload = None
+        if payload is None:
+            return run_plan(opt, table)
+        from ..obs import workload
+        workload.feed_semantic("materialize", fp)
+        stored = cache.put(key, fp, payload)
+        if not stored:
+            value, names, sel_name = payload
+            return run_plan(_resume_plan(opt, depth, names, sel_name),
+                            value)
+        cache.pin(key)
+        try:
+            return run_plan(splice_prefix(opt, depth, key), table)
+        finally:
+            cache.unpin(key)
+    finally:
+        with _STATE_LOCK:
+            _INFLIGHT.discard(key)
+
+
+def _materialize_prefix(prefix, table):
+    """Run ``prefix`` position-preserving and package the cacheable
+    payload ``(value, names, sel_name)``: the prefix's output sliced
+    back to the source's logical length (pad rows dropped, row
+    positions untouched) with its live-row selection riding as an extra
+    ``sel_name`` column.  The splice's resume steps
+    (``exec.optimize.resume_prefix_steps``) re-enter the executor's
+    ``(columns, selection)`` state from this payload, so downstream
+    float accumulation happens over the same row positions as the fused
+    run — compacting here instead would re-order the sums and drift the
+    last ulp off the bit-identity oracle.
+
+    None when the output cannot be re-bound positionally
+    (variable-width or nested columns at the prefix boundary)."""
+    from ..column import Column
+    from ..exec.compile import run_plan_padded
+    from ..table import Table
+    t, sel_col = run_plan_padded(prefix, table)
+    names = t.names
+    for nm in names:
+        c = t[nm]
+        if c.offsets is not None or c.children or c.data is None:
+            return None
+    n = table.num_rows
+
+    def _cut(c):
+        if int(c.data.shape[0]) == n:
+            return c
+        return Column(data=c.data[:n],
+                      validity=None if c.validity is None
+                      else c.validity[:n],
+                      dtype=c.dtype)
+
+    value = Table([(nm, _cut(t[nm])) for nm in names])
+    sel_name = None
+    if sel_col is not None:
+        sel_name = "__srt_sel__"
+        while sel_name in names:
+            sel_name += "_"
+        value = value.with_column(sel_name, _cut(sel_col))
+    return value, names, sel_name
+
+
+def _resume_plan(opt, depth: int, names, sel_name):
+    """``opt`` resuming after its first ``depth`` steps over an in-hand
+    position-preserving prefix payload — the fallback when a freshly
+    computed prefix could not be admitted to the cache.  The resume
+    steps restore the (columns, selection) state exactly as the
+    executor's CachedSourceStep resolver would."""
+    from ..exec.optimize import resume_prefix_steps
+    from ..exec.plan import Plan
+    rest = Plan(resume_prefix_steps(tuple(names), sel_name)
+                + tuple(opt.steps[depth:]))
+    info = getattr(opt, "opt", None)
+    if info is not None:
+        object.__setattr__(rest, "opt", info)
+    return rest
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces
+# ---------------------------------------------------------------------------
+
+def stats() -> Dict[str, Any]:
+    """Semantic-cache stats for ``/views``, ``obs views``, and the
+    semantic bench lane.  Well-defined before any query ran."""
+    cache = _CACHE
+    base: Dict[str, Any] = {
+        "enabled": semantic_cache_enabled(),
+        "entries": 0, "bytes": 0, "cap_bytes": 0,
+        "hits": 0, "misses": 0, "hit_rate": 0.0,
+        "materializations": 0, "evictions": 0,
+    }
+    if cache is not None:
+        base.update(cache.stats())
+        base["enabled"] = semantic_cache_enabled()
+    base["confirmed_prefixes"] = list(confirmed_fps())
+    return base
+
+
+def bundle_block(plan=None) -> Dict[str, Any]:
+    """Semantic block for a postmortem bundle: was the cache on, did
+    this query use it (a resolved splice marks the plan), and — the
+    doctor's hook — did the query recompute a prefix the workload
+    advisor had already *confirmed* for materialization
+    (``hot_prefix_recompute``)?  Never raises."""
+    enabled = False
+    try:
+        enabled = semantic_cache_enabled()
+    except Exception:
+        pass
+    used = plan is not None \
+        and getattr(plan, "_cached_source_key", None) is not None
+    fps: List[str] = []
+    if plan is not None:
+        try:
+            from ..exec.optimize import prefix_step_texts
+            from ..obs.history import subplan_fingerprint
+            fps = [subplan_fingerprint(t) for t in prefix_step_texts(plan)]
+        except Exception:
+            fps = []
+    confirmed = set(confirmed_fps())
+    return {
+        "enabled": bool(enabled),
+        "used": bool(used),
+        "prefix_fingerprints": fps,
+        "hot_prefix_recompute": bool(
+            enabled and not used and any(fp in confirmed for fp in fps)),
+    }
+
+
+def reset() -> None:
+    """Drop the cache, interest, claims, and confirmations (test/bench
+    isolation); releases every admission claim and uninstalls the
+    executor resolver."""
+    global _CACHE
+    with _STATE_LOCK:
+        cache, _CACHE = _CACHE, None
+        _INTEREST.clear()
+        _INFLIGHT.clear()
+        _CONFIRMED.clear()
+        _AUTO_CANDIDATES.clear()
+    if cache is not None:
+        cache.clear()
+        import sys
+        compile_mod = sys.modules.get("spark_rapids_tpu.exec.compile")
+        if compile_mod is not None:
+            compile_mod.set_cached_source_resolver(None)

@@ -8,6 +8,7 @@ Tables are pytrees, so a whole table can flow through ``jax.jit`` /
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterable, Mapping, Optional, Sequence, Union
 
 import jax
@@ -15,6 +16,10 @@ import numpy as np
 
 from .column import Column, column_from_any
 from .dtypes import DType
+
+#: Monotone source of post-mutation generation stamps (never reuses 0,
+#: the shared "pristine" generation every fresh Table starts at).
+_MUTATION_STAMPS = itertools.count(1)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -41,6 +46,7 @@ class Table:
         if len(sizes) != 1:
             raise ValueError(f"columns have mismatched lengths: "
                              f"{dict(zip(self._names, (c.size for c in self._columns)))}")
+        self._generation = 0
 
     # -- pytree protocol -----------------------------------------------------
     def tree_flatten(self):
@@ -51,6 +57,7 @@ class Table:
         obj = cls.__new__(cls)
         obj._names = names
         obj._columns = tuple(columns)
+        obj._generation = 0
         return obj
 
     # -- structure -----------------------------------------------------------
@@ -86,6 +93,29 @@ class Table:
         donation (see Column.is_deleted); such a table must be re-built,
         never read."""
         return any(c.is_deleted() for c in self._columns)
+
+    @property
+    def generation(self) -> int:
+        """Cheap version stamp for the serving caches (serve/).
+
+        Every fresh Table is generation 0 ("pristine"): content hashing
+        alone identifies it, so identical re-submissions still share one
+        cache digest.  :meth:`mark_mutated` moves the table to a
+        globally-unique generation — the sanctioned way to declare "I
+        changed this object's buffers in place" — and the caches fold
+        the stamp into their digests and refuse to serve entries whose
+        stored value moved, so an in-place mutation can never be served
+        as a stale hit."""
+        return getattr(self, "_generation", 0)
+
+    def mark_mutated(self) -> "Table":
+        """Stamp this table as mutated-in-place (see :meth:`generation`);
+        returns ``self`` for chaining.  Tables are immutable by contract —
+        call this if you broke that contract (e.g. wrote into a column's
+        numpy buffer) so the result/semantic caches invalidate instead of
+        serving the stale bytes."""
+        self._generation = next(_MUTATION_STAMPS)
+        return self
 
     def schema(self) -> list[DType]:
         return [c.dtype for c in self._columns]

@@ -1,0 +1,14 @@
+"""Materialized views with incremental maintenance (``SRT_VIEWS``).
+
+A view is a registered group-by-terminated plan whose result is kept
+current by *folding* new input batches into the streaming-combine
+accumulator (exec/stream.py dense partial-aggregate state) instead of
+recomputing from scratch — refresh cost is O(new batch), not O(history).
+See :mod:`spark_rapids_tpu.views.registry`.
+"""
+
+from .registry import (View, get, names, register, reset, snapshot,
+                       unregister, views_payload)
+
+__all__ = ["View", "register", "get", "unregister", "names", "reset",
+           "snapshot", "views_payload"]

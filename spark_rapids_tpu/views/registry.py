@@ -1,0 +1,327 @@
+"""Materialized-view registry: register once, fold batches forever.
+
+A :class:`View` wraps one group-by-terminated plan (the streaming
+combine mode's eligible shape — ``exec.stream.combine_obstacles``) and
+maintains its result **incrementally**: each :meth:`View.fold` binds
+the new batch, runs the jitted partial-aggregate program
+(``exec.compile.compiled_stream_partial``), and merges the resulting
+dense accumulator into the view's state with the same cell-wise merge
+the streaming executor uses (``exec.compile.stream_combine``).  Because
+the accumulator layout is batch-invariant (static key domains,
+``_combine_setup``) and the merge is the identical jitted program,
+folding batch-by-batch is **bit-identical** to a fresh fold over all
+batches — and :meth:`View.refresh` pays one ``stream_finalize`` (one
+host sync), not a recompute of the whole history.
+
+Staleness is tracked two ways: a monotone *rolling input digest*
+(sha256 over every folded batch's identity — compare digests to know
+whether two views saw the same inputs) and a ``stale`` bit (folds since
+the last refresh).  :meth:`View.invalidate` drops the accumulator
+entirely; the next folds rebuild from empty.
+
+The registry is process-global like the compile cache.  Registration
+is gated on ``SRT_VIEWS`` (knob-named ValueError when off) and does a
+jax-free structural check (plan ends in a plain group-by); the deep
+combine-eligibility check runs on first fold, when jax is loaded
+anyway.  Auto-registered views (``SRT_VIEWS_AUTO``, named
+``auto:<prefix fp>``) come from the workload advisor's confirmed
+``materialize_subplan`` recommendations via
+``serve.semantic._on_confirmed``.
+
+jax-free at module load — pinned by an import-hygiene test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config import views_auto, views_enabled
+
+_LOCK = threading.Lock()
+_VIEWS: Dict[str, "View"] = {}
+
+
+_COMBINE_NODONATE = None
+
+
+def _combine_nodonate():
+    """``exec.compile.stream_combine``'s cell-wise merge without
+    argument donation: a refresh merges the live binomial levels into
+    a throwaway total that future folds must still be able to read
+    (the donating merge would consume the level buffers in place).
+    Lazy-jitted on first use — this module stays jax-free at import."""
+    global _COMBINE_NODONATE
+    if _COMBINE_NODONATE is None:
+        import jax
+        import jax.numpy as jnp
+
+        def combine(a, b):
+            out = {}
+            for k, v in a.items():
+                if k.startswith("min:"):
+                    out[k] = jnp.minimum(v, b[k])
+                elif k.startswith("max:"):
+                    out[k] = jnp.maximum(v, b[k])
+                else:           # count_all / count: / sum: / sumsq:
+                    out[k] = v + b[k]
+            return out
+        _COMBINE_NODONATE = jax.jit(combine)
+    return _COMBINE_NODONATE
+
+
+class View:
+    """One incrementally-maintained materialized view.  Thread-safe;
+    create through :func:`register`."""
+
+    def __init__(self, name: str, plan, auto: bool = False):
+        steps = getattr(plan, "steps", ())
+        if not steps or type(steps[-1]).__name__ != "GroupAggStep" \
+                or getattr(steps[-1], "sets", None) is not None:
+            raise ValueError(
+                f"view {name!r}: plan must end in a plain group-by "
+                f"(no grouping sets) to be incrementally maintainable")
+        self.name = name
+        self.auto = bool(auto)
+        self._plan = plan
+        self._lock = threading.Lock()
+        self._opt = None
+        self._bound0 = None
+        self._smeta = None
+        self._dtypes = None
+        #: binomial accumulator tree — levels[i] holds 2^i batches'
+        #: worth, mirroring the streaming driver's carry
+        #: (exec/stream.py _drive_combine) so the view's float-add
+        #: association — and therefore its bits — match
+        #: ``run_plan_stream(combine=True)`` over the same history.
+        self._levels: list = []
+        self._digest = hashlib.sha256()
+        self._batches = 0
+        self._rows = 0
+        self._folds_since_refresh = 0
+        self._refreshes = 0
+        self._hits = 0
+        self._result = None
+        self._last_refresh_s = -1.0
+
+    @property
+    def plan(self):
+        return self._plan
+
+    def _setup_locked(self, batch):
+        """First-fold setup: optimize for streaming, verify combine
+        eligibility, pin the batch-invariant accumulator layout."""
+        from ..exec.optimize import optimize
+        from ..exec.stream import _combine_setup, combine_obstacles
+        if self._opt is None:
+            opt = optimize(self._plan, mode="stream")
+            obstacles = combine_obstacles(opt)
+            if obstacles:
+                raise TypeError(
+                    f"view {self.name!r} is not incrementally "
+                    f"maintainable: {'; '.join(obstacles)}")
+            self._opt = opt
+        if self._smeta is None:
+            from ..exec.compile import _bind
+            bound = _bind(self._opt, batch)
+            self._smeta, self._dtypes = _combine_setup(bound)
+            self._bound0 = bound
+
+    def fold(self, batch) -> None:
+        """Fold one input batch into the view's accumulator state —
+        the incremental-maintenance step.  Empty batches are no-ops
+        (bit-identical: zero rows contribute nothing).  Raises
+        TypeError when the plan cannot stream-combine (string keys,
+        dynamic domains, too many cells)."""
+        if getattr(batch, "num_rows", 0) <= 0:
+            return
+        with self._lock:
+            self._setup_locked(batch)
+            from ..exec.compile import (_bind, compiled_stream_partial,
+                                        stream_combine)
+            bound = _bind(self._opt, batch)
+            fn, _ = compiled_stream_partial(bound, self._smeta, False)
+            part = fn(bound.exec_cols, bound.side_inputs, bound.init_sel)
+            # Binomial carry (donates each consumed level): the same
+            # merge order as the one-shot streaming driver, so a
+            # sequence of folds is bit-identical to replaying the whole
+            # history through run_plan_stream(combine=True) — a plain
+            # left fold would re-associate float adds.
+            merge = stream_combine()
+            i = 0
+            while i < len(self._levels) and self._levels[i] is not None:
+                part = merge(self._levels[i], part)
+                self._levels[i] = None
+                i += 1
+            if i == len(self._levels):
+                self._levels.append(part)
+            else:
+                self._levels[i] = part
+            self._fold_digest_locked(batch)
+            self._batches += 1
+            self._rows += batch.num_rows
+            self._folds_since_refresh += 1
+            self._result = None
+        from ..obs.metrics import counter
+        counter("views.fold").inc()
+        from ..obs import workload
+        workload.feed_semantic("view_fold")
+
+    def _fold_digest_locked(self, batch) -> None:
+        from ..serve.result_cache import _digest_table
+        _digest_table(self._digest, batch)
+
+    def refresh(self):
+        """Finalize the accumulator into the view's result Table (ONE
+        host sync — ``exec.compile.stream_finalize``) and clear the
+        stale bit.  Raises ValueError before any batch was folded."""
+        t0 = time.perf_counter()
+        with self._lock:
+            live = [lv for lv in self._levels if lv is not None]
+            if not live:
+                raise ValueError(
+                    f"view {self.name!r} has no folded batches to "
+                    f"refresh (fold at least one, or invalidate() was "
+                    f"called)")
+            # Merge the live levels lowest-first into a throwaway total
+            # — the streaming driver's end-of-stream order — WITHOUT
+            # donation: the levels must stay readable for future folds.
+            total = live[0]
+            merge = _combine_nodonate()
+            for lv in live[1:]:
+                total = merge(total, lv)
+            from ..exec.compile import stream_finalize
+            self._result = stream_finalize(self._bound0, self._smeta,
+                                           total, self._dtypes)
+            self._folds_since_refresh = 0
+            self._refreshes += 1
+            self._last_refresh_s = time.perf_counter() - t0
+            result = self._result
+        from ..obs.metrics import counter
+        counter("views.refresh").inc()
+        from ..obs import workload
+        workload.feed_semantic("view_refresh")
+        return result
+
+    def result(self):
+        """The view's current result: the memoized Table when fresh
+        (counted as a view hit), else a :meth:`refresh`."""
+        with self._lock:
+            fresh = self._result is not None \
+                and self._folds_since_refresh == 0
+            if fresh:
+                self._hits += 1
+                result = self._result
+        if fresh:
+            from ..obs.metrics import counter
+            counter("views.hit").inc()
+            from ..obs import workload
+            workload.feed_semantic("view_hit")
+            return result
+        return self.refresh()
+
+    def invalidate(self) -> None:
+        """Drop the accumulator, memoized result, and input digest —
+        the view rebuilds from empty on the next folds."""
+        with self._lock:
+            self._levels = []
+            self._result = None
+            self._digest = hashlib.sha256()
+            self._batches = 0
+            self._rows = 0
+            self._folds_since_refresh = 0
+
+    @property
+    def stale(self) -> bool:
+        """True when batches were folded (or the view was invalidated)
+        since the last refresh."""
+        with self._lock:
+            return self._result is None or self._folds_since_refresh > 0
+
+    @property
+    def input_digest(self) -> str:
+        """Rolling identity digest of every batch folded since the last
+        :meth:`invalidate` — equal digests mean equal input history."""
+        with self._lock:
+            return self._digest.hexdigest()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self.name,
+                "auto": self.auto,
+                "batches": self._batches,
+                "rows": self._rows,
+                "stale": self._result is None
+                or self._folds_since_refresh > 0,
+                "refreshes": self._refreshes,
+                "hits": self._hits,
+                "last_refresh_s": round(self._last_refresh_s, 6),
+                "input_digest": self._digest.hexdigest(),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+def register(name: str, plan, auto: bool = False) -> View:
+    """Register ``plan`` as materialized view ``name``.  Raises a
+    knob-named ValueError when ``SRT_VIEWS`` is off, and ValueError on
+    a duplicate name or a structurally ineligible plan."""
+    if not views_enabled():
+        raise ValueError(
+            "SRT_VIEWS is disabled — set SRT_VIEWS=1 to register "
+            "materialized views")
+    view = View(name, plan, auto=auto)
+    with _LOCK:
+        if name in _VIEWS:
+            raise ValueError(f"view {name!r} is already registered")
+        _VIEWS[name] = view
+    return view
+
+
+def get(name: str) -> Optional[View]:
+    with _LOCK:
+        return _VIEWS.get(name)
+
+
+def unregister(name: str) -> bool:
+    with _LOCK:
+        return _VIEWS.pop(name, None) is not None
+
+
+def names() -> List[str]:
+    with _LOCK:
+        return sorted(_VIEWS)
+
+
+def reset() -> None:
+    """Drop every view (test/bench isolation)."""
+    with _LOCK:
+        _VIEWS.clear()
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    with _LOCK:
+        views = list(_VIEWS.values())
+    return [v.snapshot() for v in sorted(views, key=lambda v: v.name)]
+
+
+def views_payload() -> Dict[str, Any]:
+    """The ``/views`` endpoint payload (obs/server.py) — also what
+    ``python -m spark_rapids_tpu.obs views --json`` prints.  jax-free:
+    registry + semantic-cache stats + the workload advisor's semantic
+    outcome feed."""
+    from ..obs import workload
+    from ..serve import semantic
+    return {
+        "schema_version": 1,
+        "views_enabled": views_enabled(),
+        "views_auto": views_auto(),
+        "views": snapshot(),
+        "semantic_cache": semantic.stats(),
+        "outcomes": workload.semantic_stats(),
+    }

@@ -466,6 +466,61 @@ def test_serve_imports_without_jax():
     assert "jaxfree" in out.stdout
 
 
+def test_semantic_and_views_import_without_jax():
+    """The semantic subplan cache (serve/semantic.py) and the
+    materialized-view registry (views/) must stay jax-free at import
+    AND for their control-plane logic: stats, the bundle block,
+    knob-gated registration errors, and the ``/views`` payload are
+    operator surfaces a monitoring process uses with no XLA stack."""
+    import pathlib
+    pkg_dir = pathlib.Path(__file__).resolve().parents[1]
+    code = (
+        "import sys, types\n"
+        "pkg = types.ModuleType('spark_rapids_tpu')\n"
+        f"pkg.__path__ = [{str(pkg_dir / 'spark_rapids_tpu')!r}]\n"
+        "sys.modules['spark_rapids_tpu'] = pkg\n"
+        "from spark_rapids_tpu.serve import semantic\n"
+        "from spark_rapids_tpu import views\n"
+        "assert 'jax' not in sys.modules, \\\n"
+        "    'importing serve.semantic/views pulled in jax'\n"
+        "from spark_rapids_tpu import config\n"
+        "assert config.semantic_cache_enabled() is False  # env unset\n"
+        "assert config.semantic_cache_bytes() == 256 << 20\n"
+        "assert config.views_enabled() is False\n"
+        "assert config.views_auto() is False\n"
+        "s = semantic.stats()\n"
+        "assert s['enabled'] is False and s['entries'] == 0\n"
+        "assert s['hit_rate'] == 0.0\n"
+        "b = semantic.bundle_block(None)\n"
+        "assert b == {'enabled': False, 'used': False,\n"
+        "             'prefix_fingerprints': [],\n"
+        "             'hot_prefix_recompute': False}\n"
+        "c = semantic.SemanticCache(cap_bytes=1024)\n"
+        "assert c.get('missing') is None\n"
+        "assert c.stats()['entries'] == 0\n"
+        "try:\n"
+        "    views.register('v', object())\n"
+        "except ValueError as e:\n"
+        "    assert 'SRT_VIEWS' in str(e)\n"
+        "else:\n"
+        "    raise AssertionError('SRT_VIEWS off did not refuse')\n"
+        "p = views.views_payload()\n"
+        "assert p['schema_version'] == 1 and p['views'] == []\n"
+        "assert p['views_enabled'] is False\n"
+        "assert 'jax' not in sys.modules, 'semantic logic pulled in jax'\n"
+        "print('jaxfree')\n"
+    )
+    import os
+    env = dict(os.environ)
+    for k in ("SRT_METRICS", "SRT_SEMANTIC_CACHE",
+              "SRT_SEMANTIC_CACHE_BYTES", "SRT_VIEWS", "SRT_VIEWS_AUTO"):
+        env.pop(k, None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "jaxfree" in out.stdout
+
+
 def test_watchdog_imports_without_jax():
     """The mesh stall watchdog (resilience.watchdog) must stay jax-free
     at import: the guard is plain threading, and the dist-resilience

@@ -1,0 +1,599 @@
+"""Semantic subplan cache + incremental materialized views
+(spark_rapids_tpu/serve/semantic.py, spark_rapids_tpu/views/).
+
+The contracts pinned here:
+
+1. **Bit-identity oracle** — with ``SRT_SEMANTIC_CACHE`` off,
+   ``run_table_plan`` *is* ``run_plan``; with it on, every served
+   result (first compute, materializing compute, spliced cache hit)
+   is bit-identical to the bare executor, including at bucket-boundary
+   sizes with null keys, through the serving scheduler in every mode,
+   and while the recovery ladder is rescuing an injected fault.
+2. **CSE mechanics** — a shared prefix materializes on the second
+   interested submission (first, when advisor-confirmed), later
+   submissions splice it (hit counters move), an uncacheable prefix
+   falls back to running the suffix over the in-hand result, and
+   hit-rate-aware eviction reports cold evictions to the workload
+   advisor (which damps future recommendations for that prefix).
+3. **Views** — incremental fold + refresh is bit-identical to the
+   streaming-combine executor over the same batches AND to a fresh
+   view folded once; staleness/invalidate/memo-hit semantics hold;
+   registration is knob-gated with a knob-named ValueError.
+4. **Policy closure** — ``workload.advise()`` routes confirmed
+   ``materialize_subplan`` recommendations into the semantic cache's
+   confirmed set, and (``SRT_VIEWS_AUTO``) auto-registers known
+   group-by plans over confirmed prefixes as ``auto:<fp>`` views.
+5. **Result-cache mutation staleness** — an in-place Table mutation
+   (``mark_mutated``) changes the input digest and invalidates any
+   cached value holding the mutated table (regression: the cache used
+   to serve the stale pre-mutation result).
+6. **Observability** — bundle schema v4 carries the semantic block,
+   the doctor flags hot-prefix recomputes, and the ``/views`` payload
+   and ``obs views`` rendering are pure functions of the state.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Column, Table, views
+from spark_rapids_tpu import config
+from spark_rapids_tpu.exec import col, plan, run_plan_stream
+from spark_rapids_tpu.obs import registry, workload
+from spark_rapids_tpu.obs import bundle as bundle_mod
+from spark_rapids_tpu.obs.doctor import diagnose
+from spark_rapids_tpu.resilience import recovery_stats, reset_faults
+from spark_rapids_tpu.serve import (QuerySession, ResultCache, input_digest,
+                                    semantic)
+from spark_rapids_tpu.table import assert_tables_equal
+
+
+@pytest.fixture
+def semantic_on(monkeypatch):
+    monkeypatch.setenv("SRT_SEMANTIC_CACHE", "1")
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    semantic.reset()
+    views.reset()
+    workload.reset()
+    yield monkeypatch
+    semantic.reset()
+    views.reset()
+    workload.reset()
+    registry().reset()
+
+
+@pytest.fixture
+def views_on(semantic_on):
+    semantic_on.setenv("SRT_VIEWS", "1")
+    yield semantic_on
+
+
+@pytest.fixture
+def faults(monkeypatch):
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv("SRT_FAULT", raising=False)
+    reset_faults()
+
+
+def _mk(n, seed=0, khi=5, null_keys=False):
+    r = np.random.default_rng(seed)
+    kv = r.integers(0, khi, n).astype(np.int64)
+    k = Column.from_numpy(kv, validity=r.random(n) > 0.15) \
+        if null_keys else Column.from_numpy(kv)
+    return Table({
+        "k": k,
+        "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64),
+                               validity=r.random(n) > 0.2),
+    })
+
+
+def _agg_plan():
+    return plan().filter(col("v") > 10).groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "c")],
+        domains={"k": (0, 4)})
+
+
+def _etl_plan():
+    return plan().filter(col("v") > 10).with_columns(w=col("v") * 2)
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity oracle
+# ---------------------------------------------------------------------------
+
+class TestOracleIdentity:
+    def test_off_is_pass_through(self, monkeypatch):
+        monkeypatch.delenv("SRT_SEMANTIC_CACHE", raising=False)
+        semantic.reset()
+        t = _mk(256, seed=3)
+        p = _agg_plan()
+        assert_tables_equal(p.run(t), semantic.run_table_plan(p, t))
+        assert semantic.stats()["enabled"] is False
+        assert semantic.stats()["entries"] == 0
+
+    def test_materialize_then_hit_is_bit_identical(self, semantic_on):
+        t = _mk(1024, seed=4)
+        # Sibling aggregations over the same pruned+filtered prefix —
+        # the optimizer canonicalizes both to the same leading chain.
+        pa = _agg_plan()
+        pb = plan().filter(col("v") > 10).groupby_agg(
+            ["k"], [("v", "min", "mn"), ("v", "max", "mx")],
+            domains={"k": (0, 4)})
+        want_a, want_b = pa.run(t), pb.run(t)
+        # 1st: interest only; 2nd: materialize + splice; 3rd (sibling
+        # plan, same prefix): splice from cache.
+        assert_tables_equal(want_a, semantic.run_table_plan(pa, t))
+        assert_tables_equal(want_a, semantic.run_table_plan(pa, t))
+        assert_tables_equal(want_b, semantic.run_table_plan(pb, t))
+        s = semantic.stats()
+        assert s["materializations"] == 1
+        assert s["hits"] >= 1
+        assert s["entries"] == 1 and s["bytes"] > 0
+
+    def test_float_sums_splice_bit_identical(self, semantic_on):
+        """Float accumulation order is position-sensitive: a compacted
+        prefix result re-orders the rows under the downstream sum and
+        drifts the last ulp (regression — integer aggregations masked
+        this).  The position-preserving splice must match the fused
+        run exactly, through a broadcast join included."""
+        r = np.random.default_rng(11)
+        n = 257
+        t = Table({
+            "k": Column.from_numpy(r.integers(0, 7, n).astype(np.int64)),
+            "v": Column.from_numpy(r.integers(0, 100, n).astype(np.int64)),
+            "x": Column.from_numpy(r.uniform(0.0, 10.0, n)),
+        })
+        dim = Table({
+            "k2": Column.from_numpy(np.arange(7, dtype=np.int64)),
+            "w": Column.from_numpy(r.uniform(0.5, 2.0, 7)),
+        })
+        pa = (plan().filter(col("v") > 10)
+              .join_broadcast(dim, left_on="k", right_on="k2")
+              .groupby_agg(["k"], [("x", "sum", "sx"), ("w", "sum", "sw")],
+                           domains={"k": (0, 6)}))
+        pb = (plan().filter(col("v") > 10)
+              .join_broadcast(dim, left_on="k", right_on="k2")
+              .groupby_agg(["k"], [("x", "mean", "mx"), ("w", "max", "hw")],
+                           domains={"k": (0, 6)}))
+        want_a, want_b = pa.run(t), pb.run(t)
+        for _ in range(3):
+            assert_tables_equal(want_a, semantic.run_table_plan(pa, t))
+            assert_tables_equal(want_b, semantic.run_table_plan(pb, t))
+        s = semantic.stats()
+        assert s["materializations"] == 1 and s["hits"] >= 3
+
+    @pytest.mark.parametrize("n", [64, 65, 1, 129])
+    def test_bucket_boundaries_with_null_keys(self, semantic_on, n):
+        t = _mk(n, seed=n, null_keys=True)
+        pa = _agg_plan()
+        want = pa.run(t)
+        for _ in range(3):      # full, materialize, hit
+            assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        s = semantic.stats()
+        # A tiny input can filter to an empty (uncacheable) prefix —
+        # then every run is a full run, which is the oracle anyway.
+        if s["materializations"]:
+            assert s["hits"] >= 1
+
+    def test_distinct_inputs_never_cross_contaminate(self, semantic_on):
+        ta, tb = _mk(512, seed=7), _mk(512, seed=8)
+        pa = _agg_plan()
+        want_a, want_b = pa.run(ta), pa.run(tb)
+        for _ in range(3):
+            assert_tables_equal(want_a, semantic.run_table_plan(pa, ta))
+            assert_tables_equal(want_b, semantic.run_table_plan(pa, tb))
+        assert semantic.stats()["entries"] == 2
+
+    def test_session_fanout_hits_and_matches(self, semantic_on):
+        t = _mk(2048, seed=9)
+        pa, pe = _agg_plan(), _etl_plan()
+        want_a, want_e = pa.run(t).to_pydict(), pe.run(t).to_pydict()
+        s = QuerySession(max_concurrent=3, register_queued=False)
+        try:
+            for _ in range(3):
+                assert s.submit(pa, table=t).result(
+                    timeout=300).to_pydict() == want_a
+            assert s.submit(pe, table=t).result(
+                timeout=300).to_pydict() == want_e
+        finally:
+            s.close()
+        st = semantic.stats()
+        assert st["hits"] > 0 and st["materializations"] >= 1
+
+    def test_other_modes_unaffected(self, semantic_on):
+        """stream submissions bypass the subplan cache entirely — and
+        stay bit-identical with the knob on."""
+        batches = [_mk(96, seed=20 + i) for i in range(3)]
+        pe = _etl_plan()
+        want = [x.to_pydict() for x in run_plan_stream(pe, list(batches))]
+        s = QuerySession(max_concurrent=2, register_queued=False)
+        try:
+            got = s.submit(pe, list(batches)).result(timeout=300)
+        finally:
+            s.close()
+        assert [x.to_pydict() for x in got] == want
+
+    def test_fault_isolation(self, semantic_on, faults):
+        """An injected dispatch OOM during the spliced run is rescued
+        by the ladder without disturbing bit-identity — and the split
+        rungs never re-resolve the cached source into duplicates."""
+        t = _mk(2048, seed=11)
+        pa = _agg_plan()
+        want = pa.run(t)
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        faults.setenv("SRT_FAULT", "oom:dispatch:1")
+        reset_faults()
+        before = recovery_stats().snapshot()
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        delta = recovery_stats().delta(before)
+        assert delta["retries"] >= 1, delta
+        assert semantic.stats()["hits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 2. CSE mechanics
+# ---------------------------------------------------------------------------
+
+class TestCacheMechanics:
+    def test_uncacheable_prefix_falls_back_bit_identically(
+            self, semantic_on):
+        semantic_on.setenv("SRT_SEMANTIC_CACHE_BYTES", "64")
+        t = _mk(1024, seed=12)
+        pa = _agg_plan()
+        want = pa.run(t)
+        for _ in range(3):
+            assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        s = semantic.stats()
+        assert s["entries"] == 0 and s["hits"] == 0
+
+    def test_cold_eviction_feeds_advisor_damping(self, semantic_on):
+        """Evicting a zero-hit entry reports the prefix to the workload
+        advisor, which caps that prefix's future materialize_subplan
+        severity."""
+        from spark_rapids_tpu.serve.result_cache import result_nbytes
+        t = _mk(64, seed=13)
+        cache = semantic.SemanticCache(
+            cap_bytes=int(1.5 * result_nbytes(t)))
+        assert cache.put("fpA/d1", "fpA", t)
+        assert cache.put("fpB/d2", "fpB", _mk(64, seed=14))
+        assert cache.stats()["evictions"] >= 1
+        cold = workload.cold_evicted_fps()
+        assert "fpA" in cold
+        snap = {"window_seconds": 60.0, "hotspots": [], "overlaps": [{
+            "prefix_fingerprint": "fpA", "depth": 1,
+            "kinds": ["Filter"], "count": 4, "plans": 2, "inflight": 0,
+            "seconds_mean": 0.5, "measured": True,
+            "est_result_bytes": 1000, "benefit_score": 2.0}]}
+        recs = workload.recommend(snap, cold_evicted=cold)
+        assert recs and recs[0]["severity"] <= workload.COLD_SEVERITY_CAP
+        assert "damped" in recs[0]["reason"]
+        undamped = workload.recommend(snap)
+        assert undamped[0]["severity"] == 75
+
+    def test_eviction_prefers_fewest_hits(self, semantic_on):
+        from spark_rapids_tpu.serve.result_cache import result_nbytes
+        ta, tb = _mk(64, seed=15), _mk(64, seed=16)
+        cache = semantic.SemanticCache(
+            cap_bytes=int(1.5 * result_nbytes(ta)))
+        cache.put("hot/d", "hot", ta)
+        assert cache.get("hot/d") is not None       # one hit
+        cache.put("cold/d", "cold", tb)             # overflows the cap
+        assert cache.peek("hot/d") is not None      # hot survived
+        assert cache.peek("cold/d") is None
+
+    def test_pinned_entries_never_evict(self, semantic_on):
+        from spark_rapids_tpu.serve.result_cache import result_nbytes
+        t = _mk(64, seed=17)
+        cache = semantic.SemanticCache(
+            cap_bytes=int(1.5 * result_nbytes(t)))
+        cache.put("pinned/d", "p", t)
+        cache.pin("pinned/d")
+        cache.put("new/d", "n", _mk(64, seed=18))
+        assert cache.peek("pinned/d") is not None
+        cache.unpin("pinned/d")
+
+    def test_knob_validation(self, monkeypatch):
+        for knob, accessor, bad in [
+                ("SRT_SEMANTIC_CACHE", config.semantic_cache_enabled,
+                 "maybe"),
+                ("SRT_SEMANTIC_CACHE_BYTES", config.semantic_cache_bytes,
+                 "-5"),
+                ("SRT_VIEWS", config.views_enabled, "2"),
+                ("SRT_VIEWS_AUTO", config.views_auto, "yep")]:
+            monkeypatch.setenv(knob, bad)
+            with pytest.raises(ValueError, match=knob):
+                accessor()
+            monkeypatch.delenv(knob)
+
+
+# ---------------------------------------------------------------------------
+# 3. materialized views
+# ---------------------------------------------------------------------------
+
+class TestViews:
+    def _batches(self):
+        # Bucket-boundary sizes, an empty batch, and null keys.
+        sizes = [64, 65, 1, 70]
+        out = [_mk(n, seed=30 + i, null_keys=True)
+               for i, n in enumerate(sizes)]
+        empty = Table({
+            "k": Column.from_numpy(np.empty(0, dtype=np.int64)),
+            "v": Column.from_numpy(np.empty(0, dtype=np.int64)),
+        })
+        out.insert(2, empty)
+        return out
+
+    def test_incremental_equals_streaming_combine(self, views_on):
+        batches = self._batches()
+        pa = _agg_plan()
+        want = list(run_plan_stream(pa, [b for b in batches],
+                                    combine=True))
+        assert len(want) == 1
+        v = views.register("sales", pa)
+        for b in batches:
+            v.fold(b)
+        assert_tables_equal(want[0], v.result())
+        # ...and to a fresh view folded over the same history.
+        v2 = views.register("sales2", pa)
+        for b in batches:
+            v2.fold(b)
+        assert_tables_equal(v.result(), v2.result())
+        assert v.input_digest == v2.input_digest
+
+    def test_float_folds_match_streaming_combine_bits(self, views_on):
+        """Float partials are association-sensitive: the view's folds
+        must carry the same binomial tree as the one-shot streaming
+        driver, mid-stream refreshes included (regression — a plain
+        left fold re-associates the adds and drifts the last ulp;
+        integer aggregations masked this)."""
+        r = np.random.default_rng(21)
+        batches = [Table({
+            "k": Column.from_numpy(r.integers(0, 5, n).astype(np.int64)),
+            "x": Column.from_numpy(r.uniform(0.0, 10.0, n)),
+        }) for n in (64, 65, 1, 70, 33)]
+        pf = plan().groupby_agg(
+            ["k"], [("x", "sum", "sx"), ("x", "mean", "mx")],
+            domains={"k": (0, 4)})
+        v = views.register("fsales", pf)
+        for i, b in enumerate(batches):
+            v.fold(b)
+            if i == 2:          # mid-stream refresh must not disturb
+                v.refresh()     # the accumulator tree
+        want = list(run_plan_stream(pf, list(batches), combine=True))[0]
+        assert_tables_equal(want, v.result())
+
+    def test_mid_stream_refresh_and_staleness(self, views_on):
+        batches = self._batches()
+        pa = _agg_plan()
+        v = views.register("mid", pa)
+        assert v.stale
+        v.fold(batches[0])
+        early = v.refresh()
+        assert_tables_equal(
+            early, list(run_plan_stream(pa, [batches[0]],
+                                        combine=True))[0])
+        assert not v.stale
+        hits0 = v.snapshot()["hits"]
+        assert_tables_equal(early, v.result())      # memoized
+        assert v.snapshot()["hits"] == hits0 + 1
+        v.fold(batches[1])
+        assert v.stale
+        assert_tables_equal(
+            v.result(),
+            list(run_plan_stream(pa, batches[:2], combine=True))[0])
+        assert not v.stale
+
+    def test_invalidate_rebuilds_from_empty(self, views_on):
+        batches = self._batches()
+        pa = _agg_plan()
+        v = views.register("inv", pa)
+        for b in batches:
+            v.fold(b)
+        v.result()
+        v.invalidate()
+        assert v.stale and v.snapshot()["batches"] == 0
+        with pytest.raises(ValueError, match="inv"):
+            v.refresh()
+        v.fold(batches[0])
+        assert_tables_equal(
+            v.result(),
+            list(run_plan_stream(pa, [batches[0]], combine=True))[0])
+
+    def test_register_requires_knob(self, semantic_on):
+        semantic_on.delenv("SRT_VIEWS", raising=False)
+        with pytest.raises(ValueError, match="SRT_VIEWS"):
+            views.register("nope", _agg_plan())
+
+    def test_register_requires_groupby_tail(self, views_on):
+        with pytest.raises(ValueError, match="group-by"):
+            views.register("etl", _etl_plan())
+
+    def test_registry_lifecycle(self, views_on):
+        v = views.register("a", _agg_plan())
+        with pytest.raises(ValueError, match="already registered"):
+            views.register("a", _agg_plan())
+        assert views.get("a") is v
+        assert views.names() == ["a"]
+        assert views.unregister("a") and not views.unregister("a")
+        assert views.names() == []
+
+
+# ---------------------------------------------------------------------------
+# 4. policy closure
+# ---------------------------------------------------------------------------
+
+class TestPolicyClosure:
+    def _prefix_fp(self, p):
+        from spark_rapids_tpu.exec.optimize import (optimize,
+                                                    prefix_step_texts)
+        from spark_rapids_tpu.obs.history import subplan_fingerprint
+        opt = optimize(p)
+        chains = [t for t in prefix_step_texts(opt)
+                  if len(t) < len(opt.steps)]
+        return subplan_fingerprint(max(chains, key=len))
+
+    def test_confirmed_prefix_materializes_first_sight(self, semantic_on):
+        t = _mk(512, seed=40)
+        pa = _agg_plan()
+        fp = self._prefix_fp(pa)
+        semantic._on_confirmed([fp])
+        assert fp in semantic.confirmed_fps()
+        want = pa.run(t)
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        assert semantic.stats()["materializations"] == 1
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        assert semantic.stats()["hits"] == 1
+
+    def test_advise_routes_confirmations_to_sink(self, semantic_on):
+        snap = {"window_seconds": 60.0, "queries": 4, "plans": 2,
+                "step_seconds": 2.0, "hotspots": [], "overlaps": [{
+                    "prefix_fingerprint": "feedbeef", "depth": 1,
+                    "kinds": ["Filter"], "count": 4, "plans": 2,
+                    "inflight": 0, "seconds_mean": 0.5, "measured": True,
+                    "est_result_bytes": 1000, "benefit_score": 2.0}]}
+        semantic_on.setattr(workload, "snapshot", lambda window_s=None: snap)
+        payload = workload.advise(
+            advisor=workload.Advisor(confirm=1, clear=1))
+        assert any(r["action"] == "materialize_subplan:feedbeef"
+                   for r in payload["recommendations"])
+        assert "feedbeef" in semantic.confirmed_fps()
+
+    def test_auto_view_registration(self, views_on):
+        views_on.setenv("SRT_VIEWS_AUTO", "1")
+        t = _mk(512, seed=41)
+        pa = _agg_plan()
+        want = pa.run(t)
+        assert_tables_equal(want, semantic.run_table_plan(pa, t))
+        fp = self._prefix_fp(pa)
+        semantic._on_confirmed([fp])
+        name = f"auto:{fp}"
+        assert name in views.names()
+        v = views.get(name)
+        assert v.auto
+        v.fold(t)
+        assert_tables_equal(
+            list(run_plan_stream(pa, [t], combine=True))[0], v.result())
+
+    def test_auto_view_requires_both_knobs(self, views_on):
+        views_on.delenv("SRT_VIEWS_AUTO", raising=False)
+        t = _mk(256, seed=42)
+        pa = _agg_plan()
+        semantic.run_table_plan(pa, t)
+        semantic._on_confirmed([self._prefix_fp(pa)])
+        assert views.names() == []
+
+
+# ---------------------------------------------------------------------------
+# 5. result-cache mutation staleness (regression)
+# ---------------------------------------------------------------------------
+
+class TestMutationStaleness:
+    def test_mark_mutated_changes_digest(self):
+        t = _mk(128, seed=50)
+        before = input_digest(t)
+        assert before == input_digest(t)
+        t.mark_mutated()
+        assert input_digest(t) != before
+
+    def test_stale_value_invalidated_on_get(self, semantic_on):
+        c = ResultCache(cap_bytes=1 << 20)
+        t = _mk(128, seed=51)
+        c.put(("q",), t)
+        got, hit = c.get(("q",))
+        assert hit and got is t
+        t.mark_mutated()            # in-place mutation after caching
+        got, hit = c.get(("q",))
+        assert not hit and got is None
+        assert c.stats()["entries"] == 0
+        snap = registry().snapshot()
+        assert snap.get("serve.result_cache.stale_invalidations", 0) >= 1
+
+    def test_generation_survives_jax_roundtrip(self):
+        t = _mk(64, seed=52)
+        t.mark_mutated()
+        assert t.generation > 0
+
+
+# ---------------------------------------------------------------------------
+# 6. observability
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def _golden_schema(self):
+        import json
+        import os
+        path = os.path.join(os.path.dirname(__file__), "golden",
+                            "postmortem_bundle_schema.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_bundle_carries_semantic_block(self, semantic_on):
+        t = _mk(256, seed=60)
+        pa = _agg_plan()
+        semantic.run_table_plan(pa, t)
+        payload = bundle_mod.build("failure", query_id=1,
+                                   fingerprint="fp", mode="run", plan=pa)
+        assert bundle_mod.validate_bundle(
+            payload, self._golden_schema()) == []
+        sem = payload["semantic"]
+        assert sem["enabled"] is True
+        assert sem["prefix_fingerprints"]
+
+    def test_hot_prefix_recompute_flag_and_doctor(self, semantic_on):
+        t = _mk(256, seed=61)
+        pa = _agg_plan()
+        semantic.run_table_plan(pa, t)
+        fps = semantic.bundle_block(pa)["prefix_fingerprints"]
+        assert fps
+        semantic._on_confirmed([fps[-1]])
+        block = semantic.bundle_block(pa)
+        assert block["hot_prefix_recompute"] is True
+        payload = bundle_mod.build("failure", query_id=2,
+                                   fingerprint="fp", mode="run", plan=pa)
+        verdict = diagnose(payload, baseline=None)
+        assert any("subplan prefix" in f["title"]
+                   for f in verdict["findings"])
+
+    def test_views_payload_shape(self, views_on):
+        v = views.register("shape", _agg_plan())
+        v.fold(_mk(64, seed=62))
+        v.result()
+        payload = views.views_payload()
+        assert payload["schema_version"] == 1
+        assert payload["views_enabled"] is True
+        assert [x["name"] for x in payload["views"]] == ["shape"]
+        assert payload["semantic_cache"]["enabled"] is True
+        assert "events" in payload["outcomes"]
+
+    def test_cli_views_render_and_json(self, views_on, capsys):
+        from spark_rapids_tpu.obs.__main__ import main, render_views
+        v = views.register("cli", _agg_plan())
+        v.fold(_mk(64, seed=63))
+        v.result()
+        assert main(["views"]) == 0
+        out = capsys.readouterr().out
+        assert "cli" in out and "semantic cache" in out
+        assert main(["views", "--json"]) == 0
+        import json
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["views"][0]["name"] == "cli"
+        text = render_views(payload)
+        assert "fresh" in text or "STALE" in text
+
+    def test_prometheus_gauges_export(self, views_on):
+        t = _mk(256, seed=64)
+        pa = _agg_plan()
+        for _ in range(3):
+            semantic.run_table_plan(pa, t)
+        v = views.register("gauge", pa)
+        v.fold(t)
+        v.result()
+        from spark_rapids_tpu.obs import server
+        text = server.prometheus_text()
+        assert "srt_semantic_cache_hits" in text
+        assert "srt_views_registered 1" in text
+        assert 'srt_view_batches{view="gauge"} 1' in text
