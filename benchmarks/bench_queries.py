@@ -63,6 +63,15 @@ seconds, bound input columns, traced step counts, per-rule rewrite
 totals, bit-identity, and whether the history-warmed rerun closed the
 telemetry feedback loop.  Exits nonzero on any parity divergence.
 
+``--kernels`` replaces the default lanes with the Pallas-kernel lane:
+each registered kernel (join, groupby, decode, rows) runs its
+representative workload against the ``SRT_KERNELS``-off jnp oracle and
+ONE ``kernels`` JSON line records per-kernel oracle/kernel wall
+seconds, delta, measured speedup (fed to the kernel registry, hence
+the workload advisor), parity, and invocation counts — exits nonzero
+on any parity loss or a kernel that never fired.  Off-TPU the kernels
+run in Pallas interpret mode (path coverage, not a speedup claim).
+
 ``--serving`` replaces the default lanes with the concurrent-serving
 lane: a closed-loop mixed 40-query load (one-shot + streaming plans,
 repeated fingerprints) over TPC-DS data through ``serve.submit``, each
@@ -1358,6 +1367,183 @@ def bench_semantic(sf_rows=120_000, n_queries=40, n_clients=4,
             "diverged from the full streaming-combine recompute")
 
 
+def _pydict_eq(x, y):
+    """Structural equality over ``to_pydict`` payloads with NaN == NaN
+    (list equality treats two NaN floats as different)."""
+    if isinstance(x, float) and isinstance(y, float):
+        return x == y or (x != x and y != y)
+    if isinstance(x, list):
+        return (isinstance(y, list) and len(x) == len(y)
+                and all(_pydict_eq(a, b) for a, b in zip(x, y)))
+    if isinstance(x, dict):
+        return (isinstance(y, dict) and sorted(x) == sorted(y)
+                and all(_pydict_eq(x[k], y[k]) for k in x))
+    return x == y
+
+
+def bench_kernels(rows=60_000, reps=3):
+    """``--kernels``: per-kernel oracle-vs-kernel wall delta + parity.
+
+    For each registered Pallas kernel (join, groupby, decode, rows) a
+    representative workload runs twice — once with ``SRT_KERNELS``
+    empty (the jnp oracle) and once with only that kernel enabled —
+    and the two results must agree exactly (NaN-aware).  Wall deltas
+    feed the kernel registry via ``record_speedup`` so the measured
+    ratios are what the workload advisor would consume.  Emits ONE
+    ``kernels`` JSON line (per-kernel oracle/kernel wall seconds,
+    delta, speedup, parity, invocation count; decode additionally pins
+    ``scan.bytes_skipped`` identical across passes — the kernel must
+    not change what the page walk skips).  Exits nonzero on any parity
+    loss or any kernel that never fired (a lane that silently measures
+    the oracle twice is a lane failure).  Off-TPU the kernels run in
+    Pallas interpret mode, so deltas there are a path-coverage signal,
+    not a speedup claim.
+    """
+    import os
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu import dtypes as dt
+    from spark_rapids_tpu import kernels, ops
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import plan
+    from spark_rapids_tpu.io.parquet_native import read_parquet_native
+    from spark_rapids_tpu.obs import registry
+    from spark_rapids_tpu.rows.image import pack_image, unpack_image
+    from spark_rapids_tpu.rows.layout import compute_fixed_width_layout
+
+    os.environ["SRT_METRICS"] = "1"
+    rng = np.random.default_rng(11)
+
+    fact = srt.Table([
+        ("k", Column.from_numpy(rng.integers(0, 4000, rows)
+                                .astype(np.int64))),
+        ("rev", Column.from_numpy(rng.uniform(1, 100, rows))),
+    ])
+    dim = srt.Table([
+        ("k", Column.from_numpy(np.arange(4000, dtype=np.int64))),
+        ("cat", Column.from_numpy(rng.integers(0, 100, 4000)
+                                  .astype(np.int32))),
+    ])
+
+    gb_table = srt.Table([
+        ("k", Column.from_numpy(rng.integers(0, 64, rows)
+                                .astype(np.int32))),
+        ("v", Column.from_numpy(rng.uniform(-10, 10, rows))),
+    ])
+    gb_plan = plan().groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "n")],
+        domains={"k": (0, 63)})
+
+    tmpdir = tempfile.mkdtemp(prefix="srt-kernels-")
+    pq_path = os.path.join(tmpdir, "kernels.parquet")
+    pq.write_table(
+        pa.table({"g": rng.integers(0, 8, rows).astype(np.int32),
+                  "x": np.arange(rows, dtype=np.int64)}),
+        pq_path, use_dictionary=True, data_page_size=4096,
+        row_group_size=max(rows // 8, 1024))
+    pred = [("x", "<", rows // 4)]        # skips most row groups
+
+    row_schema = (dt.INT64, dt.FLOAT64, dt.INT32)
+    layout = compute_fixed_width_layout(row_schema)
+    row_datas = [np.arange(rows, dtype=np.int64),
+                 rng.normal(size=rows),
+                 rng.integers(-50, 50, rows).astype(np.int32)]
+    row_masks = [rng.random(rows) > 0.1 for _ in row_schema]
+
+    def run_join():
+        return ops.join(fact, dim, on=["k"], how="inner").to_pydict()
+
+    def run_groupby():
+        return gb_plan.run(gb_table).to_pydict()
+
+    def run_decode():
+        return read_parquet_native(pq_path, predicate=pred).to_pydict()
+
+    def run_rows():
+        image = pack_image(layout, row_datas, row_masks)
+        datas, valids = unpack_image(layout, image)
+        out = {}
+        for i, (d, v) in enumerate(zip(datas, valids)):
+            out[f"c{i}"] = np.where(np.asarray(v)[:rows],
+                                    np.asarray(d)[:rows], 0).tolist()
+        return out
+
+    lanes = {"join": run_join, "groupby": run_groupby,
+             "decode": run_decode, "rows": run_rows}
+
+    def timed(fn):
+        fn()                              # warm: compile off the clock
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        return (time.perf_counter() - t0) / reps, out
+
+    def skipped_bytes():
+        return float(registry().counter("scan.bytes_skipped").value)
+
+    had_kernels = os.environ.get("SRT_KERNELS")
+    had_rows = os.environ.pop("SRT_ROWS_IMPL", None)
+    per_kernel, failures = {}, []
+    try:
+        for name, fn in lanes.items():
+            kernels.reset()
+            os.environ["SRT_KERNELS"] = ""
+            sk0 = skipped_bytes()
+            oracle_s, oracle_out = timed(fn)
+            sk_oracle = skipped_bytes() - sk0
+
+            os.environ["SRT_KERNELS"] = name
+            sk1 = skipped_bytes()
+            kernel_s, kernel_out = timed(fn)
+            sk_kernel = skipped_bytes() - sk1
+
+            fired = kernels.stats()["per_kernel"][name]["invocations"]
+            parity = _pydict_eq(oracle_out, kernel_out)
+            if name == "decode":
+                parity = parity and sk_oracle == sk_kernel
+            if parity and fired:
+                kernels.record_speedup(name, oracle_s, kernel_s)
+            else:
+                failures.append(name)
+            entry = {
+                "oracle_s": round(oracle_s, 6),
+                "kernel_s": round(kernel_s, 6),
+                "delta_s": round(oracle_s - kernel_s, 6),
+                "speedup": round(oracle_s / kernel_s, 4)
+                if kernel_s > 0 else 0.0,
+                "parity": parity,
+                "invocations": int(fired),
+            }
+            if name == "decode":
+                entry["bytes_skipped_oracle"] = sk_oracle
+                entry["bytes_skipped_kernel"] = sk_kernel
+            per_kernel[name] = entry
+    finally:
+        if had_kernels is None:
+            os.environ.pop("SRT_KERNELS", None)
+        else:
+            os.environ["SRT_KERNELS"] = had_kernels
+        if had_rows is not None:
+            os.environ["SRT_ROWS_IMPL"] = had_rows
+
+    emit(json.dumps({
+        "metric": "kernels",
+        "rows": rows,
+        "interpret": kernels.interpret_mode(),
+        "per_kernel": per_kernel,
+        "parity": not failures,
+        "failed": sorted(failures),
+    }, sort_keys=True))
+    if failures:
+        raise SystemExit(
+            f"kernel lane failure: {sorted(failures)} — parity loss or "
+            f"kernel never fired (see the `kernels` line)")
+
+
 if __name__ == "__main__":
     import os
     if "--faults" in sys.argv:
@@ -1377,6 +1563,8 @@ if __name__ == "__main__":
             bench_serving()
         elif "--semantic" in sys.argv:
             bench_semantic()
+        elif "--kernels" in sys.argv:
+            bench_kernels()
         else:
             main()
         if "--regress" in sys.argv:

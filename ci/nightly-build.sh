@@ -20,6 +20,13 @@ if python -c 'import jax; assert jax.default_backend() != "cpu"' 2>/dev/null; th
     python benchmarks/bench_queries.py --capacity --workload | tee -a "$BENCH_OUT"
     # Standalone lane: exits nonzero on any CSE-splice or view parity loss.
     python benchmarks/bench_queries.py --semantic | tee -a "$BENCH_OUT"
+    # Pallas kernels vs jnp oracle: on-device this measures real compiled
+    # kernels (the speedups the workload advisor cites); exits nonzero on
+    # any parity loss or a kernel that never fired.
+    python benchmarks/bench_queries.py --kernels | tee -a "$BENCH_OUT"
 else
     echo "nightly: no accelerator on this runner; benchmarks skipped"
+    # The kernel parity lane is still meaningful without an accelerator:
+    # interpret mode runs the same kernel code on CPU.
+    python benchmarks/bench_queries.py --kernels | tee -a "$BENCH_OUT"
 fi

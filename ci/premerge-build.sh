@@ -954,6 +954,71 @@ assert line["view_batches"] >= 2, line
 print("bench semantic lane ok:", json.dumps(line, sort_keys=True))
 EOF
 
+# Pallas-kernel lane: the kernel suite runs with every kernel enabled
+# (interpret mode on CPU — the same kernel code that compiles on TPU),
+# then a probe bank must prove via the registry's kernel.* counters
+# that at least one kernel actually fired — a lane that silently
+# exercises the jnp oracle twice is a lane failure.
+JAX_PLATFORMS=cpu SRT_KERNELS=join,groupby,decode,rows SRT_METRICS=1 \
+python -m pytest tests/test_kernels.py -q -p no:cacheprovider
+
+JAX_PLATFORMS=cpu SRT_KERNELS=join,groupby,decode,rows SRT_METRICS=1 \
+python - <<'EOF'
+import numpy as np
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import ops
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.exec import plan
+from spark_rapids_tpu.obs import registry
+
+rng = np.random.default_rng(3)
+fact = srt.Table([
+    ("k", Column.from_numpy(rng.integers(0, 50, 4000).astype(np.int64))),
+    ("v", Column.from_numpy(rng.uniform(0, 1, 4000))),
+])
+dim = srt.Table([
+    ("k", Column.from_numpy(np.arange(50, dtype=np.int64))),
+    ("w", Column.from_numpy(np.arange(50, dtype=np.float64))),
+])
+ops.join(fact, dim, on=["k"], how="inner").to_pydict()
+plan().groupby_agg(["k"], [("v", "sum", "s")],
+                   domains={"k": (0, 49)}).run(fact).to_pydict()
+snap = registry().counters_snapshot()
+fired = sorted(k for k, v in snap.items()
+               if k.startswith("kernel.") and k.endswith(".invocations")
+               and v > 0)
+assert fired, snap              # >=1 Pallas kernel actually ran
+print("kernels lane ok: fired =", fired)
+EOF
+
+# Bench kernels gate on a premerge-sized table (the full-size --kernels
+# lane is nightly-only): the one `kernels` JSON line must report parity
+# for every kernel, every kernel firing, and an unchanged
+# scan.bytes_skipped across the decode passes.
+JAX_PLATFORMS=cpu SRT_METRICS=1 python - <<'EOF'
+import io
+import json
+import sys
+sys.path.insert(0, "benchmarks")
+import bench_queries
+
+buf = io.StringIO()
+stdout, sys.stdout = sys.stdout, buf
+try:
+    bench_queries.bench_kernels(rows=40_000, reps=2)
+finally:
+    sys.stdout = stdout
+lines = [json.loads(l) for l in buf.getvalue().splitlines() if l.strip()]
+kl = [l for l in lines if l.get("metric") == "kernels"]
+assert len(kl) == 1, lines
+line = kl[0]
+assert line["parity"] and not line["failed"], line
+assert all(k["invocations"] >= 1 for k in line["per_kernel"].values()), line
+dec = line["per_kernel"]["decode"]
+assert dec["bytes_skipped_oracle"] == dec["bytes_skipped_kernel"], line
+print("bench kernels lane ok:", json.dumps(line, sort_keys=True))
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

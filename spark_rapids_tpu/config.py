@@ -9,8 +9,14 @@ knob table lives in CONTRIBUTING.md ("Configuration knobs") the same way.
 
 Knobs (all optional):
 
+  ``SRT_KERNELS``              comma list ⊆ ``join,groupby,decode,rows``
+                               — enables individual Pallas TPU kernels
+                               (kernels/ registry); unset = every op
+                               runs its jnp oracle path.
   ``SRT_ROWS_IMPL``            ``xla`` (default) | ``pallas`` — row-image
                                kernel implementation (rows/image.py).
+                               ``pallas`` is a deprecated alias for
+                               ``SRT_KERNELS=rows``.
   ``SPARK_RAPIDS_TPU_NATIVE_LIB``  absolute path override for the native host
                                library (ffi loader), like ``-Dcudf.path``.
   ``SRT_TEST_PLATFORM``        jax platform for the test suite (conftest).
@@ -236,6 +242,7 @@ from __future__ import annotations
 
 import logging
 import os
+import warnings
 
 _TRUTHY = frozenset({"1", "true", "yes", "on"})
 
@@ -676,6 +683,41 @@ def plan_opt_rules() -> tuple[str, ...]:
     return tuple(seen)
 
 
+KERNEL_NAMES = ("join", "groupby", "decode", "rows")
+
+
+def kernels() -> tuple[str, ...]:
+    """Enabled Pallas kernel names (``SRT_KERNELS``).
+
+    Unset/empty = no kernels; every op runs its jnp oracle path.  A
+    comma list from :data:`KERNEL_NAMES` enables individual kernels
+    (``kernels/`` package); unknown names raise ``ValueError`` (no jax
+    import needed — usable from plain config validation).
+
+    ``SRT_ROWS_IMPL=pallas`` is honored as a deprecated alias for
+    enabling the ``rows`` kernel (one warning per process)."""
+    seen: list[str] = []
+    raw = os.environ.get("SRT_KERNELS")
+    if raw is not None and raw.strip():
+        for part in raw.split(","):
+            name = part.strip().lower()
+            if not name:
+                continue
+            if name not in KERNEL_NAMES:
+                raise ValueError(
+                    f"SRT_KERNELS: unknown kernel {name!r} "
+                    f"(choose from {', '.join(KERNEL_NAMES)})")
+            if name not in seen:
+                seen.append(name)
+    if rows_impl() == "pallas" and "rows" not in seen:
+        warnings.warn(
+            "SRT_ROWS_IMPL=pallas is deprecated; use SRT_KERNELS=rows "
+            "(the unified Pallas kernel registry knob)",
+            DeprecationWarning, stacklevel=2)
+        seen.append("rows")
+    return tuple(seen)
+
+
 def serve_max_concurrent() -> int:
     """Max queries the serving scheduler (serve/scheduler.py) admits to
     run concurrently; further submissions wait in the run queue.  Each
@@ -1092,7 +1134,7 @@ def knob_table() -> dict[str, str]:
              "SRT_DIST_FALLBACK", "SRT_DIST_TIMEOUT",
              "SRT_LIVE_SERVER", "SRT_LIVE_PORT",
              "SRT_ENCODED_EXEC", "SRT_SCAN_PRUNE",
-             "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES",
+             "SRT_PLAN_OPT", "SRT_PLAN_OPT_RULES", "SRT_KERNELS",
              "SRT_SERVE_MAX_CONCURRENT", "SRT_SERVE_HBM_BUDGET",
              "SRT_SERVE_POLICY", "SRT_RESULT_CACHE",
              "SRT_FLIGHT_EVENTS", "SRT_BUNDLE_DIR", "SRT_SLO_MS",

@@ -1103,6 +1103,18 @@ def _dense_accumulate(cols, sel, step: GroupAggStep, meta: _GroupMeta):
                     acc["lastpos:" + vn], pos.max(axis=1))
         return out, None
 
+    from ..kernels import registry as _kernels
+    if _kernels.enabled("groupby"):
+        from ..kernels.groupby import dense_accumulate as _pallas_accumulate
+        # Trace-time dispatch: the Pallas fold is staged into the jitted
+        # whole-plan program (the program cache keys on SRT_KERNELS, so
+        # flipping the knob never serves a stale program).  A kernel
+        # trace failure falls back to tracing the oracle scan.
+        return _kernels.dispatch(
+            "groupby",
+            lambda: _pallas_accumulate(
+                xs, init, body, interpret=_kernels.interpret_mode()),
+            lambda: jax.lax.scan(body, init, xs)[0])
     acc, _ = jax.lax.scan(body, init, xs)
     return acc
 
@@ -1590,11 +1602,21 @@ def _lru_lookup(cache, key, build, prefix, instant_name=None, **instant_kw):
     return fn, hit
 
 
+def _cache_key(key):
+    """The enabled Pallas kernel set joins every program-cache key:
+    traced programs bake the kernel-vs-oracle choice in, so an
+    ``SRT_KERNELS`` flip must never serve a program traced under the
+    other setting."""
+    from .. import config
+    return (key, config.kernels())
+
+
 def _cache_lookup(key, build):
     """LRU lookup in the whole-plan program table; ``build()`` runs on a
     miss.  Returns ``(program, was_hit)`` — the streaming executor
     reports the hit flag as its donation-reuse counter."""
-    return _lru_lookup(_COMPILED, key, build, "plan.compile_cache",
+    return _lru_lookup(_COMPILED, _cache_key(key), build,
+                       "plan.compile_cache",
                        instant_name="compile_cache")
 
 
@@ -2034,8 +2056,9 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
     if qm is not None:
         qm.bind_seconds += _time.perf_counter() - t0
         with _CACHE_LOCK:
-            qm.compile_cache = ("hit" if bound.signature() in _COMPILED
-                                else "miss")
+            qm.compile_cache = ("hit"
+                                if _cache_key(bound.signature())
+                                in _COMPILED else "miss")
         qm.steps = _static_step_metrics(bound)
 
     def do_dispatch():
@@ -2067,7 +2090,7 @@ def _execute_resilient(plan: Plan, table: Table, qm=None,
 
             def _cached_program():
                 with _CACHE_LOCK:
-                    return _COMPILED.get(sig)
+                    return _COMPILED.get(_cache_key(sig))
             _prof.cached_analysis(
                 ("plan", sig),
                 lambda: _program_cost_info(
@@ -2441,8 +2464,8 @@ def _analyze_measured(plan: Plan, table: Table, qm, lq) -> Table:
     lq.set_phase("bind")
     bound = _bind(plan, table)
     qm.bind_seconds = _time.perf_counter() - t_all
-    qm.compile_cache = ("hit" if bound.signature() in _COMPILED
-                        else "miss")
+    qm.compile_cache = ("hit" if _cache_key(bound.signature())
+                        in _COMPILED else "miss")
     fn = _compiled_for(bound)
     t0 = _time.perf_counter()
     # The whole-plan dispatch and the final materialize run under the

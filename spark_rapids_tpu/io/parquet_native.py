@@ -628,9 +628,21 @@ class RunMerger:
             is_rle = np.concatenate([is_rle, np.ones(pad, np.bool_)])
             width = np.concatenate([width, np.ones(pad, np.int32)])
         words = _bytes_to_words(b"".join(self._bufs), bucket=True)
-        out = _expand_runs(words, jnp.asarray(out_start),
-                           jnp.asarray(rle_value), jnp.asarray(bp_bit_base),
-                           jnp.asarray(is_rle), jnp.asarray(width), n=n_pad)
+        args = (words, jnp.asarray(out_start), jnp.asarray(rle_value),
+                jnp.asarray(bp_bit_base), jnp.asarray(is_rle),
+                jnp.asarray(width))
+        from ..kernels import registry as _kernels
+        if _kernels.enabled("decode"):
+            # Same run table, same page-walk accounting (scan.bytes_skipped
+            # is host-side and untouched) — only the expansion is Pallas.
+            from ..kernels.decode import expand_runs as _pallas_expand
+            out = _kernels.dispatch(
+                "decode",
+                lambda: _pallas_expand(*args, n=n_pad,
+                                       interpret=_kernels.interpret_mode()),
+                lambda: _expand_runs(*args, n=n_pad))
+        else:
+            out = _expand_runs(*args, n=n_pad)
         return out[:num_values]
 
 

@@ -1,0 +1,29 @@
+"""Pallas TPU kernel layer for the hot paths (the reference repo's
+hand-tuned-CUDA analog).
+
+One registry (`registry`) gates every kernel behind ``SRT_KERNELS``
+with the existing jnp compositions as bit-identity oracles and
+automatic compile-failure fallback:
+
+* ``join``    — hash-table build/probe (`join`) behind
+  ``ops.join._factorize_union``.
+* ``groupby`` — fused dense accumulate (`groupby`) behind
+  ``exec.compile._dense_accumulate``.
+* ``decode``  — on-device RLE/bit-packed run expansion (`decode`)
+  behind ``io.parquet_native.RunMerger.expand``.
+* ``rows``    — the row-image pack/unpack kernels of `rows.image`
+  (``SRT_ROWS_IMPL=pallas`` is the deprecated alias).
+
+This package import is jax-free (only the registry loads); the kernel
+modules import jax lazily at their call sites.
+"""
+
+from .registry import (KERNEL_NAMES, clear_quarantine, dispatch, enabled,
+                       interpret_mode, measured_speedups, quarantine,
+                       record_speedup, reset, stats)
+
+__all__ = [
+    "KERNEL_NAMES", "clear_quarantine", "dispatch", "enabled",
+    "interpret_mode", "measured_speedups", "quarantine", "record_speedup",
+    "reset", "stats",
+]

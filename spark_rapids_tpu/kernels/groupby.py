@@ -1,0 +1,106 @@
+"""Pallas dense group-by accumulate.
+
+``exec.compile._dense_accumulate`` folds row chunks into per-cell
+accumulators with ``jax.lax.scan(body, init, xs)``; XLA materializes the
+one-hot / masked intermediates of every chunk step in HBM.  This kernel
+runs the SAME ``body`` inside one Pallas program: the accumulator dict
+lives in a VMEM output block revisited across a sequential grid over
+chunks, so each (cells × chunk) intermediate exists only inside one grid
+step.
+
+Bit-identity is by construction — the caller's own ``body`` closure runs
+on each chunk in the same order with the same float op order, so the
+fold is the oracle fold, just staged through Pallas.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_accumulate(xs: dict, init: dict, body, *,
+                     interpret: bool = False) -> dict:
+    """Drop-in for ``jax.lax.scan(body, init, xs)[0]`` over chunked
+    column dicts: ``xs`` leaves are ``(nchunks, B)``, ``init`` leaves
+    ``(cells,)``, ``body(acc, chunk) -> (acc, None)``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    xs_keys = sorted(xs)
+    acc_keys = sorted(init)
+    nchunks, B = xs[xs_keys[0]].shape
+    if nchunks == 0:
+        return dict(init)
+    G = init[acc_keys[0]].shape[0]
+
+    # Pallas kernels cannot capture array constants from the caller's
+    # closure (the cell-id iota, agg identities, ...) — trace the body
+    # to a jaxpr once and feed its constants in as ride-along inputs.
+    chunk0 = {k: jax.ShapeDtypeStruct((B,), xs[k].dtype) for k in xs_keys}
+    acc0 = {k: jax.ShapeDtypeStruct((G,), init[k].dtype) for k in acc_keys}
+    fold = lambda a, c: body(a, c)[0]
+    closed = jax.make_jaxpr(fold)(acc0, chunk0)
+    out_tree = jax.tree_util.tree_structure(jax.eval_shape(fold, acc0,
+                                                           chunk0))
+    consts = [jnp.asarray(c) for c in closed.consts]
+    const_shapes = [tuple(c.shape) for c in consts]
+
+    def pure_body(acc, chunk, *cvals):
+        flat_in, _ = jax.tree_util.tree_flatten((acc, chunk))
+        out_flat = jax.core.eval_jaxpr(closed.jaxpr, list(cvals), *flat_in)
+        return jax.tree_util.tree_unflatten(out_tree, out_flat)
+
+    def kernel(*refs):
+        nacc, nxs, nc = len(acc_keys), len(xs_keys), len(consts)
+        init_refs = refs[:nacc]
+        xs_refs = refs[nacc:nacc + nxs]
+        const_refs = refs[nacc + nxs:nacc + nxs + nc]
+        out_refs = refs[nacc + nxs + nc:]
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _seed():
+            for oref, iref in zip(out_refs, init_refs):
+                oref[...] = iref[...]
+
+        acc = {k: oref[0, :] for k, oref in zip(acc_keys, out_refs)}
+        chunk = {k: xref[0, :] for k, xref in zip(xs_keys, xs_refs)}
+        cvals = [ref[...].reshape(s)
+                 for ref, s in zip(const_refs, const_shapes)]
+        out = pure_body(acc, chunk, *cvals)
+        for k, oref in zip(acc_keys, out_refs):
+            oref[0, :] = out[k]
+
+    # Singleton-first-dim grid; accumulator blocks revisit (index maps
+    # built from program ids only — the Mosaic x64 idiom of rows/image).
+    grid = (1, nchunks)
+    acc_spec = pl.BlockSpec((1, G), lambda i, j: (i, i),
+                            memory_space=pltpu.VMEM)
+    ride = lambda m: pl.BlockSpec((1, m), lambda i, j: (i, i),
+                                  memory_space=pltpu.VMEM)
+    in_specs = ([acc_spec for _ in acc_keys] +
+                # xs leaves are (nchunks, B): the CHUNK axis is axis 0,
+                # so the advancing grid coordinate lands first.
+                [pl.BlockSpec((1, B), lambda i, j: (j, i),
+                              memory_space=pltpu.VMEM) for _ in xs_keys] +
+                [ride(max(1, int(np_prod(s)))) for s in const_shapes])
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(jax.ShapeDtypeStruct((1, G), init[k].dtype)
+                        for k in acc_keys),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=tuple(acc_spec for _ in acc_keys),
+        interpret=interpret,
+    )(*[init[k][None, :] for k in acc_keys],
+      *[xs[k] for k in xs_keys],
+      *[c.reshape(1, -1) if c.ndim else c.reshape(1, 1) for c in consts])
+    return {k: o[0] for k, o in zip(acc_keys, outs)}
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

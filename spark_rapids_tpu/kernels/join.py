@@ -1,0 +1,271 @@
+"""Pallas hash-table build/probe for equi-joins.
+
+The oracle (`ops/join._factorize_probe_kernel`) factorizes the union of
+both sides with one multi-key sort, then probes with two searchsorteds —
+O((nl+nr)·log) comparisons dominated by the big probe side.  This kernel
+keeps the probe side out of any sort: an open-addressing table is built
+over the (small/broadcast) right side inside a Pallas kernel and every
+left row probes it in a handful of vectorized rounds.
+
+Bit-identity contract: the returned ``(rorder, lo, counts, rmatched)``
+produce a final join table identical to the oracle's at every valid
+lane.  The oracle orders matches per left row by ascending right row id
+— a stable argsort by table slot reproduces exactly that within-group
+order; cross-group placement inside ``rorder`` differs but is never
+observable (``lo``/``counts`` always index one group).
+
+Key equality is grouping equality (NaN == NaN, -0.0 == +0.0, null keys
+never match): keys normalize to u32 word streams whose **bitwise**
+equality is grouping equality — the same ``grouping_sort_operands``
+the oracle sorts, with floats canonicalized so equal values are
+bit-equal.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: VMEM working-set guard for the (whole-array) build+probe blocks.
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+_FNV_OFFSET = jnp.uint32(2166136261)
+_FNV_PRIME = jnp.uint32(16777619)
+_I32_MAX = 2**31 - 1
+
+
+def _to_u32_words(op: jax.Array) -> list[jax.Array]:
+    """One grouping-sort operand -> u32 word stream(s); bitwise equality
+    of the words == operand equality under ``adjacent_differs``."""
+    d = op
+    if d.dtype == jnp.bool_:
+        return [d.astype(jnp.uint32)]
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        # adjacent_differs compares with IEEE `!=`: -0.0 == +0.0.  NaNs
+        # arrive canonicalized (one bit pattern) from the operand prep.
+        d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+        u = lax.bitcast_convert_type(
+            d, {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[d.dtype.itemsize])
+    elif d.dtype.itemsize == 8:
+        u = lax.bitcast_convert_type(d, jnp.uint64)
+    elif d.dtype.itemsize == 4:
+        u = lax.bitcast_convert_type(d, jnp.uint32)
+    else:
+        u = lax.bitcast_convert_type(
+            d, {1: jnp.uint8, 2: jnp.uint16}[d.dtype.itemsize])
+    if u.dtype == jnp.uint64:
+        return [(u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                (u >> jnp.uint64(32)).astype(jnp.uint32)]
+    return [u.astype(jnp.uint32)]
+
+
+def _word_count(key_datas) -> int:
+    """Static u32-word count per row for the given key dtypes (the rank
+    operand contributes one word, 64-bit values two)."""
+    w = 0
+    for d in key_datas:
+        w += 1                                    # null-rank operand
+        w += 2 if jnp.dtype(d.dtype).itemsize == 8 else 1
+    return w
+
+
+def supported(key_datas, *, n_left: int) -> bool:
+    """Shape guard: does the whole build+probe working set fit the VMEM
+    budget?  False routes to the oracle without quarantining."""
+    from ..ops.common import pow2_bucket
+
+    n = key_datas[0].shape[0]
+    nr = n - n_left
+    nlp = pow2_bucket(max(n_left, 1))
+    nrp = pow2_bucket(max(nr, 1))
+    cap = pow2_bucket(2 * max(nr, 1))
+    w = _word_count(key_datas)
+    working = 4 * (w * (nlp + nrp) + 4 * (nlp + nrp) + 3 * cap)
+    return working <= _VMEM_BUDGET
+
+
+def _pad1(a: jax.Array, target: int, fill=0) -> jax.Array:
+    if a.shape[0] == target:
+        return a
+    return jnp.concatenate(
+        [a, jnp.full(target - a.shape[0], fill, a.dtype)])
+
+
+def _build_kernel_body(nrp: int, cap: int):
+    """Open-addressing build: claim rounds instead of a per-row loop.
+
+    Each round, every unresolved row proposes its current linear-probe
+    slot; empty contested slots are claimed by the minimum row id (a
+    deterministic vectorized scatter-min), rows whose slot owner shares
+    their key resolve to that slot, and the rest advance their probe.
+    Equal keys share a probe sequence, so they converge on one slot —
+    the table maps distinct keys to distinct slots.
+    """
+
+    def kernel(words_ref, hash_ref, valid_ref, slot_ref, owner_ref):
+        words = words_ref[...]                       # (W, nrp) u32
+        h = hash_ref[...][0]                         # (nrp,) u32
+        valid = valid_ref[...][0] != 0
+        mask = jnp.uint32(cap - 1)
+        rid = jnp.arange(nrp, dtype=jnp.int32)
+        big = jnp.int32(_I32_MAX)
+
+        owner0 = jnp.full(cap, -1, jnp.int32)
+        off0 = jnp.zeros(nrp, jnp.uint32)
+        slot0 = jnp.full(nrp, cap, jnp.int32)        # sentinel: no slot
+        resolved0 = ~valid                           # null/pad rows sit out
+
+        def cond(carry):
+            return jnp.any(~carry[3])
+
+        def step(carry):
+            owner, off, slot, resolved = carry
+            cur = ((h + off) & mask).astype(jnp.int32)
+            o = owner[cur]
+            contested = (~resolved) & (o < 0)
+            claim = jnp.full(cap + 1, big, jnp.int32).at[
+                jnp.where(contested, cur, cap)].min(
+                    jnp.where(contested, rid, big))[:cap]
+            owner = jnp.where((owner < 0) & (claim < big), claim, owner)
+            o = owner[cur]
+            ow = words[:, jnp.clip(o, 0, nrp - 1)]
+            same_key = (o >= 0) & jnp.all(words == ow, axis=0)
+            newly = (~resolved) & same_key
+            slot = jnp.where(newly, cur, slot)
+            resolved = resolved | newly
+            off = jnp.where(resolved, off, off + jnp.uint32(1))
+            return owner, off, slot, resolved
+
+        owner, _, slot, _ = lax.while_loop(
+            cond, step, (owner0, off0, slot0, resolved0))
+        slot_ref[0, :] = slot
+        owner_ref[0, :] = owner
+
+    return kernel
+
+
+def _probe_kernel_body(nrp: int, cap: int):
+    """Vectorized left-side probe: linear rounds until every row either
+    finds its key's slot or hits an empty slot (no match)."""
+
+    def kernel(rwords_ref, lwords_ref, hash_ref, valid_ref, owner_ref,
+               slot_ref):
+        rwords = rwords_ref[...]                     # (W, nrp)
+        lwords = lwords_ref[...]                     # (W, nlp)
+        h = hash_ref[...][0]
+        valid = valid_ref[...][0] != 0
+        owner = owner_ref[...][0]                    # (cap,)
+        mask = jnp.uint32(cap - 1)
+        nlp = lwords.shape[1]
+
+        off0 = jnp.zeros(nlp, jnp.uint32)
+        slot0 = jnp.full(nlp, -1, jnp.int32)         # sentinel: no match
+        resolved0 = ~valid
+
+        def cond(carry):
+            return jnp.any(~carry[2])
+
+        def step(carry):
+            off, slot, resolved = carry
+            cur = ((h + off) & mask).astype(jnp.int32)
+            o = owner[cur]
+            ow = rwords[:, jnp.clip(o, 0, nrp - 1)]
+            found = (~resolved) & (o >= 0) & jnp.all(lwords == ow, axis=0)
+            miss = (~resolved) & (o < 0)             # empty slot: no match
+            slot = jnp.where(found, cur, slot)
+            resolved = resolved | found | miss
+            off = jnp.where(resolved, off, off + jnp.uint32(1))
+            return off, slot, resolved
+
+        _, slot, _ = lax.while_loop(cond, step, (off0, slot0, resolved0))
+        slot_ref[0, :] = slot
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n_left", "interpret"))
+def hash_factorize_probe(key_datas, key_valids, *, n_left: int,
+                         interpret: bool = False):
+    """Drop-in for ``ops.join._factorize_probe_kernel``: same
+    ``(rorder, lo, counts, rmatched)`` contract, hash build/probe
+    instead of the union sort."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ..ops.common import grouping_sort_operands, pow2_bucket
+
+    n = key_datas[0].shape[0]
+    nl, nr = n_left, n - n_left
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    any_null = jnp.zeros(n, jnp.bool_)
+    for v in key_valids:
+        if v is not None:
+            any_null = any_null | ~v
+
+    if nr == 0 or nl == 0:
+        # Degenerate sides never touch the table; match the oracle's
+        # output contract directly.
+        return (jnp.arange(nr, dtype=jnp.int32), jnp.zeros(nl, jnp.int32),
+                jnp.zeros(nl, jnp.int64), jnp.zeros(nr, jnp.bool_))
+
+    words = []
+    for op in grouping_sort_operands(key_datas, key_valids):
+        words.extend(_to_u32_words(op))
+    h = jnp.full(n, _FNV_OFFSET, jnp.uint32)
+    for w in words:
+        h = (h ^ w) * _FNV_PRIME
+
+    nlp = pow2_bucket(nl)
+    nrp = pow2_bucket(nr)
+    cap = pow2_bucket(2 * nr)
+    W = len(words)
+    lwords = jnp.stack([_pad1(w[:nl], nlp) for w in words])
+    rwords = jnp.stack([_pad1(w[nl:], nrp) for w in words])
+    lvalid = _pad1((~any_null[:nl]).astype(jnp.int32), nlp)[None, :]
+    rvalid = _pad1((~any_null[nl:]).astype(jnp.int32), nrp)[None, :]
+    lhash = _pad1(h[:nl], nlp)[None, :]
+    rhash = _pad1(h[nl:], nrp)[None, :]
+
+    # Singleton-first-dim grids so every block-index component is a
+    # program id (same Mosaic x64 idiom as rows/image.py).
+    full = lambda shape: pl.BlockSpec(shape, lambda i, j: (i, j),
+                                      memory_space=pltpu.VMEM)
+    slot_r2, owner = pl.pallas_call(
+        _build_kernel_body(nrp, cap),
+        out_shape=(jax.ShapeDtypeStruct((1, nrp), jnp.int32),
+                   jax.ShapeDtypeStruct((1, cap), jnp.int32)),
+        grid=(1, 1),
+        in_specs=[full((W, nrp)), full((1, nrp)), full((1, nrp))],
+        out_specs=(full((1, nrp)), full((1, cap))),
+        interpret=interpret,
+    )(rwords, rhash, rvalid)
+    slot_l2 = pl.pallas_call(
+        _probe_kernel_body(nrp, cap),
+        out_shape=jax.ShapeDtypeStruct((1, nlp), jnp.int32),
+        grid=(1, 1),
+        in_specs=[full((W, nrp)), full((W, nlp)), full((1, nlp)),
+                  full((1, nlp)), full((1, cap))],
+        out_specs=full((1, nlp)),
+        interpret=interpret,
+    )(rwords, lwords, lhash, lvalid, owner)
+
+    slot_r = slot_r2[0, :nr]                         # cap sentinel on nulls
+    slot_l = slot_l2[0, :nl]                         # -1 sentinel on miss
+    counts_slot = jnp.zeros(cap + 1, jnp.int32).at[slot_r].add(1)[:cap]
+    offsets = jnp.cumsum(counts_slot) - counts_slot
+    # Stable argsort by slot: within a slot group right rows stay in
+    # ascending row-id order — the oracle's within-group match order.
+    rorder = jnp.argsort(slot_r, stable=True).astype(jnp.int32)
+
+    found = slot_l >= 0
+    sl = jnp.clip(slot_l, 0, cap - 1)
+    lo = jnp.where(found, offsets[sl], 0).astype(jnp.int32)
+    counts = jnp.where(found, counts_slot[sl], 0).astype(jnp.int64)
+    touched = jnp.zeros(cap + 2, jnp.bool_).at[
+        jnp.where(found, slot_l, cap + 1)].set(True)
+    rmatched = touched[jnp.minimum(slot_r, cap)]     # touched[cap] is False
+    return rorder, lo, counts, rmatched

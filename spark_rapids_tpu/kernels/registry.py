@@ -1,0 +1,181 @@
+"""Pallas kernel registry: gating, dispatch, fallback, and measurement.
+
+The registry is the one mechanism between ``SRT_KERNELS`` and the op
+layer.  Each hot path keeps its jnp composition as the bit-identity
+oracle; when a kernel is enabled, :func:`dispatch` runs the Pallas
+implementation instead and guarantees three things:
+
+* **Fallback** — a kernel failure that classifies as ``compile``
+  (Mosaic/XLA lowering errors, ``NotImplementedError`` for unsupported
+  shapes) quarantines the kernel process-wide, records a named
+  ``kernel-fallback`` recovery rung, and re-runs the oracle.  Any other
+  error propagates exactly as the oracle path would raise it, so fault
+  injection (``SRT_FAULT``) sees identical recovery behavior kernel
+  on or off.
+* **Accounting** — successes land on ``kernel.<name>.invocations`` and
+  the cumulative ``cost.kernel.<name>_seconds`` ledger gauge; fallbacks
+  on ``kernel.<name>.fallbacks``.
+* **Measurement** — :func:`record_speedup` stores oracle-vs-kernel wall
+  deltas (from the ``--kernels`` bench lane or tests); the workload
+  profiler reads :func:`measured_speedups` to replace its static 2.0×
+  projected-win prior with observed numbers.
+
+Import stays jax-free: the module is usable from config validation and
+``obs/`` (which must not pull jax in).  jax is imported lazily inside
+:func:`interpret_mode` only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from .. import config
+from ..obs.metrics import counter, gauge
+
+KERNEL_NAMES = config.KERNEL_NAMES
+
+_LOCK = threading.Lock()
+# Kernels disabled for the rest of the process after a compile-classified
+# failure — the "fall back to oracle" recovery rung is sticky so a broken
+# lowering doesn't re-fail (and re-log) on every batch.
+_QUARANTINED: set[str] = set()
+# name -> [invocations, fallbacks, cumulative_kernel_seconds]
+_STATS: dict[str, list[float]] = {}
+# name -> (oracle_seconds, kernel_seconds) from the latest measurement.
+_SPEEDUPS: dict[str, tuple[float, float]] = {}
+
+
+def _stat(name: str) -> list[float]:
+    return _STATS.setdefault(name, [0, 0, 0.0])
+
+
+def enabled(name: str) -> bool:
+    """Is kernel ``name`` gated on by ``SRT_KERNELS`` and not quarantined?"""
+    if name not in KERNEL_NAMES:
+        raise ValueError(
+            f"unknown kernel {name!r} (choose from {', '.join(KERNEL_NAMES)})")
+    with _LOCK:
+        if name in _QUARANTINED:
+            return False
+    return name in config.kernels()
+
+
+def interpret_mode() -> bool:
+    """Run Pallas kernels in interpret mode?  True off-TPU, so the tier-1
+    CPU suite executes real kernel bodies for parity."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _is_compile_failure(exc: BaseException) -> bool:
+    from ..resilience.classify import CATEGORY_COMPILE, classify
+
+    if isinstance(exc, NotImplementedError):
+        return True
+    return classify(exc) == CATEGORY_COMPILE
+
+
+def dispatch(name: str, kernel_fn: Callable[[], Any],
+             oracle_fn: Callable[[], Any]) -> Any:
+    """Run ``kernel_fn`` if kernel ``name`` is enabled, else ``oracle_fn``.
+
+    Compile-classified kernel failures quarantine the kernel and fall
+    back to the oracle (a counted, named recovery rung); every other
+    exception propagates unchanged so recovery behavior matches the
+    oracle path bit for bit.
+    """
+    if not enabled(name):
+        return oracle_fn()
+    t0 = time.perf_counter()
+    try:
+        out = kernel_fn()
+    except BaseException as exc:  # noqa: BLE001 — classified below
+        if not _is_compile_failure(exc):
+            raise
+        quarantine(name, reason=repr(exc))
+        return oracle_fn()
+    dt = time.perf_counter() - t0
+    counter(f"kernel.{name}.invocations").inc()
+    with _LOCK:
+        st = _stat(name)
+        st[0] += 1
+        st[2] += dt
+        total = st[2]
+    gauge(f"cost.kernel.{name}_seconds").set(total)
+    return out
+
+
+def quarantine(name: str, reason: str = "") -> None:
+    """Disable kernel ``name`` for the rest of the process and record the
+    oracle fallback as a named recovery rung."""
+    counter(f"kernel.{name}.fallbacks").inc()
+    with _LOCK:
+        _QUARANTINED.add(name)
+        _stat(name)[1] += 1
+    from ..obs import live as _live
+
+    _live.rung("kernel-fallback", site=f"kernel:{name}")
+    config.get_logger(__name__).warning(
+        "kernel %s failed to compile, falling back to oracle%s",
+        name, f": {reason}" if reason else "")
+
+
+def clear_quarantine() -> None:
+    """Re-arm quarantined kernels (tests)."""
+    with _LOCK:
+        _QUARANTINED.clear()
+
+
+def record_speedup(name: str, oracle_seconds: float,
+                   kernel_seconds: float) -> None:
+    """Record a measured oracle-vs-kernel wall pair for ``name`` (bench
+    lane / tests).  Non-positive times are ignored."""
+    if name not in KERNEL_NAMES:
+        raise ValueError(
+            f"unknown kernel {name!r} (choose from {', '.join(KERNEL_NAMES)})")
+    if oracle_seconds <= 0 or kernel_seconds <= 0:
+        return
+    with _LOCK:
+        _SPEEDUPS[name] = (float(oracle_seconds), float(kernel_seconds))
+
+
+def measured_speedups() -> dict[str, float]:
+    """Latest measured speedup (oracle wall / kernel wall) per kernel."""
+    with _LOCK:
+        return {n: o / k for n, (o, k) in _SPEEDUPS.items()}
+
+
+def stats() -> dict[str, Any]:
+    """Registry state for observability surfaces (jax-free)."""
+    speedups = measured_speedups()
+    with _LOCK:
+        # A kernel appears once it was dispatched OR measured — a bench
+        # run's record_speedup alone must surface in the block.
+        names = sorted(set(_STATS) | set(speedups))
+        per = {
+            n: {
+                "invocations": int(_stat(n)[0]),
+                "fallbacks": int(_stat(n)[1]),
+                "seconds": round(_stat(n)[2], 6),
+                "measured_speedup": (round(speedups[n], 4)
+                                     if n in speedups else None),
+            }
+            for n in names
+        }
+        quarantined = sorted(_QUARANTINED)
+    return {
+        "enabled": list(config.kernels()),
+        "quarantined": quarantined,
+        "per_kernel": per,
+    }
+
+
+def reset() -> None:
+    """Clear all registry state (tests)."""
+    with _LOCK:
+        _QUARANTINED.clear()
+        _STATS.clear()
+        _SPEEDUPS.clear()

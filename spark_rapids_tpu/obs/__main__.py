@@ -281,6 +281,25 @@ def render_workload(payload: dict, source: str = "local") -> str:
                     s=_human(o["benefit_score"])))
     else:
         lines.append("overlap candidates: (none recurring)")
+    kern = payload.get("kernels") or {}
+    enabled = kern.get("enabled") or []
+    if enabled:
+        lines.append("pallas kernels (SRT_KERNELS="
+                     + ",".join(enabled) + "):")
+        for name, st in sorted((kern.get("per_kernel") or {}).items()):
+            sp = st.get("measured_speedup")
+            lines.append(
+                "  {name:<8} invocations={inv:<5} fallbacks={fb:<3} "
+                "kernel_s={sec:.4f}  measured_speedup={sp}".format(
+                    name=name, inv=st.get("invocations", 0),
+                    fb=st.get("fallbacks", 0),
+                    sec=st.get("seconds", 0.0),
+                    sp=f"{sp:.2f}x" if sp else "n/a"))
+        if kern.get("quarantined"):
+            lines.append("  quarantined: "
+                         + ", ".join(kern["quarantined"]))
+    else:
+        lines.append("pallas kernels: (none enabled — jnp oracle paths)")
     recs = payload.get("recommendations") or []
     cands = payload.get("candidates") or []
     shown = recs if recs else cands
@@ -344,6 +363,7 @@ def _workload_history(path: str, last: int) -> dict:
     recs = workload.Advisor(confirm=1, clear=1).observe(candidates)
     return {"snapshot": snap, "candidates": candidates,
             "recommendations": recs,
+            "kernels": workload.kernels_block(),
             "verdict": workload.verdict_for(recs if recs else candidates)}
 
 

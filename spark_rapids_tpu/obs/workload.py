@@ -63,14 +63,28 @@ __all__ = [
     "record_from_history", "records_from_history",
     "derive", "recommend", "Advisor", "verdict_for",
     "window_records", "snapshot", "advise", "bundle_block", "reset",
-    "validate_payload",
+    "validate_payload", "KERNEL_FOR_KIND", "kernels_block",
 ]
 
 #: Assumed speedup of a hand-written Pallas kernel over the current XLA
-#: lowering for one step kind — the "projected win" a hotspot cites is
-#: its window seconds x (1 - 1/KERNEL_SPEEDUP).  A planning prior, not
-#: a measurement; the point is ranking, the constant is documented.
+#: lowering for one step kind — the fallback prior when the kernel
+#: registry has no measurement yet.  Once ``bench_queries.py --kernels``
+#: (or any dispatch site calling ``record_speedup``) has measured the
+#: kernel for a kind, the measured ratio replaces this constant in the
+#: hotspot's ``projected_win_s``; the ratio actually used is published
+#: as the hotspot's ``assumed_speedup``.
 KERNEL_SPEEDUP = 2.0
+
+#: Step kind → kernel-registry name, for looking up measured speedups.
+#: Kinds absent here (Sort, Filter, ...) have no Pallas kernel yet and
+#: keep the :data:`KERNEL_SPEEDUP` prior.
+KERNEL_FOR_KIND = {
+    "BroadcastJoin": "join",
+    "ShuffledJoin": "join",
+    "GroupBy[dense]": "groupby",
+    "GroupBy[sorted]": "groupby",
+    "Scan": "decode",
+}
 
 #: A step kind must hold at least this share of attributed step seconds
 #: (and this many absolute seconds) before the advisor proposes a
@@ -389,11 +403,16 @@ def reset() -> None:
 def derive(records: Sequence[Dict[str, Any]],
            tickets: Sequence[Tuple[str, Tuple[str, ...]]],
            window_seconds: float, *, topk: int,
-           inflight_plans: Sequence[str] = ()) -> Dict[str, Any]:
+           inflight_plans: Sequence[str] = (),
+           speedups: Optional[Dict[str, float]] = None) -> Dict[str, Any]:
     """The workload snapshot for one window of normalized records —
     pure.  ``tickets`` are ``(plan_fp, prefix_fps)`` pairs from the
     scheduler feed; ``inflight_plans`` are the live registry's
-    currently-running plan fingerprints (context only).
+    currently-running plan fingerprints (context only); ``speedups``
+    maps kernel names to measured oracle/kernel wall ratios (the kernel
+    registry's ``measured_speedups()``) — a hotspot whose kind has a
+    measured kernel cites that ratio in ``projected_win_s`` instead of
+    the :data:`KERNEL_SPEEDUP` prior.
 
     Hotspot attribution: measured step seconds are used directly;
     records without per-step measurements spread their
@@ -477,6 +496,9 @@ def derive(records: Sequence[Dict[str, Any]],
         sec = agg["seconds"]
         share = sec / total_step_seconds if total_step_seconds > 0 else 0.0
         samples = per_row.get(agg["kind"], [])
+        kernel = KERNEL_FOR_KIND.get(agg["kind"])
+        assumed = float((speedups or {}).get(kernel, 0.0)) or KERNEL_SPEEDUP
+        assumed = max(assumed, 1.0)  # a slower kernel projects no win
         hotspots.append({
             "kind": agg["kind"],
             "seconds": round(sec, 6),
@@ -490,7 +512,8 @@ def derive(records: Sequence[Dict[str, Any]],
             "host_syncs": round(agg["host_syncs"], 1),
             "per_row_p50_s": percentile(samples, 50.0),
             "per_row_p95_s": percentile(samples, 95.0),
-            "projected_win_s": round(sec * (1.0 - 1.0 / KERNEL_SPEEDUP), 6),
+            "assumed_speedup": round(assumed, 4),
+            "projected_win_s": round(sec * (1.0 - 1.0 / assumed), 6),
         })
     hotspots.sort(key=lambda h: (-h["seconds"], h["kind"]))
 
@@ -652,6 +675,28 @@ def _live_inflight_plans() -> List[str]:
         return []
 
 
+def _measured_speedups() -> Dict[str, float]:
+    """Measured per-kernel speedups from the kernel registry —
+    best-effort (an import problem must not break the snapshot)."""
+    try:
+        from ..kernels import registry
+        return registry.measured_speedups()
+    except Exception:
+        return {}
+
+
+def kernels_block() -> Dict[str, Any]:
+    """The ``kernels`` block of a ``/workload`` payload: the kernel
+    registry's enabled/quarantined sets and per-kernel counters plus
+    measured speedups — never raises."""
+    try:
+        from ..kernels import registry
+        return registry.stats()
+    except Exception as exc:  # pragma: no cover - defensive
+        return {"enabled": [], "quarantined": [],
+                "per_kernel": {}, "error": type(exc).__name__}
+
+
 def snapshot(window_s: Optional[float] = None) -> Dict[str, Any]:
     """Workload observables for the trailing window (knobs ambient)."""
     from ..config import workload_topk, workload_window_s
@@ -659,7 +704,8 @@ def snapshot(window_s: Optional[float] = None) -> Dict[str, Any]:
     w1 = _now()
     recs, tks = window_records(w1 - window, w1)
     return derive(recs, tks, window, topk=workload_topk(),
-                  inflight_plans=_live_inflight_plans())
+                  inflight_plans=_live_inflight_plans(),
+                  speedups=_measured_speedups())
 
 
 def advise(window_s: Optional[float] = None,
@@ -686,6 +732,7 @@ def advise(window_s: Optional[float] = None,
         "snapshot": snap,
         "candidates": candidates,
         "recommendations": recs,
+        "kernels": kernels_block(),
         "verdict": verdict_for(recs if recs else candidates),
     }
 
@@ -747,6 +794,10 @@ def validate_payload(payload: Dict[str, Any],
             if action.split(":", 1)[0] not in schema["actions"]:
                 errors.append(f"{group}[{i}] action {action!r} outside "
                               f"the pinned namespace {schema['actions']}")
+    kern = payload.get("kernels")
+    if not isinstance(kern, dict) \
+            or sorted(kern) != sorted(schema["kernels_keys"]):
+        errors.append(f"'kernels' keys != {schema['kernels_keys']}")
     if payload.get("verdict") not in schema["verdicts"]:
         errors.append(f"verdict {payload.get('verdict')!r} not in "
                       f"{schema['verdicts']}")

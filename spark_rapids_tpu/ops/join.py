@@ -13,6 +13,10 @@ get side-distinct sentinel group ids.
 
 Output-size materialization: one host sync for the total match count
 (inherent — the result shape is data dependent), then fixed-shape gathers.
+
+``SRT_KERNELS=join`` swaps the factorize+probe for the Pallas
+hash-table build/probe (`kernels/join.py`) — the sort path below stays
+in-tree as its bit-identity oracle and automatic fallback.
 """
 
 from __future__ import annotations
@@ -51,10 +55,23 @@ def _factorize_union(left: Table, right: Table, left_on: Sequence[str],
             validity = jnp.concatenate([lc.valid_mask(), rc.valid_mask()])
         merged_cols.append(Column(data=data, validity=validity, dtype=lc.dtype))
     merged_cols = grouping_columns(merged_cols)   # strings -> dictionary codes
-    return _factorize_probe_kernel(
-        tuple(c.data for c in merged_cols),
-        tuple(c.validity for c in merged_cols),
-        n_left=n_left)
+    datas = tuple(c.data for c in merged_cols)
+    valids = tuple(c.validity for c in merged_cols)
+
+    def _oracle():
+        return _factorize_probe_kernel(datas, valids, n_left=n_left)
+
+    from ..kernels import registry as _kernels
+    if _kernels.enabled("join"):
+        from ..kernels.join import hash_factorize_probe, supported
+        if supported(datas, n_left=n_left):
+            return _kernels.dispatch(
+                "join",
+                lambda: hash_factorize_probe(
+                    datas, valids, n_left=n_left,
+                    interpret=_kernels.interpret_mode()),
+                _oracle)
+    return _oracle()
 
 
 @functools.partial(jax.jit, static_argnames=("n_left",))

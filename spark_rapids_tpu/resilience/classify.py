@@ -28,7 +28,11 @@ CATEGORY_FATAL = "fatal"
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
                 "OOM_WHEN_ALLOCATING")
 _COMPILE_MARKERS = ("XLA compilation", "during compilation",
-                    "Compilation failure", "while lowering")
+                    "Compilation failure", "while lowering",
+                    # Pallas kernel lowering/compile failures (the kernel
+                    # registry quarantines these and falls back to the
+                    # jnp oracle as a named recovery rung).
+                    "Mosaic", "Pallas", "mosaic lowering")
 
 #: OSError subclasses that describe a *state* of the filesystem, not a
 #: transient fault — retrying cannot help.
@@ -54,7 +58,8 @@ def classify(exc: BaseException) -> str:
     if any(m in msg for m in _OOM_MARKERS):
         return CATEGORY_OOM
     name = type(exc).__name__
-    if name in ("XlaRuntimeError", "InternalError") \
+    if name in ("XlaRuntimeError", "InternalError", "LoweringError",
+                "MosaicError") \
             and any(m in msg for m in _COMPILE_MARKERS):
         return CATEGORY_COMPILE
     if isinstance(exc, _FATAL_OS):

@@ -372,11 +372,12 @@ def use_pallas() -> bool:
     row-by-row — Mosaic relayouts dominate.  XLA's fusion of the same
     expression graph is the better schedule today, so it is the default;
     the kernels stay in-tree (bit-identical, tested) as the explicit-layout
-    starting point for future Mosaic work.  Set SRT_ROWS_IMPL=pallas to
-    select them.
+    starting point for future Mosaic work.  Enable with ``SRT_KERNELS=rows``
+    via the kernel registry (``SRT_ROWS_IMPL=pallas`` is the deprecated
+    alias); on non-TPU backends the kernels run in interpret mode.
     """
-    from ..config import rows_impl
-    return rows_impl() == "pallas" and jax.default_backend() == "tpu"
+    from ..kernels import registry as _kernels
+    return _kernels.enabled("rows")
 
 
 def _pallas_supports(layout: RowLayout) -> bool:
@@ -386,13 +387,23 @@ def _pallas_supports(layout: RowLayout) -> bool:
 
 def pack_image(layout: RowLayout, datas, masks) -> jax.Array:
     if use_pallas() and _pallas_supports(layout):
-        return pack_words_pallas(layout, datas, masks)
+        from ..kernels import registry as _kernels
+        return _kernels.dispatch(
+            "rows",
+            lambda: pack_words_pallas(layout, datas, masks,
+                                      interpret=_kernels.interpret_mode()),
+            lambda: pack_words(layout, datas, masks))
     return pack_words(layout, datas, masks)
 
 
 def unpack_image(layout: RowLayout, image: jax.Array):
     if use_pallas() and _pallas_supports(layout):
-        return unpack_words_pallas(layout, image)
+        from ..kernels import registry as _kernels
+        return _kernels.dispatch(
+            "rows",
+            lambda: unpack_words_pallas(layout, image,
+                                        interpret=_kernels.interpret_mode()),
+            lambda: unpack_words(layout, image))
     return unpack_words(layout, image)
 
 

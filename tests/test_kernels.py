@@ -1,0 +1,532 @@
+"""Pallas kernel layer (SRT_KERNELS): gating, parity, fallback, feedback.
+
+Every kernel keeps its jnp composition as the bit-identity oracle; these
+tests run the kernels in Pallas interpret mode on CPU (the same kernel
+bodies that compile on TPU) and pin four contracts:
+
+1. **Gating** — ``SRT_KERNELS`` parses/dedups/validates; unknown names
+   raise a knob-named error; ``SRT_ROWS_IMPL=pallas`` survives as a
+   deprecated alias for ``rows``.
+2. **Parity** — kernel output == oracle output across bucket-boundary
+   sizes, null keys, NaN/-0.0 float keys, string keys, every join
+   ``how``, and empty inputs; join row ORDER included.
+3. **Fallback** — a compile-classified kernel failure quarantines the
+   kernel, counts a ``kernel.<name>.fallbacks`` recovery rung, and
+   re-runs the oracle; any other error propagates unchanged, so
+   ``SRT_FAULT`` recovery behaves identically kernel on or off.
+4. **Feedback** — ``record_speedup`` measurements replace the workload
+   profiler's static 2.0x projected-win prior.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import config
+from spark_rapids_tpu import dtypes as dt
+from spark_rapids_tpu import kernels, ops
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.exec import plan
+from spark_rapids_tpu.kernels import registry as kreg
+from spark_rapids_tpu.obs import registry
+from spark_rapids_tpu.table import Table
+
+ALL_KERNELS = "join,groupby,decode,rows"
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("SRT_KERNELS", raising=False)
+    monkeypatch.delenv("SRT_ROWS_IMPL", raising=False)
+    kreg.reset()
+    yield
+    kreg.reset()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+def _pydict_eq(x, y):
+    """to_pydict equality with NaN == NaN (plain list equality treats
+    two NaN floats as unequal)."""
+    if isinstance(x, float) and isinstance(y, float):
+        return x == y or (x != x and y != y)
+    if isinstance(x, list):
+        return (isinstance(y, list) and len(x) == len(y)
+                and all(_pydict_eq(a, b) for a, b in zip(x, y)))
+    if isinstance(x, dict):
+        return (isinstance(y, dict) and sorted(x) == sorted(y)
+                and all(_pydict_eq(x[k], y[k]) for k in x))
+    return x == y
+
+
+def _both(monkeypatch, fn, *, kernel, min_invocations=1):
+    """Run ``fn`` under the oracle and under ``kernel``; assert the
+    kernel actually fired and return (oracle_out, kernel_out)."""
+    monkeypatch.setenv("SRT_KERNELS", "")
+    kreg.reset()
+    want = fn()
+    monkeypatch.setenv("SRT_KERNELS", ALL_KERNELS)
+    kreg.reset()
+    got = fn()
+    fired = kreg.stats()["per_kernel"].get(kernel, {}).get("invocations", 0)
+    assert fired >= min_invocations, \
+        f"{kernel} kernel never fired (invocations={fired})"
+    return want, got
+
+
+# ---------------------------------------------------------------------------
+# 1. gating: the SRT_KERNELS knob
+# ---------------------------------------------------------------------------
+
+
+class TestKnob:
+    def test_default_off(self):
+        assert config.kernels() == ()
+        assert not kreg.enabled("join")
+
+    def test_parse_dedup_case(self, monkeypatch):
+        monkeypatch.setenv("SRT_KERNELS", " Join ,groupby,join")
+        assert config.kernels() == ("join", "groupby")
+        assert kreg.enabled("join") and kreg.enabled("groupby")
+        assert not kreg.enabled("decode")
+
+    def test_unknown_name_is_knob_named_error(self, monkeypatch):
+        monkeypatch.setenv("SRT_KERNELS", "join,warp")
+        with pytest.raises(ValueError, match="SRT_KERNELS.*'warp'"):
+            config.kernels()
+
+    def test_enabled_rejects_unknown_kernel(self):
+        with pytest.raises(ValueError, match="unknown kernel"):
+            kreg.enabled("sort")
+
+    def test_rows_impl_alias_warns_and_maps(self, monkeypatch):
+        monkeypatch.setenv("SRT_ROWS_IMPL", "pallas")
+        with pytest.warns(DeprecationWarning, match="SRT_KERNELS=rows"):
+            names = config.kernels()
+        assert "rows" in names
+        with pytest.warns(DeprecationWarning):
+            assert kreg.enabled("rows")
+
+    def test_rows_impl_alias_silent_when_superseded(self, monkeypatch):
+        import warnings
+
+        monkeypatch.setenv("SRT_ROWS_IMPL", "pallas")
+        monkeypatch.setenv("SRT_KERNELS", "rows")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert config.kernels() == ("rows",)
+
+
+# ---------------------------------------------------------------------------
+# 2. parity: kernel == oracle in interpret mode
+# ---------------------------------------------------------------------------
+
+
+def _join_tables(n, rng, *, with_nulls=True):
+    nr = max(n // 2, 1)
+    lk = rng.integers(0, max(n // 3, 2), n).astype(np.int64)
+    lmask = (rng.random(n) > 0.15) if with_nulls and n else None
+    left = srt.Table([
+        ("k", Column.from_numpy(lk, validity=lmask)),
+        ("lv", Column.from_numpy(np.arange(n, dtype=np.float64))),
+    ])
+    rk = rng.integers(0, max(n // 3, 2), nr).astype(np.int64)
+    right = srt.Table([
+        ("k", Column.from_numpy(rk)),
+        ("rv", Column.from_numpy(np.arange(nr, dtype=np.int32))),
+    ])
+    return left, right
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 127, 128, 129, 513])
+def test_join_parity_across_bucket_boundaries(monkeypatch, rng, n):
+    left, right = _join_tables(n, rng)
+
+    def run():
+        return ops.join(left, right, on=["k"], how="inner").to_pydict()
+
+    # n == 0 short-circuits before the pallas call; just demand parity.
+    want, got = _both(monkeypatch, run, kernel="join",
+                      min_invocations=0 if n == 0 else 1)
+    assert _pydict_eq(want, got)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer",
+                                 "semi", "anti"])
+def test_join_parity_every_how(monkeypatch, rng, how):
+    left, right = _join_tables(300, rng)
+
+    def run():
+        return ops.join(left, right, on=["k"], how=how).to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="join")
+    assert _pydict_eq(want, got)
+
+
+def test_join_parity_float_keys_nan_negzero(monkeypatch):
+    # Grouping equality: NaN == NaN, -0.0 == +0.0, nulls never match.
+    lk = np.array([1.5, np.nan, -0.0, 0.0, 2.5, np.nan, 3.5, 1.5])
+    lval = np.array([True, True, True, True, True, True, False, True])
+    rk = np.array([np.nan, 0.0, 1.5, 4.0])
+    rval = np.array([True, True, True, False])
+    left = srt.Table([
+        ("k", Column.from_numpy(lk, validity=lval)),
+        ("lv", Column.from_numpy(np.arange(8, dtype=np.int64))),
+    ])
+    right = srt.Table([
+        ("k", Column.from_numpy(rk, validity=rval)),
+        ("rv", Column.from_numpy(np.arange(4, dtype=np.int64))),
+    ])
+
+    def run():
+        return ops.join(left, right, on=["k"], how="outer").to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="join")
+    assert _pydict_eq(want, got)
+
+
+def test_join_parity_string_and_multi_key(monkeypatch, rng):
+    n = 200
+    words = np.array(["ash", "birch", "cedar", "oak", ""], dtype=object)
+    left = Table.from_pydict({
+        "s": words[rng.integers(0, 5, n)].tolist(),
+        "k": rng.integers(0, 4, n).astype(np.int32),
+        "lv": np.arange(n, dtype=np.int64),
+    })
+    right = Table.from_pydict({
+        "s": words[rng.integers(0, 5, 40)].tolist(),
+        "k": rng.integers(0, 4, 40).astype(np.int32),
+        "rv": np.arange(40, dtype=np.int64),
+    })
+
+    def run():
+        return ops.join(left, right, on=["s", "k"], how="inner").to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="join")
+    assert _pydict_eq(want, got)
+
+
+@pytest.mark.parametrize("n", [1, 64, 65, 513])
+def test_groupby_dense_accumulate_parity(monkeypatch, rng, n):
+    t = srt.Table([
+        ("k", Column.from_numpy(rng.integers(0, 16, n).astype(np.int32))),
+        ("v", Column.from_numpy(rng.normal(size=n))),
+    ])
+    p = plan().groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "c"),
+                ("v", "min", "lo"), ("v", "max", "hi")],
+        domains={"k": (0, 15)})
+
+    def run():
+        return p.run(t).to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="groupby")
+    assert _pydict_eq(want, got)
+
+
+def test_groupby_dense_parity_with_null_values(monkeypatch, rng):
+    n = 300
+    v = rng.normal(size=n)
+    t = srt.Table([
+        ("k", Column.from_numpy(rng.integers(0, 8, n).astype(np.int32))),
+        ("v", Column.from_numpy(v, validity=rng.random(n) > 0.2)),
+    ])
+    p = plan().groupby_agg(["k"], [("v", "sum", "s"), ("v", "mean", "m")],
+                           domains={"k": (0, 7)})
+
+    def run():
+        return p.run(t).to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="groupby")
+    assert _pydict_eq(want, got)
+
+
+def _write_parquet(path, n, rng):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    tab = pa.table({
+        "g": rng.integers(0, 6, n).astype(np.int32),
+        "x": np.arange(n, dtype=np.int64),
+        "f": rng.normal(size=n),
+    })
+    pq.write_table(tab, path, use_dictionary=True, data_page_size=1024,
+                   row_group_size=max(n // 4, 64))
+    return path
+
+
+@pytest.mark.parametrize("n", [1, 700, 4096])
+def test_decode_parity(monkeypatch, tmp_path, rng, n):
+    from spark_rapids_tpu.io.parquet_native import read_parquet_native
+
+    path = str(_write_parquet(tmp_path / "t.parquet", n, rng))
+
+    def run():
+        return read_parquet_native(path).to_pydict()
+
+    want, got = _both(monkeypatch, run, kernel="decode")
+    assert _pydict_eq(want, got)
+
+
+def test_decode_predicate_parity_and_bytes_skipped(monkeypatch, tmp_path,
+                                                   rng, metrics_on):
+    # Page/group pruning is host-side metadata work: the kernel must not
+    # change WHAT is skipped, only how survivors are decoded.
+    from spark_rapids_tpu.io.parquet_native import read_parquet_native
+
+    path = str(_write_parquet(tmp_path / "t.parquet", 4000, rng))
+    pred = [("x", "<", 900)]
+
+    def skipped():
+        return registry().counter("scan.bytes_skipped").value
+
+    monkeypatch.setenv("SRT_KERNELS", "")
+    kreg.reset()
+    s0 = skipped()
+    want = read_parquet_native(path, predicate=pred).to_pydict()
+    skipped_oracle = skipped() - s0
+
+    monkeypatch.setenv("SRT_KERNELS", ALL_KERNELS)
+    kreg.reset()
+    s1 = skipped()
+    got = read_parquet_native(path, predicate=pred).to_pydict()
+    skipped_kernel = skipped() - s1
+
+    assert _pydict_eq(want, got)
+    assert skipped_oracle == skipped_kernel
+    assert skipped_oracle > 0          # the predicate actually pruned
+    assert kreg.stats()["per_kernel"]["decode"]["invocations"] >= 1
+
+
+def test_rows_image_parity_and_alias(monkeypatch, rng):
+    from spark_rapids_tpu.rows.image import pack_image, unpack_image
+    from spark_rapids_tpu.rows.layout import compute_fixed_width_layout
+
+    schema = (dt.INT64, dt.FLOAT64, dt.INT32)
+    layout = compute_fixed_width_layout(schema)
+    n = 300
+    datas = [np.arange(n, dtype=np.int64), rng.normal(size=n),
+             rng.integers(-9, 9, n).astype(np.int32)]
+    masks = [rng.random(n) > 0.1 for _ in schema]
+
+    def run():
+        image = pack_image(layout, datas, masks)
+        out_d, out_v = unpack_image(layout, image)
+        return ([np.asarray(d) for d in out_d],
+                [np.asarray(v) for v in out_v])
+
+    want, got = _both(monkeypatch, run, kernel="rows", min_invocations=2)
+    for a, b in zip(want[0] + want[1], got[0] + got[1]):
+        np.testing.assert_array_equal(a, b)
+
+    # The deprecated alias routes the same dispatch.
+    monkeypatch.delenv("SRT_KERNELS", raising=False)
+    monkeypatch.setenv("SRT_ROWS_IMPL", "pallas")
+    kreg.reset()
+    with pytest.warns(DeprecationWarning):
+        alias = run()
+    np.testing.assert_array_equal(alias[0][0], want[0][0])
+    assert kreg.stats()["per_kernel"]["rows"]["invocations"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# 3. fallback: compile failures quarantine, others propagate
+# ---------------------------------------------------------------------------
+
+
+class LoweringError(Exception):
+    """Stand-in for a Mosaic lowering failure (name + marker matched)."""
+
+
+class TestFallback:
+    def test_compile_failure_quarantines_and_reruns_oracle(
+            self, monkeypatch, metrics_on):
+        from spark_rapids_tpu.resilience.classify import (CATEGORY_COMPILE,
+                                                          classify)
+
+        monkeypatch.setenv("SRT_KERNELS", "join")
+        exc = LoweringError("Mosaic lowering failed: unsupported dtype")
+        assert classify(exc) == CATEGORY_COMPILE
+        calls = []
+
+        def bad():
+            calls.append("kernel")
+            raise exc
+
+        assert kreg.dispatch("join", bad, lambda: "oracle") == "oracle"
+        st = kreg.stats()
+        assert st["quarantined"] == ["join"]
+        assert st["per_kernel"]["join"]["fallbacks"] == 1
+        assert registry().counter("kernel.join.fallbacks").value == 1
+        # Sticky: the next dispatch goes straight to the oracle.
+        assert kreg.dispatch("join", bad, lambda: "again") == "again"
+        assert calls == ["kernel"]
+        assert not kreg.enabled("join")
+        kreg.clear_quarantine()
+        assert kreg.enabled("join")
+
+    def test_not_implemented_is_a_compile_failure(self, monkeypatch):
+        monkeypatch.setenv("SRT_KERNELS", "decode")
+
+        def bad():
+            raise NotImplementedError("shape outside kernel envelope")
+
+        assert kreg.dispatch("decode", bad, lambda: 41) == 41
+        assert kreg.stats()["quarantined"] == ["decode"]
+
+    def test_non_compile_error_propagates(self, monkeypatch):
+        monkeypatch.setenv("SRT_KERNELS", "join")
+
+        def bad():
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            kreg.dispatch("join", bad, lambda: "oracle")
+        assert kreg.stats()["quarantined"] == []
+
+    def test_end_to_end_join_fallback(self, monkeypatch, rng, metrics_on):
+        # Break the real kernel entry point: ops.join must still return
+        # the oracle result and count the fallback rung.
+        left, right = _join_tables(150, rng)
+        monkeypatch.setenv("SRT_KERNELS", "")
+        want = ops.join(left, right, on=["k"], how="inner").to_pydict()
+
+        def bad(*a, **k):
+            raise LoweringError("Mosaic lowering failed in e2e test")
+
+        monkeypatch.setenv("SRT_KERNELS", "join")
+        monkeypatch.setattr("spark_rapids_tpu.kernels.join."
+                            "hash_factorize_probe", bad)
+        kreg.reset()
+        got = ops.join(left, right, on=["k"], how="inner").to_pydict()
+        assert _pydict_eq(want, got)
+        assert kreg.stats()["per_kernel"]["join"]["fallbacks"] == 1
+        assert registry().counter("kernel.join.fallbacks").value == 1
+
+    def test_fault_injection_parity_on_off(self, monkeypatch, rng,
+                                           metrics_on):
+        # SRT_FAULT recovery must engage identically kernel on or off:
+        # the injected OOM classifies and recovers the same way, and the
+        # recovered results agree.
+        from spark_rapids_tpu.resilience.faults import reset_faults
+        from spark_rapids_tpu.resilience.retry import recovery_stats
+
+        n = 256
+        t = srt.Table([
+            ("k", Column.from_numpy(rng.integers(0, 8, n)
+                                    .astype(np.int32))),
+            ("v", Column.from_numpy(rng.normal(size=n))),
+        ])
+        p = plan().groupby_agg(["k"], [("v", "sum", "s")],
+                               domains={"k": (0, 7)})
+        outs, injected = {}, {}
+        for mode in ("", ALL_KERNELS):
+            monkeypatch.setenv("SRT_KERNELS", mode)
+            monkeypatch.setenv("SRT_FAULT", "oom:dispatch:1")
+            kreg.reset()
+            reset_faults()
+            before = recovery_stats().snapshot()
+            outs[mode] = p.run(t).to_pydict()
+            injected[mode] = \
+                recovery_stats().delta(before)["faults_injected"]
+        monkeypatch.delenv("SRT_FAULT")
+        reset_faults()
+        assert injected[""] == injected[ALL_KERNELS] == 1
+        assert _pydict_eq(outs[""], outs[ALL_KERNELS])
+
+
+# ---------------------------------------------------------------------------
+# 4. accounting + workload feedback
+# ---------------------------------------------------------------------------
+
+
+def test_counters_and_cost_ledger(monkeypatch, rng, metrics_on):
+    left, right = _join_tables(200, rng)
+    monkeypatch.setenv("SRT_KERNELS", ALL_KERNELS)
+    kreg.reset()
+    ops.join(left, right, on=["k"], how="inner").to_pydict()
+    assert registry().counter("kernel.join.invocations").value >= 1
+    assert registry().gauge("cost.kernel.join_seconds").value > 0
+    st = kreg.stats()["per_kernel"]["join"]
+    assert st["invocations"] >= 1 and st["seconds"] > 0
+
+
+def test_measured_speedups_replace_static_prior():
+    from spark_rapids_tpu.obs import workload
+
+    rec = {"fingerprint": "fpA", "mode": "table", "total_seconds": 2.0,
+           "execute_seconds": 1.0, "rows": 1000, "bytes_accessed": 0.0,
+           "ici_seconds": 0.0, "host_syncs": 0, "prefixes": [],
+           "steps": [{"kind": "BroadcastJoin", "seconds": 1.0,
+                      "rows_in": 1000, "rows_out": 1000}]}
+    # No measurement: the 2.0x prior.
+    snap = workload.derive([rec], [], 60.0, topk=4)
+    h = snap["hotspots"][0]
+    assert h["assumed_speedup"] == workload.KERNEL_SPEEDUP
+    assert h["projected_win_s"] == pytest.approx(
+        1.0 * (1 - 1 / workload.KERNEL_SPEEDUP))
+
+    # Measured 4x: the measurement replaces the prior.
+    kreg.record_speedup("join", 2.0, 0.5)
+    snap = workload.derive([rec], [], 60.0, topk=4,
+                           speedups=kreg.measured_speedups())
+    h = snap["hotspots"][0]
+    assert h["assumed_speedup"] == pytest.approx(4.0)
+    assert h["projected_win_s"] == pytest.approx(1.0 * (1 - 1 / 4.0))
+
+    # A kernel measured SLOWER than the oracle projects no win.
+    kreg.record_speedup("join", 0.5, 2.0)
+    snap = workload.derive([rec], [], 60.0, topk=4,
+                           speedups=kreg.measured_speedups())
+    h = snap["hotspots"][0]
+    assert h["assumed_speedup"] == 1.0
+    assert h["projected_win_s"] == 0.0
+
+    # Kinds with no kernel keep the prior even with measurements around.
+    rec["steps"] = [{"kind": "Sort", "seconds": 1.0,
+                     "rows_in": 1000, "rows_out": 1000}]
+    snap = workload.derive([rec], [], 60.0, topk=4,
+                           speedups={"join": 4.0})
+    assert snap["hotspots"][0]["assumed_speedup"] == workload.KERNEL_SPEEDUP
+
+
+def test_workload_payload_carries_kernels_block(monkeypatch, metrics_on):
+    import json
+    import pathlib
+
+    from spark_rapids_tpu.obs import workload
+
+    monkeypatch.setenv("SRT_KERNELS", "join")
+    kreg.record_speedup("join", 1.0, 0.25)
+    payload = workload.advise(window_s=60)
+    assert payload["kernels"]["enabled"] == ["join"]
+    assert payload["kernels"]["per_kernel"]["join"]["measured_speedup"] \
+        == pytest.approx(4.0)
+    schema = json.loads(
+        (pathlib.Path(__file__).parent / "golden"
+         / "workload_endpoint_schema.json").read_text())
+    assert workload.validate_payload(payload, schema) == []
+
+
+def test_render_workload_shows_kernels(monkeypatch):
+    from spark_rapids_tpu.obs import workload
+    from spark_rapids_tpu.obs.__main__ import render_workload
+
+    monkeypatch.setenv("SRT_KERNELS", "join,rows")
+    kreg.record_speedup("rows", 1.0, 0.5)
+    kreg.dispatch("rows", lambda: 1, lambda: 2)
+    payload = {"snapshot": workload.derive([], [], 1.0, topk=1),
+               "candidates": [], "recommendations": [],
+               "kernels": workload.kernels_block(), "verdict": "quiet"}
+    out = render_workload(payload, source="test")
+    assert "pallas kernels (SRT_KERNELS=join,rows)" in out
+    assert "rows" in out and "measured_speedup=2.00x" in out
+    off = render_workload({"snapshot": workload.derive([], [], 1.0, topk=1),
+                           "candidates": [], "recommendations": [],
+                           "verdict": "quiet"})
+    assert "none enabled" in off

@@ -96,6 +96,7 @@ def _hot(kind, seconds, share, **over):
          "queries": 1, "rows_in": 0, "rows_out": 0, "bytes": 0.0,
          "ici_seconds": 0.0, "host_syncs": 0.0, "per_row_p50_s": None,
          "per_row_p95_s": None,
+         "assumed_speedup": workload.KERNEL_SPEEDUP,
          "projected_win_s": seconds * (1 - 1 / workload.KERNEL_SPEEDUP)}
     h.update(over)
     return h
@@ -428,7 +429,7 @@ def test_validate_payload_flags_drift():
     schema = _golden("workload_endpoint_schema.json")
     snap = workload.derive([], [], 60.0, topk=8)
     good = {"snapshot": snap, "candidates": [], "recommendations": [],
-            "verdict": "quiet"}
+            "kernels": workload.kernels_block(), "verdict": "quiet"}
     assert workload.validate_payload(good, schema) == []
     assert workload.validate_payload({"snapshot": snap}, schema)
     bad_snap = dict(snap)
@@ -439,6 +440,8 @@ def test_validate_payload_flags_drift():
     assert any("namespace" in e
                for e in workload.validate_payload(rogue, schema))
     assert workload.validate_payload(dict(good, verdict="?"), schema)
+    assert workload.validate_payload(dict(good, kernels={"bogus": 1}),
+                                     schema)
 
 
 def test_bundle_carries_workload_block(metrics_on):
