@@ -78,6 +78,15 @@ repeated fingerprints) over TPC-DS data through ``serve.submit``, each
 result checked bit-identical to the sequential executors, emitting ONE
 ``serving`` JSON line (sustained qps, p50/p99 latency, result-cache hit
 rate, admission rejects).  Exits nonzero on any parity failure.
+
+``--spill`` replaces the default lanes with the out-of-core lane: a
+streaming combine group-by runs once unconstrained (the ``SRT_SPILL=0``
+oracle) and once under a deliberately tiny ``SRT_SERVE_HBM_BUDGET``
+with ``SRT_SPILL=1`` forcing every paged partition through the Parquet
+disk tier, and ONE ``spill`` JSON line records both wall times, bytes
+paged out/in, page counts, spill files, and page-in seconds.  Exits
+nonzero on parity loss or when nothing actually paged (a lane that
+silently measures the oracle twice is a lane failure).
 """
 
 from __future__ import annotations
@@ -1544,6 +1553,107 @@ def bench_kernels(rows=60_000, reps=3):
             f"kernel never fired (see the `kernels` line)")
 
 
+def bench_spill(n_batches=8, batch_rows=40_000):
+    """``--spill``: out-of-core lane — oracle vs spill-forced wall + parity.
+
+    A streaming combine group-by (5 aggregates over a dense key domain)
+    runs twice: once with spill off (the ``SRT_SPILL=0`` oracle) and
+    once under ``SRT_SPILL=1`` with a deliberately tiny
+    ``SRT_SERVE_HBM_BUDGET`` and ``SRT_SPILL_HOST_BYTES=0``, so the
+    watermark pages every cold combine level all the way through the
+    Parquet disk tier and back.  The two results must agree exactly
+    (NaN-aware).  Emits ONE ``spill`` JSON line (oracle/spilled wall
+    seconds, pages + bytes out/in, spill files, page-in seconds).
+    Exits nonzero on parity loss or when ``bytes_out`` stayed zero —
+    a lane that never pages is measuring the oracle twice.
+    """
+    import os
+    import tempfile
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.column import Column
+    from spark_rapids_tpu.exec import plan
+    from spark_rapids_tpu.resilience import recovery_stats, reset_spill
+
+    rng = np.random.default_rng(23)
+    batches = [srt.Table([
+        ("k", Column.from_numpy(rng.integers(0, 64, batch_rows)
+                                .astype(np.int32))),
+        ("v", Column.from_numpy(rng.uniform(-10, 10, batch_rows))),
+    ]) for _ in range(n_batches)]
+    gb_plan = plan().groupby_agg(
+        ["k"], [("v", "sum", "s"), ("v", "count", "n"),
+                ("v", "mean", "m"), ("v", "min", "lo"),
+                ("v", "max", "hi")],
+        domains={"k": (0, 63)})
+
+    def run_combine():
+        t0 = time.perf_counter()
+        outs = list(gb_plan.run_stream(iter(batches), inflight=2,
+                                       combine=True))
+        wall = time.perf_counter() - t0
+        assert len(outs) == 1
+        return wall, outs[0].to_pydict()
+
+    knobs = ("SRT_SPILL", "SRT_SPILL_DIR", "SRT_SPILL_HOST_BYTES",
+             "SRT_SPILL_WATERMARK", "SRT_SERVE_HBM_BUDGET")
+    saved = {k: os.environ.get(k) for k in knobs}
+    for k in knobs:
+        os.environ.pop(k, None)
+    reset_spill()
+    try:
+        oracle_s, oracle_out = run_combine()
+
+        spill_dir = tempfile.mkdtemp(prefix="srt-bench-spill-")
+        os.environ["SRT_SPILL"] = "1"
+        os.environ["SRT_SPILL_DIR"] = spill_dir
+        os.environ["SRT_SPILL_HOST_BYTES"] = "0"   # force the disk tier
+        os.environ["SRT_SERVE_HBM_BUDGET"] = "64"  # tiny: accumulators
+        os.environ["SRT_SPILL_WATERMARK"] = "0.5"  # must page out
+        reset_spill()
+        before = recovery_stats().snapshot()
+        spilled_s, spilled_out = run_combine()
+        d = recovery_stats().delta(before)
+        leftovers = os.listdir(spill_dir)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        reset_spill()
+
+    parity = _pydict_eq(oracle_out, spilled_out)
+    emit(json.dumps({
+        "metric": "spill",
+        "batches": n_batches,
+        "rows_per_batch": batch_rows,
+        "oracle_s": round(oracle_s, 6),
+        "spilled_s": round(spilled_s, 6),
+        "overhead_s": round(spilled_s - oracle_s, 6),
+        "pages_out": d["spill_pages_out"],
+        "pages_in": d["spill_pages_in"],
+        "bytes_out": d["spill_bytes_out"],
+        "bytes_in": d["spill_bytes_in"],
+        "files": d["spill_files"],
+        "page_in_seconds": round(d["spill_page_in_seconds"], 6),
+        "parity": parity,
+        "leaked_files": len(leftovers),
+    }, sort_keys=True))
+    if not parity:
+        raise SystemExit(
+            "spill lane failure: spilled result diverged from the "
+            "SRT_SPILL=0 oracle (see the `spill` line)")
+    if d["spill_bytes_out"] <= 0:
+        raise SystemExit(
+            "spill lane failure: nothing paged out — the lane measured "
+            "the oracle twice (see the `spill` line)")
+    if leftovers:
+        raise SystemExit(
+            f"spill lane failure: {len(leftovers)} page files leaked in "
+            f"the spill directory after the run")
+
+
 if __name__ == "__main__":
     import os
     if "--faults" in sys.argv:
@@ -1565,6 +1675,8 @@ if __name__ == "__main__":
             bench_semantic()
         elif "--kernels" in sys.argv:
             bench_kernels()
+        elif "--spill" in sys.argv:
+            bench_spill()
         else:
             main()
         if "--regress" in sys.argv:

@@ -24,9 +24,15 @@ if python -c 'import jax; assert jax.default_backend() != "cpu"' 2>/dev/null; th
     # kernels (the speedups the workload advisor cites); exits nonzero on
     # any parity loss or a kernel that never fired.
     python benchmarks/bench_queries.py --kernels | tee -a "$BENCH_OUT"
+    # Out-of-core lane: oracle-vs-spilled wall + bytes paged; exits
+    # nonzero on parity loss or a run that never actually paged.
+    python benchmarks/bench_queries.py --spill | tee -a "$BENCH_OUT"
 else
     echo "nightly: no accelerator on this runner; benchmarks skipped"
     # The kernel parity lane is still meaningful without an accelerator:
     # interpret mode runs the same kernel code on CPU.
     python benchmarks/bench_queries.py --kernels | tee -a "$BENCH_OUT"
+    # Spill parity is HBM-budget arithmetic, not device behavior — the
+    # CPU runner exercises the identical page-out/page-in path.
+    python benchmarks/bench_queries.py --spill | tee -a "$BENCH_OUT"
 fi

@@ -1019,6 +1019,60 @@ assert dec["bytes_skipped_oracle"] == dec["bytes_skipped_kernel"], line
 print("bench kernels lane ok:", json.dumps(line, sort_keys=True))
 EOF
 
+# Out-of-core spill gate: a streaming group-by whose working set is
+# pushed over a deliberately tiny SRT_SERVE_HBM_BUDGET must COMPLETE by
+# paging cold combine levels through the Parquet disk tier
+# (SRT_SPILL_HOST_BYTES=0) and come back bit-identical to the
+# SRT_SPILL=0 oracle, with recovery.spill receipts proving pages went
+# out AND back.  A run that never pages is a gate failure — it would be
+# measuring the oracle twice.
+JAX_PLATFORMS=cpu SRT_METRICS=1 python - <<'EOF'
+import json
+import os
+import tempfile
+
+import numpy as np
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.column import Column
+from spark_rapids_tpu.exec import plan
+from spark_rapids_tpu.resilience import recovery_stats, reset_spill
+
+rng = np.random.default_rng(7)
+batches = [srt.Table([
+    ("k", Column.from_numpy(rng.integers(0, 64, 20_000).astype(np.int32))),
+    ("v", Column.from_numpy(rng.uniform(-5, 5, 20_000))),
+]) for _ in range(6)]
+gb = plan().groupby_agg(
+    ["k"], [("v", "sum", "s"), ("v", "count", "n"), ("v", "mean", "m")],
+    domains={"k": (0, 63)})
+
+def run():
+    outs = list(gb.run_stream(iter(batches), inflight=2, combine=True))
+    assert len(outs) == 1
+    return outs[0].to_pydict()
+
+oracle = run()                           # SRT_SPILL unset: the oracle
+
+spill_dir = tempfile.mkdtemp(prefix="srt-ci-spill-")
+os.environ["SRT_SPILL"] = "1"
+os.environ["SRT_SPILL_DIR"] = spill_dir
+os.environ["SRT_SPILL_HOST_BYTES"] = "0"     # force the disk tier
+os.environ["SRT_SERVE_HBM_BUDGET"] = "64"    # tiny: combine accumulators
+os.environ["SRT_SPILL_WATERMARK"] = "0.5"
+reset_spill()
+before = recovery_stats().snapshot()
+spilled = run()
+d = recovery_stats().delta(before)
+assert d["spill_bytes_out"] > 0, d           # pages actually went out...
+assert d["spill_bytes_in"] == d["spill_bytes_out"], d    # ...and back
+assert d["spill_files"] > 0, d               # through the Parquet tier
+assert spilled == oracle, "spilled result diverged from the oracle"
+assert not os.listdir(spill_dir), "spill page files leaked"
+print("spill lane ok:", json.dumps(
+    {k: v for k, v in d.items() if k.startswith("spill_")},
+    sort_keys=True))
+EOF
+
 # Driver entry points compile and run.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" SRT_TEST_PLATFORM=cpu \
 python - <<'EOF'

@@ -233,6 +233,24 @@ Knobs (all optional):
                                recommendations auto-register matching
                                group-by-terminated plans as views
                                (requires ``SRT_VIEWS=1``).
+  ``SRT_SPILL``                ``1`` enables out-of-core spill
+                               (resilience/spill.py): the OOM ladder's
+                               terminal rung and the admission watermark
+                               page cold partitions out of HBM to host
+                               RAM, then Parquet spill files, and page
+                               them back on demand.  Off (default): the
+                               ladder fails with named rungs — the
+                               bit-identity oracle for spilled runs.
+  ``SRT_SPILL_DIR``            directory for Parquet spill files
+                               (default ``<tmpdir>/srt_spill``); startup
+                               sweeps orphans left by dead processes.
+  ``SRT_SPILL_HOST_BYTES``     byte cap of the pinned host-RAM spill
+                               tier's LRU (default 256 MiB); ``0``/
+                               ``off`` = page straight to disk.
+  ``SRT_SPILL_WATERMARK``      fraction of ``SRT_SERVE_HBM_BUDGET`` at
+                               which admission proactively spills cold
+                               pages instead of waiting for the ladder
+                               (float in (0, 1], default 0.8).
 
 Accessors return live values (no import-time caching) because the reference's
 properties are per-invocation too.
@@ -1054,6 +1072,79 @@ def views_auto() -> bool:
     return _strict_flag("SRT_VIEWS_AUTO")
 
 
+def spill_enabled() -> bool:
+    """Out-of-core spill on/off (``SRT_SPILL``).
+
+    When on, the OOM recovery ladder gains a terminal ``spill`` rung
+    (resilience/spill.py pages registered cold partitions out of HBM to
+    host RAM / Parquet spill files and the failed attempt retries), and
+    the serving admission controller spills instead of rejecting when a
+    plan could fit after paging.  Off (the default) the ladder fails
+    with named rungs — the bit-identity oracle spilled runs are compared
+    against."""
+    return _strict_flag("SRT_SPILL")
+
+
+def spill_dir() -> str:
+    """Directory Parquet spill files are written to (``SRT_SPILL_DIR``,
+    default ``<system tmpdir>/srt_spill``).  Files are named
+    ``srt-spill-<pid>-<n>.parquet``; the spill store's startup sweep
+    removes only orphans whose embedded pid is dead, so concurrent
+    processes can share the directory."""
+    raw = os.environ.get("SRT_SPILL_DIR")
+    if raw is not None and raw.strip():
+        return raw
+    import tempfile
+    return os.path.join(tempfile.gettempdir(), "srt_spill")
+
+
+def spill_host_bytes() -> int:
+    """Byte cap of the host-RAM spill tier's LRU (resilience/spill.py).
+
+    Pages spill to host memory first and overflow oldest-first to
+    Parquet files in ``SRT_SPILL_DIR``.  Tune with
+    ``SRT_SPILL_HOST_BYTES`` (>= 0 bytes, default 256 MiB; ``0``/``off``
+    = disk-only spill)."""
+    raw = os.environ.get("SRT_SPILL_HOST_BYTES")
+    if raw is None or not raw.strip():
+        return 256 << 20
+    val = raw.strip().lower()
+    if val in ("0", "off", "false", "no"):
+        return 0
+    try:
+        out = int(val)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SPILL_HOST_BYTES must be an integer byte count >= 0 "
+            f"(or off), got {raw!r}") from None
+    if out < 0:
+        raise ValueError(
+            f"SRT_SPILL_HOST_BYTES must be >= 0 bytes (or off), "
+            f"got {out}")
+    return out
+
+
+def spill_watermark() -> float:
+    """Proactive-spill watermark: the fraction of
+    ``SRT_SERVE_HBM_BUDGET`` at which the admission controller asks the
+    spill manager to page out cold partitions *before* claims would have
+    to wait (serve/admission.py).  Tune with ``SRT_SPILL_WATERMARK``
+    (float in (0, 1], default 0.8)."""
+    raw = os.environ.get("SRT_SPILL_WATERMARK")
+    if raw is None or not raw.strip():
+        return 0.8
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"SRT_SPILL_WATERMARK must be a fraction in (0, 1], "
+            f"got {raw!r}") from None
+    if not 0.0 < val <= 1.0:
+        raise ValueError(
+            f"SRT_SPILL_WATERMARK must be in (0, 1], got {val}")
+    return val
+
+
 def metrics_history_path() -> str | None:
     """JSONL metrics-history sink path (obs/history.py), or None when no
     history should be written."""
@@ -1141,5 +1232,7 @@ def knob_table() -> dict[str, str]:
              "SRT_LIVE_RECENT", "SRT_CAPACITY_WINDOW_S",
              "SRT_CAPACITY_TARGETS", "SRT_WORKLOAD_WINDOW_S",
              "SRT_WORKLOAD_TOPK", "SRT_SEMANTIC_CACHE",
-             "SRT_SEMANTIC_CACHE_BYTES", "SRT_VIEWS", "SRT_VIEWS_AUTO")
+             "SRT_SEMANTIC_CACHE_BYTES", "SRT_VIEWS", "SRT_VIEWS_AUTO",
+             "SRT_SPILL", "SRT_SPILL_DIR", "SRT_SPILL_HOST_BYTES",
+             "SRT_SPILL_WATERMARK")
     return {n: os.environ.get(n, "<default>") for n in names}

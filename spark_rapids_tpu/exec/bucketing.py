@@ -56,6 +56,11 @@ _SHAPES_SEEN: dict[int, set] = {}
 #: See exec/stats.py for the guarded-identity-cache idiom.
 _PAD_CACHE: dict = {}
 
+#: key -> monotonic touch stamp; orders the pad cache by last use so the
+#: spill rung (resilience/spill.py) can victimize coldest-first.
+_PAD_TOUCH: dict = {}
+_PAD_SEQ = 0
+
 
 @dataclass(frozen=True)
 class BucketedInput:
@@ -184,6 +189,7 @@ def prepare_input(plan, table) -> Optional[BucketedInput]:
         _guarded_cache_put(_PAD_CACHE, key, buffers, (padded, mask))
         _propagate_resident_encodings(table, padded, capacity)
 
+    _touch(key)
     _record(capacity, n)
     return BucketedInput(table=padded, live_mask=mask,
                          logical_rows=n, capacity=capacity)
@@ -236,7 +242,39 @@ def clear_pad_cache() -> int:
     keep its process-lifetime meaning across recoveries."""
     dropped = len(_PAD_CACHE)
     _PAD_CACHE.clear()
+    _PAD_TOUCH.clear()
     return dropped
+
+
+def _touch(key) -> None:
+    global _PAD_SEQ
+    _PAD_SEQ += 1
+    _PAD_TOUCH[key] = _PAD_SEQ
+
+
+def _entry_nbytes(value) -> int:
+    """Device bytes held by one pad-cache entry (padded Table + mask)."""
+    import jax
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(value))
+
+
+def spill_pad_victims(target_bytes: Optional[int] = None) -> int:
+    """Spill-rung victim pass over the pad cache: drop memoized padded
+    copies coldest-first (by :data:`_PAD_TOUCH` stamp) until
+    ``target_bytes`` device bytes are freed (None = drop them all).
+    Returns bytes freed.  Unlike :func:`clear_pad_cache` this respects
+    recency — a streaming query's hot bucket keeps its pad while colder
+    queries' copies go; dropped entries simply re-pad on next bind."""
+    freed = 0
+    for key in sorted(_PAD_CACHE, key=lambda k: _PAD_TOUCH.get(k, 0)):
+        if target_bytes is not None and freed >= target_bytes:
+            break
+        entry = _PAD_CACHE.pop(key, None)
+        _PAD_TOUCH.pop(key, None)
+        if entry is not None:
+            freed += _entry_nbytes(entry[1])
+    return freed
 
 
 def recompiles_avoided() -> int:

@@ -596,8 +596,112 @@ def _drive_batches(plan, source, k: int, acct: _Account) -> Iterator:
         yield drain_oldest()
 
 
+class _SpilledLevel:
+    """Placeholder in the combine driver's ``levels`` list for an
+    accumulator paged out of HBM: occupies the binomial-tree slot (so
+    carry order is unchanged) and names the spill-manager page holding
+    its bit-identical host/disk copy."""
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+
+class _CombineSpill:
+    """Out-of-core hooks for the streaming combine driver: the binomial
+    tree's idle levels are the driver's spillable cold state.  Registered
+    as a recovery-ladder victim (resilience/spill.py) so the ``spill``
+    rung can park every idle accumulator when evict/retry is spent, and
+    driven proactively after each carry when live accumulator bytes cross
+    the ``SRT_SPILL_WATERMARK`` fraction of ``SRT_SERVE_HBM_BUDGET``.
+    Paged levels come back bit-identical through :meth:`ensure_live`
+    right before they are merged, so the fold order — and therefore the
+    result — is exactly the ``SRT_SPILL=0`` oracle's."""
+
+    def __init__(self):
+        from ..resilience.spill import spill_manager
+        self.mgr = spill_manager()
+        self.levels = None
+        self.busy: set = set()      # level indexes a merge is reading
+        self._seq = 0
+        self._tag = f"stream-levels:{id(self)}"
+
+    def attach(self, levels: list) -> None:
+        self.levels = levels
+        if self.mgr.enabled:
+            self.mgr.register_victim(self._tag, self.page_out_idle)
+
+    def ensure_live(self, i: int):
+        """Page level ``i`` back onto the device if it was parked."""
+        lv = self.levels[i]
+        if isinstance(lv, _SpilledLevel):
+            lv = self.mgr.page_in(lv.key)
+            self.levels[i] = lv
+        return lv
+
+    def page_out_idle(self) -> int:
+        """Victim callback: park every live level no merge is reading.
+        Returns device bytes freed."""
+        if self.levels is None:
+            return 0
+        import jax
+        freed = 0
+        for i, lv in enumerate(self.levels):
+            if (lv is None or isinstance(lv, _SpilledLevel)
+                    or i in self.busy):
+                continue
+            jax.block_until_ready(lv)
+            self._seq += 1
+            key = (self._tag, i, self._seq)
+            freed += self.mgr.page_out(key, lv)
+            self.levels[i] = _SpilledLevel(key)
+        return freed
+
+    def maybe_page_out(self, hot: int) -> None:
+        """Proactive paging after a carry: when the live accumulator
+        bytes cross the watermark, park everything except the level just
+        written (the next carry's first merge input)."""
+        if self.levels is None or not self.mgr.enabled:
+            return
+        import jax
+        live = 0
+        for lv in self.levels:
+            if lv is None or isinstance(lv, _SpilledLevel):
+                continue
+            live += sum(int(getattr(leaf, "nbytes", 0))
+                        for leaf in jax.tree_util.tree_leaves(lv))
+        if not self.mgr.over_watermark(live):
+            return
+        self.busy.add(hot)
+        try:
+            self.page_out_idle()
+        finally:
+            self.busy.discard(hot)
+
+    def close(self) -> None:
+        self.mgr.unregister_victim(self._tag)
+        if self.levels is not None:
+            for lv in self.levels:
+                if isinstance(lv, _SpilledLevel):
+                    self.mgr.drop_page(lv.key)
+
+
 def _drive_combine(plan, source, k: int, acct: _Account,
                    strict: bool) -> Iterator:
+    """Streaming combine with out-of-core spill: delegates to
+    :func:`_drive_combine_inner` under a :class:`_CombineSpill` whose
+    victim registration is always torn down (and abandoned pages
+    dropped), however the generator exits."""
+    spill = _CombineSpill()
+    try:
+        yield from _drive_combine_inner(plan, source, k, acct, strict,
+                                        spill)
+    finally:
+        spill.close()
+
+
+def _drive_combine_inner(plan, source, k: int, acct: _Account,
+                         strict: bool, spill: _CombineSpill) -> Iterator:
     """Streaming combine: per-batch partial accumulators fold into a
     binomial tree (level i holds 2^i batches' worth), bounding both the
     number of live accumulator sets (log2 of the stream) and the
@@ -616,7 +720,8 @@ def _drive_combine(plan, source, k: int, acct: _Account,
     from .compile import (_bind, compiled_stream_partial, run_plan_eager,
                           stream_combine, stream_finalize)
 
-    levels: list = []           # levels[i]: acc of 2^i batches, or None
+    levels: list = []           # levels[i]: acc of 2^i batches, None, or
+    spill.attach(levels)        # a _SpilledLevel parked out of HBM
     bound0 = smeta = dtypes = None
     last_empty = None
     consumed: list = []         # batches seen before viability is decided
@@ -625,9 +730,10 @@ def _drive_combine(plan, source, k: int, acct: _Account,
 
     def drain_levels():
         """Recovery hook: force the whole accumulator tree to finish so
-        its transient dispatch scratch frees before a retry."""
+        its transient dispatch scratch frees before a retry.  Parked
+        levels are host/disk-side — nothing in flight to wait on."""
         for lv in levels:
-            if lv is not None:
+            if lv is not None and not isinstance(lv, _SpilledLevel):
                 jax.block_until_ready(lv)
 
     def split_partial(batch):
@@ -735,14 +841,21 @@ def _drive_combine(plan, source, k: int, acct: _Account,
         merge = stream_combine()
         i = 0
         while i < len(levels) and levels[i] is not None:
-            lv, acc_in = levels[i], acc
-            with _tspan("stream.combine", cat="stream", step_kind="dispatch",
-                        lane="combine", level=i, batch=bi):
-                acc = oom_ladder(
-                    "stream-combine",
-                    lambda lv=lv, a=acc_in: (fault_point("stream-combine"),
-                                             merge(lv, a))[1],
-                    drain=drain_levels)
+            # busy-mark the slot so the spill victim (which the ladder
+            # below may fire) never pages out the level mid-merge.
+            spill.busy.add(i)
+            try:
+                lv, acc_in = spill.ensure_live(i), acc
+                with _tspan("stream.combine", cat="stream",
+                            step_kind="dispatch", lane="combine", level=i,
+                            batch=bi):
+                    acc = oom_ladder(
+                        "stream-combine",
+                        lambda lv=lv, a=acc_in: (
+                            fault_point("stream-combine"), merge(lv, a))[1],
+                        drain=drain_levels)
+            finally:
+                spill.busy.discard(i)
             levels[i] = None
             i += 1
         if i == len(levels):
@@ -761,6 +874,7 @@ def _drive_combine(plan, source, k: int, acct: _Account,
                         level=i):
                 jax.block_until_ready(levels[i])
             since_block = 0
+        spill.maybe_page_out(i)
 
     if smeta is None:
         if last_empty is not None:      # schema known, zero groups
@@ -768,19 +882,25 @@ def _drive_combine(plan, source, k: int, acct: _Account,
         return
     total = None
     merge = stream_combine()
-    for lv in levels:
-        if lv is None:
+    for i in range(len(levels)):
+        if levels[i] is None:
             continue
-        if total is None:
-            total = lv
-            continue
-        t, l = total, lv
-        with _tspan("stream.combine", cat="stream", step_kind="dispatch",
-                lane="combine"):
-            total = oom_ladder(
-                "stream-combine",
-                lambda t=t, l=l: (fault_point("stream-combine"),
-                                  merge(t, l))[1])
+        spill.busy.add(i)
+        try:
+            lv = spill.ensure_live(i)
+            levels[i] = None    # ``total`` owns it now; never re-spill
+            if total is None:
+                total = lv
+                continue
+            t, l = total, lv
+            with _tspan("stream.combine", cat="stream",
+                        step_kind="dispatch", lane="combine"):
+                total = oom_ladder(
+                    "stream-combine",
+                    lambda t=t, l=l: (fault_point("stream-combine"),
+                                      merge(t, l))[1])
+        finally:
+            spill.busy.discard(i)
     t0 = _time.perf_counter()
     with _tspan("stream.finalize", cat="stream", step_kind="materialize",
                 lane="combine"):

@@ -1,0 +1,237 @@
+"""Parquet spill-file store — the disk tier of out-of-core execution.
+
+The spill manager (resilience/spill.py) pages cold device partitions to
+host RAM first; when the host tier's ``SRT_SPILL_HOST_BYTES`` cap
+overflows, the oldest pages land here as Parquet files in
+``SRT_SPILL_DIR``.  Each page is an arbitrary pytree's leaves: one
+Parquet row per leaf, carrying the raw little-endian bytes, the dtype
+string, and the shape — enough to reconstruct every numpy array exactly
+(bit-identical round trip) without the store knowing anything about
+Tables or accumulators.
+
+Robustness contract (mirrors io/feed.py's ``_read_retry``):
+
+  * every write/read runs under :func:`~..resilience.with_retries`
+    against transient-IO classification, with seeded fault sites
+    ``spill-write`` / ``spill-read`` (``SRT_FAULT=io:spill-write:N``);
+  * when ``SRT_STREAM_TIMEOUT`` is set, each attempt additionally runs
+    under the stall watchdog (:func:`~..resilience.dist_guard`), so a
+    wedged disk raises a named ``DistStallError`` instead of hanging
+    the ladder;
+  * writes are atomic (tmp + ``os.replace``) — a crash mid-write leaves
+    a ``.tmp`` orphan, never a truncated page;
+  * the directory is count- and byte-capped; overflow raises
+    :class:`SpillCapacityError` (fatal-classified), the honest-failure
+    path;
+  * filenames embed the owning pid (``srt-spill-<pid>-<n>.parquet``)
+    and startup sweeps only orphans whose pid is DEAD, so concurrent
+    processes share one spill directory safely.
+
+Heavy imports (pyarrow, numpy) are function-local: importing this module
+costs nothing on hosts that never spill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..config import spill_dir, stream_timeout
+from ..resilience import CATEGORY_IO, dist_guard, fault_point, with_retries
+
+#: Most spill files the store keeps before refusing (honest failure
+#: instead of filling a disk); constructor-overridable.
+MAX_SPILL_FILES = 1024
+
+#: Byte cap across all live spill files; constructor-overridable.
+MAX_SPILL_BYTES = 16 << 30
+
+_FILE_PREFIX = "srt-spill-"
+_FILE_SUFFIX = ".parquet"
+
+
+class SpillCapacityError(ValueError):
+    """The spill directory's count/byte cap is exhausted — deliberately
+    a ``ValueError`` (fatal-classified): retrying cannot free disk, so
+    the ladder fails honestly naming the cap."""
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True            # alive, owned by someone else
+    except OSError:
+        return True            # unknowable: never delete a maybe-live file
+    return True
+
+
+def _guarded_io(site: str, fn):
+    """One spill IO attempt: fault site + stall watchdog inside a
+    transient-IO retry loop.  The watchdog sits INSIDE the retry so a
+    stall-injected attempt raises the fatal ``DistStallError`` straight
+    through ``with_retries`` (no retry into the same wedge), while
+    io-classified flakes are retried with backoff."""
+    def attempt():
+        def body():
+            fault_point(site)
+            return fn()
+        return dist_guard(site, body, timeout=stream_timeout())
+    return with_retries(attempt, retryable=(CATEGORY_IO,), site=site)
+
+
+class SpillFileStore:
+    """Capped, atomic, crash-safe Parquet page files in one directory."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_files: int = MAX_SPILL_FILES,
+                 max_bytes: int = MAX_SPILL_BYTES):
+        self.directory = directory or spill_dir()
+        self.max_files = int(max_files)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._live: Dict[str, int] = {}          # path -> nbytes on disk
+        os.makedirs(self.directory, exist_ok=True)
+        self.orphans_swept = self._sweep_orphans()
+
+    # -- startup hygiene -------------------------------------------------
+
+    def _sweep_orphans(self) -> int:
+        """Remove spill files (and ``.tmp`` partials) left by DEAD
+        processes; live pids' files are never touched."""
+        swept = 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith(_FILE_PREFIX):
+                continue
+            stem = name
+            for suffix in (_FILE_SUFFIX + ".tmp", _FILE_SUFFIX):
+                if stem.endswith(suffix):
+                    stem = stem[len(_FILE_PREFIX):-len(suffix)]
+                    break
+            else:
+                continue
+            try:
+                pid = int(stem.split("-", 1)[0])
+            except ValueError:
+                continue
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            try:
+                os.unlink(os.path.join(self.directory, name))
+                swept += 1
+            except OSError:
+                pass
+        if swept:
+            from ..obs.metrics import counter
+            counter("spill.orphans_swept").inc(swept)
+        return swept
+
+    # -- page IO ---------------------------------------------------------
+
+    def write(self, np_leaves: List) -> Tuple[str, int]:
+        """Persist one page's leaves; returns ``(path, disk_bytes)``.
+
+        Atomic: the page file either exists complete or not at all.
+        Raises :class:`SpillCapacityError` when the directory caps are
+        exhausted (fatal — counted on ``spill.cap_refusals``).
+        """
+        payload_bytes = sum(int(leaf.nbytes) for leaf in np_leaves)
+        with self._lock:
+            if (len(self._live) >= self.max_files
+                    or sum(self._live.values()) + payload_bytes
+                    > self.max_bytes):
+                from ..obs.metrics import counter
+                counter("spill.cap_refusals").inc()
+                raise SpillCapacityError(
+                    f"spill directory {self.directory!r} is full "
+                    f"({len(self._live)} files / "
+                    f"{sum(self._live.values())} bytes; caps "
+                    f"{self.max_files} files / {self.max_bytes} bytes) — "
+                    f"cannot page out {payload_bytes} more bytes")
+            self._seq += 1
+            name = f"{_FILE_PREFIX}{os.getpid()}-{self._seq}{_FILE_SUFFIX}"
+            path = os.path.join(self.directory, name)
+            # Reserve the slot before the (retryable) IO so concurrent
+            # writers never race the caps.
+            self._live[path] = payload_bytes
+
+        def _write():
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+            table = pa.table({
+                "data": pa.array([leaf.tobytes() for leaf in np_leaves],
+                                 type=pa.binary()),
+                "dtype": pa.array([str(leaf.dtype) for leaf in np_leaves]),
+                "shape": pa.array([json.dumps(list(leaf.shape))
+                                   for leaf in np_leaves]),
+            })
+            tmp = path + ".tmp"
+            pq.write_table(table, tmp, compression="snappy")
+            os.replace(tmp, path)
+            return os.path.getsize(path)
+
+        try:
+            disk_bytes = _guarded_io("spill-write", _write)
+        except BaseException:
+            with self._lock:
+                self._live.pop(path, None)
+            try:
+                os.unlink(path + ".tmp")
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            self._live[path] = int(disk_bytes)
+        self._publish_gauges()
+        return path, int(disk_bytes)
+
+    def read(self, path: str) -> List:
+        """Reconstruct one page's numpy leaves exactly as written."""
+        def _read():
+            import numpy as np
+            import pyarrow.parquet as pq
+            table = pq.read_table(path)
+            datas = table.column("data").to_pylist()
+            dtypes = table.column("dtype").to_pylist()
+            shapes = table.column("shape").to_pylist()
+            return [np.frombuffer(d, dtype=np.dtype(t))
+                    .reshape(json.loads(s))
+                    for d, t, s in zip(datas, dtypes, shapes)]
+        return _guarded_io("spill-read", _read)
+
+    def remove(self, path: str) -> None:
+        """Drop a page file (after page-in, or on reset)."""
+        with self._lock:
+            self._live.pop(path, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._publish_gauges()
+
+    # -- accounting ------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"files": len(self._live),
+                    "bytes": sum(self._live.values()),
+                    "orphans_swept": self.orphans_swept}
+
+    def _publish_gauges(self) -> None:
+        from ..obs.metrics import gauge
+        s = self.stats()
+        gauge("spill.files").set(s["files"])
+        gauge("spill.file_bytes").set(s["bytes"])
+
+
+__all__ = ["MAX_SPILL_BYTES", "MAX_SPILL_FILES", "SpillCapacityError",
+           "SpillFileStore"]

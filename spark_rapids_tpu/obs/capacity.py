@@ -417,6 +417,25 @@ def recommend(snap: Dict[str, Any],
                 "target_hbm_headroom": t["hbm_headroom"],
             },
         })
+    # The spill block is attached by the ambient snapshot() wrapper, not
+    # by the pure derive() — absent (unit-test snapshots) means no rule.
+    spill = snap.get("spill") or {}
+    if spill.get("bytes_out", 0) > 0:
+        out.append({
+            "action": "spill_pressure", "severity": 65,
+            "reason": "queries are paging working sets out of HBM "
+                      "(SRT_SPILL) — throughput is paying disk/host "
+                      "page-in wall; grow SRT_SERVE_HBM_BUDGET or shed "
+                      "concurrent heavy queries",
+            "evidence": {
+                "spill_pages_out": spill.get("pages_out", 0),
+                "spill_bytes_out": spill.get("bytes_out", 0),
+                "spill_bytes_in": spill.get("bytes_in", 0),
+                "spill_files": spill.get("files", 0),
+                "page_in_seconds": spill.get("page_in_seconds", 0.0),
+                "budget_bytes": adm["budget_bytes"],
+            },
+        })
     if not snap["result_cache_on"] and snap["repeated_fingerprints"]:
         out.append({
             "action": "enable_result_cache", "severity": 60,
@@ -534,10 +553,28 @@ def snapshot(window_s: Optional[float] = None) -> Dict[str, Any]:
     window = capacity_window_s() if window_s is None else float(window_s)
     w1 = _now()
     w0 = w1 - window
-    return derive(window_events(w0, w1), w0, w1,
+    snap = derive(window_events(w0, w1), w0, w1,
                   max_concurrent=serve_max_concurrent(),
                   hbm_budget=serve_hbm_budget(),
                   result_cache_on=result_cache_bytes() is not None)
+    # Out-of-core view, attached HERE (not in the pure derive()): the
+    # spill totals live in the process-wide recovery stats, not in the
+    # windowed event rings.  Guarded so a broken stats read never takes
+    # the saturation snapshot down with it.
+    try:
+        from ..resilience import recovery_stats
+        s = recovery_stats().snapshot()
+        snap["spill"] = {
+            "pages_out": int(s["spill_pages_out"]),
+            "pages_in": int(s["spill_pages_in"]),
+            "bytes_out": int(s["spill_bytes_out"]),
+            "bytes_in": int(s["spill_bytes_in"]),
+            "files": int(s["spill_files"]),
+            "page_in_seconds": round(float(s["spill_page_in_seconds"]), 6),
+        }
+    except Exception:  # pragma: no cover - defensive
+        snap["spill"] = None
+    return snap
 
 
 def advise(window_s: Optional[float] = None,

@@ -163,6 +163,22 @@ def _cost_findings(qm: dict, base: Optional[dict]) -> List[Dict[str, Any]]:
             f"backoff={rec.get('backoff_seconds', 0.0):.3f}s, "
             f"cache_evictions={rec.get('cache_evictions', 0)} — HBM "
             f"pressure even though the query completed"))
+    spill = rec.get("spill") or {}
+    if spill.get("bytes_out", 0) > 0:
+        pages_out = spill.get("pages_out", 0)
+        pages_in = spill.get("pages_in", 0)
+        thrashed = pages_in > pages_out  # some page cycled out AND back >1x
+        title = ("this query thrashed the spill cache"
+                 if thrashed else
+                 "this query ran out-of-core (spill engaged)")
+        out.append(_finding(
+            70 if thrashed else 55, title,
+            f"{spill.get('bytes_out', 0)} bytes paged out over "
+            f"{pages_out} pages, {pages_in} paged back in "
+            f"({spill.get('files', 0)} spill files, "
+            f"page_in={spill.get('page_in_seconds', 0.0):.3f}s) — the "
+            f"working set exceeds SRT_SERVE_HBM_BUDGET; grow the budget "
+            f"or raise SRT_SPILL_HOST_BYTES to keep pages off disk"))
     return out
 
 

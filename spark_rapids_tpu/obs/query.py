@@ -154,6 +154,15 @@ class QueryMetrics:
     recovery_dist_splits: int = 0       # per-shard capacity halvings
     recovery_dist_fallbacks: int = 0    # SRT_DIST_FALLBACK=collect rungs
     recovery_dist_evictions: int = 0
+    # -- out-of-core share (resilience/spill.py; zero unless SRT_SPILL
+    # engaged): pages/bytes that left HBM and came back, spill files
+    # written, and the wall spent paging back in.
+    recovery_spill_pages_out: int = 0
+    recovery_spill_pages_in: int = 0
+    recovery_spill_bytes_out: int = 0
+    recovery_spill_bytes_in: int = 0
+    recovery_spill_files: int = 0
+    recovery_spill_page_in_seconds: float = 0.0
     # -- cost ledger inputs (obs/profile.py; filled by a CostCollector
     # over the metered run, zero/empty when nothing was collected) ------
     cost_analysis_available: bool = False   # XLA cost_analysis() worked
@@ -209,6 +218,13 @@ class QueryMetrics:
         self.recovery_dist_splits = int(delta.get("dist_splits", 0))
         self.recovery_dist_fallbacks = int(delta.get("dist_fallbacks", 0))
         self.recovery_dist_evictions = int(delta.get("dist_evictions", 0))
+        self.recovery_spill_pages_out = int(delta.get("spill_pages_out", 0))
+        self.recovery_spill_pages_in = int(delta.get("spill_pages_in", 0))
+        self.recovery_spill_bytes_out = int(delta.get("spill_bytes_out", 0))
+        self.recovery_spill_bytes_in = int(delta.get("spill_bytes_in", 0))
+        self.recovery_spill_files = int(delta.get("spill_files", 0))
+        self.recovery_spill_page_in_seconds = float(
+            delta.get("spill_page_in_seconds", 0.0))
 
     def apply_opt(self, info) -> None:
         """Fold an optimizer record (exec/optimize.OptInfo) into the opt
@@ -244,7 +260,10 @@ class QueryMetrics:
             # v10: added the always-present "serve" block (queue wait,
             #     admission outcome, result-cache status, scheduler
             #     policy — empty/zero outside a QuerySession).
-            "schema_version": 10,
+            # v11: added "recovery.spill" (the out-of-core share:
+            #     pages/bytes paged out of HBM and back, spill files
+            #     written, page-in wall — zero unless SRT_SPILL engaged).
+            "schema_version": 11,
             "metric": "query_metrics",
             "query_id": self.query_id,
             "fingerprint": self.fingerprint,
@@ -300,6 +319,19 @@ class QueryMetrics:
                     "splits": self.recovery_dist_splits,
                     "fallbacks": self.recovery_dist_fallbacks,
                     "cache_evictions": self.recovery_dist_evictions,
+                },
+                # Out-of-core share (always present, zero unless the
+                # spill rung / proactive watermark engaged): nonzero
+                # bytes_out with bytes_in proves pages left HBM and came
+                # back — the query ran larger than memory.
+                "spill": {
+                    "pages_out": self.recovery_spill_pages_out,
+                    "pages_in": self.recovery_spill_pages_in,
+                    "bytes_out": self.recovery_spill_bytes_out,
+                    "bytes_in": self.recovery_spill_bytes_in,
+                    "files": self.recovery_spill_files,
+                    "page_in_seconds": round(
+                        self.recovery_spill_page_in_seconds, 6),
                 },
             },
             # Always present (zeroed on a non-pruning run): the scan
@@ -402,6 +434,14 @@ class QueryMetrics:
                 f"splits={self.recovery_dist_splits} "
                 f"fallbacks={self.recovery_dist_fallbacks} "
                 f"cache_evictions={self.recovery_dist_evictions}")
+        if self.recovery_spill_pages_out:
+            lines.append(
+                f"  recovery.spill: pages={self.recovery_spill_pages_out}"
+                f"/{self.recovery_spill_pages_in} "
+                f"bytes={self.recovery_spill_bytes_out}"
+                f"/{self.recovery_spill_bytes_in} "
+                f"files={self.recovery_spill_files} "
+                f"page_in={_ms(self.recovery_spill_page_in_seconds)}")
         n = len(self.steps)
         for i, s in enumerate(self.steps):
             branch = "└─" if i == n - 1 else "├─"
@@ -586,6 +626,34 @@ def _recovery_payload() -> dict:
             "fallbacks": int(snap["dist_fallbacks"]),
             "cache_evictions": int(snap["dist_evictions"]),
         },
+        "spill": {
+            "pages_out": int(snap["spill_pages_out"]),
+            "pages_in": int(snap["spill_pages_in"]),
+            "bytes_out": int(snap["spill_bytes_out"]),
+            "bytes_in": int(snap["spill_bytes_in"]),
+            "files": int(snap["spill_files"]),
+            "page_in_seconds": round(
+                float(snap["spill_page_in_seconds"]), 6),
+        },
+    }
+
+
+def _spill_payload() -> dict:
+    """Payload for ``bench_line("spill")``: the process-lifetime
+    out-of-core totals — pages/bytes paged out of HBM and back, spill
+    files written, page-in wall.  ``bench_queries.py --spill`` merges
+    its measured oracle-vs-spilled walls and parity verdict into this
+    payload before emitting its one line."""
+    from ..resilience import recovery_stats
+    snap = recovery_stats().snapshot()
+    return {
+        "metric": "spill",
+        "pages_out": int(snap["spill_pages_out"]),
+        "pages_in": int(snap["spill_pages_in"]),
+        "bytes_out": int(snap["spill_bytes_out"]),
+        "bytes_in": int(snap["spill_bytes_in"]),
+        "files": int(snap["spill_files"]),
+        "page_in_seconds": round(float(snap["spill_page_in_seconds"]), 6),
     }
 
 
@@ -685,6 +753,7 @@ _BENCH_PAYLOADS = {
     "stream": _stream_payload,
     "dist_stream": _dist_stream_payload,
     "recovery": _recovery_payload,
+    "spill": _spill_payload,
     "regress": _regress_payload,
     "encoded_scan": _encoded_scan_payload,
     "serving": _serving_payload,
@@ -699,6 +768,7 @@ def bench_line(kind: str) -> str:
     ``"cache"`` (compile cache + bucketing), ``"stream"`` (last streaming
     run), ``"dist_stream"`` (sharded-stream view of the last streaming
     run), ``"recovery"`` (process-lifetime resilience totals),
+    ``"spill"`` (process-lifetime out-of-core paging totals),
     ``"regress"`` (perf-regression report vs the metrics history),
     ``"encoded_scan"`` (scan pruning / encoded-residency totals),
     ``"serving"`` (serving-layer admission/result-cache totals),

@@ -50,12 +50,15 @@ from .classify import (CATEGORY_COMPILE, CATEGORY_FATAL, CATEGORY_IO,
                        StreamStallError, classify)
 from .faults import InjectedFault, fault_point, reset_faults
 from .retry import (RecoveryStats, RetryPolicy, recovery_stats, with_retries)
+from .spill import (SpillManager, maybe_proactive_spill, reset_spill,
+                    spill_manager)
 from .watchdog import dist_guard
 
 __all__ = [
     "CATEGORY_COMPILE", "CATEGORY_FATAL", "CATEGORY_IO", "CATEGORY_OOM",
     "DistStallError", "ExecutionRecoveryError", "InjectedFault",
-    "RecoveryStats", "RecoverySummary", "RetryPolicy",
-    "ShuffleOverflowError", "StreamStallError", "classify", "dist_guard",
-    "fault_point", "recovery_stats", "reset_faults", "with_retries",
+    "RecoveryStats", "RecoverySummary", "RetryPolicy", "ShuffleOverflowError",
+    "SpillManager", "StreamStallError", "classify", "dist_guard",
+    "fault_point", "maybe_proactive_spill", "recovery_stats", "reset_faults",
+    "reset_spill", "spill_manager", "with_retries",
 ]

@@ -150,6 +150,29 @@ def oom_ladder(site: str, fn: Callable,
         except Exception as exc:
             if classify(exc) not in (CATEGORY_OOM, CATEGORY_COMPILE):
                 raise
+    # Terminal rung: spill-and-continue (SRT_SPILL).  Evict/backoff/retry
+    # is spent; before declaring exhaustion, page cold device state out
+    # through the spill manager (bucketing's last-touch pad caches plus
+    # any registered victims — e.g. a streaming driver's idle combine
+    # levels) and re-run ONCE against the freed HBM.  Default-off keeps
+    # the old fail-with-named-rungs behavior bit-for-bit.
+    from .spill import spill_manager
+    mgr = spill_manager()
+    if mgr.enabled:
+        with span("recovery.spill", cat="resilience", site=site):
+            freed = mgr.reclaim()
+        if freed > 0:
+            summary.steps.append(f"spill[{freed}]")
+            instant("recovery.spill", cat="resilience", site=site,
+                    freed=freed)
+            _live.rung("spill", site=site)
+            try:
+                return fn()
+            except Exception as exc:
+                if classify(exc) not in (CATEGORY_OOM, CATEGORY_COMPILE):
+                    raise
+        else:
+            summary.steps.append("spill-unavailable")
     err = ExecutionRecoveryError(site, summary)
     # The ladder is out of rungs: capture the postmortem HERE, while the
     # ring still holds the events leading up to the original OOM.  The

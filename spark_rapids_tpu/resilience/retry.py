@@ -48,7 +48,13 @@ class RecoveryStats:
 
     _FIELDS = ("retries", "splits", "cache_evictions", "backoff_seconds",
                "faults_injected", "dist_retries", "dist_splits",
-               "dist_fallbacks", "dist_evictions")
+               "dist_fallbacks", "dist_evictions", "spill_pages_out",
+               "spill_pages_in", "spill_bytes_out", "spill_bytes_in",
+               "spill_files", "spill_page_in_seconds")
+
+    #: Float-seconds fields whose mirrored counter counts OCCURRENCES,
+    #: not the (fractional) amount added to the stat.
+    _SECONDS_FIELDS = ("backoff_seconds", "spill_page_in_seconds")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -64,12 +70,21 @@ class RecoveryStats:
         self.dist_splits = 0
         self.dist_fallbacks = 0
         self.dist_evictions = 0
+        # Out-of-core view (resilience/spill.py): pages/bytes that left
+        # HBM and came back, spill files written, and page-in wall — the
+        # ``recovery.spill`` block of QueryMetrics.
+        self.spill_pages_out = 0
+        self.spill_pages_in = 0
+        self.spill_bytes_out = 0
+        self.spill_bytes_in = 0
+        self.spill_files = 0
+        self.spill_page_in_seconds = 0.0
 
     def _bump(self, name: str, amount, counter_name: str) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + amount)
         from ..obs.metrics import counter
-        counter(counter_name).inc(amount if name != "backoff_seconds"
+        counter(counter_name).inc(amount if name not in self._SECONDS_FIELDS
                                   else 1)
 
     def add_retry(self) -> None:
@@ -99,6 +114,20 @@ class RecoveryStats:
 
     def add_dist_evictions(self, n: int) -> None:
         self._bump("dist_evictions", n, "recovery.dist.cache_evictions")
+
+    def add_spill_page_out(self, nbytes: int) -> None:
+        self._bump("spill_pages_out", 1, "recovery.spill.pages_out")
+        self._bump("spill_bytes_out", nbytes, "recovery.spill.bytes_out")
+
+    def add_spill_page_in(self, nbytes: int, seconds: float) -> None:
+        self._bump("spill_pages_in", 1, "recovery.spill.pages_in")
+        self._bump("spill_bytes_in", nbytes, "recovery.spill.bytes_in")
+        if seconds > 0:
+            self._bump("spill_page_in_seconds", seconds,
+                       "recovery.spill.page_in_seconds")
+
+    def add_spill_file(self) -> None:
+        self._bump("spill_files", 1, "recovery.spill.files")
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:

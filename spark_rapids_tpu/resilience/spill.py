@@ -1,0 +1,298 @@
+"""Spill manager — out-of-core paging for the OOM ladder's terminal rung.
+
+When the recovery ladder's evict/backoff/split rungs are exhausted (and
+proactively, when serving admission sees claimed bytes crossing the
+``SRT_SPILL_WATERMARK`` fraction of ``SRT_SERVE_HBM_BUDGET``), this
+module pages cold partitions OUT of HBM — first into a byte-capped
+host-RAM LRU (``SRT_SPILL_HOST_BYTES``), overflowing oldest-first to
+Parquet spill files (io/spill.py, ``SRT_SPILL_DIR``) — and pages them
+back on demand, so a working set larger than HBM completes instead of
+failing.  Paged values are arbitrary jax pytrees (streaming-combine
+partial accumulators, bucket buffers, Tables): flatten → ``device_get``
+→ free the device buffers, and the reverse on page-in, so a paged-back
+value is bit-identical to the one paged out and folds through exactly
+the same compute (the ``SRT_SPILL=0`` oracle contract).
+
+Two integration surfaces:
+
+  * **pages** — :meth:`SpillManager.page_out` / :meth:`page_in`, used by
+    holders of cold state (exec/stream.py parks idle combine levels);
+  * **victims** — :meth:`register_victim` callbacks the ladder's
+    ``spill`` rung (:mod:`.recovery`) and admission's proactive path
+    drive via :meth:`reclaim`: each callback frees device bytes it owns
+    (the bucketing pad cache's last-touch LRU, a streaming driver's
+    idle levels) and returns how many.
+
+Everything lands in the ``recovery.spill.*`` stats/counters
+(:mod:`.retry`) — pages/bytes out and in, files, page-in seconds — the
+receipts QueryMetrics, the capacity advisor's ``spill_pressure`` rule,
+and the doctor's thrash finding are built from.
+
+jax-free at module import (the package rule): jax/numpy/pyarrow load
+only inside paging methods, at which point the engine is necessarily
+live.  With ``SRT_SPILL`` unset everything here is inert — the ladder
+keeps its old fail-with-named-rungs behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+from .retry import recovery_stats
+
+
+class _Page:
+    """One paged-out pytree: host leaves (or a disk path) + treedef."""
+    __slots__ = ("key", "leaves", "treedef", "nbytes", "path")
+
+    def __init__(self, key, leaves, treedef, nbytes):
+        self.key = key
+        self.leaves = leaves            # numpy leaves, or None once on disk
+        self.treedef = treedef
+        self.nbytes = nbytes
+        self.path: Optional[str] = None  # spill-file path once flushed
+
+
+class SpillManager:
+    """Process-wide two-tier (host RAM → Parquet) page store + victim
+    registry.  All methods are thread-safe; the serving scheduler's
+    workers and the recovery ladder share one instance."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pages: "OrderedDict[Any, _Page]" = OrderedDict()
+        self._victims: "OrderedDict[str, Callable]" = OrderedDict()
+        self._store = None
+        self._host_bytes = 0
+
+    # -- config reads (live, so tests can flip knobs per-case) -----------
+
+    @property
+    def enabled(self) -> bool:
+        from ..config import spill_enabled
+        return spill_enabled()
+
+    def over_watermark(self, live_bytes: int,
+                       budget: Optional[int] = None) -> bool:
+        """True when ``live_bytes`` crosses the proactive-spill
+        watermark of the serving HBM budget (both knobs must be set)."""
+        if not self.enabled:
+            return False
+        if budget is None:
+            from ..config import serve_hbm_budget
+            budget = serve_hbm_budget()
+        if not budget:
+            return False
+        from ..config import spill_watermark
+        return live_bytes > spill_watermark() * budget
+
+    def _file_store(self):
+        if self._store is None:
+            from ..io.spill import SpillFileStore
+            self._store = SpillFileStore()
+        return self._store
+
+    # -- paging ----------------------------------------------------------
+
+    def page_out(self, key: Any, value: Any) -> int:
+        """Move ``value`` (any jax pytree) out of HBM under ``key``;
+        returns device bytes freed.  The caller must treat ``value`` as
+        gone until :meth:`page_in` hands back its bit-identical twin."""
+        import jax
+        import numpy as np
+        from ..utils.memory import free
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        np_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+        free(*leaves)
+        nbytes = sum(int(leaf.nbytes) for leaf in np_leaves)
+        page = _Page(key, np_leaves, treedef, nbytes)
+        with self._lock:
+            old = self._pages.pop(key, None)
+            if old is not None:
+                self._drop_page_storage(old)
+            self._pages[key] = page
+            self._host_bytes += nbytes
+            self._flush_over_cap_locked()
+        stats = recovery_stats()
+        stats.add_spill_page_out(nbytes)
+        from ..obs.metrics import gauge
+        gauge("spill.host_bytes").set(self._host_bytes)
+        from ..obs.timeline import instant
+        instant("spill.page_out", cat="resilience", key=str(key),
+                nbytes=nbytes)
+        return nbytes
+
+    def page_in(self, key: Any) -> Any:
+        """Bring a page back as device arrays; removes the page (and its
+        spill file).  Raises ``KeyError`` for an unknown key."""
+        t0 = time.perf_counter()
+        with self._lock:
+            page = self._pages.pop(key)
+            if page.leaves is None:
+                # On disk: read outside the lock would be nicer, but the
+                # page is already ours (popped) — only the store syncs.
+                pass
+            else:
+                self._host_bytes -= page.nbytes
+        leaves = page.leaves
+        if leaves is None:
+            leaves = self._file_store().read(page.path)
+            self._file_store().remove(page.path)
+        import jax.numpy as jnp
+        device_leaves = [jnp.asarray(leaf) for leaf in leaves]
+        value = page.treedef.unflatten(device_leaves)
+        seconds = time.perf_counter() - t0
+        stats = recovery_stats()
+        stats.add_spill_page_in(page.nbytes, seconds)
+        from ..obs.metrics import gauge
+        gauge("spill.host_bytes").set(self._host_bytes)
+        from ..obs.timeline import instant
+        instant("spill.page_in", cat="resilience", key=str(key),
+                nbytes=page.nbytes, seconds=round(seconds, 6))
+        return value
+
+    def has_page(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._pages
+
+    def drop_page(self, key: Any) -> None:
+        """Discard a page without reviving it (owner abandoned the
+        value — e.g. a streaming driver torn down mid-query)."""
+        with self._lock:
+            page = self._pages.pop(key, None)
+            if page is not None:
+                self._drop_page_storage(page)
+
+    def _flush_over_cap_locked(self) -> None:
+        """Overflow oldest host pages to Parquet until under the
+        ``SRT_SPILL_HOST_BYTES`` cap (0 = disk-only: everything
+        flushes).  Caller holds the lock."""
+        from ..config import spill_host_bytes
+        cap = spill_host_bytes()
+        if self._host_bytes <= cap:
+            return
+        stats = recovery_stats()
+        for page in list(self._pages.values()):
+            if self._host_bytes <= cap:
+                break
+            if page.leaves is None:
+                continue
+            path, _ = self._file_store().write(page.leaves)
+            page.path = path
+            page.leaves = None
+            self._host_bytes -= page.nbytes
+            stats.add_spill_file()
+
+    def _drop_page_storage(self, page: _Page) -> None:
+        if page.leaves is not None:
+            self._host_bytes -= page.nbytes
+            page.leaves = None
+        elif page.path is not None:
+            self._file_store().remove(page.path)
+
+    # -- victims (the ladder's spill rung drives these) ------------------
+
+    def register_victim(self, name: str, fn: Callable[[], int]) -> None:
+        """Register a callback that frees device bytes it owns (pages
+        its cold state out through this manager, or drops recomputable
+        buffers) and returns how many it freed."""
+        with self._lock:
+            self._victims[name] = fn
+
+    def unregister_victim(self, name: str) -> None:
+        with self._lock:
+            self._victims.pop(name, None)
+
+    def reclaim(self, target_bytes: Optional[int] = None) -> int:
+        """The spill rung's body: free device bytes by dropping the
+        bucketing layer's last-touch pad/resident caches and running
+        every registered victim, until ``target_bytes`` is met (None =
+        free everything reclaimable).  Returns bytes freed."""
+        freed = 0
+        try:
+            from ..exec.bucketing import spill_pad_victims
+            freed += spill_pad_victims(target_bytes)
+        except ImportError:                      # pragma: no cover
+            pass
+        with self._lock:
+            victims = list(self._victims.items())
+        for name, fn in victims:
+            if target_bytes is not None and freed >= target_bytes:
+                break
+            try:
+                freed += int(fn() or 0)
+            except Exception:
+                # A broken victim must not turn one OOM into two
+                # failures; it just contributes nothing.
+                self.unregister_victim(name)
+        if freed:
+            from ..obs.metrics import counter
+            counter("spill.reclaimed_bytes").inc(freed)
+        return freed
+
+    # -- accounting / lifecycle ------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            on_disk = sum(1 for p in self._pages.values()
+                          if p.leaves is None)
+            return {"pages": len(self._pages),
+                    "pages_on_disk": on_disk,
+                    "host_bytes": self._host_bytes,
+                    "victims": len(self._victims)}
+
+    def reset(self) -> None:
+        """Drop all pages (removing their files) and victims — test and
+        bench isolation.  Parked state referencing dropped pages is the
+        caller's to forget."""
+        with self._lock:
+            pages = list(self._pages.values())
+            self._pages.clear()
+            self._host_bytes = 0
+            self._victims.clear()
+            store, self._store = self._store, None
+        if store is not None:
+            for page in pages:
+                if page.path is not None:
+                    store.remove(page.path)
+
+
+_MANAGER = SpillManager()
+
+
+def spill_manager() -> SpillManager:
+    """The process-wide spill manager."""
+    return _MANAGER
+
+
+def reset_spill() -> None:
+    """Reset the process-wide manager (test isolation)."""
+    _MANAGER.reset()
+
+
+def maybe_proactive_spill(projected_bytes: int,
+                          budget: Optional[int]) -> int:
+    """Admission's proactive hook: when ``projected_bytes`` (claimed +
+    the incoming estimate) crosses the watermark fraction of the
+    budget, reclaim enough to get back under it BEFORE the claim has to
+    wait.  Returns bytes freed (0 when spill is off or under the
+    watermark)."""
+    mgr = spill_manager()
+    if not mgr.over_watermark(projected_bytes, budget):
+        return 0
+    from ..config import spill_watermark
+    target = projected_bytes - int(spill_watermark() * budget)
+    freed = mgr.reclaim(target)
+    if freed:
+        from ..obs.metrics import counter
+        counter("spill.proactive").inc()
+        from ..obs import live as _live
+        _live.rung("spill-proactive", site="admission")
+    return freed
+
+
+__all__ = ["SpillManager", "maybe_proactive_spill", "reset_spill",
+           "spill_manager"]

@@ -62,8 +62,20 @@ class AdmissionController:
 
     def check(self, estimate: int) -> None:
         """Raise :class:`AdmissionRejected` when ``estimate`` alone can
-        never fit the budget."""
+        never fit the budget.
+
+        With out-of-core spill on (``SRT_SPILL=1``) the premise behind
+        rejection — a working set bigger than the budget can never run —
+        no longer holds: the spill rung pages cold partitions to
+        host/disk, so the query is admitted instead (counted on
+        ``serve.admission.spill_admitted``) and the ladder + spill
+        manager carry it through."""
         if self.budget is not None and estimate > self.budget:
+            from ..resilience.spill import spill_manager
+            if spill_manager().enabled:
+                from ..obs.metrics import counter
+                counter("serve.admission.spill_admitted").inc()
+                return
             from ..obs.metrics import counter
             counter("serve.admission.rejected").inc()
             from ..obs import capacity
@@ -85,6 +97,12 @@ class AdmissionController:
         waited = False
         from ..obs import capacity
         from ..obs.metrics import counter, gauge
+        # Proactive spill: if this claim would push us past the
+        # watermark, page cold device state out BEFORE queueing on HBM —
+        # free memory the claim can use instead of fighting running
+        # queries for it.  No-op unless SRT_SPILL is on.
+        from ..resilience.spill import maybe_proactive_spill
+        maybe_proactive_spill(self.claimed_bytes() + estimate, self.budget)
         with self._cond:
             while self._claimed and self._claimed + estimate > self.budget:
                 if not waited:

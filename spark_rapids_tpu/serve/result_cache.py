@@ -81,6 +81,19 @@ def result_nbytes(result: Any) -> int:
     return total
 
 
+def contains_deleted(value: Any) -> bool:
+    """True when any Table inside an executor result has had its device
+    buffers donated away (``Table.is_deleted``) — e.g. the streaming
+    executor donated a padded input mid-stream.  Such a value must never
+    be cached: a later hit would hand out dead buffers."""
+    tables = value if isinstance(value, (list, tuple)) else [value]
+    for t in tables:
+        is_deleted = getattr(t, "is_deleted", None)
+        if callable(is_deleted) and is_deleted():
+            return True
+    return False
+
+
 def _value_generations(value: Any) -> Tuple[int, ...]:
     """Generation stamps of every Table inside an executor result, in
     order — the snapshot taken at ``put`` and re-checked at ``get`` so a
@@ -131,6 +144,10 @@ class ResultCache:
 
     def put(self, key: Optional[Tuple], value: Any) -> None:
         if not self.enabled or key is None:
+            return
+        if contains_deleted(value):
+            from ..obs.metrics import counter
+            counter("serve.cache.refused_deleted").inc()
             return
         nbytes = result_nbytes(value)
         if nbytes <= 0 or nbytes > self.cap_bytes:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Iterable, List, Optional
 
@@ -55,7 +56,7 @@ class Ticket:
                  "submitted_unix", "queue_wait_seconds", "run_seconds",
                  "admission", "result_cache", "estimate", "metrics",
                  "_t_submit", "_event", "_result", "_error", "_thunk",
-                 "_cache_key")
+                 "_cache_key", "_session", "_finalizer", "__weakref__")
 
     def __init__(self, sub_id: int, fingerprint: str, mode: str,
                  weight: float):
@@ -77,9 +78,22 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self._thunk = None
         self._cache_key = None
+        self._session = None        # weakref.ref set by submit
+        self._finalizer = None      # claim-release guard set at acquire
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def cancel(self) -> bool:
+        """Withdraw a still-queued submission: the ticket resolves to a
+        cancellation error, its admission claim (if any) is freed, and
+        the worker pool never sees it.  Returns False when the query
+        already started running (or finished) — a running executor is
+        not interruptible."""
+        session = self._session() if self._session is not None else None
+        if session is None or self.done():
+            return False
+        return session._cancel_ticket(self)
 
     def result(self, timeout: Optional[float] = None) -> Any:
         """Block until the query finishes; re-raises its error (an
@@ -245,6 +259,7 @@ class QuerySession:
         else:
             mode = "dist_stream" if mesh is not None else "stream"
         t = Ticket(next(_SUBMISSION_IDS), fingerprint, mode, float(weight))
+        t._session = weakref.ref(self)
         counter("serve.submitted").inc()
 
         # Result cache: only identity-checkable inputs participate.
@@ -374,6 +389,11 @@ class QuerySession:
         # The HBM claim: blocks this worker until running claims fit.
         if self.admission.acquire(t.id, t.estimate):
             t.admission = info["admission"] = "queued"
+        # Ledger-leak guard: if the caller abandons the ticket (never
+        # re-joins ``result(timeout=)``) and it becomes garbage before a
+        # release ran, GC frees the claim.  ``release`` is idempotent,
+        # so the normal finally-path release below makes this a no-op.
+        t._finalizer = weakref.finalize(t, self.admission.release, t.id)
         _oq.set_serve_context(info)
         t0 = time.perf_counter()
         try:
@@ -398,11 +418,30 @@ class QuerySession:
             if gate is not None:
                 self._gate.unregister(t.id)
             self.admission.release(t.id)
+            if t._finalizer is not None:
+                t._finalizer.detach()
             t.run_seconds = time.perf_counter() - t0
             timer("serve.run").observe(t.run_seconds)
             t.metrics = info.get("qm")
             counter("serve.completed").inc()
             t._event.set()
+
+    def _cancel_ticket(self, t: Ticket) -> bool:
+        from ..obs.metrics import counter, gauge
+        with self._cond:
+            try:
+                self._queue.remove(t)
+            except ValueError:
+                return False        # a worker already claimed it
+            gauge("serve.queue_depth").set(len(self._queue))
+            from ..obs import capacity as _capacity
+            _capacity.feed_queue_depth(len(self._queue))
+        self.admission.release(t.id)
+        t.status = "cancelled"
+        t._error = RuntimeError(f"query {t.id} cancelled")
+        counter("serve.cancelled").inc()
+        t._event.set()
+        return True
 
     # -- introspection / lifecycle ---------------------------------------
 

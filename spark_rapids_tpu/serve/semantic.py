@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import (semantic_cache_bytes, semantic_cache_enabled,
                       views_auto, views_enabled)
-from .result_cache import input_digest, result_nbytes
+from .result_cache import contains_deleted, input_digest, result_nbytes
 
 #: A prefix must be wanted by this many submissions before it is
 #: materialized (1 for advisor-confirmed prefixes — the policy loop's
@@ -141,10 +141,14 @@ class SemanticCache:
 
     def put(self, key: str, prefix_fp: str, value: Any) -> bool:
         """Store a materialized prefix; False when it cannot be cached
-        (unmeasurable, larger than the cap, or denied an HBM claim by
-        the admission controller)."""
-        nbytes = result_nbytes(value[0] if isinstance(value, tuple)
-                               else value)
+        (buffers already donated away, unmeasurable, larger than the
+        cap, or denied an HBM claim by the admission controller)."""
+        payload = value[0] if isinstance(value, tuple) else value
+        if contains_deleted(payload):
+            from ..obs.metrics import counter
+            counter("serve.cache.refused_deleted").inc()
+            return False
+        nbytes = result_nbytes(payload)
         if nbytes <= 0 or nbytes > self.cap_bytes:
             return False
         if self.admission is not None \

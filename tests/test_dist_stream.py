@@ -295,7 +295,7 @@ class TestShardedStreamObservability:
                                     combine=True))
         payload = json.loads(last_stream_metrics().to_json())
         assert payload["mode"] == "dist_stream"
-        assert payload["schema_version"] == 10
+        assert payload["schema_version"] == 11
         s = payload["stream"]
         assert s["shards"] == 8
         assert s["merge_collectives"] == 1

@@ -557,7 +557,7 @@ class TestDistCompileCache:
         _rep_plan().run_dist(shard_table(t, mesh), mesh)
         payload = json.loads(last_query_metrics().to_json())
         assert payload["mode"] == "dist"
-        assert payload["schema_version"] == 10
+        assert payload["schema_version"] == 11
         rec = payload["recovery"]["dist"]
         assert rec["retries"] >= 1 and rec["cache_evictions"] >= 1
         assert "recovery.dist:" in last_query_metrics().render()

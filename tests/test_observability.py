@@ -262,7 +262,7 @@ def test_query_metrics_json_round_trips(metrics_on):
     t = _table("js")
     _query("js").explain_analyze(t)
     payload = json.loads(last_query_metrics().to_json())
-    assert payload["schema_version"] == 10
+    assert payload["schema_version"] == 11
     assert payload["metric"] == "query_metrics"
     assert payload["output"]["rows"] == 7
     # bind-time stats probe + materialize count (first run of this table)

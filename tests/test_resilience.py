@@ -271,7 +271,7 @@ class TestRunPlanRecovery:
         reset_faults()
         assert run_plan(p, t).to_pydict() == oracle
         payload = json.loads(last_query_metrics().to_json())
-        assert payload["schema_version"] == 10
+        assert payload["schema_version"] == 11
         rec = payload["recovery"]
         assert rec["retries"] >= 1
         assert rec["cache_evictions"] >= 1
@@ -284,7 +284,10 @@ class TestRunPlanRecovery:
         assert rec == {"retries": 0, "splits": 0, "cache_evictions": 0,
                        "backoff_seconds": 0.0,
                        "dist": {"retries": 0, "splits": 0, "fallbacks": 0,
-                                "cache_evictions": 0}}
+                                "cache_evictions": 0},
+                       "spill": {"pages_out": 0, "pages_in": 0,
+                                 "bytes_out": 0, "bytes_in": 0, "files": 0,
+                                 "page_in_seconds": 0.0}}
 
     def test_concat_split_across_bucket_boundary(self, monkeypatch):
         # 150 rows straddles buckets (64/88/120/160): the snapped cut at

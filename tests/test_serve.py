@@ -170,7 +170,7 @@ class TestServeMetrics:
         t.result(timeout=300)
         assert t.metrics is not None
         d = t.metrics.to_dict()
-        assert d["schema_version"] == 10
+        assert d["schema_version"] == 11
         assert d["serve"]["policy"] == "rr"
         assert d["serve"]["admission"] in ("admitted", "queued")
         assert d["serve"]["queue_wait_seconds"] >= 0.0

@@ -1,0 +1,587 @@
+"""Out-of-core spill: the OOM ladder's terminal rung pages cold
+partitions to host RAM / Parquet and back (resilience/spill.py +
+io/spill.py), so a working set larger than the HBM budget completes
+bit-identical to the unspilled oracle (``SRT_SPILL=0``).
+
+Covers: the four ``SRT_SPILL*`` knobs (knob-named ``ValueError``\\ s),
+manager paging round trips through both tiers, the spill-file store's
+atomic capped Parquet pages + dead-pid orphan sweep, the ladder's named
+``spill`` rung (engaged, exhausted, and default-off), postmortem bundles
+naming the rung, seeded spill-IO faults (``io:spill-write`` /
+``io:spill-read`` retried bit-identical; ``stall`` fails honestly via
+the watchdog instead of hanging), the end-to-end streaming group-by
+oracle parity with ``recovery.spill.*`` receipts, admission's
+spill-instead-of-reject + proactive watermark, and the two satellite
+bugfixes (donated-Table cache refusals; ticket cancel / GC releasing
+the admission claim ledger).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import threading
+import weakref
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import Table
+from spark_rapids_tpu.exec import col, plan
+from spark_rapids_tpu.io.spill import (SpillCapacityError, SpillFileStore)
+from spark_rapids_tpu.obs import last_stream_metrics, registry
+from spark_rapids_tpu.resilience import (DistStallError, classify,
+                                         fault_point, recovery_stats,
+                                         reset_faults, reset_spill,
+                                         spill_manager)
+from spark_rapids_tpu.resilience.recovery import oom_ladder
+from spark_rapids_tpu.serve.admission import (AdmissionController,
+                                              AdmissionRejected)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for knob in ("SRT_FAULT", "SRT_SPILL", "SRT_SPILL_DIR",
+                 "SRT_SPILL_HOST_BYTES", "SRT_SPILL_WATERMARK",
+                 "SRT_SERVE_HBM_BUDGET", "SRT_STREAM_TIMEOUT"):
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setenv("SRT_RETRY_BACKOFF", "0")
+    # Pad-cache leftovers from earlier test files are legitimate spill
+    # victims — clear them so byte-exact reclaim assertions hold.
+    from spark_rapids_tpu.exec.bucketing import clear_pad_cache
+    clear_pad_cache()
+    reset_faults()
+    reset_spill()
+    yield
+    reset_faults()
+    reset_spill()
+
+
+@pytest.fixture
+def metrics_on(monkeypatch):
+    monkeypatch.setenv("SRT_METRICS", "1")
+    registry().reset()
+    yield
+    registry().reset()
+
+
+@pytest.fixture
+def spill_on(monkeypatch, tmp_path):
+    monkeypatch.setenv("SRT_SPILL", "1")
+    monkeypatch.setenv("SRT_SPILL_DIR", str(tmp_path / "spill"))
+    yield tmp_path / "spill"
+
+
+def _mk(n, seed=0, hi=3):
+    r = np.random.default_rng(seed)
+    return Table.from_pydict({"k": r.integers(0, hi, n),
+                              "v": r.integers(0, 100, n)})
+
+
+def _value(seed=0):
+    import jax.numpy as jnp
+    r = np.random.default_rng(seed)
+    return {"a": jnp.asarray(r.integers(0, 1000, 500)),
+            "b": jnp.asarray(r.random((20, 30), dtype=np.float32))}
+
+
+def _np_eq(a, b):
+    fa = [np.asarray(x) for x in _leaves(a)]
+    fb = [np.asarray(x) for x in _leaves(b)]
+    return len(fa) == len(fb) and all(
+        x.dtype == y.dtype and x.shape == y.shape and np.array_equal(x, y)
+        for x, y in zip(fa, fb))
+
+
+def _leaves(v):
+    import jax
+    return jax.tree_util.tree_leaves(v)
+
+
+AGGS = [("v", "sum", "vs"), ("v", "count", "vc"), ("v", "mean", "vm"),
+        ("v", "min", "vlo"), ("v", "max", "vhi")]
+
+
+def _agg_plan():
+    return plan().groupby_agg(["k"], AGGS, domains={"k": (0, 2)})
+
+
+def _combine(sizes=(60, 64, 89, 100, 33, 77, 55, 120)):
+    batches = [_mk(n, s) for s, n in enumerate(sizes)]
+    outs = list(_agg_plan().run_stream(iter(batches), inflight=2,
+                                       combine=True))
+    assert len(outs) == 1
+    return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# 1. knobs
+# ---------------------------------------------------------------------------
+
+class TestKnobs:
+    def test_defaults(self):
+        from spark_rapids_tpu.config import (spill_dir, spill_enabled,
+                                             spill_host_bytes,
+                                             spill_watermark)
+        assert spill_enabled() is False
+        assert spill_dir().endswith("srt_spill")
+        assert spill_host_bytes() == 256 << 20
+        assert spill_watermark() == 0.8
+
+    @pytest.mark.parametrize("raw", ["x", "-1", "1.5"])
+    def test_host_bytes_rejects_garbage(self, monkeypatch, raw):
+        from spark_rapids_tpu.config import spill_host_bytes
+        monkeypatch.setenv("SRT_SPILL_HOST_BYTES", raw)
+        with pytest.raises(ValueError, match="SRT_SPILL_HOST_BYTES"):
+            spill_host_bytes()
+
+    def test_host_bytes_off_means_disk_only(self, monkeypatch):
+        from spark_rapids_tpu.config import spill_host_bytes
+        for raw in ("0", "off"):
+            monkeypatch.setenv("SRT_SPILL_HOST_BYTES", raw)
+            assert spill_host_bytes() == 0
+
+    @pytest.mark.parametrize("raw", ["x", "0", "-0.2", "1.5"])
+    def test_watermark_rejects_out_of_range(self, monkeypatch, raw):
+        from spark_rapids_tpu.config import spill_watermark
+        monkeypatch.setenv("SRT_SPILL_WATERMARK", raw)
+        with pytest.raises(ValueError, match="SRT_SPILL_WATERMARK"):
+            spill_watermark()
+
+    def test_knob_table_lists_spill_knobs(self):
+        from spark_rapids_tpu.config import knob_table
+        names = set(knob_table())
+        assert {"SRT_SPILL", "SRT_SPILL_DIR", "SRT_SPILL_HOST_BYTES",
+                "SRT_SPILL_WATERMARK"} <= names
+
+
+# ---------------------------------------------------------------------------
+# 2. manager paging, both tiers
+# ---------------------------------------------------------------------------
+
+class TestManagerPaging:
+    def test_host_tier_round_trip_bit_identical(self, spill_on):
+        mgr = spill_manager()
+        val = _value(1)
+        oracle = [np.asarray(x).copy() for x in _leaves(val)]
+        before = recovery_stats().snapshot()
+        freed = mgr.page_out("k", val)
+        assert freed > 0 and mgr.stats()["pages"] == 1
+        assert mgr.stats()["pages_on_disk"] == 0   # fits the host LRU
+        back = mgr.page_in("k")
+        assert all(np.array_equal(o, np.asarray(l))
+                   for o, l in zip(oracle, _leaves(back)))
+        d = recovery_stats().delta(before)
+        assert d["spill_pages_out"] == 1 and d["spill_pages_in"] == 1
+        assert d["spill_bytes_out"] == freed == d["spill_bytes_in"]
+        assert d["spill_files"] == 0
+        assert mgr.stats() == {"pages": 0, "pages_on_disk": 0,
+                               "host_bytes": 0, "victims": 0}
+
+    def test_disk_tier_round_trip_and_file_cleanup(self, spill_on,
+                                                   monkeypatch):
+        monkeypatch.setenv("SRT_SPILL_HOST_BYTES", "0")
+        mgr = spill_manager()
+        val = _value(2)
+        oracle = [np.asarray(x).copy() for x in _leaves(val)]
+        before = recovery_stats().snapshot()
+        mgr.page_out("k", val)
+        assert mgr.stats()["pages_on_disk"] == 1
+        files = os.listdir(spill_on)
+        assert len(files) == 1 and files[0].endswith(".parquet")
+        back = mgr.page_in("k")
+        assert all(np.array_equal(o, np.asarray(l))
+                   for o, l in zip(oracle, _leaves(back)))
+        assert os.listdir(spill_on) == []          # page-in removed it
+        d = recovery_stats().delta(before)
+        assert d["spill_files"] == 1
+        assert d["spill_page_in_seconds"] > 0
+
+    def test_host_lru_overflows_oldest_to_disk(self, spill_on,
+                                               monkeypatch):
+        mgr = spill_manager()
+        nbytes = mgr.page_out("a", _value(1))
+        monkeypatch.setenv("SRT_SPILL_HOST_BYTES", str(nbytes + 16))
+        mgr.page_out("b", _value(2))   # over cap -> oldest ("a") flushes
+        s = mgr.stats()
+        assert s["pages"] == 2 and s["pages_on_disk"] == 1
+        assert _np_eq(mgr.page_in("a"), _value(1))   # disk tier
+        assert _np_eq(mgr.page_in("b"), _value(2))   # host tier
+
+    def test_page_in_unknown_key_raises(self, spill_on):
+        with pytest.raises(KeyError):
+            spill_manager().page_in("nope")
+
+    def test_reclaim_runs_victims_and_pad_cache(self, spill_on):
+        mgr = spill_manager()
+        mgr.register_victim("v1", lambda: 100)
+        calls = []
+        mgr.register_victim("v2", lambda: calls.append(1) or 50)
+        assert mgr.reclaim() == 150 and calls
+        mgr.unregister_victim("v1")
+        mgr.unregister_victim("v2")
+
+    def test_broken_victim_is_dropped_not_fatal(self, spill_on):
+        mgr = spill_manager()
+        def boom():
+            raise RuntimeError("victim broke")
+        mgr.register_victim("bad", boom)
+        mgr.register_victim("good", lambda: 7)
+        assert mgr.reclaim() == 7
+        assert mgr.stats()["victims"] == 1         # "bad" dropped
+
+
+# ---------------------------------------------------------------------------
+# 3. spill-file store: caps, atomicity, orphan sweep
+# ---------------------------------------------------------------------------
+
+class TestSpillFileStore:
+    def test_cap_refusal_is_fatal_and_names_caps(self, tmp_path,
+                                                 metrics_on):
+        store = SpillFileStore(str(tmp_path), max_files=1)
+        leaves = [np.arange(10)]
+        store.write(leaves)
+        with pytest.raises(SpillCapacityError, match="1 files"):
+            store.write(leaves)
+        assert classify(SpillCapacityError("full")) == "fatal"
+        assert registry().snapshot().get("spill.cap_refusals", 0) == 1
+
+    def test_byte_cap(self, tmp_path):
+        store = SpillFileStore(str(tmp_path), max_bytes=8)
+        with pytest.raises(SpillCapacityError, match="bytes"):
+            store.write([np.arange(100)])
+
+    def test_orphan_sweep_dead_pid_only(self, tmp_path):
+        proc = subprocess.Popen(["sleep", "0"])
+        proc.wait()
+        dead = proc.pid
+        live = os.getpid()
+        (tmp_path / f"srt-spill-{dead}-1.parquet").write_bytes(b"x")
+        (tmp_path / f"srt-spill-{dead}-2.parquet.tmp").write_bytes(b"x")
+        (tmp_path / f"srt-spill-{live}-1.parquet").write_bytes(b"x")
+        (tmp_path / "unrelated.parquet").write_bytes(b"x")
+        store = SpillFileStore(str(tmp_path))
+        assert store.orphans_swept == 2
+        left = sorted(os.listdir(tmp_path))
+        assert left == sorted([f"srt-spill-{live}-1.parquet",
+                               "unrelated.parquet"])
+
+    def test_round_trip_preserves_dtype_and_shape(self, tmp_path):
+        store = SpillFileStore(str(tmp_path))
+        leaves = [np.arange(24, dtype=np.int16).reshape(2, 3, 4),
+                  np.array([1.5, np.nan], dtype=np.float64),
+                  np.array([True, False])]
+        path, disk_bytes = store.write(leaves)
+        assert disk_bytes > 0 and os.path.exists(path)
+        back = store.read(path)
+        for a, b in zip(leaves, back):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b, equal_nan=True)
+        store.remove(path)
+        assert store.stats()["files"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. the ladder's spill rung
+# ---------------------------------------------------------------------------
+
+class TestSpillRung:
+    def test_rung_saves_the_run(self, spill_on, monkeypatch):
+        # default budget = initial + 3 evict-retries; the 4 injected
+        # OOMs burn all of them and only the spill-rung retry succeeds.
+        monkeypatch.setenv("SRT_FAULT", "oom:lad:4")
+        reset_faults()
+        mgr = spill_manager()
+        mgr.register_victim("t", lambda: 512)
+        before = recovery_stats().snapshot()
+        out = oom_ladder("lad", lambda: (fault_point("lad"), "ok")[1])
+        assert out == "ok"
+        assert recovery_stats().delta(before)["retries"] == 3
+
+    def test_exhaustion_names_spill_rung(self, spill_on, monkeypatch,
+                                         tmp_path):
+        from spark_rapids_tpu.resilience import ExecutionRecoveryError
+        monkeypatch.setenv("SRT_BUNDLE_DIR", str(tmp_path / "bundles"))
+        monkeypatch.setenv("SRT_FAULT", "oom:lad2:99")
+        reset_faults()
+        spill_manager().register_victim("t", lambda: 256)
+        with pytest.raises(ExecutionRecoveryError) as ei:
+            oom_ladder("lad2", lambda: (fault_point("lad2"), None)[1])
+        steps = ei.value.summary.steps
+        assert steps[-1] == "spill[256]"
+        assert "evict-caches" in steps[0] and "retry" in steps
+        # the postmortem bundle carries the same chain, rung included
+        bdir = tmp_path / "bundles"
+        bundles = [json.loads((bdir / f).read_text())
+                   for f in os.listdir(bdir)]
+        rungs = [b["recovery"]["steps"] for b in bundles
+                 if b.get("reason") == "recovery_exhausted"]
+        assert rungs and any("spill[256]" in s for s in rungs)
+
+    def test_enabled_but_nothing_to_free_is_named(self, spill_on,
+                                                  monkeypatch):
+        from spark_rapids_tpu.resilience import ExecutionRecoveryError
+        monkeypatch.setenv("SRT_FAULT", "oom:lad3:99")
+        reset_faults()
+        with pytest.raises(ExecutionRecoveryError) as ei:
+            oom_ladder("lad3", lambda: (fault_point("lad3"), None)[1])
+        assert ei.value.summary.steps[-1] == "spill-unavailable"
+
+    def test_default_off_keeps_old_chain(self, monkeypatch):
+        from spark_rapids_tpu.resilience import ExecutionRecoveryError
+        monkeypatch.setenv("SRT_FAULT", "oom:lad4:99")
+        reset_faults()
+        spill_manager().register_victim("t", lambda: 256)
+        with pytest.raises(ExecutionRecoveryError) as ei:
+            oom_ladder("lad4", lambda: (fault_point("lad4"), None)[1])
+        assert not any("spill" in s for s in ei.value.summary.steps)
+
+
+# ---------------------------------------------------------------------------
+# 5. end-to-end: larger-than-budget group-by, bit-identical to the oracle
+# ---------------------------------------------------------------------------
+
+class TestOutOfCoreOracleParity:
+    def _force_spill(self, monkeypatch, spill_dir):
+        monkeypatch.setenv("SRT_SPILL_HOST_BYTES", "0")   # disk tier
+        monkeypatch.setenv("SRT_SERVE_HBM_BUDGET", "64")  # tiny budget
+        monkeypatch.setenv("SRT_SPILL_WATERMARK", "0.5")
+
+    def test_combine_bit_identical_with_receipts(self, spill_on,
+                                                 monkeypatch, metrics_on):
+        monkeypatch.delenv("SRT_SPILL", raising=False)
+        oracle = _combine()                         # SRT_SPILL=0 oracle
+        monkeypatch.setenv("SRT_SPILL", "1")
+        self._force_spill(monkeypatch, spill_on)
+        before = recovery_stats().snapshot()
+        spilled = _combine()
+        d = recovery_stats().delta(before)
+        assert d["spill_bytes_out"] > 0, "no pages went out"
+        assert d["spill_bytes_in"] == d["spill_bytes_out"]
+        assert d["spill_pages_in"] == d["spill_pages_out"]
+        assert d["spill_files"] > 0                 # through the disk tier
+        assert spilled.to_pydict() == oracle.to_pydict()
+        assert os.listdir(spill_on) == []           # no files leaked
+        # the receipts land in QueryMetrics' recovery.spill block
+        payload = json.loads(last_stream_metrics().to_json())
+        assert payload["schema_version"] == 11
+        spill_block = payload["recovery"]["spill"]
+        assert spill_block["bytes_out"] > 0
+        assert spill_block["bytes_in"] == spill_block["bytes_out"]
+        assert "recovery.spill:" in last_stream_metrics().render()
+
+    @pytest.mark.parametrize("fault", ["io:spill-write:1",
+                                       "io:spill-read:1"])
+    def test_faulted_spill_io_stays_bit_identical(self, spill_on,
+                                                  monkeypatch, fault):
+        monkeypatch.delenv("SRT_SPILL", raising=False)
+        oracle = _combine()
+        monkeypatch.setenv("SRT_SPILL", "1")
+        self._force_spill(monkeypatch, spill_on)
+        monkeypatch.setenv("SRT_FAULT", fault)
+        reset_faults()
+        before = recovery_stats().snapshot()
+        spilled = _combine()
+        d = recovery_stats().delta(before)
+        assert d["faults_injected"] >= 1, "fault never fired"
+        assert d["spill_bytes_out"] > 0
+        assert spilled.to_pydict() == oracle.to_pydict()
+
+    def test_spill_write_stall_fails_honestly(self, spill_on,
+                                              monkeypatch):
+        # A wedged disk must raise the named watchdog error, not hang:
+        # the stall is fatal-classified, so with_retries re-raises it
+        # straight through instead of retrying into the same wedge.
+        monkeypatch.setenv("SRT_STREAM_TIMEOUT", "0.2")
+        monkeypatch.setenv("SRT_FAULT", "stall:spill-write:1")
+        reset_faults()
+        store = SpillFileStore(str(spill_on))
+        with pytest.raises(DistStallError, match="spill-write"):
+            store.write([np.arange(10)])
+        monkeypatch.delenv("SRT_FAULT")  # else reset_faults re-arms it
+        reset_faults()                  # release the parked stall thread
+        # the store works again (roomy timeout: cold Parquet writer)
+        monkeypatch.setenv("SRT_STREAM_TIMEOUT", "30")
+        path, _ = store.write([np.arange(10)])
+        assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# 6. admission: spill instead of reject + proactive watermark
+# ---------------------------------------------------------------------------
+
+class TestAdmissionSpill:
+    def test_oversize_estimate_rejected_without_spill(self):
+        with pytest.raises(AdmissionRejected, match="SRT_SERVE_HBM_BUDGET"):
+            AdmissionController(budget=100).check(1000)
+
+    def test_oversize_estimate_admitted_with_spill(self, spill_on,
+                                                   metrics_on):
+        AdmissionController(budget=100).check(1000)   # no raise
+        snap = registry().snapshot()
+        assert snap.get("serve.admission.spill_admitted", 0) == 1
+
+    def test_acquire_triggers_proactive_reclaim(self, spill_on,
+                                                monkeypatch):
+        monkeypatch.setenv("SRT_SPILL_WATERMARK", "0.5")
+        freed = []
+        mgr = spill_manager()
+        mgr.register_victim("t", lambda: freed.append(64) or 64)
+        adm = AdmissionController(budget=100)
+        adm.acquire(1, 80)              # 80 > 0.5 * 100 -> reclaim
+        assert freed == [64]
+        adm.release(1)
+        assert adm.claimed_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# 7. satellite: donated Tables must never be cached
+# ---------------------------------------------------------------------------
+
+class TestRefusedDeleted:
+    def _donated_table(self):
+        import jax
+        from spark_rapids_tpu.utils.memory import free
+        t = _mk(64, seed=9)
+        t = plan().with_columns(w=col("v") * 2).run(t)
+        free(*[leaf for leaf in jax.tree_util.tree_leaves(t)
+               if leaf is not None])
+        assert t.is_deleted()
+        return t
+
+    def test_result_cache_refuses_deleted(self, metrics_on):
+        from spark_rapids_tpu.serve.result_cache import ResultCache
+        cache = ResultCache(1 << 20)
+        cache.put(("k",), self._donated_table())
+        assert cache.stats()["entries"] == 0
+        _, hit = cache.get(("k",))
+        assert not hit
+        snap = registry().snapshot()
+        assert snap.get("serve.cache.refused_deleted", 0) == 1
+
+    def test_result_cache_refuses_deleted_in_list(self, metrics_on):
+        from spark_rapids_tpu.serve.result_cache import ResultCache
+        cache = ResultCache(1 << 20)
+        cache.put(("k",), [_mk(8, 1), self._donated_table()])
+        assert cache.stats()["entries"] == 0
+
+    def test_semantic_cache_refuses_deleted(self, metrics_on):
+        from spark_rapids_tpu.serve.semantic import SemanticCache
+        cache = SemanticCache(1 << 20)
+        assert cache.put("fp/dig", "fp", self._donated_table()) is False
+        assert cache.peek("fp/dig") is None
+        snap = registry().snapshot()
+        assert snap.get("serve.cache.refused_deleted", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# 8. satellite: the admission ledger survives abandoned tickets
+# ---------------------------------------------------------------------------
+
+class TestTicketLedger:
+    def test_gc_of_abandoned_ticket_releases_claim(self):
+        from spark_rapids_tpu.serve.scheduler import Ticket
+        adm = AdmissionController(budget=1000)
+        t = Ticket(7, "fp", "run", 1.0)
+        adm.acquire(t.id, 400)
+        t._finalizer = weakref.finalize(t, adm.release, t.id)
+        assert adm.claimed_bytes() == 400
+        del t
+        gc.collect()
+        assert adm.claimed_bytes() == 0
+
+    def test_cancel_queued_ticket(self):
+        from spark_rapids_tpu.serve.scheduler import QuerySession
+        session = QuerySession(max_concurrent=1, register_queued=False)
+        gate = threading.Event()
+
+        def slow_batches():
+            gate.wait(30)
+            yield _mk(64, 0)
+
+        t1 = session.submit(plan().with_columns(w=col("v") + 1),
+                            batches=slow_batches())
+        t2 = session.submit(plan().with_columns(w=col("v") + 2),
+                            table=_mk(64, 1))
+        assert t2.cancel() is True
+        assert t2.status == "cancelled"
+        with pytest.raises(RuntimeError, match="cancelled"):
+            t2.result(timeout=5)
+        gate.set()
+        t1.result(timeout=120)
+        assert t1.status == "done"
+        assert t2.cancel() is False     # already resolved
+        assert t1.cancel() is False     # already done
+        assert session.admission.claimed_bytes() == 0
+        session.close()
+
+    def test_ledger_zero_after_full_run(self):
+        from spark_rapids_tpu.serve.scheduler import QuerySession
+        session = QuerySession(max_concurrent=1, register_queued=False)
+        t = session.submit(plan().with_columns(w=col("v") + 1),
+                           table=_mk(32, 2))
+        t.result(timeout=120)
+        assert session.admission.claimed_bytes() == 0
+        session.close()
+
+
+# ---------------------------------------------------------------------------
+# 9. obs: advisor rule + doctor finding + bench line
+# ---------------------------------------------------------------------------
+
+class TestSpillObservability:
+    def test_capacity_snapshot_and_rule(self, spill_on):
+        from spark_rapids_tpu.obs import capacity
+        spill_manager().page_out("k", _value(3))
+        snap = capacity.snapshot(window_s=60.0)
+        assert snap["spill"]["bytes_out"] > 0
+        recs = capacity.recommend(snap)
+        actions = {r["action"]: r for r in recs}
+        assert "spill_pressure" in actions
+        assert actions["spill_pressure"]["evidence"]["spill_bytes_out"] > 0
+        spill_manager().page_in("k")
+
+    def test_recommend_without_spill_block_is_quiet(self):
+        # derive() stays pure: unit-style snapshots carry no spill block
+        # and must not trip the rule.
+        from spark_rapids_tpu.obs import capacity
+        snap = capacity.snapshot(window_s=60.0)
+        snap.pop("spill", None)
+        assert all(r["action"] != "spill_pressure"
+                   for r in capacity.recommend(snap))
+
+    def test_doctor_flags_spill_thrash(self):
+        from spark_rapids_tpu.obs.doctor import diagnose
+        qm = {"metric": "query_metrics", "recovery": {
+            "spill": {"pages_out": 2, "pages_in": 5, "bytes_out": 4096,
+                      "bytes_in": 10240, "files": 3,
+                      "page_in_seconds": 0.5}}}
+        titles = [f["title"] for f in diagnose(qm)["findings"]]
+        assert any("thrashed the spill cache" in t for t in titles)
+
+    def test_doctor_notes_plain_out_of_core(self):
+        from spark_rapids_tpu.obs.doctor import diagnose
+        qm = {"metric": "query_metrics", "recovery": {
+            "spill": {"pages_out": 2, "pages_in": 2, "bytes_out": 4096,
+                      "bytes_in": 4096, "files": 0,
+                      "page_in_seconds": 0.1}}}
+        titles = [f["title"] for f in diagnose(qm)["findings"]]
+        assert any("ran out-of-core" in t for t in titles)
+
+    def test_bench_line_spill(self, spill_on):
+        from spark_rapids_tpu.obs import bench_line
+        spill_manager().page_out("k", _value(4))
+        spill_manager().page_in("k")
+        payload = json.loads(bench_line("spill"))
+        assert payload["metric"] == "spill"
+        assert payload["bytes_out"] > 0
+        assert payload["bytes_in"] == payload["bytes_out"]
+
+    def test_metrics_counters_mirror(self, spill_on, metrics_on):
+        spill_manager().page_out("k", _value(5))
+        spill_manager().page_in("k")
+        snap = registry().snapshot()
+        assert snap.get("recovery.spill.pages_out", 0) == 1
+        assert snap.get("recovery.spill.pages_in", 0) == 1
+        assert snap.get("recovery.spill.bytes_out", 0) > 0
+        assert snap.get("recovery.spill.page_in_seconds", 0) == 1

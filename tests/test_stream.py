@@ -355,7 +355,7 @@ class TestStreamMetrics:
         list(run_plan_stream(self.P, iter(batches), inflight=2))
         payload = json.loads(last_stream_metrics().to_json())
         assert payload["mode"] == "stream"
-        assert payload["schema_version"] == 10
+        assert payload["schema_version"] == 11
         s = payload["stream"]
         assert s["batches"] == 5
         assert s["inflight"] == 2
